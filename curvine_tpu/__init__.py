@@ -1,0 +1,17 @@
+"""curvine_tpu — a TPU-native distributed caching file system.
+
+A ground-up rebuild of the capabilities of CurvineIO/curvine (Rust) as a
+TPU-pod data-cache layer: POSIX-ish file semantics over object storage with
+a multi-tier distributed cache (HBM / MEM / SSD / HDD), asyncio+C++ runtime,
+and JAX-native ingest paths (zero-copy blocks into TPU HBM, sharded loaders,
+checkpoint broadcast over the ICI mesh).
+
+Reference parity map: see SURVEY.md §2.
+"""
+
+__version__ = "0.1.0"
+
+from curvine_tpu.common.conf import ClusterConf
+from curvine_tpu.common.errors import CurvineError, ErrorCode
+
+__all__ = ["ClusterConf", "CurvineError", "ErrorCode", "__version__"]

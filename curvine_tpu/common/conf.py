@@ -1,0 +1,133 @@
+"""Cluster configuration.
+
+Parity: curvine-common/src/conf/ (master/worker/client/fuse/job sections,
+loaded from a TOML file with programmatic overrides)."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tomllib
+from dataclasses import dataclass, field
+
+MB = 1024 * 1024
+GB = 1024 * MB
+
+
+@dataclass
+class MasterConf:
+    hostname: str = "127.0.0.1"
+    rpc_port: int = 8995
+    web_port: int = 9000
+    meta_dir: str = "data/meta"
+    # journal
+    journal_dir: str = "data/journal"
+    snapshot_interval_entries: int = 100_000
+    # heartbeats
+    worker_heartbeat_ms: int = 3_000
+    worker_lost_timeout_ms: int = 30_000
+    heartbeat_check_ms: int = 1_000
+    # block allocation
+    block_placement_policy: str = "local"   # local|random|robin|weighted|load|ici
+    min_replication: int = 1
+    # retry cache
+    retry_cache_size: int = 100_000
+    retry_cache_ttl_ms: int = 600_000
+    # ttl scanner
+    ttl_check_ms: int = 1_000
+    ttl_bucket_ms: int = 1_000
+    # audit/metrics
+    audit_log: bool = False
+    # raft (HA); empty peers → single-node journal mode
+    raft_peers: list[str] = field(default_factory=list)
+    raft_node_id: int = 1
+
+
+@dataclass
+class TierConf:
+    storage_type: str = "mem"   # hbm|mem|ssd|hdd
+    dir: str = "data/mem"
+    capacity: int = 1 * GB
+
+
+@dataclass
+class WorkerConf:
+    hostname: str = "127.0.0.1"
+    rpc_port: int = 8996
+    web_port: int = 9001
+    tiers: list[TierConf] = field(default_factory=lambda: [TierConf()])
+    heartbeat_ms: int = 3_000
+    block_report_interval_ms: int = 60_000
+    io_chunk_size: int = 512 * 1024
+    # eviction watermarks (fraction of tier capacity)
+    eviction_high_water: float = 0.95
+    eviction_low_water: float = 0.80
+    # TPU/ICI placement
+    ici_coords: list[int] = field(default_factory=list)
+    # hbm tier (bytes reserved on device for cache; 0 disables)
+    hbm_capacity: int = 0
+    task_parallelism: int = 4
+
+
+@dataclass
+class ClientConf:
+    master_addrs: list[str] = field(default_factory=lambda: ["127.0.0.1:8995"])
+    block_size: int = 64 * MB
+    replicas: int = 1
+    write_chunk_size: int = 512 * 1024
+    read_chunk_size: int = 512 * 1024
+    read_ahead_chunks: int = 4
+    short_circuit: bool = True
+    storage_type: str = "mem"
+    write_type: str = "cache"      # cache|fs
+    rpc_timeout_ms: int = 30_000
+    conn_retry_max: int = 3
+    conn_retry_base_ms: int = 100
+    conn_pool_size: int = 4
+
+
+@dataclass
+class FuseConf:
+    mount_point: str = "/tmp/curvine-fuse"
+    fs_path: str = "/"
+    attr_ttl_ms: int = 1_000
+    entry_ttl_ms: int = 1_000
+    max_write: int = 128 * 1024
+    workers: int = 2
+
+
+@dataclass
+class ClusterConf:
+    cluster_name: str = "curvine-tpu"
+    master: MasterConf = field(default_factory=MasterConf)
+    worker: WorkerConf = field(default_factory=WorkerConf)
+    client: ClientConf = field(default_factory=ClientConf)
+    fuse: FuseConf = field(default_factory=FuseConf)
+    data_dir: str = "data"
+
+    @staticmethod
+    def load(path: str | None = None) -> "ClusterConf":
+        """Load from TOML; CURVINE_CONF env var is the fallback location."""
+        path = path or os.environ.get("CURVINE_CONF", "")
+        conf = ClusterConf()
+        if path and os.path.exists(path):
+            with open(path, "rb") as f:
+                data = tomllib.load(f)
+            _apply(conf, data)
+        return conf
+
+    def master_addr(self) -> str:
+        return f"{self.master.hostname}:{self.master.rpc_port}"
+
+
+def _apply(obj, data: dict) -> None:
+    for k, v in data.items():
+        if not hasattr(obj, k):
+            continue
+        cur = getattr(obj, k)
+        if dataclasses.is_dataclass(cur) and isinstance(v, dict):
+            _apply(cur, v)
+        elif k == "tiers" and isinstance(v, list):
+            obj.tiers = [TierConf(**t) for t in v]
+        else:
+            setattr(obj, k, v)

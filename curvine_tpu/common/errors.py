@@ -1,0 +1,124 @@
+"""Error taxonomy.
+
+Mirrors the reference's FsError/ErrorKind split (curvine-common/src/error/
+fs_error.rs) with retryable classification used by the RPC retry policy
+(orpc/src/io/retry/)."""
+
+from __future__ import annotations
+
+import enum
+
+
+class ErrorCode(enum.IntEnum):
+    UNDEFINED = 0
+    IO = 1
+    FILE_NOT_FOUND = 2
+    FILE_ALREADY_EXISTS = 3
+    DIR_NOT_EMPTY = 4
+    NOT_A_DIRECTORY = 5
+    IS_A_DIRECTORY = 6
+    INVALID_PATH = 7
+    INVALID_ARGUMENT = 8
+    LEASE_CONFLICT = 9
+    BLOCK_NOT_FOUND = 10
+    WORKER_NOT_FOUND = 11
+    NO_AVAILABLE_WORKER = 12
+    CAPACITY_EXCEEDED = 13
+    QUOTA_EXCEEDED = 14
+    NOT_LEADER = 15
+    TIMEOUT = 16
+    CANCELLED = 17
+    UNSUPPORTED = 18
+    IN_PROGRESS = 19
+    ABNORMAL_DATA = 20
+    UFS_ERROR = 21
+    MOUNT_NOT_FOUND = 22
+    PERMISSION_DENIED = 23
+    EXPIRED = 24
+    JOB_NOT_FOUND = 25
+    CONNECT = 26
+    UNCOMPLETED = 27
+
+    # Errors where the operation may succeed if retried (possibly against a
+    # different master/worker).
+    @property
+    def retryable(self) -> bool:
+        return self in _RETRYABLE
+
+
+_RETRYABLE = {
+    ErrorCode.TIMEOUT,
+    ErrorCode.NOT_LEADER,
+    ErrorCode.CONNECT,
+    ErrorCode.IN_PROGRESS,
+}
+
+
+class CurvineError(Exception):
+    """Base error carrying an ErrorCode across the RPC boundary."""
+
+    code: ErrorCode = ErrorCode.UNDEFINED
+
+    def __init__(self, message: str = "", code: ErrorCode | None = None):
+        super().__init__(message)
+        if code is not None:
+            self.code = ErrorCode(code)
+
+    @property
+    def retryable(self) -> bool:
+        return self.code.retryable
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"{type(self).__name__}({self.code.name}: {self})"
+
+    @staticmethod
+    def from_wire(code: int, message: str) -> "CurvineError":
+        try:
+            ec = ErrorCode(code)
+        except ValueError:
+            ec = ErrorCode.UNDEFINED
+        cls = _CODE_TO_CLASS.get(ec, CurvineError)
+        return cls(message, code=ec)
+
+
+def _make(name: str, code: ErrorCode) -> type[CurvineError]:
+    cls = type(name, (CurvineError,), {"code": code})
+    return cls
+
+
+FileNotFound = _make("FileNotFound", ErrorCode.FILE_NOT_FOUND)
+FileAlreadyExists = _make("FileAlreadyExists", ErrorCode.FILE_ALREADY_EXISTS)
+DirNotEmpty = _make("DirNotEmpty", ErrorCode.DIR_NOT_EMPTY)
+NotADirectory = _make("NotADirectory", ErrorCode.NOT_A_DIRECTORY)
+IsADirectory = _make("IsADirectory", ErrorCode.IS_A_DIRECTORY)
+InvalidPath = _make("InvalidPath", ErrorCode.INVALID_PATH)
+InvalidArgument = _make("InvalidArgument", ErrorCode.INVALID_ARGUMENT)
+LeaseConflict = _make("LeaseConflict", ErrorCode.LEASE_CONFLICT)
+BlockNotFound = _make("BlockNotFound", ErrorCode.BLOCK_NOT_FOUND)
+WorkerNotFound = _make("WorkerNotFound", ErrorCode.WORKER_NOT_FOUND)
+NoAvailableWorker = _make("NoAvailableWorker", ErrorCode.NO_AVAILABLE_WORKER)
+CapacityExceeded = _make("CapacityExceeded", ErrorCode.CAPACITY_EXCEEDED)
+QuotaExceeded = _make("QuotaExceeded", ErrorCode.QUOTA_EXCEEDED)
+NotLeader = _make("NotLeader", ErrorCode.NOT_LEADER)
+RpcTimeout = _make("RpcTimeout", ErrorCode.TIMEOUT)
+Cancelled = _make("Cancelled", ErrorCode.CANCELLED)
+Unsupported = _make("Unsupported", ErrorCode.UNSUPPORTED)
+AbnormalData = _make("AbnormalData", ErrorCode.ABNORMAL_DATA)
+UfsError = _make("UfsError", ErrorCode.UFS_ERROR)
+MountNotFound = _make("MountNotFound", ErrorCode.MOUNT_NOT_FOUND)
+PermissionDenied = _make("PermissionDenied", ErrorCode.PERMISSION_DENIED)
+JobNotFound = _make("JobNotFound", ErrorCode.JOB_NOT_FOUND)
+ConnectError = _make("ConnectError", ErrorCode.CONNECT)
+Uncompleted = _make("Uncompleted", ErrorCode.UNCOMPLETED)
+
+_CODE_TO_CLASS: dict[ErrorCode, type[CurvineError]] = {
+    c.code: c
+    for c in [
+        FileNotFound, FileAlreadyExists, DirNotEmpty, NotADirectory,
+        IsADirectory, InvalidPath, InvalidArgument, LeaseConflict,
+        BlockNotFound, WorkerNotFound, NoAvailableWorker, CapacityExceeded,
+        QuotaExceeded, NotLeader, RpcTimeout, Cancelled, Unsupported,
+        AbnormalData, UfsError, MountNotFound, PermissionDenied, JobNotFound,
+        ConnectError, Uncompleted,
+    ]
+}

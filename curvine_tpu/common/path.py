@@ -1,0 +1,99 @@
+"""Path type with scheme/authority parsing.
+
+Parity: curvine-common/src/fs/path.rs. Paths look like
+``cv://host:port/a/b``, ``s3://bucket/key``, or bare ``/a/b``."""
+
+from __future__ import annotations
+
+from curvine_tpu.common.errors import InvalidPath
+
+SEPARATOR = "/"
+
+
+class Path:
+    __slots__ = ("scheme", "authority", "path")
+
+    def __init__(self, full: str):
+        if not full:
+            raise InvalidPath("empty path")
+        scheme, authority, path = "", "", full
+        if "://" in full:
+            scheme, rest = full.split("://", 1)
+            if not scheme:
+                raise InvalidPath(f"bad scheme in {full!r}")
+            if "/" in rest:
+                authority, p = rest.split("/", 1)
+                path = "/" + p
+            else:
+                authority, path = rest, "/"
+        if not path.startswith(SEPARATOR):
+            raise InvalidPath(f"path must be absolute: {full!r}")
+        self.scheme = scheme
+        self.authority = authority
+        self.path = _normalize(path)
+
+    @property
+    def name(self) -> str:
+        return self.path.rsplit(SEPARATOR, 1)[-1]
+
+    @property
+    def is_root(self) -> bool:
+        return self.path == SEPARATOR
+
+    def parent(self) -> "Path | None":
+        if self.is_root:
+            return None
+        parent = self.path.rsplit(SEPARATOR, 1)[0] or SEPARATOR
+        return Path(self._with_path(parent))
+
+    def join(self, *parts: str) -> "Path":
+        p = self.path.rstrip(SEPARATOR)
+        for part in parts:
+            p += SEPARATOR + part.strip(SEPARATOR)
+        return Path(self._with_path(p or SEPARATOR))
+
+    def components(self) -> list[str]:
+        if self.is_root:
+            return []
+        return self.path[1:].split(SEPARATOR)
+
+    def _with_path(self, p: str) -> str:
+        if self.scheme:
+            return f"{self.scheme}://{self.authority}{p}"
+        return p
+
+    def full_path(self) -> str:
+        return self._with_path(self.path)
+
+    def __str__(self) -> str:
+        return self.full_path()
+
+    def __repr__(self) -> str:
+        return f"Path({self.full_path()!r})"
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Path) and self.full_path() == other.full_path()
+
+    def __hash__(self) -> int:
+        return hash(self.full_path())
+
+
+def _normalize(p: str) -> str:
+    out: list[str] = []
+    for c in p.split(SEPARATOR):
+        if c in ("", "."):
+            continue
+        if c == "..":
+            if not out:
+                raise InvalidPath(f"path escapes root: {p!r}")
+            out.pop()
+        else:
+            out.append(c)
+    return SEPARATOR + SEPARATOR.join(out)
+
+
+def norm_path(p: "str | Path") -> str:
+    """Normalize a user-supplied path to its in-namespace form (no scheme)."""
+    if isinstance(p, Path):
+        return p.path
+    return Path(p).path

@@ -1,0 +1,335 @@
+"""Core state types shared by master / worker / client.
+
+Parity: curvine-common/src/state/ and curvine-common/proto/common.proto.
+All types round-trip through plain dicts (msgpack-safe) via ``to_wire`` /
+``from_wire`` so they can cross the RPC boundary without protobuf."""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+
+def now_ms() -> int:
+    return int(time.time() * 1000)
+
+
+class StorageType(enum.IntEnum):
+    """Cache tiers, fastest first.
+
+    Parity: proto/common.proto StorageTypeProto (MEM/SSD/HDD/UFS/DISK) with a
+    TPU-native tier-0 extension: HBM — block resident in device memory."""
+
+    HBM = -1  # TPU extension: tier-0, device-resident
+    MEM = 0
+    SSD = 1
+    HDD = 2
+    UFS = 3
+    DISK = 4
+
+    @property
+    def is_cache(self) -> bool:
+        return self != StorageType.UFS
+
+
+class TtlAction(enum.IntEnum):
+    NONE = 0
+    DELETE = 1
+    FREE = 2
+
+
+class WriteType(enum.IntEnum):
+    CACHE = 0       # write to cache only
+    FS = 1          # write-through to UFS
+
+
+class FileType(enum.IntEnum):
+    DIR = 0
+    FILE = 1
+    LINK = 2
+    STREAM = 3
+    AGG = 4
+    OBJECT = 5
+
+
+class StorageState(enum.IntEnum):
+    CV = 1          # only in cache
+    UFS = 2         # only in under-store
+    BOTH = 3
+
+
+class BlockState(enum.IntEnum):
+    TEMP = 0        # being written
+    COMMITTED = 1
+
+
+class WorkerState(enum.IntEnum):
+    LIVE = 0
+    LOST = 1
+    DECOMMISSIONING = 2
+    DECOMMISSIONED = 3
+
+
+class JobState(enum.IntEnum):
+    PENDING = 0
+    RUNNING = 1
+    COMPLETED = 2
+    FAILED = 3
+    CANCELLED = 4
+
+
+def _to_wire(v: Any) -> Any:
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        return {f.name: _to_wire(getattr(v, f.name)) for f in dataclasses.fields(v)}
+    if isinstance(v, enum.Enum):
+        return v.value
+    if isinstance(v, (list, tuple)):
+        return [_to_wire(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _to_wire(x) for k, x in v.items()}
+    return v
+
+
+class Wire:
+    """Mixin: dataclass ↔ msgpack-safe dict."""
+
+    def to_wire(self) -> dict:
+        return _to_wire(self)
+
+    @classmethod
+    def from_wire(cls, d: dict):
+        kwargs = {}
+        for f in dataclasses.fields(cls):  # type: ignore[arg-type]
+            if f.name not in d:
+                continue
+            v = d[f.name]
+            t = _WIRE_FIELD_TYPES.get((cls, f.name))
+            if t is not None and v is not None:
+                if isinstance(t, tuple):  # list of nested
+                    inner = t[0]
+                    if issubclass(inner, enum.Enum):
+                        v = [inner(x) for x in v]
+                    else:
+                        v = [inner.from_wire(x) for x in v]
+                elif issubclass(t, enum.Enum):
+                    v = t(v)
+                else:
+                    v = t.from_wire(v)
+            kwargs[f.name] = v
+        return cls(**kwargs)  # type: ignore[call-arg]
+
+
+# Registered (class, field) -> nested type for from_wire reconstruction.
+_WIRE_FIELD_TYPES: dict[tuple[type, str], Any] = {}
+
+
+def _register(cls: type, **fields: Any) -> None:
+    for name, t in fields.items():
+        _WIRE_FIELD_TYPES[(cls, name)] = t
+
+
+@dataclass
+class StoragePolicy(Wire):
+    """Parity: proto/common.proto StoragePolicyProto."""
+
+    storage_type: StorageType = StorageType.DISK
+    ttl_ms: int = 0
+    ttl_action: TtlAction = TtlAction.NONE
+    ufs_mtime: int = 0
+    state: StorageState = StorageState.CV
+
+
+@dataclass
+class FileStatus(Wire):
+    """Parity: proto/common.proto FileStatusProto."""
+
+    id: int = 0
+    path: str = ""
+    name: str = ""
+    is_dir: bool = False
+    mtime: int = 0
+    atime: int = 0
+    children_num: int = 0
+    is_complete: bool = False
+    len: int = 0
+    replicas: int = 1
+    block_size: int = 64 * 1024 * 1024
+    file_type: FileType = FileType.FILE
+    x_attr: dict = field(default_factory=dict)
+    storage_policy: StoragePolicy = field(default_factory=StoragePolicy)
+    owner: str = ""
+    group: str = ""
+    mode: int = 0o644
+    target: str | None = None   # symlink target
+    nlink: int = 1
+
+
+@dataclass(frozen=True)
+class WorkerAddress(Wire):
+    """Parity: common.proto WorkerAddressProto."""
+
+    worker_id: int = 0
+    hostname: str = ""
+    ip_addr: str = ""
+    rpc_port: int = 0
+    web_port: int = 0
+
+
+@dataclass
+class StorageInfo(Wire):
+    """Per-tier capacity on one worker dir."""
+
+    storage_type: StorageType = StorageType.MEM
+    dir_id: str = ""
+    capacity: int = 0
+    available: int = 0
+    block_num: int = 0
+
+
+@dataclass
+class WorkerInfo(Wire):
+    address: WorkerAddress = field(default_factory=WorkerAddress)
+    state: WorkerState = WorkerState.LIVE
+    storages: list[StorageInfo] = field(default_factory=list)
+    last_heartbeat_ms: int = 0
+    # TPU extension: position of this worker's host in the ICI mesh
+    # (x, y, z) torus coordinates; empty when not on a TPU pod.
+    ici_coords: list[int] = field(default_factory=list)
+
+    @property
+    def capacity(self) -> int:
+        return sum(s.capacity for s in self.storages)
+
+    @property
+    def available(self) -> int:
+        return sum(s.available for s in self.storages)
+
+
+@dataclass(frozen=True)
+class ExtendedBlock(Wire):
+    """Parity: common.proto ExtendedBlockProto."""
+
+    id: int = 0
+    len: int = 0
+    storage_type: StorageType = StorageType.DISK
+    file_type: FileType = FileType.FILE
+
+
+@dataclass
+class BlockLocation(Wire):
+    worker_id: int = 0
+    storage_type: StorageType = StorageType.MEM
+
+
+@dataclass
+class LocatedBlock(Wire):
+    """Parity: common.proto LocatedBlockProto — block + worker addresses."""
+
+    block: ExtendedBlock = field(default_factory=ExtendedBlock)
+    offset: int = 0
+    locs: list[WorkerAddress] = field(default_factory=list)
+    storage_types: list[StorageType] = field(default_factory=list)
+
+
+@dataclass
+class FileBlocks(Wire):
+    """Parity: common.proto FileBlocksProto."""
+
+    status: FileStatus = field(default_factory=FileStatus)
+    block_locs: list[LocatedBlock] = field(default_factory=list)
+
+
+@dataclass
+class CommitBlock(Wire):
+    """Parity: common.proto CommitBlockProto."""
+
+    block_id: int = 0
+    block_len: int = 0
+    worker_ids: list[int] = field(default_factory=list)
+    storage_type: StorageType = StorageType.MEM
+
+
+@dataclass
+class MasterInfo(Wire):
+    active_master: str = ""
+    journal_nodes: list[str] = field(default_factory=list)
+    inode_num: int = 0
+    block_num: int = 0
+    capacity: int = 0
+    available: int = 0
+    fs_used: int = 0
+    live_workers: list[WorkerInfo] = field(default_factory=list)
+    lost_workers: list[WorkerInfo] = field(default_factory=list)
+
+
+@dataclass
+class MountInfo(Wire):
+    """Parity: proto/mount.proto MountInfo — cv path ↔ ufs path binding."""
+
+    mount_id: int = 0
+    cv_path: str = ""
+    ufs_path: str = ""
+    properties: dict = field(default_factory=dict)
+    auto_cache: bool = False
+    write_type: WriteType = WriteType.CACHE
+
+
+@dataclass
+class TaskInfo(Wire):
+    task_id: str = ""
+    job_id: str = ""
+    worker_id: int = 0
+    path: str = ""
+    state: JobState = JobState.PENDING
+    message: str = ""
+    total_len: int = 0
+    loaded_len: int = 0
+
+
+@dataclass
+class JobInfo(Wire):
+    job_id: str = ""
+    kind: str = "load"
+    path: str = ""
+    state: JobState = JobState.PENDING
+    message: str = ""
+    create_ms: int = 0
+    finish_ms: int = 0
+    tasks: list[TaskInfo] = field(default_factory=list)
+
+
+@dataclass
+class SetAttrOpts(Wire):
+    """Parity: curvine-common/src/state SetAttrOpts."""
+
+    replicas: int | None = None
+    owner: str | None = None
+    group: str | None = None
+    mode: int | None = None
+    ttl_ms: int | None = None
+    ttl_action: int | None = None
+    add_x_attr: dict = field(default_factory=dict)
+    remove_x_attr: list[str] = field(default_factory=list)
+    atime: int | None = None
+    mtime: int | None = None
+
+
+_register(StoragePolicy, storage_type=StorageType, ttl_action=TtlAction,
+          state=StorageState)
+_register(FileStatus, file_type=FileType, storage_policy=StoragePolicy)
+_register(WorkerInfo, address=WorkerAddress, state=WorkerState,
+          storages=(StorageInfo,))
+_register(StorageInfo, storage_type=StorageType)
+_register(ExtendedBlock, storage_type=StorageType, file_type=FileType)
+_register(BlockLocation, storage_type=StorageType)
+_register(LocatedBlock, block=ExtendedBlock, locs=(WorkerAddress,),
+          storage_types=(StorageType,))
+_register(FileBlocks, status=FileStatus, block_locs=(LocatedBlock,))
+_register(CommitBlock, storage_type=StorageType)
+_register(MasterInfo, live_workers=(WorkerInfo,), lost_workers=(WorkerInfo,))
+_register(MountInfo, write_type=WriteType)
+_register(TaskInfo, state=JobState)
+_register(JobInfo, state=JobState, tasks=(TaskInfo,))

@@ -1,0 +1,232 @@
+"""RPC client: connection, response routing, pool, retry policy.
+
+Parity: orpc/src/client/ (ClusterConnector/conn pool) and
+orpc/src/io/retry/ (exponential backoff, retryable error classification)."""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import random
+from typing import Any, AsyncIterator
+
+from curvine_tpu.common.errors import ConnectError, CurvineError, RpcTimeout
+from curvine_tpu.rpc.frame import (
+    Flags, Message, pack, read_frame, unpack, write_frame,
+)
+
+log = logging.getLogger(__name__)
+
+_req_ids = itertools.count(1)
+
+
+class Connection:
+    """One TCP connection; multiplexes concurrent requests by req_id."""
+
+    def __init__(self, addr: str, timeout_ms: int = 30_000):
+        self.addr = addr
+        self.timeout = timeout_ms / 1000
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._waiters: dict[int, asyncio.Queue] = {}
+        self._reader_task: asyncio.Task | None = None
+        self._wlock = asyncio.Lock()
+        self.closed = False
+
+    async def connect(self) -> "Connection":
+        host, port = self.addr.rsplit(":", 1)
+        try:
+            self._reader, self._writer = await asyncio.wait_for(
+                asyncio.open_connection(host, int(port)), self.timeout)
+        except (OSError, asyncio.TimeoutError) as e:
+            raise ConnectError(f"connect {self.addr}: {e}") from e
+        self._reader_task = asyncio.ensure_future(self._read_loop())
+        return self
+
+    async def _read_loop(self) -> None:
+        assert self._reader is not None
+        try:
+            while True:
+                msg = await read_frame(self._reader)
+                q = self._waiters.get(msg.req_id)
+                if q is not None:
+                    # own the buffer: the next read reuses the frame memory
+                    msg.data = bytes(msg.data)
+                    q.put_nowait(msg)
+                else:
+                    log.debug("drop orphan frame req_id=%d", msg.req_id)
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+            pass
+        except asyncio.CancelledError:
+            pass
+        finally:
+            self.closed = True
+            err = Message(status=1, header={"error_code": 26,
+                                            "error": f"connection {self.addr} closed"},
+                          flags=Flags.RESPONSE | Flags.EOF)
+            for q in self._waiters.values():
+                q.put_nowait(err)
+
+    async def close(self) -> None:
+        self.closed = True
+        if self._reader_task:
+            self._reader_task.cancel()
+        if self._writer:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except Exception:
+                pass
+
+    async def send(self, msg: Message) -> None:
+        if self.closed or self._writer is None:
+            raise ConnectError(f"connection {self.addr} is closed")
+        async with self._wlock:
+            write_frame(self._writer, msg)
+            await self._writer.drain()
+
+    def register(self, req_id: int) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue()
+        self._waiters[req_id] = q
+        return q
+
+    def unregister(self, req_id: int) -> None:
+        self._waiters.pop(req_id, None)
+
+    async def call(self, code: int, header: dict | None = None,
+                   data: bytes | memoryview = b"",
+                   timeout: float | None = None) -> Message:
+        """Unary request → single response."""
+        req_id = next(_req_ids)
+        q = self.register(req_id)
+        try:
+            await self.send(Message(code=int(code), req_id=req_id,
+                                    header=header or {}, data=data))
+            try:
+                rep: Message = await asyncio.wait_for(q.get(), timeout or self.timeout)
+            except asyncio.TimeoutError as e:
+                raise RpcTimeout(f"rpc {code} to {self.addr} timed out") from e
+            return rep.check()
+        finally:
+            self.unregister(req_id)
+
+    async def call_stream(self, code: int, header: dict | None = None,
+                          timeout: float | None = None,
+                          ) -> AsyncIterator[Message]:
+        """Unary request → stream of chunk frames ending with EOF."""
+        req_id = next(_req_ids)
+        q = self.register(req_id)
+        try:
+            await self.send(Message(code=int(code), req_id=req_id,
+                                    header=header or {}))
+            while True:
+                try:
+                    rep: Message = await asyncio.wait_for(q.get(), timeout or self.timeout)
+                except asyncio.TimeoutError as e:
+                    raise RpcTimeout(f"stream rpc {code} to {self.addr} timed out") from e
+                rep.check()
+                yield rep
+                if rep.is_eof:
+                    return
+        finally:
+            self.unregister(req_id)
+
+    class _UploadStream:
+        """Chunked upload for one req_id; ends with EOF then awaits the ack."""
+
+        def __init__(self, conn: "Connection", code: int, req_id: int,
+                     q: asyncio.Queue, timeout: float):
+            self.conn, self.code, self.req_id, self.q = conn, code, req_id, q
+            self.timeout = timeout
+
+        async def send_chunk(self, data: bytes | memoryview,
+                             header: dict | None = None) -> None:
+            await self.conn.send(Message(code=self.code, req_id=self.req_id,
+                                         flags=Flags.CHUNK, header=header or {},
+                                         data=data))
+
+        async def finish(self, header: dict | None = None) -> Message:
+            await self.conn.send(Message(code=self.code, req_id=self.req_id,
+                                         flags=Flags.EOF, header=header or {}))
+            try:
+                rep: Message = await asyncio.wait_for(self.q.get(), self.timeout)
+            except asyncio.TimeoutError as e:
+                raise RpcTimeout(f"upload {self.code} ack timed out") from e
+            finally:
+                self.conn.unregister(self.req_id)
+            return rep.check()
+
+        async def abort(self) -> None:
+            self.conn.unregister(self.req_id)
+
+    async def open_upload(self, code: int, header: dict | None = None,
+                          timeout: float | None = None) -> "Connection._UploadStream":
+        """Start a chunked upload: request frame, then CHUNK*, EOF → ack."""
+        req_id = next(_req_ids)
+        q = self.register(req_id)
+        await self.send(Message(code=int(code), req_id=req_id, header=header or {}))
+        return Connection._UploadStream(self, int(code), req_id, q,
+                                        timeout or self.timeout)
+
+
+class ConnectionPool:
+    """Per-address connection pool with lazy dial and broken-conn eviction."""
+
+    def __init__(self, size: int = 4, timeout_ms: int = 30_000):
+        self.size = size
+        self.timeout_ms = timeout_ms
+        self._conns: dict[str, list[Connection]] = {}
+        self._rr: dict[str, int] = {}
+        self._lock = asyncio.Lock()
+
+    async def get(self, addr: str) -> Connection:
+        async with self._lock:
+            conns = self._conns.setdefault(addr, [])
+            conns[:] = [c for c in conns if not c.closed]
+            if len(conns) < self.size:
+                conn = await Connection(addr, self.timeout_ms).connect()
+                conns.append(conn)
+                return conn
+            i = self._rr[addr] = (self._rr.get(addr, -1) + 1) % len(conns)
+            return conns[i]
+
+    async def close(self) -> None:
+        async with self._lock:
+            for conns in self._conns.values():
+                for c in conns:
+                    await c.close()
+            self._conns.clear()
+
+
+class RetryPolicy:
+    """Exponential backoff with jitter on retryable errors."""
+
+    def __init__(self, max_retries: int = 3, base_ms: int = 100,
+                 max_ms: int = 5_000):
+        self.max_retries = max_retries
+        self.base_ms = base_ms
+        self.max_ms = max_ms
+
+    async def run(self, fn, *args, **kwargs) -> Any:
+        attempt = 0
+        while True:
+            try:
+                return await fn(*args, **kwargs)
+            except CurvineError as e:
+                if not e.retryable or attempt >= self.max_retries:
+                    raise
+                delay = min(self.max_ms, self.base_ms * (2 ** attempt))
+                delay = delay * (0.5 + random.random() / 2) / 1000
+                log.debug("retry %d after %.3fs: %s", attempt + 1, delay, e)
+                await asyncio.sleep(delay)
+                attempt += 1
+
+
+def obj_call(conn: Connection, code: int, obj: Any, **kw) -> Any:
+    """Convenience: msgpack-object request body in `data`."""
+    return conn.call(code, data=pack(obj), **kw)
+
+
+def unpack_data(msg: Message) -> Any:
+    return unpack(msg.data)

@@ -1,0 +1,81 @@
+"""RPC operation codes.
+
+Parity: curvine-common/src/fs/rpc_code.rs:20 (same catalogue, same grouping;
+TPU-specific codes appended at 100+)."""
+
+from __future__ import annotations
+
+import enum
+
+
+class RpcCode(enum.IntEnum):
+    UNDEFINED = 0
+    HEARTBEAT = 1
+
+    # filesystem API (master)
+    MKDIR = 2
+    DELETE = 3
+    CREATE_FILE = 4
+    OPEN_FILE = 5
+    APPEND_FILE = 6
+    FILE_STATUS = 7
+    LIST_STATUS = 8
+    EXISTS = 9
+    RENAME = 10
+    ADD_BLOCK = 11
+    COMPLETE_FILE = 12
+    GET_BLOCK_LOCATIONS = 13
+    GET_MASTER_INFO = 14
+    SET_ATTR = 15
+    SYMLINK = 16
+    LINK = 17
+    RESIZE_FILE = 18
+    ASSIGN_WORKER = 19
+    GET_LOCK = 20
+    SET_LOCK = 21
+    LIST_LOCK = 22
+    CREATE_FILES_BATCH = 23
+    ADD_BLOCKS_BATCH = 24
+    COMPLETE_FILES_BATCH = 25
+    FREE = 26
+    LIST_OPTIONS = 27
+
+    # manager interface
+    MOUNT = 30
+    UNMOUNT = 31
+    UPDATE_MOUNT = 32
+    GET_MOUNT_TABLE = 33
+    GET_MOUNT_INFO = 34
+
+    SUBMIT_JOB = 35
+    GET_JOB_STATUS = 36
+    CANCEL_JOB = 37
+    REPORT_TASK = 38
+    SUBMIT_TASK = 39
+    WORKER_HEARTBEAT = 40
+    WORKER_BLOCK_REPORT = 41
+
+    SUBMIT_BLOCK_REPLICATION_JOB = 42
+    REPORT_BLOCK_REPLICATION_RESULT = 43
+    REQUEST_REPLACEMENT_WORKER = 44
+    REPORT_UNDER_REPLICATED_BLOCKS = 45
+
+    METRICS_REPORT = 60
+
+    # block interface (worker)
+    WRITE_BLOCK = 80
+    READ_BLOCK = 81
+    WRITE_BLOCKS_BATCH = 82
+    WRITE_COMMITS_BATCH = 83
+    DELETE_BLOCK = 84
+    GET_BLOCK_INFO = 85
+
+    # raft-lite (master HA journal replication)
+    RAFT_VOTE = 90
+    RAFT_APPEND = 91
+    RAFT_SNAPSHOT = 92
+
+    # TPU extensions
+    HBM_PIN = 100        # pin a cached block into the HBM tier
+    HBM_UNPIN = 101
+    BROADCAST_MODEL = 102  # checkpoint broadcast over the pod

@@ -1,0 +1,134 @@
+"""Wire framing.
+
+Parity: orpc/src/message/rpc_message.rs — same shape as orpc's
+``[total_len][header_len][header][data]`` frame with a small fixed metadata
+block (version, code, req_id, status, flags). Control payloads are msgpack;
+block data rides in ``data`` untouched (zero-copy: encode emits the caller's
+buffer without copying; decode returns a memoryview slice)."""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Any
+
+import msgpack
+
+from curvine_tpu.common.errors import CurvineError, ErrorCode
+
+VERSION = 1
+# fixed metadata after the u32 frame length:
+#   u8 version | u16 code | u64 req_id | u8 status | u8 flags | u32 header_len
+_FIXED = struct.Struct(">BHQBBI")
+FIXED_LEN = _FIXED.size
+LEN_PREFIX = struct.Struct(">I")
+MAX_FRAME = 64 * 1024 * 1024 + 1024  # one chunk + slack
+
+STATUS_OK = 0
+STATUS_ERROR = 1
+
+
+class Flags:
+    REQUEST = 0
+    RESPONSE = 1 << 0
+    CHUNK = 1 << 1   # intermediate streaming frame
+    EOF = 1 << 2     # final streaming frame
+
+
+@dataclass
+class Message:
+    code: int = 0
+    req_id: int = 0
+    status: int = STATUS_OK
+    flags: int = Flags.REQUEST
+    header: dict = field(default_factory=dict)
+    data: bytes | bytearray | memoryview = b""
+
+    @property
+    def is_response(self) -> bool:
+        return bool(self.flags & Flags.RESPONSE)
+
+    @property
+    def is_chunk(self) -> bool:
+        return bool(self.flags & Flags.CHUNK)
+
+    @property
+    def is_eof(self) -> bool:
+        return bool(self.flags & Flags.EOF)
+
+    def check(self) -> "Message":
+        """Raise the carried remote error, if any."""
+        if self.status != STATUS_OK:
+            code = self.header.get("error_code", ErrorCode.UNDEFINED)
+            raise CurvineError.from_wire(code, self.header.get("error", ""))
+        return self
+
+    def encode(self) -> list[bytes | memoryview]:
+        """Returns buffers to write, data buffer passed through uncopied."""
+        hdr = msgpack.packb(self.header, use_bin_type=True) if self.header else b""
+        total = FIXED_LEN + len(hdr) + len(self.data)
+        prefix = LEN_PREFIX.pack(total) + _FIXED.pack(
+            VERSION, self.code, self.req_id, self.status, self.flags, len(hdr)
+        )
+        out: list[bytes | memoryview] = [prefix]
+        if hdr:
+            out.append(hdr)
+        if len(self.data):
+            out.append(self.data)
+        return out
+
+    @staticmethod
+    def decode(payload: memoryview) -> "Message":
+        """Decode one frame body (without the u32 length prefix)."""
+        version, code, req_id, status, flags, hdr_len = _FIXED.unpack_from(payload, 0)
+        if version != VERSION:
+            raise CurvineError(f"unsupported frame version {version}",
+                               code=ErrorCode.ABNORMAL_DATA)
+        off = FIXED_LEN
+        header: dict = {}
+        if hdr_len:
+            header = msgpack.unpackb(payload[off:off + hdr_len], raw=False)
+            off += hdr_len
+        data = payload[off:]
+        return Message(code=code, req_id=req_id, status=status, flags=flags,
+                       header=header, data=data)
+
+
+def response_for(req: Message, header: dict | None = None,
+                 data: bytes | memoryview = b"",
+                 flags: int = Flags.RESPONSE) -> Message:
+    return Message(code=req.code, req_id=req.req_id, status=STATUS_OK,
+                   flags=flags, header=header or {}, data=data)
+
+
+def error_for(req: Message, err: Exception) -> Message:
+    if isinstance(err, CurvineError):
+        code, msg = int(err.code), str(err)
+    else:
+        code, msg = int(ErrorCode.IO), f"{type(err).__name__}: {err}"
+    return Message(code=req.code, req_id=req.req_id, status=STATUS_ERROR,
+                   flags=Flags.RESPONSE | Flags.EOF,
+                   header={"error_code": code, "error": msg})
+
+
+def pack(obj: Any) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def unpack(buf: bytes | memoryview) -> Any:
+    return msgpack.unpackb(buf, raw=False) if len(buf) else None
+
+
+async def read_frame(reader) -> Message:
+    """Read one frame from an asyncio StreamReader."""
+    prefix = await reader.readexactly(4)
+    (total,) = LEN_PREFIX.unpack(prefix)
+    if total > MAX_FRAME or total < FIXED_LEN:
+        raise CurvineError(f"bad frame length {total}", code=ErrorCode.ABNORMAL_DATA)
+    body = await reader.readexactly(total)
+    return Message.decode(memoryview(body))
+
+
+def write_frame(writer, msg: Message) -> None:
+    """Queue a frame on an asyncio StreamWriter (caller drains)."""
+    writer.writelines(msg.encode())

@@ -1,0 +1,150 @@
+"""Asyncio RPC server with handler registry and streaming support.
+
+Parity: orpc/src/server/ + orpc/src/handler/. Handlers are registered per
+RpcCode. A handler may:
+  * return a (header, data) tuple / dict / None → single response frame;
+  * call ``conn.send`` itself for streaming responses and return None after
+    sending an EOF frame;
+  * consume an inbound stream via ``conn.open_stream(req_id)`` for chunked
+    uploads (WriteBlock)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable
+
+from curvine_tpu.common.errors import CurvineError
+from curvine_tpu.rpc.frame import (
+    Flags, Message, error_for, read_frame, response_for, write_frame,
+)
+
+log = logging.getLogger(__name__)
+
+Handler = Callable[[Message, "ServerConn"], Awaitable[object]]
+
+
+class ServerConn:
+    """One accepted connection; routes chunk frames to open streams."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self.reader = reader
+        self.writer = writer
+        self.peer = writer.get_extra_info("peername")
+        self._streams: dict[int, asyncio.Queue] = {}
+        self._wlock = asyncio.Lock()
+
+    def open_stream(self, req_id: int, maxsize: int = 256) -> asyncio.Queue:
+        # get-or-create: chunk frames may beat the handler task here.
+        q = self._streams.get(req_id)
+        if q is None:
+            q = self._streams[req_id] = asyncio.Queue(maxsize=maxsize)
+        return q
+
+    def close_stream(self, req_id: int) -> None:
+        self._streams.pop(req_id, None)
+
+    async def send(self, msg: Message) -> None:
+        async with self._wlock:
+            write_frame(self.writer, msg)
+            await self.writer.drain()
+
+    async def route_or_none(self, msg: Message) -> bool:
+        """True if msg was an inbound stream chunk (routed, not dispatched)."""
+        if not (msg.is_chunk or msg.is_eof) or msg.is_response:
+            return False
+        # Copy chunk data: the frame buffer is reused after this returns.
+        msg.data = bytes(msg.data)
+        await self.open_stream(msg.req_id).put(msg)
+        return True
+
+
+class RpcServer:
+    def __init__(self, host: str, port: int, name: str = "rpc"):
+        self.host = host
+        self.port = port
+        self.name = name
+        self._handlers: dict[int, Handler] = {}
+        self._server: asyncio.base_events.Server | None = None
+        self._conn_tasks: set[asyncio.Task] = set()
+
+    def register(self, code: int, handler: Handler) -> None:
+        self._handlers[int(code)] = handler
+
+    def handler(self, code: int):
+        def deco(fn: Handler) -> Handler:
+            self.register(code, fn)
+            return fn
+        return deco
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_conn, self.host, self.port, reuse_address=True)
+        if self.port == 0:
+            self.port = self._server.sockets[0].getsockname()[1]
+        log.info("%s server listening on %s:%d", self.name, self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        for t in list(self._conn_tasks):
+            t.cancel()
+        self._conn_tasks.clear()
+
+    @property
+    def addr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def _on_conn(self, reader: asyncio.StreamReader,
+                       writer: asyncio.StreamWriter) -> None:
+        conn = ServerConn(reader, writer)
+        pending: set[asyncio.Task] = set()
+        try:
+            while True:
+                try:
+                    msg = await read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    break
+                if await conn.route_or_none(msg):
+                    continue
+                # Dispatch concurrently so a streaming write handler can
+                # consume chunk frames read by this same loop.
+                t = asyncio.ensure_future(self._dispatch(msg, conn))
+                pending.add(t)
+                t.add_done_callback(pending.discard)
+        finally:
+            for t in pending:
+                t.cancel()
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _dispatch(self, msg: Message, conn: ServerConn) -> None:
+        handler = self._handlers.get(msg.code)
+        try:
+            if handler is None:
+                raise CurvineError(f"no handler for code {msg.code}")
+            result = await handler(msg, conn)
+            if result is None:
+                return  # handler streamed its own response
+            if isinstance(result, tuple):
+                header, data = result
+            elif isinstance(result, (bytes, bytearray, memoryview)):
+                header, data = {}, result
+            else:
+                header, data = result, b""
+            await conn.send(response_for(
+                msg, header=header, data=data, flags=Flags.RESPONSE | Flags.EOF))
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — all errors cross the wire
+            if not isinstance(e, CurvineError):
+                log.exception("%s handler error code=%s", self.name, msg.code)
+            try:
+                await conn.send(error_for(msg, e))
+            except Exception:
+                pass

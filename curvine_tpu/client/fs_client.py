@@ -1,0 +1,197 @@
+"""Metadata RPC client.
+
+Parity: curvine-client/src/rpc/ (FsClient with master failover + retry) —
+every mutation carries (client_id, call_id) for the master's retry cache."""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import socket
+import uuid
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.common.conf import ClusterConf
+from curvine_tpu.common.types import (
+    CommitBlock, FileBlocks, FileStatus, JobInfo, LocatedBlock, MasterInfo,
+    MountInfo, SetAttrOpts,
+)
+from curvine_tpu.rpc import RpcCode
+from curvine_tpu.rpc.client import Connection, ConnectionPool, RetryPolicy
+from curvine_tpu.rpc.frame import pack, unpack
+
+log = logging.getLogger(__name__)
+
+
+class FsClient:
+    def __init__(self, conf: ClusterConf | None = None):
+        self.conf = conf or ClusterConf()
+        cc = self.conf.client
+        self.masters = list(cc.master_addrs)
+        self._active = 0
+        self.pool = ConnectionPool(size=cc.conn_pool_size,
+                                   timeout_ms=cc.rpc_timeout_ms)
+        self.retry = RetryPolicy(max_retries=cc.conn_retry_max,
+                                 base_ms=cc.conn_retry_base_ms)
+        self.client_id = uuid.uuid4().hex
+        self._call_ids = itertools.count(1)
+        self.client_host = socket.gethostname()
+
+    async def close(self) -> None:
+        await self.pool.close()
+
+    async def _conn(self) -> Connection:
+        return await self.pool.get(self.masters[self._active])
+
+    async def call(self, code: RpcCode, req: dict, mutate: bool = False) -> dict:
+        if mutate:
+            req = dict(req)
+            req["client_id"] = self.client_id
+            req["call_id"] = next(self._call_ids)
+
+        async def once() -> dict:
+            try:
+                conn = await self._conn()
+                rep = await conn.call(code, data=pack(req))
+                return unpack(rep.data) or {}
+            except err.CurvineError as e:
+                if e.code in (err.ErrorCode.NOT_LEADER, err.ErrorCode.CONNECT):
+                    self._active = (self._active + 1) % len(self.masters)
+                raise
+
+        return await self.retry.run(once)
+
+    # ---------------- namespace API ----------------
+
+    async def mkdir(self, path: str, create_parent: bool = True,
+                    **kw) -> FileStatus:
+        rep = await self.call(RpcCode.MKDIR,
+                              {"path": path, "create_parent": create_parent,
+                               **kw}, mutate=True)
+        return FileStatus.from_wire(rep["status"])
+
+    async def create_file(self, path: str, overwrite: bool = False,
+                          **kw) -> FileStatus:
+        req = {"path": path, "overwrite": overwrite,
+               "replicas": kw.pop("replicas", self.conf.client.replicas),
+               "block_size": kw.pop("block_size", self.conf.client.block_size),
+               "client_name": self.client_id, **kw}
+        rep = await self.call(RpcCode.CREATE_FILE, req, mutate=True)
+        return FileStatus.from_wire(rep["status"])
+
+    async def append_file(self, path: str) -> FileBlocks:
+        rep = await self.call(RpcCode.APPEND_FILE,
+                              {"path": path, "client_name": self.client_id},
+                              mutate=True)
+        return FileBlocks.from_wire(rep["file_blocks"])
+
+    async def exists(self, path: str) -> bool:
+        return (await self.call(RpcCode.EXISTS, {"path": path}))["exists"]
+
+    async def file_status(self, path: str) -> FileStatus:
+        rep = await self.call(RpcCode.FILE_STATUS, {"path": path})
+        return FileStatus.from_wire(rep["status"])
+
+    async def list_status(self, path: str) -> list[FileStatus]:
+        rep = await self.call(RpcCode.LIST_STATUS, {"path": path})
+        return [FileStatus.from_wire(s) for s in rep["statuses"]]
+
+    async def delete(self, path: str, recursive: bool = False) -> None:
+        await self.call(RpcCode.DELETE,
+                        {"path": path, "recursive": recursive}, mutate=True)
+
+    async def rename(self, src: str, dst: str) -> bool:
+        rep = await self.call(RpcCode.RENAME, {"src": src, "dst": dst},
+                              mutate=True)
+        return rep["result"]
+
+    async def set_attr(self, path: str, opts: SetAttrOpts) -> None:
+        await self.call(RpcCode.SET_ATTR,
+                        {"path": path, "opts": opts.to_wire()}, mutate=True)
+
+    async def symlink(self, target: str, link: str) -> FileStatus:
+        rep = await self.call(RpcCode.SYMLINK,
+                              {"target": target, "link": link}, mutate=True)
+        return FileStatus.from_wire(rep["status"])
+
+    async def link(self, src: str, dst: str) -> FileStatus:
+        rep = await self.call(RpcCode.LINK, {"src": src, "dst": dst},
+                              mutate=True)
+        return FileStatus.from_wire(rep["status"])
+
+    async def resize_file(self, path: str, new_len: int) -> None:
+        await self.call(RpcCode.RESIZE_FILE,
+                        {"path": path, "len": new_len}, mutate=True)
+
+    async def free(self, path: str, recursive: bool = False) -> int:
+        rep = await self.call(RpcCode.FREE,
+                              {"path": path, "recursive": recursive},
+                              mutate=True)
+        return rep.get("freed", 0)
+
+    # ---------------- block API ----------------
+
+    async def add_block(self, path: str,
+                        commit_blocks: list[CommitBlock] | None = None,
+                        exclude_workers: list[int] | None = None,
+                        ici_coords: list[int] | None = None) -> LocatedBlock:
+        rep = await self.call(RpcCode.ADD_BLOCK, {
+            "path": path, "client_host": self.client_host,
+            "commit_blocks": [c.to_wire() for c in commit_blocks or []],
+            "exclude_workers": exclude_workers or [],
+            "ici_coords": ici_coords or []}, mutate=True)
+        return LocatedBlock.from_wire(rep["block"])
+
+    async def complete_file(self, path: str, length: int,
+                            commit_blocks: list[CommitBlock] | None = None,
+                            only_flush: bool = False) -> bool:
+        rep = await self.call(RpcCode.COMPLETE_FILE, {
+            "path": path, "len": length,
+            "commit_blocks": [c.to_wire() for c in commit_blocks or []],
+            "client_name": self.client_id, "only_flush": only_flush},
+            mutate=True)
+        return rep["result"]
+
+    async def get_block_locations(self, path: str) -> FileBlocks:
+        rep = await self.call(RpcCode.GET_BLOCK_LOCATIONS, {"path": path})
+        return FileBlocks.from_wire(rep["file_blocks"])
+
+    async def master_info(self) -> MasterInfo:
+        rep = await self.call(RpcCode.GET_MASTER_INFO, {})
+        return MasterInfo.from_wire(rep["info"])
+
+    # ---------------- mounts / jobs ----------------
+
+    async def mount(self, cv_path: str, ufs_path: str,
+                    properties: dict | None = None, auto_cache: bool = False,
+                    write_type: int = 0) -> MountInfo:
+        rep = await self.call(RpcCode.MOUNT, {
+            "cv_path": cv_path, "ufs_path": ufs_path,
+            "properties": properties or {}, "auto_cache": auto_cache,
+            "write_type": write_type}, mutate=True)
+        return MountInfo.from_wire(rep["mount"])
+
+    async def umount(self, cv_path: str) -> None:
+        await self.call(RpcCode.UNMOUNT, {"cv_path": cv_path}, mutate=True)
+
+    async def mount_table(self) -> list[MountInfo]:
+        rep = await self.call(RpcCode.GET_MOUNT_TABLE, {})
+        return [MountInfo.from_wire(m) for m in rep["mounts"]]
+
+    async def get_mount_info(self, path: str) -> MountInfo | None:
+        rep = await self.call(RpcCode.GET_MOUNT_INFO, {"path": path})
+        return MountInfo.from_wire(rep["mount"]) if rep.get("mount") else None
+
+    async def submit_load(self, path: str, recursive: bool = True,
+                          replicas: int = 1) -> str:
+        rep = await self.call(RpcCode.SUBMIT_JOB, {
+            "kind": "load", "path": path, "recursive": recursive,
+            "replicas": replicas}, mutate=True)
+        return rep["job_id"]
+
+    async def job_status(self, job_id: str) -> JobInfo:
+        rep = await self.call(RpcCode.GET_JOB_STATUS, {"job_id": job_id})
+        return JobInfo.from_wire(rep["job"])
+
+    async def cancel_job(self, job_id: str) -> None:
+        await self.call(RpcCode.CANCEL_JOB, {"job_id": job_id}, mutate=True)

@@ -1,0 +1,153 @@
+"""Streaming file reader with short-circuit local reads and read-ahead.
+
+Parity: curvine-client/src/file/ FsReader. Worker selection is local-first
+(same host) falling back to the first live location — with short-circuit:
+when the block file is on this host, bypass RPC and read (mmap) directly,
+the path the reference takes for fuse/local clients."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.common.types import FileBlocks, LocatedBlock
+from curvine_tpu.rpc import RpcCode
+from curvine_tpu.rpc.client import ConnectionPool
+from curvine_tpu.rpc.frame import pack, unpack
+
+log = logging.getLogger(__name__)
+
+
+class FsReader:
+    def __init__(self, fs_client, path: str, file_blocks: FileBlocks,
+                 pool: ConnectionPool, chunk_size: int = 512 * 1024,
+                 short_circuit: bool = True):
+        self.fs = fs_client
+        self.path = path
+        self.blocks = file_blocks
+        self.pool = pool
+        self.chunk_size = chunk_size
+        self.short_circuit = short_circuit
+        self.pos = 0
+        self.len = file_blocks.status.len
+        self._local_paths: dict[int, str | None] = {}
+
+    # ---------------- positioning ----------------
+
+    def seek(self, pos: int) -> None:
+        if pos < 0 or pos > self.len:
+            raise err.InvalidArgument(f"seek {pos} out of [0, {self.len}]")
+        self.pos = pos
+
+    def _locate(self, offset: int) -> tuple[LocatedBlock, int] | None:
+        for lb in self.blocks.block_locs:
+            if lb.offset <= offset < lb.offset + lb.block.len:
+                return lb, offset - lb.offset
+        return None
+
+    def _pick_loc(self, lb: LocatedBlock):
+        if not lb.locs:
+            raise err.BlockNotFound(
+                f"block {lb.block.id} has no live locations")
+        host = self.fs.client_host
+        for loc in lb.locs:
+            if host and host in (loc.hostname, loc.ip_addr):
+                return loc
+        return lb.locs[0]
+
+    # ---------------- short-circuit ----------------
+
+    async def _local_path(self, lb: LocatedBlock) -> str | None:
+        """Resolve the on-disk path for a co-located block (cached)."""
+        bid = lb.block.id
+        if bid in self._local_paths:
+            return self._local_paths[bid]
+        path = None
+        if self.short_circuit:
+            loc = self._pick_loc(lb)
+            if self.fs.client_host in (loc.hostname, loc.ip_addr) or \
+                    loc.ip_addr in ("127.0.0.1", "localhost"):
+                try:
+                    conn = await self.pool.get(
+                        f"{loc.ip_addr or loc.hostname}:{loc.rpc_port}")
+                    rep = await conn.call(RpcCode.GET_BLOCK_INFO,
+                                          data=pack({"block_id": bid}))
+                    p = (unpack(rep.data) or {}).get("path")
+                    if p and os.path.exists(p):
+                        path = p
+                except err.CurvineError as e:
+                    log.debug("short-circuit probe failed for %d: %s", bid, e)
+        self._local_paths[bid] = path
+        return path
+
+    # ---------------- reads ----------------
+
+    async def read(self, n: int = -1) -> bytes:
+        if n < 0:
+            n = self.len - self.pos
+        n = min(n, self.len - self.pos)
+        if n <= 0:
+            return b""
+        out = bytearray()
+        while len(out) < n:
+            got = await self._read_some(self.pos, n - len(out))
+            if not got:
+                break
+            out += got
+            self.pos += len(got)
+        return bytes(out)
+
+    async def read_all(self) -> bytes:
+        self.seek(0)
+        return await self.read(self.len)
+
+    async def pread(self, offset: int, n: int) -> bytes:
+        """Positional read without moving the cursor."""
+        out = bytearray()
+        while len(out) < n and offset + len(out) < self.len:
+            got = await self._read_some(offset + len(out), n - len(out))
+            if not got:
+                break
+            out += got
+        return bytes(out)
+
+    async def _read_some(self, offset: int, n: int) -> bytes:
+        located = self._locate(offset)
+        if located is None:
+            return b""
+        lb, block_off = located
+        n = min(n, lb.block.len - block_off)
+        local = await self._local_path(lb)
+        if local is not None:
+            return await asyncio.to_thread(_pread_file, local, block_off, n)
+        loc = self._pick_loc(lb)
+        conn = await self.pool.get(
+            f"{loc.ip_addr or loc.hostname}:{loc.rpc_port}")
+        out = bytearray()
+        async for m in conn.call_stream(RpcCode.READ_BLOCK, header={
+                "block_id": lb.block.id, "offset": block_off, "len": n,
+                "chunk_size": self.chunk_size}):
+            if len(m.data):
+                out += m.data
+        return bytes(out)
+
+    async def chunks(self, chunk_size: int | None = None):
+        """Sequential whole-file chunk stream with one-block read-ahead."""
+        chunk_size = chunk_size or self.chunk_size
+        self.seek(0)
+        while self.pos < self.len:
+            data = await self.read(chunk_size)
+            if not data:
+                break
+            yield data
+
+    async def close(self) -> None:
+        return None
+
+
+def _pread_file(path: str, offset: int, n: int) -> bytes:
+    with open(path, "rb") as f:
+        f.seek(offset)
+        return f.read(n)

@@ -1,0 +1,150 @@
+"""Unified client: cache + UFS fall-through.
+
+Parity: curvine-client/src/unified/ (UnifiedFileSystem). Reads hit the
+cache; a miss (file known to the mount but not cached / not complete)
+falls back to reading straight from the UFS, optionally warming the cache
+(auto_cache). Writes honor WriteType: CACHE (cache only) or FS
+(write-through to UFS)."""
+
+from __future__ import annotations
+
+import logging
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.common.conf import ClusterConf
+from curvine_tpu.common.types import StorageType, WriteType
+from curvine_tpu.client.fs_client import FsClient
+from curvine_tpu.client.reader import FsReader
+from curvine_tpu.client.writer import FsWriter
+from curvine_tpu.rpc.client import ConnectionPool
+
+log = logging.getLogger(__name__)
+
+_TIERS = {"hbm": StorageType.HBM, "mem": StorageType.MEM,
+          "ssd": StorageType.SSD, "hdd": StorageType.HDD}
+
+
+class CurvineClient:
+    """High-level facade: open/create/read/write + unified UFS fallback."""
+
+    def __init__(self, conf: ClusterConf | None = None):
+        self.conf = conf or ClusterConf()
+        self.meta = FsClient(self.conf)
+        self.pool = ConnectionPool(size=self.conf.client.conn_pool_size,
+                                   timeout_ms=self.conf.client.rpc_timeout_ms)
+        self._mount_cache: dict[str, object] = {}
+
+    async def close(self) -> None:
+        await self.meta.close()
+        await self.pool.close()
+
+    # ---------------- plain cache paths ----------------
+
+    async def create(self, path: str, overwrite: bool = False,
+                     replicas: int | None = None,
+                     block_size: int | None = None,
+                     storage_type: str | None = None) -> FsWriter:
+        cc = self.conf.client
+        st = _TIERS.get(storage_type or cc.storage_type, StorageType.MEM)
+        await self.meta.create_file(
+            path, overwrite=overwrite,
+            replicas=replicas if replicas is not None else cc.replicas,
+            block_size=block_size or cc.block_size)
+        return FsWriter(self.meta, path, self.pool,
+                        block_size=block_size or cc.block_size,
+                        chunk_size=cc.write_chunk_size, storage_type=st,
+                        ici_coords=list(self.conf.worker.ici_coords) or None)
+
+    async def append(self, path: str) -> FsWriter:
+        fb = await self.meta.append_file(path)
+        cc = self.conf.client
+        w = FsWriter(self.meta, path, self.pool,
+                     block_size=fb.status.block_size,
+                     chunk_size=cc.write_chunk_size,
+                     storage_type=_TIERS.get(cc.storage_type, StorageType.MEM))
+        w.pos = fb.status.len
+        return w
+
+    async def open(self, path: str) -> FsReader:
+        fb = await self.meta.get_block_locations(path)
+        cc = self.conf.client
+        return FsReader(self.meta, path, fb, self.pool,
+                        chunk_size=cc.read_chunk_size,
+                        short_circuit=cc.short_circuit)
+
+    async def write_all(self, path: str, data: bytes, **kw) -> None:
+        async with await self.create(path, overwrite=True, **kw) as w:
+            await w.write(data)
+
+    async def read_all(self, path: str) -> bytes:
+        return await self.unified_read(path)
+
+    # ---------------- unified (cache + UFS) ----------------
+
+    async def _ufs_for(self, path: str):
+        from curvine_tpu.ufs import create_ufs
+        mount = await self.meta.get_mount_info(path)
+        if mount is None:
+            raise err.MountNotFound(f"no mount covers {path}")
+        rel = path[len(mount.cv_path):] if mount.cv_path != "/" else path
+        return mount, create_ufs(mount.ufs_path, properties=mount.properties), \
+            mount.ufs_path + rel
+
+    async def unified_read(self, path: str) -> bytes:
+        """Cache first; fall back to UFS through the mount table."""
+        try:
+            st = await self.meta.file_status(path)
+            if st.is_complete and (st.len == 0 or
+                                   await self._has_cached_blocks(path, st)):
+                r = await self.open(path)
+                return await r.read_all()
+        except err.FileNotFound:
+            pass
+        mount, ufs, uri = await self._ufs_for(path)
+        data = await ufs.read_all(uri)
+        if mount.auto_cache:
+            try:
+                await self.write_all(path, data)
+            except err.CurvineError as e:
+                log.debug("auto-cache of %s failed: %s", path, e)
+        return data
+
+    async def _has_cached_blocks(self, path: str, st) -> bool:
+        fb = await self.meta.get_block_locations(path)
+        covered = sum(lb.block.len for lb in fb.block_locs if lb.locs)
+        return covered >= st.len
+
+    async def unified_open(self, path: str) -> FsReader:
+        """Open preferring cache; UFS data is materialized through a local
+        buffer reader when not cached."""
+        st = await self.meta.file_status(path)
+        if await self._has_cached_blocks(path, st):
+            return await self.open(path)
+        raise err.Uncompleted(f"{path} not fully cached; use unified_read")
+
+    async def load_from_ufs(self, path: str, replicas: int | None = None) -> int:
+        """Warm one file: UFS → cache (the worker-side of load tasks)."""
+        mount, ufs, uri = await self._ufs_for(path)
+        st = await ufs.stat(uri)
+        if st is None:
+            raise err.FileNotFound(uri)
+        w = await self.create(path, overwrite=True, replicas=replicas)
+        total = 0
+        try:
+            async for chunk in ufs.read(uri):
+                await w.write(chunk)
+                total += len(chunk)
+            await w.close()
+        except Exception:
+            await w.abort()
+            raise
+        return total
+
+    async def write_through(self, path: str, data: bytes) -> None:
+        """WriteType.FS: persist to UFS and cache."""
+        mount, ufs, uri = await self._ufs_for(path)
+        await ufs.write_all(uri, data)
+        try:
+            await self.write_all(path, data)
+        except err.CurvineError as e:
+            log.debug("cache copy of %s failed: %s", path, e)

@@ -1,0 +1,133 @@
+"""Streaming file writer.
+
+Parity: curvine-client/src/file/ FsWriter — allocates blocks from the
+master, streams chunks to the chosen worker (pipelined against the next
+buffer fill), commits on block roll and file complete. Data is replicated
+by writing to every worker in the located block (reference writes a
+pipeline; with cache-tier replication ≤3 fan-out is equivalent)."""
+
+from __future__ import annotations
+
+import logging
+import zlib
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.common.types import CommitBlock, LocatedBlock, StorageType
+from curvine_tpu.rpc import RpcCode
+from curvine_tpu.rpc.client import Connection, ConnectionPool
+
+log = logging.getLogger(__name__)
+
+
+class FsWriter:
+    def __init__(self, fs_client, path: str, pool: ConnectionPool,
+                 block_size: int, chunk_size: int = 512 * 1024,
+                 storage_type: StorageType = StorageType.MEM,
+                 ici_coords: list[int] | None = None):
+        self.fs = fs_client
+        self.path = path
+        self.pool = pool
+        self.block_size = block_size
+        self.chunk_size = chunk_size
+        self.storage_type = storage_type
+        self.ici_coords = ici_coords
+        self.pos = 0
+        self._buf = bytearray()
+        self._block: LocatedBlock | None = None
+        self._uploads: list = []           # one per replica location
+        self._block_written = 0
+        self._block_crc = 0
+        self._commits: list[CommitBlock] = []
+        self._closed = False
+
+    async def write(self, data: bytes | memoryview) -> int:
+        if self._closed:
+            raise err.InvalidArgument("writer is closed")
+        view = memoryview(data)
+        total = len(view)
+        while len(view):
+            if self._block is None:
+                await self._next_block()
+            room = self.block_size - self._block_written - len(self._buf)
+            take = min(room, len(view), self.chunk_size * 8)
+            self._buf += view[:take]
+            view = view[take:]
+            while len(self._buf) >= self.chunk_size:
+                await self._flush_chunk(self.chunk_size)
+            if self._block_written + len(self._buf) >= self.block_size:
+                await self._seal_block()
+        self.pos += total
+        return total
+
+    async def _next_block(self) -> None:
+        self._block = await self.fs.add_block(
+            self.path, commit_blocks=self._take_commits(),
+            ici_coords=self.ici_coords)
+        if not self._block.locs:
+            raise err.NoAvailableWorker(f"no locations for {self.path}")
+        self._uploads = []
+        for loc in self._block.locs:
+            conn = await self.pool.get(
+                f"{loc.ip_addr or loc.hostname}:{loc.rpc_port}")
+            up = await conn.open_upload(RpcCode.WRITE_BLOCK, header={
+                "block_id": self._block.block.id,
+                "storage_type": int(self.storage_type),
+                "len_hint": self.block_size})
+            self._uploads.append(up)
+        self._block_written = 0
+        self._block_crc = 0
+
+    async def _flush_chunk(self, n: int | None = None) -> None:
+        n = len(self._buf) if n is None else min(n, len(self._buf))
+        if n == 0:
+            return
+        chunk = bytes(self._buf[:n])
+        del self._buf[:n]
+        self._block_crc = zlib.crc32(chunk, self._block_crc)
+        for up in self._uploads:
+            await up.send_chunk(chunk)
+        self._block_written += n
+
+    async def _seal_block(self) -> None:
+        if self._block is None:
+            return
+        await self._flush_chunk(None)
+        worker_ids = []
+        for up, loc in zip(self._uploads, self._block.locs):
+            ack = await up.finish(header={"crc32": self._block_crc})
+            worker_ids.append(ack.header.get("worker_id", loc.worker_id))
+        self._commits.append(CommitBlock(
+            block_id=self._block.block.id, block_len=self._block_written,
+            worker_ids=worker_ids, storage_type=self.storage_type))
+        self._block = None
+        self._uploads = []
+
+    def _take_commits(self) -> list[CommitBlock]:
+        out, self._commits = self._commits, []
+        return out
+
+    async def flush(self) -> None:
+        """Push buffered data to workers (block stays open)."""
+        await self._flush_chunk(None)
+
+    async def close(self) -> None:
+        if self._closed:
+            return
+        await self._seal_block()
+        await self.fs.complete_file(self.path, self.pos,
+                                    commit_blocks=self._take_commits())
+        self._closed = True
+
+    async def abort(self) -> None:
+        for up in self._uploads:
+            await up.abort()
+        self._closed = True
+
+    async def __aenter__(self) -> "FsWriter":
+        return self
+
+    async def __aexit__(self, et, ev, tb) -> None:
+        if et is None:
+            await self.close()
+        else:
+            await self.abort()

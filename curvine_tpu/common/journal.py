@@ -1,0 +1,146 @@
+"""Write-ahead journal with snapshots.
+
+Parity: curvine-server/src/master/journal/ (journal_writer, journal_loader,
+journal_system) and curvine-common/src/raft/storage/file/log_segment.rs.
+
+Entry frame on disk: ``[u32 len][u32 crc32][payload]`` where payload is
+msgpack ``[seq, op, args]``. Snapshots are msgpack blobs named
+``snapshot-<last_applied_seq>``; on recovery the newest valid snapshot is
+loaded and later segments are replayed. Torn tails are truncated."""
+
+from __future__ import annotations
+
+import logging
+import os
+import struct
+import zlib
+
+import msgpack
+
+log = logging.getLogger(__name__)
+
+_ENTRY = struct.Struct(">II")
+SEGMENT_MAX = 64 * 1024 * 1024
+
+
+class Journal:
+    def __init__(self, journal_dir: str, fsync: bool = False):
+        self.dir = journal_dir
+        self.fsync = fsync
+        os.makedirs(self.dir, exist_ok=True)
+        self.seq = 0                       # last written seq
+        self._fh = None
+        self._fh_size = 0
+
+    # ---------- write ----------
+    def append(self, op: str, args: dict) -> int:
+        self.seq += 1
+        payload = msgpack.packb([self.seq, op, args], use_bin_type=True)
+        frame = _ENTRY.pack(len(payload), zlib.crc32(payload)) + payload
+        fh = self._writer()
+        fh.write(frame)
+        fh.flush()
+        if self.fsync:
+            os.fsync(fh.fileno())
+        self._fh_size += len(frame)
+        if self._fh_size >= SEGMENT_MAX:
+            self._roll()
+        return self.seq
+
+    def _writer(self):
+        if self._fh is None:
+            path = os.path.join(self.dir, f"edits-{self.seq + 1:020d}.log")
+            self._fh = open(path, "ab")
+            self._fh_size = self._fh.tell()
+        return self._fh
+
+    def _roll(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+            self._fh_size = 0
+
+    # ---------- snapshot ----------
+    def write_snapshot(self, state: dict) -> str:
+        path = os.path.join(self.dir, f"snapshot-{self.seq:020d}")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(msgpack.packb(state, use_bin_type=True))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        self._gc(before_seq=self.seq)
+        return path
+
+    def _gc(self, before_seq: int) -> None:
+        """Drop segments fully covered by the snapshot, and older snapshots."""
+        snaps = sorted(self._list("snapshot-"))
+        for s, p in snaps[:-1]:
+            os.unlink(p)
+        for start_seq, p in self._list("edits-"):
+            # a segment is removable if the NEXT segment also starts <= covered
+            nexts = [s for s, _ in self._list("edits-") if s > start_seq]
+            end = min(nexts) - 1 if nexts else self.seq
+            if end <= before_seq and start_seq <= before_seq and nexts:
+                os.unlink(p)
+
+    def _list(self, prefix: str) -> list[tuple[int, str]]:
+        out = []
+        for name in os.listdir(self.dir):
+            if name.startswith(prefix) and not name.endswith(".tmp"):
+                try:
+                    out.append((int(name[len(prefix):].removesuffix(".log")),
+                                os.path.join(self.dir, name)))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    # ---------- recover ----------
+    def recover(self):
+        """Returns (snapshot_state | None, entries iterator past snapshot).
+
+        Also positions the journal to append after the last good entry."""
+        snaps = self._list("snapshot-")
+        snap_state, snap_seq = None, 0
+        if snaps:
+            snap_seq, path = snaps[-1]
+            with open(path, "rb") as f:
+                snap_state = msgpack.unpackb(f.read(), raw=False,
+                                             strict_map_key=False)
+        entries = []
+        last_seq = snap_seq
+        for _, path in self._list("edits-"):
+            last_seq = self._read_segment(path, snap_seq, entries, last_seq)
+        self.seq = last_seq
+        return snap_state, entries
+
+    def _read_segment(self, path: str, snap_seq: int, out: list,
+                      last_seq: int) -> int:
+        with open(path, "rb") as f:
+            data = f.read()
+        off = 0
+        while off + _ENTRY.size <= len(data):
+            length, crc = _ENTRY.unpack_from(data, off)
+            start = off + _ENTRY.size
+            end = start + length
+            if end > len(data):
+                log.warning("journal %s: torn tail at %d, truncating", path, off)
+                with open(path, "ab") as f:
+                    f.truncate(off)
+                break
+            payload = data[start:end]
+            if zlib.crc32(payload) != crc:
+                log.warning("journal %s: bad crc at %d, truncating", path, off)
+                with open(path, "ab") as f:
+                    f.truncate(off)
+                break
+            seq, op, args = msgpack.unpackb(payload, raw=False,
+                                            strict_map_key=False)
+            if seq > snap_seq:
+                out.append((seq, op, args))
+            last_seq = max(last_seq, seq)
+            off = end
+        return last_seq
+
+    def close(self) -> None:
+        self._roll()

@@ -1,0 +1,3 @@
+from curvine_tpu.master.server import MasterServer
+
+__all__ = ["MasterServer"]

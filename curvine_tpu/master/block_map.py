@@ -1,0 +1,98 @@
+"""Block → location map.
+
+Parity: curvine-server/src/master/fs/state/block_map.rs. Tracks committed
+block replicas per worker; reconciled by worker block reports; feeds the
+replication manager's under-replicated scan."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from curvine_tpu.common.types import BlockLocation, StorageType
+
+
+@dataclass
+class BlockMeta:
+    block_id: int
+    len: int = 0
+    inode_id: int = 0
+    replicas: int = 1              # desired
+    locs: dict = field(default_factory=dict)   # worker_id -> BlockLocation
+
+
+class BlockMap:
+    def __init__(self) -> None:
+        self.blocks: dict[int, BlockMeta] = {}
+        # worker_id -> set of block ids (for loss handling)
+        self.worker_blocks: dict[int, set[int]] = {}
+
+    def get(self, block_id: int) -> BlockMeta | None:
+        return self.blocks.get(block_id)
+
+    def commit(self, block_id: int, length: int, worker_id: int,
+               storage_type: StorageType, inode_id: int = 0,
+               replicas: int = 1) -> BlockMeta:
+        meta = self.blocks.get(block_id)
+        if meta is None:
+            meta = BlockMeta(block_id=block_id, len=length, inode_id=inode_id,
+                             replicas=replicas)
+            self.blocks[block_id] = meta
+        meta.len = max(meta.len, length)
+        if inode_id:
+            meta.inode_id = inode_id
+        meta.locs[worker_id] = BlockLocation(worker_id=worker_id,
+                                             storage_type=storage_type)
+        self.worker_blocks.setdefault(worker_id, set()).add(block_id)
+        return meta
+
+    def remove_block(self, block_id: int) -> BlockMeta | None:
+        meta = self.blocks.pop(block_id, None)
+        if meta:
+            for wid in meta.locs:
+                self.worker_blocks.get(wid, set()).discard(block_id)
+        return meta
+
+    def remove_replica(self, block_id: int, worker_id: int) -> None:
+        meta = self.blocks.get(block_id)
+        if meta:
+            meta.locs.pop(worker_id, None)
+        self.worker_blocks.get(worker_id, set()).discard(block_id)
+
+    def worker_lost(self, worker_id: int) -> list[int]:
+        """Drop all replicas on a lost worker; returns affected block ids."""
+        affected = list(self.worker_blocks.pop(worker_id, set()))
+        for bid in affected:
+            meta = self.blocks.get(bid)
+            if meta:
+                meta.locs.pop(worker_id, None)
+        return affected
+
+    def under_replicated(self) -> list[BlockMeta]:
+        return [m for m in self.blocks.values() if 0 < len(m.locs) < m.replicas]
+
+    def apply_report(self, worker_id: int, held: dict[int, int],
+                     storage_types: dict[int, int],
+                     incremental: bool = False) -> list[int]:
+        """Block report from a worker: {block_id: len}. Returns block ids
+        the worker holds that the master doesn't know (orphans to GC).
+        Full reports also retire replicas the worker no longer holds."""
+        known = self.worker_blocks.setdefault(worker_id, set())
+        orphans = []
+        for bid, length in held.items():
+            meta = self.blocks.get(bid)
+            if meta is None:
+                orphans.append(bid)
+                continue
+            st = StorageType(storage_types.get(bid, int(StorageType.MEM)))
+            meta.locs[worker_id] = BlockLocation(worker_id=worker_id,
+                                                 storage_type=st)
+            meta.len = max(meta.len, length)
+            known.add(bid)
+        if not incremental:
+            # replicas the master thinks this worker has but it doesn't
+            for bid in list(known - set(held)):
+                self.remove_replica(bid, worker_id)
+        return orphans
+
+    def count(self) -> int:
+        return len(self.blocks)

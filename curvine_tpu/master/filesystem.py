@@ -1,0 +1,574 @@
+"""MasterFilesystem: the namespace + block management core.
+
+Parity: curvine-server/src/master/fs/master_filesystem.rs (+ fs/context.rs,
+master/meta/fs_dir.rs). All mutations flow through journaled apply-ops so a
+restart (or a raft follower) reaches the same state by replay."""
+
+from __future__ import annotations
+
+import logging
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.common.journal import Journal
+from curvine_tpu.common.types import (
+    CommitBlock, ExtendedBlock, FileBlocks, FileStatus, FileType, LocatedBlock,
+    MasterInfo, SetAttrOpts, StoragePolicy, StorageState, StorageType,
+    TtlAction, WorkerInfo, now_ms,
+)
+from curvine_tpu.master.block_map import BlockMap
+from curvine_tpu.master.inode import Inode, InodeTree
+from curvine_tpu.master.placement import PlacementPolicy, create_policy
+from curvine_tpu.master.worker_map import WorkerMap
+
+log = logging.getLogger(__name__)
+
+
+class MasterFilesystem:
+    def __init__(self, journal: Journal | None = None,
+                 placement: str | PlacementPolicy = "local",
+                 lost_timeout_ms: int = 30_000,
+                 snapshot_interval: int = 100_000):
+        self.tree = InodeTree()
+        self.blocks = BlockMap()
+        self.workers = WorkerMap(lost_timeout_ms=lost_timeout_ms)
+        self.journal = journal
+        self.snapshot_interval = snapshot_interval
+        self._entries_since_snapshot = 0
+        if isinstance(placement, str):
+            placement = create_policy(placement)
+        self.policy = placement
+        # worker_id -> block ids scheduled for deletion (drained by heartbeat)
+        self.pending_deletes: dict[int, set[int]] = {}
+        self.mounts = None          # set by MountManager
+        self.on_worker_lost = None  # hook: ReplicationManager
+        self.start_ms = now_ms()
+
+    # ==================== journal plumbing ====================
+
+    def recover(self) -> None:
+        if self.journal is None:
+            return
+        snap, entries = self.journal.recover()
+        if snap is not None:
+            self._load_snapshot(snap)
+        for _seq, op, args in entries:
+            try:
+                self._apply(op, args)
+            except err.CurvineError as e:
+                log.warning("journal replay: %s(%s) -> %s", op, args, e)
+        if snap is not None or entries:
+            log.info("recovered namespace: %d inodes, %d blocks, seq=%d",
+                     self.tree.count(), self.blocks.count(), self.journal.seq)
+
+    def _log(self, op: str, args: dict):
+        result = self._apply(op, args)
+        if self.journal is not None:
+            self.journal.append(op, args)
+            self._entries_since_snapshot += 1
+            if self._entries_since_snapshot >= self.snapshot_interval:
+                self.checkpoint()
+        return result
+
+    def checkpoint(self) -> None:
+        if self.journal is None:
+            return
+        self.journal.write_snapshot(self._snapshot_state())
+        self._entries_since_snapshot = 0
+
+    def _snapshot_state(self) -> dict:
+        inodes = []
+        for node in self.tree.inodes.values():
+            inodes.append({
+                "id": node.id, "name": node.name, "ft": int(node.file_type),
+                "pid": node.parent_id, "mtime": node.mtime, "atime": node.atime,
+                "owner": node.owner, "group": node.group, "mode": node.mode,
+                "xattr": node.x_attr, "sp": node.storage_policy.to_wire(),
+                "nlink": node.nlink, "len": node.len, "bs": node.block_size,
+                "rep": node.replicas, "blocks": node.blocks,
+                "done": node.is_complete, "target": node.target,
+                "dir": node.children is not None,
+            })
+        blocks = [(m.block_id, m.len, m.inode_id, m.replicas)
+                  for m in self.blocks.blocks.values()]
+        state = {"next_id": self.tree.next_id,
+                 "next_block_id": self.tree.next_block_id,
+                 "inodes": inodes, "blocks": blocks}
+        if self.mounts is not None:
+            state["mounts"] = self.mounts.snapshot_state()
+        return state
+
+    def _load_snapshot(self, snap: dict) -> None:
+        self.tree.inodes.clear()
+        for d in snap["inodes"]:
+            node = Inode(
+                id=d["id"], name=d["name"], file_type=FileType(d["ft"]),
+                parent_id=d["pid"], mtime=d["mtime"], atime=d["atime"],
+                owner=d["owner"], group=d["group"], mode=d["mode"],
+                x_attr=d["xattr"] or {},
+                storage_policy=StoragePolicy.from_wire(d["sp"]),
+                nlink=d["nlink"], len=d["len"], block_size=d["bs"],
+                replicas=d["rep"], blocks=list(d["blocks"]),
+                is_complete=d["done"], target=d.get("target"),
+                children={} if d["dir"] else None)
+            self.tree.inodes[node.id] = node
+        # rebuild children indexes
+        for node in self.tree.inodes.values():
+            if node.parent_id and node.parent_id in self.tree.inodes:
+                parent = self.tree.inodes[node.parent_id]
+                if parent.children is not None:
+                    parent.children[node.name] = node.id
+        self.tree.next_id = snap["next_id"]
+        self.tree.next_block_id = snap["next_block_id"]
+        for bid, blen, iid, rep in snap["blocks"]:
+            meta = self.blocks.blocks.get(bid)
+            if meta is None:
+                from curvine_tpu.master.block_map import BlockMeta
+                self.blocks.blocks[bid] = BlockMeta(
+                    block_id=bid, len=blen, inode_id=iid, replicas=rep)
+        if self.mounts is not None and "mounts" in snap:
+            self.mounts.load_snapshot_state(snap["mounts"])
+
+    def _apply(self, op: str, args: dict):
+        fn = getattr(self, f"_apply_{op}", None)
+        if fn is None:
+            raise err.InvalidArgument(f"unknown journal op {op!r}")
+        return fn(**args)
+
+    # ==================== namespace ops ====================
+
+    def mkdir(self, path: str, create_parent: bool = True, mode: int = 0o755,
+              owner: str = "root", group: str = "root",
+              x_attr: dict | None = None) -> FileStatus:
+        node = self.tree.resolve(path)
+        if node is not None:
+            if node.is_dir:
+                return node.to_status(path)
+            raise err.FileAlreadyExists(f"{path} exists and is a file")
+        parent, _ = self.tree.resolve_parent(path)
+        if parent is None and not create_parent:
+            raise err.FileNotFound(f"parent of {path} not found")
+        return self._log("mkdir", dict(path=path, create_parent=create_parent,
+                                       mode=mode, owner=owner, group=group,
+                                       x_attr=x_attr or {}))
+
+    def _apply_mkdir(self, path: str, create_parent: bool, mode: int,
+                     owner: str, group: str, x_attr: dict) -> FileStatus:
+        node, _ = self.tree.mkdirs(path, mode=mode, owner=owner, group=group,
+                                   create_parent=create_parent, x_attr=x_attr)
+        return node.to_status(path)
+
+    def create_file(self, path: str, overwrite: bool = False,
+                    create_parent: bool = True, replicas: int = 1,
+                    block_size: int = 64 * 1024 * 1024, mode: int = 0o644,
+                    owner: str = "root", group: str = "root",
+                    client_name: str = "", x_attr: dict | None = None,
+                    storage_policy: dict | None = None,
+                    file_type: int = int(FileType.FILE)) -> FileStatus:
+        existing = self.tree.resolve(path)
+        if existing is not None:
+            if existing.is_dir:
+                raise err.IsADirectory(path)
+            if not overwrite:
+                raise err.FileAlreadyExists(path)
+        parent, _name = self.tree.resolve_parent(path)
+        if parent is None and not create_parent:
+            raise err.FileNotFound(f"parent of {path} not found")
+        return self._log("create", dict(
+            path=path, overwrite=overwrite, create_parent=create_parent,
+            replicas=replicas, block_size=block_size, mode=mode, owner=owner,
+            group=group, client_name=client_name, x_attr=x_attr or {},
+            storage_policy=storage_policy or StoragePolicy().to_wire(),
+            file_type=file_type))
+
+    def _apply_create(self, path: str, overwrite: bool, create_parent: bool,
+                      replicas: int, block_size: int, mode: int, owner: str,
+                      group: str, client_name: str, x_attr: dict,
+                      storage_policy: dict, file_type: int) -> FileStatus:
+        existing = self.tree.resolve(path)
+        if existing is not None:
+            self._delete_inode(existing, recursive=False)
+        parent, name = self.tree.resolve_parent(path)
+        if parent is None:
+            parent, _ = self.tree.mkdirs("/".join(path.split("/")[:-1]) or "/")
+        if not parent.is_dir:
+            raise err.NotADirectory(self.tree.path_of(parent))
+        node = Inode(id=self.tree._alloc_id(), name=name,
+                     file_type=FileType(file_type), parent_id=parent.id,
+                     mtime=now_ms(), atime=now_ms(), owner=owner, group=group,
+                     mode=mode, x_attr=dict(x_attr),
+                     storage_policy=StoragePolicy.from_wire(storage_policy),
+                     replicas=replicas, block_size=block_size,
+                     is_complete=False, client_name=client_name)
+        self.tree.add_child(parent, node)
+        return node.to_status(path)
+
+    def append_file(self, path: str, client_name: str = "") -> FileBlocks:
+        node = self._file_or_raise(path)
+        if not node.is_complete:
+            raise err.LeaseConflict(f"{path} is being written")
+        self._log("set_incomplete", dict(inode_id=node.id,
+                                         client_name=client_name))
+        return self._file_blocks(node, path)
+
+    def _apply_set_incomplete(self, inode_id: int, client_name: str) -> None:
+        node = self._inode_or_raise(inode_id)
+        node.is_complete = False
+        node.client_name = client_name
+
+    def exists(self, path: str) -> bool:
+        return self.tree.resolve(path) is not None
+
+    def file_status(self, path: str) -> FileStatus:
+        node = self.tree.resolve(path)
+        if node is None:
+            raise err.FileNotFound(path)
+        return node.to_status(path)
+
+    def list_status(self, path: str) -> list[FileStatus]:
+        node = self.tree.resolve(path)
+        if node is None:
+            raise err.FileNotFound(path)
+        if not node.is_dir:
+            return [node.to_status(path)]
+        out = []
+        base = path.rstrip("/")
+        for name in sorted(node.children or {}):
+            child = self.tree.inodes[node.children[name]]
+            out.append(child.to_status(f"{base}/{name}"))
+        return out
+
+    def rename(self, src: str, dst: str) -> bool:
+        s = self.tree.resolve(src)
+        if s is None:
+            raise err.FileNotFound(src)
+        if src == "/" or dst.startswith(src.rstrip("/") + "/"):
+            raise err.InvalidArgument(f"cannot rename {src} into itself")
+        d = self.tree.resolve(dst)
+        if d is not None:
+            if d.is_dir and d.children:
+                raise err.DirNotEmpty(dst)
+            if d.is_dir != s.is_dir:
+                raise (err.IsADirectory if d.is_dir else err.NotADirectory)(dst)
+        return self._log("rename", dict(src=src, dst=dst))
+
+    def _apply_rename(self, src: str, dst: str) -> bool:
+        s = self.tree.resolve(src)
+        if s is None:
+            raise err.FileNotFound(src)
+        d = self.tree.resolve(dst)
+        if d is not None:
+            self._delete_inode(d, recursive=False)
+        new_parent, new_name = self.tree.resolve_parent(dst)
+        if new_parent is None or not new_parent.is_dir:
+            raise err.FileNotFound(f"parent of {dst} not found")
+        old_parent = self.tree.inodes[s.parent_id]
+        assert old_parent.children is not None
+        old_parent.children.pop(s.name, None)
+        old_parent.mtime = now_ms()
+        s.name = new_name
+        s.parent_id = new_parent.id
+        assert new_parent.children is not None
+        new_parent.children[new_name] = s.id
+        new_parent.mtime = now_ms()
+        return True
+
+    def delete(self, path: str, recursive: bool = False) -> None:
+        node = self.tree.resolve(path)
+        if node is None:
+            raise err.FileNotFound(path)
+        if node.is_dir and node.children and not recursive:
+            raise err.DirNotEmpty(path)
+        if node.id == 1:
+            raise err.InvalidArgument("cannot delete root")
+        self._log("delete", dict(path=path, recursive=recursive))
+
+    def _apply_delete(self, path: str, recursive: bool) -> None:
+        node = self.tree.resolve(path)
+        if node is None:
+            raise err.FileNotFound(path)
+        self._delete_inode(node, recursive)
+
+    def _delete_inode(self, node: Inode, recursive: bool) -> None:
+        if node.is_dir and node.children:
+            if not recursive:
+                raise err.DirNotEmpty(self.tree.path_of(node))
+            for cid in list(node.children.values()):
+                self._delete_inode(self.tree.inodes[cid], recursive=True)
+        parent = self.tree.inodes.get(node.parent_id)
+        if parent is not None:
+            removed = self.tree.remove_child(parent, node.name)
+            if removed is not None and removed.nlink <= 0:
+                self._free_blocks(removed)
+
+    def _free_blocks(self, node: Inode) -> None:
+        for bid in node.blocks:
+            meta = self.blocks.remove_block(bid)
+            if meta:
+                for wid in meta.locs:
+                    self.pending_deletes.setdefault(wid, set()).add(bid)
+        node.blocks = []
+
+    def free(self, path: str, recursive: bool = False) -> int:
+        """Drop cached blocks but keep metadata (data remains in UFS)."""
+        node = self.tree.resolve(path)
+        if node is None:
+            raise err.FileNotFound(path)
+        return self._log("free", dict(path=path, recursive=recursive))
+
+    def _apply_free(self, path: str, recursive: bool) -> int:
+        node = self.tree.resolve(path)
+        if node is None:
+            raise err.FileNotFound(path)
+        return self._free_inode(node, recursive)
+
+    def _free_inode(self, node: Inode, recursive: bool) -> int:
+        n = 0
+        if node.is_dir:
+            if not recursive:
+                return 0
+            for cid in list((node.children or {}).values()):
+                n += self._free_inode(self.tree.inodes[cid], recursive)
+            return n
+        if node.blocks:
+            self._free_blocks(node)
+            node.storage_policy.state = StorageState.UFS
+            n += 1
+        return n
+
+    def set_attr(self, path: str, opts: SetAttrOpts) -> None:
+        if self.tree.resolve(path) is None:
+            raise err.FileNotFound(path)
+        self._log("set_attr", dict(path=path, opts=opts.to_wire()))
+
+    def _apply_set_attr(self, path: str, opts: dict) -> None:
+        node = self.tree.resolve(path)
+        if node is None:
+            raise err.FileNotFound(path)
+        o = SetAttrOpts.from_wire(opts)
+        if o.replicas is not None:
+            node.replicas = o.replicas
+        if o.owner is not None:
+            node.owner = o.owner
+        if o.group is not None:
+            node.group = o.group
+        if o.mode is not None:
+            node.mode = o.mode
+        if o.ttl_ms is not None:
+            node.storage_policy.ttl_ms = o.ttl_ms
+        if o.ttl_action is not None:
+            node.storage_policy.ttl_action = TtlAction(o.ttl_action)
+        if o.atime is not None:
+            node.atime = o.atime
+        if o.mtime is not None:
+            node.mtime = o.mtime
+        node.x_attr.update(o.add_x_attr)
+        for k in o.remove_x_attr:
+            node.x_attr.pop(k, None)
+
+    def symlink(self, target: str, link: str) -> FileStatus:
+        if self.tree.resolve(link) is not None:
+            raise err.FileAlreadyExists(link)
+        return self._log("symlink", dict(target=target, link=link))
+
+    def _apply_symlink(self, target: str, link: str) -> FileStatus:
+        parent, name = self.tree.resolve_parent(link)
+        if parent is None or not parent.is_dir:
+            raise err.FileNotFound(f"parent of {link} not found")
+        node = Inode(id=self.tree._alloc_id(), name=name,
+                     file_type=FileType.LINK, parent_id=parent.id,
+                     mtime=now_ms(), atime=now_ms(), target=target)
+        self.tree.add_child(parent, node)
+        return node.to_status(link)
+
+    def link(self, src: str, dst: str) -> FileStatus:
+        node = self._file_or_raise(src)
+        if self.tree.resolve(dst) is not None:
+            raise err.FileAlreadyExists(dst)
+        return self._log("link", dict(src=src, dst=dst))
+
+    def _apply_link(self, src: str, dst: str) -> FileStatus:
+        node = self._file_or_raise(src)
+        parent, name = self.tree.resolve_parent(dst)
+        if parent is None or not parent.is_dir:
+            raise err.FileNotFound(f"parent of {dst} not found")
+        assert parent.children is not None
+        parent.children[name] = node.id
+        node.nlink += 1
+        parent.mtime = now_ms()
+        return node.to_status(dst)
+
+    def resize_file(self, path: str, new_len: int) -> None:
+        node = self._file_or_raise(path)
+        if new_len > node.len:
+            raise err.InvalidArgument("resize can only shrink")
+        self._log("resize", dict(path=path, new_len=new_len))
+
+    def _apply_resize(self, path: str, new_len: int) -> None:
+        node = self._file_or_raise(path)
+        node.len = new_len
+        node.mtime = now_ms()
+        # drop whole blocks past the new length
+        keep, off = [], 0
+        for bid in node.blocks:
+            meta = self.blocks.get(bid)
+            blen = meta.len if meta else node.block_size
+            if off < new_len:
+                keep.append(bid)
+            else:
+                removed = self.blocks.remove_block(bid)
+                if removed:
+                    for wid in removed.locs:
+                        self.pending_deletes.setdefault(wid, set()).add(bid)
+            off += blen
+        node.blocks = keep
+
+    # ==================== block ops ====================
+
+    def add_block(self, path: str, client_host: str = "",
+                  exclude_workers: list[int] | None = None,
+                  commit_blocks: list[CommitBlock] | None = None,
+                  ici_coords: list[int] | None = None,
+                  storage_type: StorageType = StorageType.MEM,
+                  ) -> LocatedBlock:
+        node = self._file_or_raise(path)
+        if node.is_complete:
+            raise err.LeaseConflict(f"{path} is not open for writing")
+        self._commit(node, commit_blocks)
+        chosen = self.policy.choose(
+            self.workers.live_workers(), max(1, node.replicas),
+            client_host=client_host, exclude=set(exclude_workers or []),
+            needed=node.block_size, ici_coords=ici_coords)
+        block_id = self._log("alloc_block", dict(inode_id=node.id))
+        block = ExtendedBlock(id=block_id, len=0, storage_type=storage_type,
+                              file_type=node.file_type)
+        off = sum((self.blocks.get(b).len if self.blocks.get(b) else 0)
+                  for b in node.blocks[:-1])
+        return LocatedBlock(block=block, offset=off,
+                            locs=[w.address for w in chosen],
+                            storage_types=[storage_type] * len(chosen))
+
+    def _apply_alloc_block(self, inode_id: int) -> int:
+        node = self._inode_or_raise(inode_id)
+        block_id = self.tree.alloc_block_id()
+        node.blocks.append(block_id)
+        return block_id
+
+    def complete_file(self, path: str, length: int,
+                      commit_blocks: list[CommitBlock] | None = None,
+                      client_name: str = "", only_flush: bool = False) -> bool:
+        node = self._file_or_raise(path)
+        self._commit(node, commit_blocks)
+        if not only_flush:
+            self._log("complete", dict(path=path, length=length))
+        return True
+
+    def _apply_complete(self, path: str, length: int) -> None:
+        node = self._file_or_raise(path)
+        node.len = length
+        node.is_complete = True
+        node.mtime = now_ms()
+        node.client_name = ""
+
+    def _commit(self, node: Inode, commit_blocks: list[CommitBlock] | None
+                ) -> None:
+        """Journal block lens (durable), then register replica locations
+        (runtime state, rebuilt from worker reports after a restart)."""
+        if not commit_blocks:
+            return
+        self._log("commit_blocks", dict(
+            inode_id=node.id,
+            commits=[[cb.block_id, cb.block_len] for cb in commit_blocks]))
+        for cb in commit_blocks:
+            for wid in cb.worker_ids:
+                self.blocks.commit(cb.block_id, cb.block_len, wid,
+                                   cb.storage_type, inode_id=node.id,
+                                   replicas=node.replicas)
+
+    def _apply_commit_blocks(self, inode_id: int, commits: list) -> None:
+        from curvine_tpu.master.block_map import BlockMeta
+        node = self.tree.get(inode_id)
+        replicas = node.replicas if node is not None else 1
+        for bid, blen in commits:
+            meta = self.blocks.blocks.get(bid)
+            if meta is None:
+                meta = self.blocks.blocks[bid] = BlockMeta(
+                    block_id=bid, inode_id=inode_id, replicas=replicas)
+            meta.len = max(meta.len, blen)
+
+    def get_block_locations(self, path: str) -> FileBlocks:
+        node = self._file_or_raise(path)
+        return self._file_blocks(node, path)
+
+    def _file_blocks(self, node: Inode, path: str) -> FileBlocks:
+        out = []
+        off = 0
+        for bid in node.blocks:
+            meta = self.blocks.get(bid)
+            if meta is None:
+                continue
+            locs, sts = [], []
+            for wid, loc in meta.locs.items():
+                try:
+                    w = self.workers.get(wid)
+                except err.WorkerNotFound:
+                    continue
+                if w.state.value == 0:  # LIVE
+                    locs.append(w.address)
+                    sts.append(loc.storage_type)
+            out.append(LocatedBlock(
+                block=ExtendedBlock(id=bid, len=meta.len,
+                                    storage_type=sts[0] if sts else StorageType.MEM,
+                                    file_type=node.file_type),
+                offset=off, locs=locs, storage_types=sts))
+            off += meta.len
+        return FileBlocks(status=node.to_status(path), block_locs=out)
+
+    # ==================== worker plane ====================
+
+    def worker_heartbeat(self, info_wire: dict) -> dict:
+        info = WorkerInfo.from_wire(info_wire)
+        self.workers.heartbeat(info.address, info.storages, info.ici_coords)
+        wid = info.address.worker_id
+        deletes = list(self.pending_deletes.pop(wid, set()))
+        return {"delete_blocks": deletes}
+
+    def worker_block_report(self, worker_id: int, held: dict,
+                            storage_types: dict,
+                            incremental: bool = False) -> dict:
+        held = {int(k): int(v) for k, v in held.items()}
+        storage_types = {int(k): int(v) for k, v in storage_types.items()}
+        orphans = self.blocks.apply_report(worker_id, held, storage_types,
+                                           incremental=incremental)
+        return {"delete_blocks": orphans}
+
+    def check_lost_workers(self) -> list[WorkerInfo]:
+        newly_lost = self.workers.check_lost()
+        for w in newly_lost:
+            affected = self.blocks.worker_lost(w.address.worker_id)
+            if affected and self.on_worker_lost:
+                self.on_worker_lost(w, affected)
+        return newly_lost
+
+    def master_info(self, addr: str = "") -> MasterInfo:
+        cap, avail = self.workers.capacity()
+        return MasterInfo(
+            active_master=addr, inode_num=self.tree.count(),
+            block_num=self.blocks.count(), capacity=cap, available=avail,
+            fs_used=cap - avail, live_workers=self.workers.live_workers(),
+            lost_workers=self.workers.lost_workers())
+
+    # ==================== helpers ====================
+
+    def _file_or_raise(self, path: str) -> Inode:
+        node = self.tree.resolve(path)
+        if node is None:
+            raise err.FileNotFound(path)
+        if node.is_dir:
+            raise err.IsADirectory(path)
+        return node
+
+    def _inode_or_raise(self, inode_id: int) -> Inode:
+        node = self.tree.get(inode_id)
+        if node is None:
+            raise err.FileNotFound(f"inode {inode_id}")
+        return node

@@ -1,0 +1,187 @@
+"""Namespace inode tree.
+
+Parity: curvine-server/src/master/meta/inode/ (InodeDir/InodeFile/InodeView,
+fs_dir.rs path resolution, inode_id.rs allocation). The tree is in-memory
+(dict-based children index); durability comes from the journal (replayed
+mutations + snapshots), mirroring the reference's journal-backed design."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.common.types import (
+    FileStatus, FileType, StoragePolicy, StorageState, now_ms,
+)
+
+ROOT_ID = 1
+
+
+@dataclass
+class Inode:
+    id: int = 0
+    name: str = ""
+    file_type: FileType = FileType.FILE
+    parent_id: int = 0
+    mtime: int = 0
+    atime: int = 0
+    owner: str = "root"
+    group: str = "root"
+    mode: int = 0o755
+    x_attr: dict = field(default_factory=dict)
+    storage_policy: StoragePolicy = field(default_factory=StoragePolicy)
+    nlink: int = 1
+    # dir fields
+    children: dict | None = None          # name -> inode id
+    # file fields
+    len: int = 0
+    block_size: int = 64 * 1024 * 1024
+    replicas: int = 1
+    blocks: list[int] = field(default_factory=list)
+    is_complete: bool = True
+    client_name: str = ""
+    # symlink
+    target: str | None = None
+
+    @property
+    def is_dir(self) -> bool:
+        return self.file_type == FileType.DIR
+
+    def to_status(self, path: str) -> FileStatus:
+        return FileStatus(
+            id=self.id, path=path, name=self.name, is_dir=self.is_dir,
+            mtime=self.mtime, atime=self.atime,
+            children_num=len(self.children) if self.children is not None else 0,
+            is_complete=self.is_complete, len=self.len, replicas=self.replicas,
+            block_size=self.block_size, file_type=self.file_type,
+            x_attr=dict(self.x_attr), storage_policy=self.storage_policy,
+            owner=self.owner, group=self.group, mode=self.mode,
+            target=self.target, nlink=self.nlink,
+        )
+
+
+class InodeTree:
+    """id → inode map plus path resolution. Single-writer (master actor)."""
+
+    def __init__(self) -> None:
+        self.inodes: dict[int, Inode] = {}
+        self.next_id = ROOT_ID
+        self.next_block_id = 1
+        root = Inode(id=self._alloc_id(), name="", file_type=FileType.DIR,
+                     parent_id=0, children={}, mtime=now_ms(), atime=now_ms())
+        self.inodes[root.id] = root
+
+    # -- id allocation (journaled via op replay determinism) --
+    def _alloc_id(self) -> int:
+        i = self.next_id
+        self.next_id += 1
+        return i
+
+    def alloc_block_id(self) -> int:
+        b = self.next_block_id
+        self.next_block_id += 1
+        return b
+
+    @property
+    def root(self) -> Inode:
+        return self.inodes[ROOT_ID]
+
+    def get(self, inode_id: int) -> Inode | None:
+        return self.inodes.get(inode_id)
+
+    # -- path resolution --
+    def resolve(self, path: str) -> Inode | None:
+        node = self.root
+        for comp in _components(path):
+            if node.children is None:
+                return None
+            cid = node.children.get(comp)
+            if cid is None:
+                return None
+            node = self.inodes[cid]
+        return node
+
+    def resolve_parent(self, path: str) -> tuple[Inode | None, str]:
+        comps = _components(path)
+        if not comps:
+            return None, ""
+        node = self.root
+        for comp in comps[:-1]:
+            if node.children is None:
+                return None, comps[-1]
+            cid = node.children.get(comp)
+            if cid is None:
+                return None, comps[-1]
+            node = self.inodes[cid]
+        return node, comps[-1]
+
+    def path_of(self, inode: Inode) -> str:
+        parts: list[str] = []
+        node = inode
+        while node.id != ROOT_ID:
+            parts.append(node.name)
+            node = self.inodes[node.parent_id]
+        return "/" + "/".join(reversed(parts))
+
+    # -- mutations (called only via journaled ops) --
+    def add_child(self, parent: Inode, inode: Inode) -> None:
+        assert parent.children is not None
+        parent.children[inode.name] = inode.id
+        parent.mtime = inode.mtime
+        self.inodes[inode.id] = inode
+
+    def remove_child(self, parent: Inode, name: str) -> Inode | None:
+        assert parent.children is not None
+        cid = parent.children.pop(name, None)
+        if cid is None:
+            return None
+        node = self.inodes[cid]
+        node.nlink -= 1
+        if node.nlink <= 0:
+            del self.inodes[cid]
+        parent.mtime = now_ms()
+        return node
+
+    def mkdirs(self, path: str, mode: int = 0o755, owner: str = "root",
+               group: str = "root", create_parent: bool = True,
+               x_attr: dict | None = None,
+               policy: StoragePolicy | None = None) -> tuple[Inode, bool]:
+        """Returns (inode, created)."""
+        node = self.root
+        comps = _components(path)
+        if not comps:
+            return node, False
+        created = False
+        for i, comp in enumerate(comps):
+            assert node.children is not None
+            cid = node.children.get(comp)
+            if cid is not None:
+                node = self.inodes[cid]
+                if not node.is_dir:
+                    raise err.NotADirectory(f"{'/'.join(comps[:i + 1])} is a file")
+                continue
+            if i < len(comps) - 1 and not create_parent:
+                raise err.FileNotFound(f"parent /{'/'.join(comps[:i + 1])} not found")
+            child = Inode(id=self._alloc_id(), name=comp,
+                          file_type=FileType.DIR, parent_id=node.id,
+                          children={}, mtime=now_ms(), atime=now_ms(),
+                          owner=owner, group=group, mode=mode,
+                          x_attr=dict(x_attr or {}) if i == len(comps) - 1 else {},
+                          storage_policy=policy or StoragePolicy())
+            self.add_child(node, child)
+            node = child
+            created = True
+        return node, created
+
+    def count(self) -> int:
+        return len(self.inodes)
+
+    def iter_files(self):
+        for node in self.inodes.values():
+            if node.file_type != FileType.DIR:
+                yield node
+
+
+def _components(path: str) -> list[str]:
+    path = path.strip("/")
+    return path.split("/") if path else []

@@ -1,0 +1,137 @@
+"""Async job management (distributed UFS→cache load).
+
+Parity: curvine-server/src/master/job/ (job_manager, job_runner, job_store,
+job_worker_client). A load job enumerates files under a mounted UFS path,
+creates one task per file, and dispatches tasks to live workers
+(RpcCode.SUBMIT_TASK). Workers run the transfer and report progress back
+(RpcCode.REPORT_TASK → JobManager.report_task)."""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import uuid
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.common.types import JobInfo, JobState, TaskInfo, now_ms
+from curvine_tpu.rpc import RpcCode
+from curvine_tpu.rpc.client import ConnectionPool
+from curvine_tpu.rpc.frame import pack
+
+log = logging.getLogger(__name__)
+
+
+class JobManager:
+    def __init__(self, fs, mounts, dispatch_interval_s: float = 0.2):
+        self.fs = fs
+        self.mounts = mounts
+        self.jobs: dict[str, JobInfo] = {}
+        self.pool = ConnectionPool(size=1)
+        self.dispatch_interval_s = dispatch_interval_s
+        self._pending: asyncio.Queue[TaskInfo] = asyncio.Queue()
+        self._rr = itertools.count()
+
+    def submit(self, kind: str, path: str, recursive: bool = True,
+               replicas: int = 1) -> JobInfo:
+        if kind != "load":
+            raise err.Unsupported(f"job kind {kind!r}")
+        job = JobInfo(job_id=uuid.uuid4().hex[:16], kind=kind, path=path,
+                      state=JobState.PENDING, create_ms=now_ms())
+        self.jobs[job.job_id] = job
+        asyncio.ensure_future(self._plan_load(job, recursive, replicas))
+        return job
+
+    async def _plan_load(self, job: JobInfo, recursive: bool,
+                         replicas: int) -> None:
+        """Enumerate UFS files under job.path → one task per file."""
+        from curvine_tpu.ufs import create_ufs
+        try:
+            mount, ufs_uri = self.mounts.resolve(job.path)
+            ufs = create_ufs(ufs_uri, properties=mount.properties)
+            files = []
+            st = await ufs.stat(ufs_uri)
+            if st is None:
+                raise err.FileNotFound(ufs_uri)
+            if st.is_dir:
+                async for f in ufs.walk(ufs_uri, recursive=recursive):
+                    if not f.is_dir:
+                        files.append(f)
+            else:
+                files.append(st)
+            for f in files:
+                _, cv_path = self.mounts.reverse(f.path)
+                task = TaskInfo(task_id=uuid.uuid4().hex[:16],
+                                job_id=job.job_id, path=cv_path,
+                                total_len=f.len)
+                job.tasks.append(task)
+                await self._pending.put(task)
+            job.state = JobState.RUNNING
+            if not files:
+                job.state = JobState.COMPLETED
+                job.finish_ms = now_ms()
+        except Exception as e:  # noqa: BLE001 — job fails with message
+            log.warning("load job %s planning failed: %s", job.job_id, e)
+            job.state = JobState.FAILED
+            job.message = str(e)
+
+    async def run(self) -> None:
+        while True:
+            task = await self._pending.get()
+            job = self.jobs.get(task.job_id)
+            if job is None or job.state in (JobState.CANCELLED, JobState.FAILED):
+                continue
+            try:
+                await self._dispatch(task)
+            except Exception as e:  # noqa: BLE001
+                task.state = JobState.FAILED
+                task.message = str(e)
+                self._maybe_finish(job)
+
+    async def _dispatch(self, task: TaskInfo) -> None:
+        workers = self.fs.workers.live_workers()
+        if not workers:
+            raise err.NoAvailableWorker("no live workers for load task")
+        w = workers[next(self._rr) % len(workers)]
+        task.worker_id = w.address.worker_id
+        task.state = JobState.RUNNING
+        conn = await self.pool.get(
+            f"{w.address.ip_addr or w.address.hostname}:{w.address.rpc_port}")
+        await conn.call(RpcCode.SUBMIT_TASK, data=pack({"task": task.to_wire()}))
+
+    def report_task(self, task_wire: dict) -> None:
+        t = TaskInfo.from_wire(task_wire)
+        job = self.jobs.get(t.job_id)
+        if job is None:
+            raise err.JobNotFound(t.job_id)
+        for i, existing in enumerate(job.tasks):
+            if existing.task_id == t.task_id:
+                job.tasks[i] = t
+                break
+        self._maybe_finish(job)
+
+    def _maybe_finish(self, job: JobInfo) -> None:
+        if job.state not in (JobState.RUNNING, JobState.PENDING):
+            return
+        states = {t.state for t in job.tasks}
+        if states <= {JobState.COMPLETED}:
+            job.state = JobState.COMPLETED
+            job.finish_ms = now_ms()
+        elif JobState.FAILED in states and not (
+                states & {JobState.PENDING, JobState.RUNNING}):
+            job.state = JobState.FAILED
+            job.finish_ms = now_ms()
+            job.message = "; ".join(t.message for t in job.tasks
+                                    if t.state == JobState.FAILED)[:500]
+
+    def status(self, job_id: str) -> JobInfo:
+        job = self.jobs.get(job_id)
+        if job is None:
+            raise err.JobNotFound(job_id)
+        return job
+
+    def cancel(self, job_id: str) -> None:
+        job = self.status(job_id)
+        if job.state in (JobState.PENDING, JobState.RUNNING):
+            job.state = JobState.CANCELLED
+            job.finish_ms = now_ms()

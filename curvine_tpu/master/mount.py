@@ -1,0 +1,114 @@
+"""UFS mount table.
+
+Parity: curvine-server/src/master/mount/ (mount_manager.rs, mount_table.rs).
+A mount binds a cv namespace subtree to a UFS URI; path resolution maps
+``/mnt/s3/a/b`` ↔ ``s3://bucket/a/b``. Mount mutations are journaled
+through the master filesystem so they survive restart."""
+
+from __future__ import annotations
+
+import itertools
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.common.types import MountInfo, WriteType
+
+
+class MountManager:
+    def __init__(self, fs):
+        self.fs = fs
+        self._mounts: dict[str, MountInfo] = {}   # cv_path -> info
+        self._ids = itertools.count(1)
+        # register journal apply-ops on the master filesystem
+        fs._apply_mount_add = self._apply_add
+        fs._apply_mount_remove = self._apply_remove
+        fs._apply_mount_update = self._apply_update
+
+    # ---------- mutations (journaled via fs._log) ----------
+    def mount(self, cv_path: str, ufs_path: str, properties: dict | None = None,
+              auto_cache: bool = False, write_type: int = 0) -> MountInfo:
+        cv_path = cv_path.rstrip("/") or "/"
+        if cv_path in self._mounts:
+            raise err.FileAlreadyExists(f"mount point {cv_path} exists")
+        for existing in self._mounts:
+            if cv_path.startswith(existing + "/") or existing.startswith(cv_path + "/"):
+                raise err.InvalidArgument(
+                    f"nested mounts: {cv_path} vs {existing}")
+        self.fs.mkdir(cv_path, create_parent=True)
+        return self.fs._log("mount_add", dict(
+            cv_path=cv_path, ufs_path=ufs_path.rstrip("/"),
+            properties=properties or {}, auto_cache=auto_cache,
+            write_type=write_type))
+
+    def _apply_add(self, cv_path: str, ufs_path: str, properties: dict,
+                   auto_cache: bool, write_type: int) -> MountInfo:
+        info = MountInfo(mount_id=next(self._ids), cv_path=cv_path,
+                         ufs_path=ufs_path, properties=properties,
+                         auto_cache=auto_cache,
+                         write_type=WriteType(write_type))
+        self._mounts[cv_path] = info
+        return info
+
+    def umount(self, cv_path: str) -> None:
+        cv_path = cv_path.rstrip("/") or "/"
+        if cv_path not in self._mounts:
+            raise err.MountNotFound(cv_path)
+        self.fs._log("mount_remove", dict(cv_path=cv_path))
+
+    def _apply_remove(self, cv_path: str) -> None:
+        self._mounts.pop(cv_path, None)
+
+    def update(self, cv_path: str, properties: dict | None = None,
+               auto_cache: bool | None = None) -> MountInfo:
+        cv_path = cv_path.rstrip("/") or "/"
+        if cv_path not in self._mounts:
+            raise err.MountNotFound(cv_path)
+        return self.fs._log("mount_update", dict(
+            cv_path=cv_path, properties=properties, auto_cache=auto_cache))
+
+    def _apply_update(self, cv_path: str, properties: dict | None,
+                      auto_cache: bool | None) -> MountInfo:
+        info = self._mounts[cv_path]
+        if properties is not None:
+            info.properties.update(properties)
+        if auto_cache is not None:
+            info.auto_cache = auto_cache
+        return info
+
+    # ---------- resolution ----------
+    def table(self) -> list[MountInfo]:
+        return sorted(self._mounts.values(), key=lambda m: m.cv_path)
+
+    def get_mount(self, path: str) -> MountInfo | None:
+        """Deepest mount whose cv_path is a prefix of `path`."""
+        best = None
+        for cv, info in self._mounts.items():
+            if path == cv or path.startswith(cv + "/") or cv == "/":
+                if best is None or len(cv) > len(best.cv_path):
+                    best = info
+        return best
+
+    def resolve(self, path: str) -> tuple[MountInfo, str]:
+        """cv path → (mount, full ufs uri)."""
+        info = self.get_mount(path)
+        if info is None:
+            raise err.MountNotFound(f"no mount covers {path}")
+        rel = path[len(info.cv_path):] if info.cv_path != "/" else path
+        return info, info.ufs_path + rel
+
+    def reverse(self, ufs_uri: str) -> tuple[MountInfo, str]:
+        """ufs uri → (mount, cv path)."""
+        for info in self._mounts.values():
+            if ufs_uri == info.ufs_path or ufs_uri.startswith(info.ufs_path + "/"):
+                rel = ufs_uri[len(info.ufs_path):]
+                return info, (info.cv_path + rel) or "/"
+        raise err.MountNotFound(f"no mount covers {ufs_uri}")
+
+    # ---------- snapshot ----------
+    def snapshot_state(self) -> list[dict]:
+        return [m.to_wire() for m in self._mounts.values()]
+
+    def load_snapshot_state(self, state: list[dict]) -> None:
+        self._mounts = {m["cv_path"]: MountInfo.from_wire(m) for m in state}
+        if self._mounts:
+            top = max(m.mount_id for m in self._mounts.values())
+            self._ids = itertools.count(top + 1)

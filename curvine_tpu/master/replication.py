@@ -1,0 +1,100 @@
+"""Block re-replication.
+
+Parity: curvine-server/src/master/replication/ (master_replication_manager,
+master_replication_handler) + worker pull-based execution. The master scans
+for under-replicated blocks (replica loss, raised replication factor),
+picks a source and a destination worker, and asks the destination to pull
+the block from the source (RpcCode.SUBMIT_BLOCK_REPLICATION_JOB)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.common.types import WorkerInfo
+from curvine_tpu.rpc import RpcCode
+from curvine_tpu.rpc.client import ConnectionPool
+from curvine_tpu.rpc.frame import pack
+
+log = logging.getLogger(__name__)
+
+
+class ReplicationManager:
+    def __init__(self, fs, scan_interval_s: float = 5.0):
+        self.fs = fs
+        self.scan_interval_s = scan_interval_s
+        self.pool = ConnectionPool(size=1)
+        self.queue: asyncio.Queue[int] = asyncio.Queue()
+        self._inflight: set[int] = set()
+        self._queued: set[int] = set()
+
+    def enqueue(self, block_ids: list[int]) -> None:
+        for bid in block_ids:
+            if bid not in self._inflight and bid not in self._queued:
+                self._queued.add(bid)
+                self.queue.put_nowait(bid)
+
+    def on_worker_lost(self, worker: WorkerInfo, affected: list[int]) -> None:
+        log.info("worker %d lost; %d blocks affected",
+                 worker.address.worker_id, len(affected))
+        self.enqueue(affected)
+
+    def replacement_worker(self, block_id: int, exclude: set[int]) -> WorkerInfo:
+        meta = self.fs.blocks.get(block_id)
+        holders = set(meta.locs) if meta else set()
+        chosen = self.fs.policy.choose(
+            self.fs.workers.live_workers(), 1,
+            exclude=exclude | holders, needed=meta.len if meta else 0)
+        return chosen[0]
+
+    async def run(self) -> None:
+        scan = asyncio.ensure_future(self._scan_loop())
+        try:
+            while True:
+                bid = await self.queue.get()
+                self._queued.discard(bid)
+                try:
+                    await self._replicate(bid)
+                except Exception as e:
+                    log.warning("replication of block %d failed: %s", bid, e)
+        finally:
+            scan.cancel()
+
+    async def _scan_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.scan_interval_s)
+            under = [m.block_id for m in self.fs.blocks.under_replicated()]
+            if under:
+                log.info("scan: %d under-replicated blocks", len(under))
+                self.enqueue(under)
+
+    async def _replicate(self, block_id: int) -> None:
+        meta = self.fs.blocks.get(block_id)
+        if meta is None or len(meta.locs) >= meta.replicas or not meta.locs:
+            return
+        src_id = next(iter(meta.locs))
+        try:
+            src = self.fs.workers.get(src_id)
+            dst = self.replacement_worker(block_id, exclude=set())
+        except err.CurvineError as e:
+            log.debug("no replication target for block %d: %s", block_id, e)
+            return
+        self._inflight.add(block_id)
+        try:
+            conn = await self.pool.get(
+                f"{dst.address.ip_addr or dst.address.hostname}:{dst.address.rpc_port}")
+            await conn.call(RpcCode.SUBMIT_BLOCK_REPLICATION_JOB, data=pack({
+                "block_id": block_id,
+                "block_len": meta.len,
+                "source": src.address.to_wire(),
+            }))
+        finally:
+            self._inflight.discard(block_id)
+
+    def on_result(self, block_id: int, worker_id: int, success: bool,
+                  message: str) -> None:
+        if not success:
+            log.warning("replication of %d on worker %d failed: %s",
+                        block_id, worker_id, message)
+            self.enqueue([block_id])

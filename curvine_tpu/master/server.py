@@ -1,0 +1,302 @@
+"""Master RPC server: binds RpcCode → MasterFilesystem + managers.
+
+Parity: curvine-server/src/master/master_handler.rs + master_server.rs.
+The namespace is a single-writer actor: all handlers run on one asyncio
+loop, so mutations are serialized without locks (the reference uses an
+actor + RwLock split; asyncio gives us the same property for free)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from curvine_tpu.common.conf import ClusterConf
+from curvine_tpu.common.journal import Journal
+from curvine_tpu.common.types import CommitBlock, SetAttrOpts, now_ms
+from curvine_tpu.common.metrics import MetricsRegistry
+from curvine_tpu.master.filesystem import MasterFilesystem
+from curvine_tpu.master.jobs import JobManager
+from curvine_tpu.master.mount import MountManager
+from curvine_tpu.master.replication import ReplicationManager
+from curvine_tpu.master.retry_cache import RetryCache
+from curvine_tpu.master.ttl import TtlManager
+from curvine_tpu.rpc import Message, RpcCode, RpcServer, ServerConn
+from curvine_tpu.rpc.frame import pack, unpack
+
+log = logging.getLogger(__name__)
+
+
+class MasterServer:
+    def __init__(self, conf: ClusterConf | None = None,
+                 journal: bool = True):
+        self.conf = conf or ClusterConf()
+        mc = self.conf.master
+        j = Journal(mc.journal_dir) if journal else None
+        self.fs = MasterFilesystem(
+            journal=j, placement=mc.block_placement_policy,
+            lost_timeout_ms=mc.worker_lost_timeout_ms,
+            snapshot_interval=mc.snapshot_interval_entries)
+        self.mounts = MountManager(self.fs)
+        self.fs.mounts = self.mounts
+        self.metrics = MetricsRegistry("master")
+        self.jobs = JobManager(self.fs, self.mounts)
+        self.replication = ReplicationManager(self.fs)
+        self.fs.on_worker_lost = self.replication.on_worker_lost
+        self.ttl = TtlManager(self.fs, check_ms=mc.ttl_check_ms)
+        self.retry_cache = RetryCache(mc.retry_cache_size, mc.retry_cache_ttl_ms)
+        self.rpc = RpcServer(mc.hostname, mc.rpc_port, "master")
+        self._register_handlers()
+        self._bg: list[asyncio.Task] = []
+
+    @property
+    def addr(self) -> str:
+        return self.rpc.addr
+
+    async def start(self) -> None:
+        self.fs.recover()
+        await self.rpc.start()
+        self._bg.append(asyncio.ensure_future(self._heartbeat_checker()))
+        self._bg.append(asyncio.ensure_future(self.ttl.run()))
+        self._bg.append(asyncio.ensure_future(self.replication.run()))
+        self._bg.append(asyncio.ensure_future(self.jobs.run()))
+        log.info("master started at %s", self.addr)
+
+    async def stop(self) -> None:
+        for t in self._bg:
+            t.cancel()
+        self._bg.clear()
+        await self.rpc.stop()
+        if self.fs.journal:
+            self.fs.journal.close()
+
+    async def _heartbeat_checker(self) -> None:
+        interval = self.conf.master.heartbeat_check_ms / 1000
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                self.fs.check_lost_workers()
+            except Exception:
+                log.exception("heartbeat checker")
+
+    # ---------------- handlers ----------------
+
+    def _register_handlers(self) -> None:
+        r = self.rpc.register
+        C = RpcCode
+        r(C.MKDIR, self._h(self._mkdir, mutate=True))
+        r(C.DELETE, self._h(self._delete, mutate=True))
+        r(C.CREATE_FILE, self._h(self._create_file, mutate=True))
+        r(C.OPEN_FILE, self._h(self._open_file))
+        r(C.APPEND_FILE, self._h(self._append_file, mutate=True))
+        r(C.FILE_STATUS, self._h(self._file_status))
+        r(C.LIST_STATUS, self._h(self._list_status))
+        r(C.EXISTS, self._h(self._exists))
+        r(C.RENAME, self._h(self._rename, mutate=True))
+        r(C.ADD_BLOCK, self._h(self._add_block, mutate=True))
+        r(C.COMPLETE_FILE, self._h(self._complete_file, mutate=True))
+        r(C.GET_BLOCK_LOCATIONS, self._h(self._get_block_locations))
+        r(C.GET_MASTER_INFO, self._h(self._master_info))
+        r(C.SET_ATTR, self._h(self._set_attr, mutate=True))
+        r(C.SYMLINK, self._h(self._symlink, mutate=True))
+        r(C.LINK, self._h(self._link, mutate=True))
+        r(C.RESIZE_FILE, self._h(self._resize, mutate=True))
+        r(C.FREE, self._h(self._free, mutate=True))
+        r(C.CREATE_FILES_BATCH, self._h(self._create_files_batch, mutate=True))
+        r(C.ADD_BLOCKS_BATCH, self._h(self._add_blocks_batch, mutate=True))
+        r(C.COMPLETE_FILES_BATCH, self._h(self._complete_files_batch, mutate=True))
+        # worker plane
+        r(C.WORKER_HEARTBEAT, self._h(self._worker_heartbeat))
+        r(C.WORKER_BLOCK_REPORT, self._h(self._worker_block_report))
+        r(C.REQUEST_REPLACEMENT_WORKER, self._h(self._replacement_worker))
+        r(C.REPORT_UNDER_REPLICATED_BLOCKS, self._h(self._report_under_replicated))
+        r(C.REPORT_BLOCK_REPLICATION_RESULT, self._h(self._replication_result))
+        # mounts
+        r(C.MOUNT, self._h(self._mount, mutate=True))
+        r(C.UNMOUNT, self._h(self._umount, mutate=True))
+        r(C.UPDATE_MOUNT, self._h(self._update_mount, mutate=True))
+        r(C.GET_MOUNT_TABLE, self._h(self._mount_table))
+        r(C.GET_MOUNT_INFO, self._h(self._mount_info))
+        # jobs
+        r(C.SUBMIT_JOB, self._h(self._submit_job, mutate=True))
+        r(C.GET_JOB_STATUS, self._h(self._job_status))
+        r(C.CANCEL_JOB, self._h(self._cancel_job, mutate=True))
+        r(C.REPORT_TASK, self._h(self._report_task))
+
+    def _h(self, fn, mutate: bool = False):
+        metrics = self.metrics
+        async def handler(msg: Message, conn: ServerConn):
+            req = unpack(msg.data) or {}
+            with metrics.timer(f"rpc.{fn.__name__.lstrip('_')}"):
+                if mutate:
+                    key = (req.get("client_id"), req.get("call_id"))
+                    if key[0] is not None and key[1] is not None:
+                        cached = self.retry_cache.get(key)
+                        if cached is not None:
+                            return {}, cached
+                        rep = fn(req)
+                        data = pack(rep)
+                        self.retry_cache.put(key, data)
+                        return {}, data
+                rep = fn(req)
+            return {}, pack(rep)
+        return handler
+
+    # --- fs ---
+    def _mkdir(self, q):
+        st = self.fs.mkdir(q["path"], create_parent=q.get("create_parent", True),
+                           mode=q.get("mode", 0o755), owner=q.get("owner", "root"),
+                           group=q.get("group", "root"), x_attr=q.get("x_attr"))
+        return {"status": st.to_wire()}
+
+    def _delete(self, q):
+        self.fs.delete(q["path"], recursive=q.get("recursive", False))
+        return {}
+
+    def _create_file(self, q):
+        st = self.fs.create_file(
+            q["path"], overwrite=q.get("overwrite", False),
+            create_parent=q.get("create_parent", True),
+            replicas=q.get("replicas", 1),
+            block_size=q.get("block_size", self.conf.client.block_size),
+            mode=q.get("mode", 0o644), owner=q.get("owner", "root"),
+            group=q.get("group", "root"), client_name=q.get("client_name", ""),
+            x_attr=q.get("x_attr"), storage_policy=q.get("storage_policy"),
+            file_type=q.get("file_type", 1))
+        return {"status": st.to_wire()}
+
+    def _open_file(self, q):
+        fb = self.fs.get_block_locations(q["path"])
+        return {"file_blocks": fb.to_wire()}
+
+    def _append_file(self, q):
+        fb = self.fs.append_file(q["path"], client_name=q.get("client_name", ""))
+        return {"file_blocks": fb.to_wire()}
+
+    def _file_status(self, q):
+        return {"status": self.fs.file_status(q["path"]).to_wire()}
+
+    def _list_status(self, q):
+        return {"statuses": [s.to_wire() for s in self.fs.list_status(q["path"])]}
+
+    def _exists(self, q):
+        return {"exists": self.fs.exists(q["path"])}
+
+    def _rename(self, q):
+        return {"result": self.fs.rename(q["src"], q["dst"])}
+
+    def _add_block(self, q):
+        lb = self.fs.add_block(
+            q["path"], client_host=q.get("client_host", ""),
+            exclude_workers=q.get("exclude_workers"),
+            commit_blocks=[CommitBlock.from_wire(c)
+                           for c in q.get("commit_blocks", [])],
+            ici_coords=q.get("ici_coords"))
+        return {"block": lb.to_wire()}
+
+    def _complete_file(self, q):
+        ok = self.fs.complete_file(
+            q["path"], q.get("len", 0),
+            commit_blocks=[CommitBlock.from_wire(c)
+                           for c in q.get("commit_blocks", [])],
+            client_name=q.get("client_name", ""),
+            only_flush=q.get("only_flush", False))
+        return {"result": ok}
+
+    def _get_block_locations(self, q):
+        return {"file_blocks": self.fs.get_block_locations(q["path"]).to_wire()}
+
+    def _master_info(self, q):
+        return {"info": self.fs.master_info(self.addr).to_wire()}
+
+    def _set_attr(self, q):
+        self.fs.set_attr(q["path"], SetAttrOpts.from_wire(q.get("opts", {})))
+        return {}
+
+    def _symlink(self, q):
+        return {"status": self.fs.symlink(q["target"], q["link"]).to_wire()}
+
+    def _link(self, q):
+        return {"status": self.fs.link(q["src"], q["dst"]).to_wire()}
+
+    def _resize(self, q):
+        self.fs.resize_file(q["path"], q["len"])
+        return {}
+
+    def _free(self, q):
+        return {"freed": self.fs.free(q["path"], q.get("recursive", False))}
+
+    def _create_files_batch(self, q):
+        return {"responses": [self._create_file(r) for r in q["requests"]]}
+
+    def _add_blocks_batch(self, q):
+        return {"responses": [self._add_block(r) for r in q["requests"]]}
+
+    def _complete_files_batch(self, q):
+        return {"responses": [self._complete_file(r) for r in q["requests"]]}
+
+    # --- worker plane ---
+    def _worker_heartbeat(self, q):
+        cmds = self.fs.worker_heartbeat(q["info"])
+        self.metrics.gauge("workers.live", len(self.fs.workers.live_workers()))
+        return cmds
+
+    def _worker_block_report(self, q):
+        return self.fs.worker_block_report(
+            q["worker_id"], q.get("blocks", {}), q.get("storage_types", {}),
+            incremental=q.get("incremental", False))
+
+    def _replacement_worker(self, q):
+        w = self.replication.replacement_worker(
+            q["block_id"], set(q.get("exclude_workers", [])))
+        return {"worker": w.address.to_wire()}
+
+    def _report_under_replicated(self, q):
+        self.replication.enqueue(q.get("block_ids", []))
+        return {"success": True}
+
+    def _replication_result(self, q):
+        self.replication.on_result(q["block_id"], q["worker_id"],
+                                   q.get("success", False), q.get("message", ""))
+        return {}
+
+    # --- mounts ---
+    def _mount(self, q):
+        info = self.mounts.mount(q["cv_path"], q["ufs_path"],
+                                 properties=q.get("properties"),
+                                 auto_cache=q.get("auto_cache", False),
+                                 write_type=q.get("write_type", 0))
+        return {"mount": info.to_wire()}
+
+    def _umount(self, q):
+        self.mounts.umount(q["cv_path"])
+        return {}
+
+    def _update_mount(self, q):
+        info = self.mounts.update(q["cv_path"], properties=q.get("properties"),
+                                  auto_cache=q.get("auto_cache"))
+        return {"mount": info.to_wire()}
+
+    def _mount_table(self, q):
+        return {"mounts": [m.to_wire() for m in self.mounts.table()]}
+
+    def _mount_info(self, q):
+        m = self.mounts.get_mount(q["path"])
+        return {"mount": m.to_wire() if m else None}
+
+    # --- jobs ---
+    def _submit_job(self, q):
+        job = self.jobs.submit(q.get("kind", "load"), q["path"],
+                               recursive=q.get("recursive", True),
+                               replicas=q.get("replicas", 1))
+        return {"job_id": job.job_id}
+
+    def _job_status(self, q):
+        return {"job": self.jobs.status(q["job_id"]).to_wire()}
+
+    def _cancel_job(self, q):
+        self.jobs.cancel(q["job_id"])
+        return {}
+
+    def _report_task(self, q):
+        self.jobs.report_task(q["task"])
+        return {}

@@ -1,0 +1,102 @@
+"""TTL expiry management.
+
+Parity: curvine-server/src/master/meta/inode/ttl/ (ttl_bucket, ttl_checker,
+ttl_executor, ttl_manager, ttl_scheduler). Files with a StoragePolicy ttl
+are indexed into coarse time buckets; an async checker walks due buckets
+and applies the TTL action (DELETE removes the file, FREE drops cached
+blocks but keeps metadata)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.common.types import TtlAction, now_ms
+
+log = logging.getLogger(__name__)
+
+
+class TtlBuckets:
+    """expiry-bucket → set of inode ids."""
+
+    def __init__(self, bucket_ms: int = 1_000):
+        self.bucket_ms = bucket_ms
+        self.buckets: dict[int, set[int]] = {}
+
+    def _key(self, expire_ms: int) -> int:
+        return expire_ms // self.bucket_ms
+
+    def add(self, inode_id: int, expire_ms: int) -> None:
+        self.buckets.setdefault(self._key(expire_ms), set()).add(inode_id)
+
+    def remove(self, inode_id: int, expire_ms: int) -> None:
+        b = self.buckets.get(self._key(expire_ms))
+        if b:
+            b.discard(inode_id)
+
+    def due(self, now: int) -> list[int]:
+        key_now = self._key(now)
+        out = []
+        for key in [k for k in self.buckets if k <= key_now]:
+            out.extend(self.buckets.pop(key))
+        return out
+
+
+class TtlManager:
+    def __init__(self, fs, check_ms: int = 1_000, bucket_ms: int = 1_000):
+        self.fs = fs
+        self.check_ms = check_ms
+        self.buckets = TtlBuckets(bucket_ms)
+        self._indexed: dict[int, int] = {}   # inode id -> expire_ms
+
+    def index(self, inode_id: int, mtime: int, ttl_ms: int) -> None:
+        old = self._indexed.pop(inode_id, None)
+        if old is not None:
+            self.buckets.remove(inode_id, old)
+        if ttl_ms > 0:
+            expire = mtime + ttl_ms
+            self.buckets.add(inode_id, expire)
+            self._indexed[inode_id] = expire
+
+    def rescan(self) -> None:
+        """Re-index everything (after restart/journal replay)."""
+        self.buckets = TtlBuckets(self.buckets.bucket_ms)
+        self._indexed.clear()
+        for node in self.fs.tree.iter_files():
+            if node.storage_policy.ttl_ms > 0:
+                self.index(node.id, node.mtime, node.storage_policy.ttl_ms)
+
+    async def run(self) -> None:
+        self.rescan()
+        while True:
+            await asyncio.sleep(self.check_ms / 1000)
+            try:
+                self.check(now_ms())
+            except Exception:
+                log.exception("ttl checker")
+
+    def check(self, now: int) -> int:
+        """Apply TTL actions on everything due; returns count acted on."""
+        acted = 0
+        for inode_id in self.buckets.due(now):
+            self._indexed.pop(inode_id, None)
+            node = self.fs.tree.get(inode_id)
+            if node is None:
+                continue
+            sp = node.storage_policy
+            if sp.ttl_ms <= 0 or node.mtime + sp.ttl_ms > now:
+                # ttl was changed/refreshed since indexing: re-index
+                self.index(inode_id, node.mtime, sp.ttl_ms)
+                continue
+            path = self.fs.tree.path_of(node)
+            try:
+                if sp.ttl_action == TtlAction.DELETE:
+                    self.fs.delete(path, recursive=True)
+                elif sp.ttl_action == TtlAction.FREE:
+                    self.fs.free(path, recursive=True)
+                acted += 1
+                log.info("ttl %s applied to %s", sp.ttl_action.name, path)
+            except err.CurvineError as e:
+                log.warning("ttl action on %s failed: %s", path, e)
+        return acted

@@ -1,0 +1,72 @@
+"""Worker registry + liveness.
+
+Parity: curvine-server/src/master/fs/state/worker_map.rs and
+worker_manager.rs + heartbeat_checker.rs."""
+
+from __future__ import annotations
+
+import logging
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.common.types import (
+    StorageInfo, WorkerAddress, WorkerInfo, WorkerState, now_ms,
+)
+
+log = logging.getLogger(__name__)
+
+
+class WorkerMap:
+    def __init__(self, lost_timeout_ms: int = 30_000):
+        self.workers: dict[int, WorkerInfo] = {}
+        self.lost_timeout_ms = lost_timeout_ms
+
+    def heartbeat(self, address: WorkerAddress, storages: list[StorageInfo],
+                  ici_coords: list[int] | None = None) -> WorkerInfo:
+        info = self.workers.get(address.worker_id)
+        if info is None:
+            info = WorkerInfo(address=address)
+            self.workers[address.worker_id] = info
+            log.info("worker registered: %s", address)
+        info.address = address
+        info.storages = storages
+        info.last_heartbeat_ms = now_ms()
+        if ici_coords is not None:
+            info.ici_coords = list(ici_coords)
+        if info.state == WorkerState.LOST:
+            log.info("worker %d back alive", address.worker_id)
+            info.state = WorkerState.LIVE
+        return info
+
+    def get(self, worker_id: int) -> WorkerInfo:
+        info = self.workers.get(worker_id)
+        if info is None:
+            raise err.WorkerNotFound(f"worker {worker_id} not registered")
+        return info
+
+    def live_workers(self) -> list[WorkerInfo]:
+        return [w for w in self.workers.values() if w.state == WorkerState.LIVE]
+
+    def lost_workers(self) -> list[WorkerInfo]:
+        return [w for w in self.workers.values() if w.state == WorkerState.LOST]
+
+    def check_lost(self) -> list[WorkerInfo]:
+        """Mark workers whose heartbeat expired; returns newly-lost ones."""
+        deadline = now_ms() - self.lost_timeout_ms
+        newly_lost = []
+        for w in self.workers.values():
+            if w.state == WorkerState.LIVE and w.last_heartbeat_ms < deadline:
+                w.state = WorkerState.LOST
+                newly_lost.append(w)
+                log.warning("worker %d lost (no heartbeat for %dms)",
+                            w.address.worker_id, self.lost_timeout_ms)
+        return newly_lost
+
+    def decommission(self, worker_id: int) -> None:
+        self.get(worker_id).state = WorkerState.DECOMMISSIONING
+
+    def capacity(self) -> tuple[int, int]:
+        cap = avail = 0
+        for w in self.live_workers():
+            cap += w.capacity
+            avail += w.available
+        return cap, avail

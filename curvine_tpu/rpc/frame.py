@@ -87,7 +87,7 @@ class Message:
         off = FIXED_LEN
         header: dict = {}
         if hdr_len:
-            header = msgpack.unpackb(payload[off:off + hdr_len], raw=False)
+            header = msgpack.unpackb(payload[off:off + hdr_len], raw=False, strict_map_key=False)
             off += hdr_len
         data = payload[off:]
         return Message(code=code, req_id=req_id, status=status, flags=flags,
@@ -116,7 +116,7 @@ def pack(obj: Any) -> bytes:
 
 
 def unpack(buf: bytes | memoryview) -> Any:
-    return msgpack.unpackb(buf, raw=False) if len(buf) else None
+    return msgpack.unpackb(buf, raw=False, strict_map_key=False) if len(buf) else None
 
 
 async def read_frame(reader) -> Message:

@@ -66,7 +66,7 @@ class RpcServer:
         self.name = name
         self._handlers: dict[int, Handler] = {}
         self._server: asyncio.base_events.Server | None = None
-        self._conn_tasks: set[asyncio.Task] = set()
+        self._conns: set[ServerConn] = set()
 
     def register(self, code: int, handler: Handler) -> None:
         self._handlers[int(code)] = handler
@@ -87,11 +87,12 @@ class RpcServer:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
+            # force-close live connections: wait_closed() (3.12+) blocks on
+            # in-flight handlers, and idle clients never hang up on their own
+            for conn in list(self._conns):
+                conn.writer.close()
             await self._server.wait_closed()
             self._server = None
-        for t in list(self._conn_tasks):
-            t.cancel()
-        self._conn_tasks.clear()
 
     @property
     def addr(self) -> str:
@@ -100,6 +101,7 @@ class RpcServer:
     async def _on_conn(self, reader: asyncio.StreamReader,
                        writer: asyncio.StreamWriter) -> None:
         conn = ServerConn(reader, writer)
+        self._conns.add(conn)
         pending: set[asyncio.Task] = set()
         try:
             while True:
@@ -115,6 +117,7 @@ class RpcServer:
                 pending.add(t)
                 t.add_done_callback(pending.discard)
         finally:
+            self._conns.discard(conn)
             for t in pending:
                 t.cancel()
             writer.close()

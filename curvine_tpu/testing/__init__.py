@@ -1,0 +1,3 @@
+from curvine_tpu.testing.cluster import MiniCluster
+
+__all__ = ["MiniCluster"]

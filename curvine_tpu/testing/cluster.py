@@ -1,0 +1,107 @@
+"""In-process mini-cluster for tests and local play.
+
+Parity: curvine-server/src/test/mini_cluster.rs + curvine-tests/src/
+testing.rs. One master + N workers on ephemeral localhost ports, all on
+the current asyncio loop; data under a temp dir."""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import os
+import tempfile
+
+from curvine_tpu.common.conf import ClusterConf, TierConf
+from curvine_tpu.client import CurvineClient
+from curvine_tpu.master import MasterServer
+from curvine_tpu.worker import WorkerServer
+
+MB = 1024 * 1024
+
+
+class MiniCluster:
+    def __init__(self, workers: int = 1, base_dir: str | None = None,
+                 conf: ClusterConf | None = None, journal: bool = True,
+                 tier_capacity: int = 256 * MB, block_size: int = 4 * MB,
+                 worker_heartbeat_ms: int = 200,
+                 lost_timeout_ms: int = 2_000):
+        self.n_workers = workers
+        self.base_dir = base_dir or tempfile.mkdtemp(prefix="curvine-test-")
+        self.conf = conf or ClusterConf()
+        self.conf.master.hostname = "127.0.0.1"
+        self.conf.master.rpc_port = 0
+        self.conf.master.journal_dir = os.path.join(self.base_dir, "journal")
+        self.conf.master.worker_lost_timeout_ms = lost_timeout_ms
+        self.conf.master.heartbeat_check_ms = 200
+        self.conf.client.block_size = block_size
+        self.journal = journal
+        self.tier_capacity = tier_capacity
+        self.worker_heartbeat_ms = worker_heartbeat_ms
+        self.master: MasterServer | None = None
+        self.workers: list[WorkerServer] = []
+        self._clients: list[CurvineClient] = []
+
+    async def start(self) -> "MiniCluster":
+        self.master = MasterServer(self.conf, journal=self.journal)
+        await self.master.start()
+        # pin the ephemeral port so a master restart comes back reachable
+        self.conf.master.rpc_port = self.master.rpc.port
+        self.conf.client.master_addrs = [self.master.addr]
+        for i in range(self.n_workers):
+            await self.add_worker(i)
+        await self.await_workers(self.n_workers)
+        return self
+
+    async def add_worker(self, idx: int | None = None) -> WorkerServer:
+        idx = idx if idx is not None else len(self.workers)
+        wconf = copy.deepcopy(self.conf)
+        wconf.worker.hostname = "127.0.0.1"
+        wconf.worker.rpc_port = 0
+        wconf.worker.heartbeat_ms = self.worker_heartbeat_ms
+        wconf.worker.tiers = [TierConf(
+            storage_type="mem",
+            dir=os.path.join(self.base_dir, f"worker{idx}", "mem"),
+            capacity=self.tier_capacity)]
+        wconf.worker.ici_coords = [idx, 0]
+        w = WorkerServer(wconf)
+        await w.start()
+        self.workers.append(w)
+        return w
+
+    async def await_workers(self, n: int, timeout: float = 10.0) -> None:
+        assert self.master is not None
+        async def wait():
+            while len(self.master.fs.workers.live_workers()) < n:
+                await asyncio.sleep(0.05)
+        await asyncio.wait_for(wait(), timeout)
+
+    def client(self) -> CurvineClient:
+        c = CurvineClient(copy.deepcopy(self.conf))
+        self._clients.append(c)
+        return c
+
+    async def kill_worker(self, idx: int) -> None:
+        await self.workers[idx].stop()
+
+    async def restart_master(self) -> None:
+        assert self.master is not None
+        await self.master.stop()
+        self.master = MasterServer(self.conf, journal=self.journal)
+        await self.master.start()
+
+    async def stop(self) -> None:
+        for c in self._clients:
+            await c.close()
+        self._clients.clear()
+        for w in self.workers:
+            await w.stop()
+        self.workers.clear()
+        if self.master is not None:
+            await self.master.stop()
+            self.master = None
+
+    async def __aenter__(self) -> "MiniCluster":
+        return await self.start()
+
+    async def __aexit__(self, et, ev, tb) -> None:
+        await self.stop()

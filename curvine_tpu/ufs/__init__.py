@@ -1,0 +1,8 @@
+from curvine_tpu.ufs.base import Ufs, UfsStatus, create_ufs, register_scheme
+
+# register built-in schemes
+import curvine_tpu.ufs.local   # noqa: F401  (file://)
+import curvine_tpu.ufs.memory  # noqa: F401  (mem://)
+import curvine_tpu.ufs.s3      # noqa: F401  (s3://, env-gated)
+
+__all__ = ["Ufs", "UfsStatus", "create_ufs", "register_scheme"]

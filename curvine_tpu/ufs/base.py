@@ -1,0 +1,103 @@
+"""Under-filesystem (UFS) abstraction.
+
+Parity: curvine-ufs/src/fs/ (opendal-backed object storage adapters). A
+Ufs exposes object-store semantics: stat/list/walk/read/write/delete on
+full URIs (``scheme://authority/key``). New backends register a scheme,
+mirroring the reference's opendal service features (s3/oss/gcs/hdfs/...)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import AsyncIterator, Callable
+
+from curvine_tpu.common import errors as err
+
+
+@dataclass
+class UfsStatus:
+    path: str            # full uri
+    is_dir: bool = False
+    len: int = 0
+    mtime: int = 0
+
+
+class Ufs:
+    scheme = ""
+
+    def __init__(self, properties: dict | None = None):
+        self.properties = properties or {}
+
+    async def stat(self, uri: str) -> UfsStatus | None:
+        raise NotImplementedError
+
+    async def list(self, uri: str) -> list[UfsStatus]:
+        raise NotImplementedError
+
+    async def walk(self, uri: str, recursive: bool = True
+                   ) -> AsyncIterator[UfsStatus]:
+        for st in await self.list(uri):
+            yield st
+            if st.is_dir and recursive:
+                async for sub in self.walk(st.path, recursive=True):
+                    yield sub
+
+    async def read(self, uri: str, offset: int = 0, length: int = -1,
+                   chunk_size: int = 1024 * 1024) -> AsyncIterator[bytes]:
+        raise NotImplementedError
+        yield b""  # pragma: no cover
+
+    async def read_all(self, uri: str) -> bytes:
+        out = bytearray()
+        async for chunk in self.read(uri):
+            out += chunk
+        return bytes(out)
+
+    async def write(self, uri: str, chunks) -> int:
+        """Write full object from an async iterator of bytes; returns len."""
+        raise NotImplementedError
+
+    async def write_all(self, uri: str, data: bytes) -> int:
+        async def one():
+            yield data
+        return await self.write(uri, one())
+
+    async def delete(self, uri: str) -> None:
+        raise NotImplementedError
+
+    async def mkdir(self, uri: str) -> None:
+        """Object stores have no real dirs; default is a no-op."""
+        return None
+
+    async def rename(self, src: str, dst: str) -> None:
+        # default: copy + delete (object-store semantics, no atomic rename)
+        data = await self.read_all(src)
+        await self.write_all(dst, data)
+        await self.delete(src)
+
+
+_SCHEMES: dict[str, Callable[..., Ufs]] = {}
+
+
+def register_scheme(scheme: str, factory: Callable[..., Ufs]) -> None:
+    _SCHEMES[scheme] = factory
+
+
+def split_uri(uri: str) -> tuple[str, str, str]:
+    """uri → (scheme, authority, key-path)."""
+    if "://" not in uri:
+        return "file", "", uri
+    scheme, rest = uri.split("://", 1)
+    if "/" in rest:
+        authority, key = rest.split("/", 1)
+    else:
+        authority, key = rest, ""
+    return scheme, authority, key
+
+
+def create_ufs(uri: str, properties: dict | None = None) -> Ufs:
+    scheme, _, _ = split_uri(uri)
+    factory = _SCHEMES.get(scheme)
+    if factory is None:
+        raise err.UfsError(f"no UFS backend for scheme {scheme!r}; "
+                           f"registered: {sorted(_SCHEMES)}")
+    return factory(properties=properties)

@@ -1,0 +1,3 @@
+from curvine_tpu.worker.server import WorkerServer
+
+__all__ = ["WorkerServer"]

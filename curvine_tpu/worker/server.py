@@ -1,0 +1,307 @@
+"""Worker server: block read/write handlers, heartbeat, tasks, replication.
+
+Parity: curvine-server/src/worker/ (worker_server.rs, handler/read_handler,
+handler/write_handler, block/heartbeat_task, task/load_task_runner,
+replication/worker_replication_handler)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import socket
+import zlib
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.common.conf import ClusterConf
+from curvine_tpu.common.metrics import MetricsRegistry
+from curvine_tpu.common.types import (
+    JobState, StorageType, TaskInfo, WorkerAddress, WorkerInfo, now_ms,
+)
+from curvine_tpu.rpc import Message, RpcCode, RpcServer, ServerConn
+from curvine_tpu.rpc.client import Connection, ConnectionPool
+from curvine_tpu.rpc.frame import Flags, pack, response_for, unpack
+from curvine_tpu.worker.storage import BlockStore, TierDir
+
+log = logging.getLogger(__name__)
+
+_TIER_NAMES = {"hbm": StorageType.HBM, "mem": StorageType.MEM,
+               "ssd": StorageType.SSD, "hdd": StorageType.HDD}
+
+
+def worker_id_for(hostname: str, port: int) -> int:
+    return zlib.crc32(f"{hostname}:{port}".encode()) & 0x7FFFFFFF
+
+
+class WorkerServer:
+    def __init__(self, conf: ClusterConf | None = None,
+                 worker_id: int | None = None):
+        self.conf = conf or ClusterConf()
+        wc = self.conf.worker
+        self.rpc = RpcServer(wc.hostname, wc.rpc_port, "worker")
+        tiers = [TierDir(_TIER_NAMES.get(t.storage_type, StorageType.MEM),
+                         t.dir, t.capacity) for t in wc.tiers]
+        self.store = BlockStore(tiers, wc.eviction_high_water,
+                                wc.eviction_low_water)
+        self.metrics = MetricsRegistry("worker")
+        self.master_pool = ConnectionPool(size=2)
+        self.peer_pool = ConnectionPool(size=2)
+        self.worker_id = worker_id if worker_id is not None else 0
+        self.chunk_size = wc.io_chunk_size
+        self._bg: list[asyncio.Task] = []
+        self._task_sem = asyncio.Semaphore(wc.task_parallelism)
+        self._register_handlers()
+
+    @property
+    def address(self) -> WorkerAddress:
+        return WorkerAddress(
+            worker_id=self.worker_id, hostname=self.conf.worker.hostname,
+            ip_addr=self.conf.worker.hostname, rpc_port=self.rpc.port,
+            web_port=self.conf.worker.web_port)
+
+    @property
+    def addr(self) -> str:
+        return self.rpc.addr
+
+    async def start(self) -> None:
+        await self.rpc.start()
+        if not self.worker_id:
+            self.worker_id = worker_id_for(self.conf.worker.hostname,
+                                           self.rpc.port)
+        self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
+        self._bg.append(asyncio.ensure_future(self._report_loop()))
+        self._bg.append(asyncio.ensure_future(self._eviction_loop()))
+        log.info("worker %d started at %s", self.worker_id, self.addr)
+
+    async def stop(self) -> None:
+        for t in self._bg:
+            t.cancel()
+        self._bg.clear()
+        await self.rpc.stop()
+        await self.master_pool.close()
+        await self.peer_pool.close()
+
+    # ---------------- master plane ----------------
+
+    async def _master_conn(self) -> Connection:
+        return await self.master_pool.get(self.conf.client.master_addrs[0])
+
+    def _info(self) -> WorkerInfo:
+        return WorkerInfo(address=self.address, storages=self.store.storages(),
+                          last_heartbeat_ms=now_ms(),
+                          ici_coords=list(self.conf.worker.ici_coords))
+
+    async def heartbeat_once(self) -> None:
+        conn = await self._master_conn()
+        rep = await conn.call(RpcCode.WORKER_HEARTBEAT,
+                              data=pack({"info": self._info().to_wire()}))
+        cmds = unpack(rep.data) or {}
+        for bid in cmds.get("delete_blocks", []):
+            self.store.delete(bid)
+
+    async def block_report_once(self) -> None:
+        held, types = self.store.report()
+        conn = await self._master_conn()
+        rep = await conn.call(RpcCode.WORKER_BLOCK_REPORT, data=pack({
+            "worker_id": self.worker_id, "blocks": held,
+            "storage_types": types}))
+        for bid in (unpack(rep.data) or {}).get("delete_blocks", []):
+            self.store.delete(bid)
+
+    async def _heartbeat_loop(self) -> None:
+        interval = self.conf.worker.heartbeat_ms / 1000
+        while True:
+            try:
+                await self.heartbeat_once()
+            except Exception as e:
+                log.warning("heartbeat failed: %s", e)
+            await asyncio.sleep(interval)
+
+    async def _report_loop(self) -> None:
+        interval = self.conf.worker.block_report_interval_ms / 1000
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                await self.block_report_once()
+            except Exception as e:
+                log.warning("block report failed: %s", e)
+
+    async def _eviction_loop(self) -> None:
+        while True:
+            await asyncio.sleep(1.0)
+            try:
+                evicted = await asyncio.to_thread(self.store.maybe_evict)
+                if evicted:
+                    self.metrics.inc("blocks.evicted", len(evicted))
+            except Exception:
+                log.exception("eviction loop")
+
+    # ---------------- handlers ----------------
+
+    def _register_handlers(self) -> None:
+        r = self.rpc.register
+        r(RpcCode.WRITE_BLOCK, self._write_block)
+        r(RpcCode.READ_BLOCK, self._read_block)
+        r(RpcCode.DELETE_BLOCK, self._delete_block)
+        r(RpcCode.GET_BLOCK_INFO, self._get_block_info)
+        r(RpcCode.SUBMIT_BLOCK_REPLICATION_JOB, self._replicate_block)
+        r(RpcCode.SUBMIT_TASK, self._submit_task)
+
+    async def _write_block(self, msg: Message, conn: ServerConn):
+        """Chunked upload: request header {block_id, storage_type, len_hint},
+        then CHUNK frames, then EOF {crc32}. Parity: write_handler.rs."""
+        q = unpack(msg.data) or msg.header
+        block_id = q["block_id"]
+        hint = StorageType(q.get("storage_type", int(StorageType.MEM)))
+        info = self.store.create_temp(block_id, hint, q.get("len_hint", 0))
+        stream = conn.open_stream(msg.req_id)
+        crc = 0
+        total = 0
+        try:
+            f = await asyncio.to_thread(open, info.path, "wb")
+            try:
+                while True:
+                    m = await stream.get()
+                    if len(m.data):
+                        crc = zlib.crc32(m.data, crc)
+                        total += len(m.data)
+                        await asyncio.to_thread(f.write, m.data)
+                    if m.is_eof:
+                        want = m.header.get("crc32")
+                        if want is not None and want != crc:
+                            raise err.AbnormalData(
+                                f"block {block_id} crc mismatch: "
+                                f"{crc:#x} != {want:#x}")
+                        break
+            finally:
+                await asyncio.to_thread(f.close)
+            self.store.commit(block_id, total)
+            self.metrics.inc("bytes.written", total)
+            return {"block_id": block_id, "len": total, "crc32": crc,
+                    "worker_id": self.worker_id}
+        except Exception:
+            self.store.delete(block_id)
+            raise
+        finally:
+            conn.close_stream(msg.req_id)
+
+    async def _read_block(self, msg: Message, conn: ServerConn):
+        """Streaming download. Request {block_id, offset, len, chunk_size}.
+        Parity: read_handler.rs."""
+        q = unpack(msg.data) or msg.header
+        info = self.store.get(q["block_id"])
+        offset = q.get("offset", 0)
+        length = q.get("len", -1)
+        chunk_size = q.get("chunk_size", self.chunk_size)
+        end = info.len if length < 0 else min(info.len, offset + length)
+
+        def read_range(f, off, n):
+            f.seek(off)
+            return f.read(n)
+
+        f = await asyncio.to_thread(open, info.path, "rb")
+        try:
+            crc = 0
+            pos = offset
+            while pos < end:
+                n = min(chunk_size, end - pos)
+                chunk = await asyncio.to_thread(read_range, f, pos, n)
+                if not chunk:
+                    break
+                crc = zlib.crc32(chunk, crc)
+                pos += len(chunk)
+                await conn.send(response_for(
+                    msg, data=chunk, flags=Flags.RESPONSE | Flags.CHUNK))
+            await conn.send(response_for(
+                msg, header={"crc32": crc, "len": pos - offset},
+                flags=Flags.RESPONSE | Flags.EOF))
+            self.metrics.inc("bytes.read", pos - offset)
+        finally:
+            await asyncio.to_thread(f.close)
+        return None
+
+    async def _delete_block(self, msg: Message, conn: ServerConn):
+        q = unpack(msg.data) or {}
+        self.store.delete(q["block_id"])
+        return {}
+
+    async def _get_block_info(self, msg: Message, conn: ServerConn):
+        """Metadata + local path (enables client short-circuit reads)."""
+        q = unpack(msg.data) or {}
+        info = self.store.get(q["block_id"])
+        return {"block_id": info.block_id, "len": info.len,
+                "storage_type": int(info.tier.storage_type),
+                "path": os.path.abspath(info.path)}
+
+    async def _replicate_block(self, msg: Message, conn: ServerConn):
+        """Pull a block replica from a peer worker and report to master.
+        Parity: worker/replication/replication_job.rs (pull-based)."""
+        q = unpack(msg.data) or {}
+        block_id = q["block_id"]
+        src = WorkerAddress.from_wire(q["source"])
+        ok, message = True, ""
+        try:
+            if not self.store.contains(block_id):
+                peer = await self.peer_pool.get(
+                    f"{src.ip_addr or src.hostname}:{src.rpc_port}")
+                info = self.store.create_temp(block_id,
+                                              size_hint=q.get("block_len", 0))
+                total = 0
+                f = await asyncio.to_thread(open, info.path, "wb")
+                try:
+                    async for m in peer.call_stream(
+                            RpcCode.READ_BLOCK, header={"block_id": block_id}):
+                        if len(m.data):
+                            await asyncio.to_thread(f.write, m.data)
+                            total += len(m.data)
+                finally:
+                    await asyncio.to_thread(f.close)
+                self.store.commit(block_id, total)
+                # tell master about the new replica via commit on next report;
+                # also push an immediate incremental report
+                mc = await self._master_conn()
+                await mc.call(RpcCode.WORKER_BLOCK_REPORT, data=pack({
+                    "worker_id": self.worker_id,
+                    "blocks": {block_id: total},
+                    "storage_types": {block_id: int(info.tier.storage_type)},
+                    "incremental": True}))
+        except Exception as e:  # noqa: BLE001
+            ok, message = False, str(e)
+            self.store.delete(block_id)
+        try:
+            mc = await self._master_conn()
+            await mc.call(RpcCode.REPORT_BLOCK_REPLICATION_RESULT, data=pack({
+                "block_id": block_id, "worker_id": self.worker_id,
+                "success": ok, "message": message}))
+        except Exception as e:
+            log.warning("replication result report failed: %s", e)
+        return {"success": ok, "message": message}
+
+    async def _submit_task(self, msg: Message, conn: ServerConn):
+        q = unpack(msg.data) or {}
+        task = TaskInfo.from_wire(q["task"])
+        asyncio.ensure_future(self._run_load_task(task))
+        return {"accepted": True}
+
+    async def _run_load_task(self, task: TaskInfo) -> None:
+        """UFS → cache transfer. Parity: worker/task/load_task_runner.rs."""
+        from curvine_tpu.client import CurvineClient
+        async with self._task_sem:
+            client = CurvineClient(self.conf)
+            try:
+                n = await client.load_from_ufs(task.path)
+                task.state = JobState.COMPLETED
+                task.loaded_len = n
+            except Exception as e:  # noqa: BLE001
+                task.state = JobState.FAILED
+                task.message = str(e)
+                log.warning("load task %s failed: %s", task.task_id, e)
+            finally:
+                task.worker_id = self.worker_id
+                try:
+                    mc = await self._master_conn()
+                    await mc.call(RpcCode.REPORT_TASK,
+                                  data=pack({"task": task.to_wire()}))
+                except Exception as e:
+                    log.warning("task report failed: %s", e)
+                await client.close()

@@ -1,0 +1,221 @@
+"""Tiered block storage.
+
+Parity: curvine-server/src/worker/storage/ (vfs_dataset, vfs_dir, dir_state,
+file_layout) + worker/block/block_store.rs. Tiers are ordered fastest-first
+(MEM > SSD > HDD); a block is created on the fastest tier with room, spills
+downward under pressure, and is evicted LRU when every tier is full.
+Block files live in hashed subdirs (``<root>/<id % 256>/<id>.blk``), temp
+files alongside (``.tmp``) renamed on commit — same layout discipline as
+the reference's file_layout.rs."""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.common.types import BlockState, StorageInfo, StorageType
+
+log = logging.getLogger(__name__)
+
+_SUBDIRS = 256
+
+
+@dataclass
+class BlockInfo:
+    block_id: int
+    tier: "TierDir"
+    len: int = 0
+    state: BlockState = BlockState.TEMP
+    atime: float = field(default_factory=time.time)
+
+    @property
+    def path(self) -> str:
+        suffix = ".tmp" if self.state == BlockState.TEMP else ".blk"
+        return self.tier.block_path(self.block_id, suffix)
+
+
+class TierDir:
+    def __init__(self, storage_type: StorageType, root: str, capacity: int,
+                 dir_id: str = ""):
+        self.storage_type = storage_type
+        self.root = root
+        self.capacity = capacity
+        self.used = 0
+        self.dir_id = dir_id or f"{storage_type.name.lower()}:{root}"
+        os.makedirs(root, exist_ok=True)
+
+    def block_path(self, block_id: int, suffix: str = ".blk") -> str:
+        sub = os.path.join(self.root, f"{block_id % _SUBDIRS:02x}")
+        os.makedirs(sub, exist_ok=True)
+        return os.path.join(sub, f"{block_id}{suffix}")
+
+    @property
+    def available(self) -> int:
+        return max(0, self.capacity - self.used)
+
+    def info(self, block_num: int = 0) -> StorageInfo:
+        return StorageInfo(storage_type=self.storage_type, dir_id=self.dir_id,
+                           capacity=self.capacity, available=self.available,
+                           block_num=block_num)
+
+
+class BlockStore:
+    """Thread-safe tiered store (handlers run on the event loop; file IO in
+    worker threads)."""
+
+    def __init__(self, tiers: list[TierDir], high_water: float = 0.95,
+                 low_water: float = 0.80):
+        if not tiers:
+            raise err.InvalidArgument("worker needs at least one tier")
+        self.tiers = sorted(tiers, key=lambda t: int(t.storage_type))
+        self.blocks: dict[int, BlockInfo] = {}
+        self.high_water = high_water
+        self.low_water = low_water
+        self._lock = threading.Lock()
+        self._load_existing()
+
+    def _load_existing(self) -> None:
+        """Rebuild the index from disk (worker restart)."""
+        for tier in self.tiers:
+            for sub in os.listdir(tier.root):
+                subdir = os.path.join(tier.root, sub)
+                if not os.path.isdir(subdir):
+                    continue
+                for name in os.listdir(subdir):
+                    full = os.path.join(subdir, name)
+                    if name.endswith(".tmp"):
+                        os.unlink(full)  # torn write from a previous run
+                        continue
+                    if not name.endswith(".blk"):
+                        continue
+                    bid = int(name[:-4])
+                    size = os.path.getsize(full)
+                    self.blocks[bid] = BlockInfo(block_id=bid, tier=tier,
+                                                 len=size,
+                                                 state=BlockState.COMMITTED)
+                    tier.used += size
+        if self.blocks:
+            log.info("block store recovered %d blocks", len(self.blocks))
+
+    # ---------- lifecycle ----------
+    def pick_tier(self, hint: StorageType | None, size_hint: int) -> TierDir:
+        # Preferred tier first, then any tier fastest-first with room.
+        ordered = self.tiers
+        if hint is not None:
+            ordered = ([t for t in self.tiers if t.storage_type == hint]
+                       + [t for t in self.tiers if t.storage_type != hint])
+        for tier in ordered:
+            if tier.available >= size_hint:
+                return tier
+        # under pressure: evict on the preferred tier
+        tier = ordered[0]
+        self.evict(tier, size_hint)
+        if tier.available < size_hint:
+            raise err.CapacityExceeded(
+                f"tier {tier.dir_id}: need {size_hint}, have {tier.available}")
+        return tier
+
+    def create_temp(self, block_id: int, hint: StorageType | None = None,
+                    size_hint: int = 0) -> BlockInfo:
+        with self._lock:
+            if block_id in self.blocks:
+                old = self.blocks[block_id]
+                if old.state == BlockState.COMMITTED:
+                    raise err.FileAlreadyExists(f"block {block_id} committed")
+                self._remove_locked(old)
+            tier = self.pick_tier(hint, size_hint)
+            info = BlockInfo(block_id=block_id, tier=tier)
+            self.blocks[block_id] = info
+            return info
+
+    def commit(self, block_id: int, length: int) -> BlockInfo:
+        with self._lock:
+            info = self._get_locked(block_id)
+            if info.state == BlockState.COMMITTED:
+                return info
+            tmp = info.path
+            info.state = BlockState.COMMITTED
+            info.len = length
+            os.replace(tmp, info.path)
+            info.tier.used += length
+            return info
+
+    def get(self, block_id: int, touch: bool = True) -> BlockInfo:
+        with self._lock:
+            info = self._get_locked(block_id)
+            if touch:
+                info.atime = time.time()
+            return info
+
+    def contains(self, block_id: int) -> bool:
+        return block_id in self.blocks
+
+    def delete(self, block_id: int) -> None:
+        with self._lock:
+            info = self.blocks.get(block_id)
+            if info is not None:
+                self._remove_locked(info)
+
+    def _remove_locked(self, info: BlockInfo) -> None:
+        try:
+            os.unlink(info.path)
+        except FileNotFoundError:
+            pass
+        if info.state == BlockState.COMMITTED:
+            info.tier.used -= info.len
+        self.blocks.pop(info.block_id, None)
+
+    def _get_locked(self, block_id: int) -> BlockInfo:
+        info = self.blocks.get(block_id)
+        if info is None:
+            raise err.BlockNotFound(f"block {block_id}")
+        return info
+
+    # ---------- eviction ----------
+    def evict(self, tier: TierDir, need: int) -> list[int]:
+        """LRU-evict committed blocks from `tier` until `need` fits or the
+        low-water mark is reached. Returns evicted block ids."""
+        target_free = max(need, int(tier.capacity * (1 - self.low_water)))
+        victims = sorted(
+            (b for b in self.blocks.values()
+             if b.tier is tier and b.state == BlockState.COMMITTED),
+            key=lambda b: b.atime)
+        evicted = []
+        for b in victims:
+            if tier.available >= target_free:
+                break
+            self._remove_locked(b)
+            evicted.append(b.block_id)
+        if evicted:
+            log.info("evicted %d blocks from %s", len(evicted), tier.dir_id)
+        return evicted
+
+    def maybe_evict(self) -> list[int]:
+        """Background check: any tier above high-water gets trimmed."""
+        out = []
+        with self._lock:
+            for tier in self.tiers:
+                if tier.capacity and tier.used > tier.capacity * self.high_water:
+                    out.extend(self.evict(tier, 0))
+        return out
+
+    # ---------- reporting ----------
+    def storages(self) -> list[StorageInfo]:
+        counts: dict[str, int] = {}
+        for b in self.blocks.values():
+            counts[b.tier.dir_id] = counts.get(b.tier.dir_id, 0) + 1
+        return [t.info(counts.get(t.dir_id, 0)) for t in self.tiers]
+
+    def report(self) -> tuple[dict[int, int], dict[int, int]]:
+        """(block_id → len, block_id → storage_type) for committed blocks."""
+        held, types = {}, {}
+        with self._lock:
+            for b in self.blocks.values():
+                if b.state == BlockState.COMMITTED:
+                    held[b.block_id] = b.len
+                    types[b.block_id] = int(b.tier.storage_type)
+        return held, types

@@ -1,0 +1,40 @@
+"""Test config: JAX pinned to a virtual 8-device CPU mesh (multi-chip
+sharding tests run without TPU hardware), asyncio helpers."""
+
+import os
+
+# Must be set before jax import anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import asyncio
+import inspect
+
+import pytest
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers",
+                            "asyncio_plain: async test run via asyncio.run")
+
+
+def pytest_collection_modifyitems(items):
+    for item in items:
+        if inspect.iscoroutinefunction(item.function):
+            item.add_marker(pytest.mark.asyncio_plain)
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    """Minimal asyncio runner: any `async def test_*` runs in a fresh loop
+    (no pytest-asyncio dependency in the image)."""
+    fn = pyfuncitem.function
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {name: pyfuncitem.funcargs[name]
+                  for name in pyfuncitem._fixtureinfo.argnames}
+        asyncio.run(fn(**kwargs))
+        return True
+    return None

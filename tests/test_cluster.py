@@ -1,0 +1,168 @@
+"""Integration: mini-cluster end-to-end fs + block paths.
+
+Mirrors reference tests: curvine-tests/tests/cluster_test.rs,
+curvine-server/tests/master_fs_test.rs, worker_test.rs."""
+
+import asyncio
+import os
+
+import pytest
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.common.types import SetAttrOpts
+from curvine_tpu.testing import MiniCluster
+
+MB = 1024 * 1024
+
+
+async def test_fs_crud():
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.meta.mkdir("/a/b/c")
+        assert await c.meta.exists("/a/b/c")
+        st = await c.meta.file_status("/a/b")
+        assert st.is_dir
+        ls = await c.meta.list_status("/a")
+        assert [s.name for s in ls] == ["b"]
+
+        await c.meta.rename("/a/b", "/a/z")
+        assert await c.meta.exists("/a/z/c")
+        assert not await c.meta.exists("/a/b")
+
+        with pytest.raises(err.DirNotEmpty):
+            await c.meta.delete("/a")
+        await c.meta.delete("/a", recursive=True)
+        assert not await c.meta.exists("/a")
+
+        with pytest.raises(err.FileNotFound):
+            await c.meta.file_status("/nope")
+
+
+async def test_write_read_roundtrip():
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        data = os.urandom(3 * MB)
+        await c.write_all("/f1", data)
+        st = await c.meta.file_status("/f1")
+        assert st.len == len(data) and st.is_complete
+        r = await c.open("/f1")
+        assert await r.read_all() == data
+        # ranged read
+        assert await r.pread(100, 1000) == data[100:1100]
+        # sequential chunked
+        got = bytearray()
+        async for ch in (await c.open("/f1")).chunks(256 * 1024):
+            got += ch
+        assert bytes(got) == data
+
+
+async def test_multi_block_file():
+    async with MiniCluster(workers=1, block_size=1 * MB) as mc:
+        c = mc.client()
+        data = os.urandom(3 * MB + 12345)   # spans 4 blocks
+        await c.write_all("/big", data)
+        fb = await c.meta.get_block_locations("/big")
+        assert len(fb.block_locs) == 4
+        assert sum(b.block.len for b in fb.block_locs) == len(data)
+        r = await c.open("/big")
+        assert await r.read_all() == data
+        # read across block boundary
+        assert await r.pread(MB - 10, 20) == data[MB - 10:MB + 10]
+
+
+async def test_append():
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.write_all("/ap", b"hello ")
+        w = await c.append("/ap")
+        await w.write(b"world")
+        await w.close()
+        assert await (await c.open("/ap")).read_all() == b"hello world"
+
+
+async def test_overwrite_and_delete_file():
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.write_all("/x", b"one")
+        with pytest.raises(err.FileAlreadyExists):
+            await c.meta.create_file("/x")
+        await c.write_all("/x", b"two-longer")
+        assert await (await c.open("/x")).read_all() == b"two-longer"
+        await c.meta.delete("/x")
+        assert not await c.meta.exists("/x")
+
+
+async def test_set_attr_and_symlink():
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.write_all("/s", b"data")
+        await c.meta.set_attr("/s", SetAttrOpts(owner="bob", mode=0o600,
+                                                add_x_attr={"k": b"v"}))
+        st = await c.meta.file_status("/s")
+        assert st.owner == "bob" and st.mode == 0o600
+        assert st.x_attr == {"k": b"v"}
+
+        await c.meta.symlink("/s", "/lnk")
+        st = await c.meta.file_status("/lnk")
+        assert st.target == "/s"
+
+        await c.meta.link("/s", "/hard")
+        st = await c.meta.file_status("/hard")
+        assert st.nlink == 2
+        # deleting one name keeps the data reachable via the other
+        await c.meta.delete("/s")
+        assert await c.meta.exists("/hard")
+
+
+async def test_master_info_and_capacity():
+    async with MiniCluster(workers=2) as mc:
+        c = mc.client()
+        info = await c.meta.master_info()
+        assert len(info.live_workers) == 2
+        assert info.capacity > 0
+        await c.write_all("/cap", os.urandom(1 * MB))
+        info = await c.meta.master_info()
+        assert info.block_num >= 1
+
+
+async def test_replicated_write():
+    async with MiniCluster(workers=2) as mc:
+        c = mc.client()
+        data = os.urandom(1 * MB)
+        await c.write_all("/rep", data, replicas=2)
+        fb = await c.meta.get_block_locations("/rep")
+        assert all(len(b.locs) == 2 for b in fb.block_locs)
+        assert await (await c.open("/rep")).read_all() == data
+
+
+async def test_journal_restart_recovery():
+    mc = MiniCluster(workers=1)
+    async with mc:
+        c = mc.client()
+        await c.meta.mkdir("/keep/me")
+        data = os.urandom(1 * MB)
+        await c.write_all("/keep/f", data)
+        await c.close()
+
+        await mc.restart_master()
+        await mc.await_workers(1)
+        c2 = mc.client()
+        assert await c2.meta.exists("/keep/me")
+        st = await c2.meta.file_status("/keep/f")
+        assert st.len == len(data)
+        # block locations come back via worker re-report/heartbeat
+        await mc.workers[0].block_report_once()
+        r = await c2.open("/keep/f")
+        assert await r.read_all() == data
+
+
+async def test_free_releases_cache():
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.write_all("/fr", os.urandom(1 * MB))
+        freed = await c.meta.free("/fr")
+        assert freed == 1
+        st = await c.meta.file_status("/fr")
+        assert st.len == 1 * MB       # metadata kept
+        fb = await c.meta.get_block_locations("/fr")
+        assert fb.block_locs == []    # cache dropped

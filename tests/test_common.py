@@ -1,0 +1,149 @@
+"""Unit tests: types wire roundtrip, path, conf, errors, journal, metrics.
+
+Mirrors reference tests: curvine-common/tests/ (proto roundtrips, conf,
+fs_error) and journal_test.rs."""
+
+import os
+
+import pytest
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.common.conf import ClusterConf
+from curvine_tpu.common.journal import Journal
+from curvine_tpu.common.metrics import MetricsRegistry
+from curvine_tpu.common.path import Path, norm_path
+from curvine_tpu.common.types import (
+    CommitBlock, ExtendedBlock, FileBlocks, FileStatus, LocatedBlock,
+    MasterInfo, MountInfo, StoragePolicy, StorageType, TtlAction,
+    WorkerAddress, WorkerInfo, StorageInfo,
+)
+
+
+def test_wire_roundtrip():
+    st = FileStatus(id=7, path="/a/b", name="b", len=123, replicas=2,
+                    storage_policy=StoragePolicy(storage_type=StorageType.SSD,
+                                                 ttl_ms=1000,
+                                                 ttl_action=TtlAction.FREE),
+                    x_attr={"k": b"v"})
+    d = st.to_wire()
+    back = FileStatus.from_wire(d)
+    assert back == st
+    assert back.storage_policy.storage_type == StorageType.SSD
+
+    lb = LocatedBlock(block=ExtendedBlock(id=5, len=10),
+                      locs=[WorkerAddress(worker_id=1, hostname="h",
+                                          rpc_port=1234)],
+                      storage_types=[StorageType.MEM])
+    fb = FileBlocks(status=st, block_locs=[lb])
+    back = FileBlocks.from_wire(fb.to_wire())
+    assert back.block_locs[0].locs[0].rpc_port == 1234
+    assert back.block_locs[0].storage_types == [StorageType.MEM]
+
+    wi = WorkerInfo(address=WorkerAddress(worker_id=9),
+                    storages=[StorageInfo(capacity=100, available=40)],
+                    ici_coords=[1, 2])
+    mi = MasterInfo(live_workers=[wi])
+    back = MasterInfo.from_wire(mi.to_wire())
+    assert back.live_workers[0].address.worker_id == 9
+    assert back.live_workers[0].capacity == 100
+
+
+def test_path():
+    p = Path("cv://host:99/a/b/c")
+    assert p.scheme == "cv" and p.authority == "host:99"
+    assert p.path == "/a/b/c" and p.name == "c"
+    assert p.parent().path == "/a/b"
+    assert Path("/x/../y").path == "/y"
+    assert Path("/a//b/./c").path == "/a/b/c"
+    assert Path("/").is_root and Path("/").components() == []
+    assert norm_path("s3://bucket/k") == "/k"
+    with pytest.raises(err.InvalidPath):
+        Path("relative/path")
+    with pytest.raises(err.InvalidPath):
+        Path("/a/../../b")
+    assert Path("/a").join("b", "c").path == "/a/b/c"
+
+
+def test_conf_load(tmp_path):
+    f = tmp_path / "curvine.toml"
+    f.write_text("""
+cluster_name = "t1"
+[master]
+rpc_port = 7777
+[worker]
+hostname = "w1"
+[[worker.tiers]]
+storage_type = "ssd"
+dir = "/tmp/ssd"
+capacity = 1024
+[client]
+block_size = 1048576
+""")
+    c = ClusterConf.load(str(f))
+    assert c.cluster_name == "t1"
+    assert c.master.rpc_port == 7777
+    assert c.worker.tiers[0].storage_type == "ssd"
+    assert c.worker.tiers[0].capacity == 1024
+    assert c.client.block_size == 1048576
+
+
+def test_error_taxonomy():
+    e = err.CurvineError.from_wire(int(err.ErrorCode.FILE_NOT_FOUND), "gone")
+    assert isinstance(e, err.FileNotFound)
+    assert not e.retryable
+    assert err.RpcTimeout("t").retryable
+    assert err.NotLeader("n").retryable
+
+
+def test_journal_replay(tmp_path):
+    j = Journal(str(tmp_path / "j"))
+    for i in range(10):
+        j.append("op", {"i": i})
+    j.close()
+
+    j2 = Journal(str(tmp_path / "j"))
+    snap, entries = j2.recover()
+    assert snap is None
+    assert [a["i"] for _, _, a in entries] == list(range(10))
+    assert j2.seq == 10
+    # continue appending, snapshot, more entries
+    j2.append("op", {"i": 10})
+    j2.write_snapshot({"state": "s11"})
+    j2.append("op", {"i": 11})
+    j2.close()
+
+    j3 = Journal(str(tmp_path / "j"))
+    snap, entries = j3.recover()
+    assert snap == {"state": "s11"}
+    assert [a["i"] for _, _, a in entries] == [11]
+
+
+def test_journal_torn_tail(tmp_path):
+    j = Journal(str(tmp_path / "j"))
+    j.append("op", {"i": 0})
+    j.append("op", {"i": 1})
+    j.close()
+    # corrupt: truncate mid-entry
+    seg = [f for f in os.listdir(j.dir) if f.startswith("edits-")][0]
+    full = os.path.join(j.dir, seg)
+    size = os.path.getsize(full)
+    with open(full, "ab") as f:
+        f.truncate(size - 3)
+    j2 = Journal(str(tmp_path / "j"))
+    _, entries = j2.recover()
+    assert [a["i"] for _, _, a in entries] == [0]
+
+
+def test_metrics():
+    m = MetricsRegistry("test")
+    m.inc("reqs")
+    m.inc("reqs", 2)
+    m.gauge("cap", 5)
+    with m.timer("lat"):
+        pass
+    text = m.prometheus_text()
+    assert "curvine_test_reqs 3" in text
+    assert "curvine_test_cap 5" in text
+    assert "curvine_test_lat_count 1" in text
+    snap = m.snapshot()
+    assert snap["counters"]["reqs"] == 3

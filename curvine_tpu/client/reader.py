@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import mmap
 import os
 
 from curvine_tpu.common import errors as err
@@ -33,6 +34,7 @@ class FsReader:
         self.pos = 0
         self.len = file_blocks.status.len
         self._local_paths: dict[int, str | None] = {}
+        self._mmaps: dict[int, mmap.mmap] = {}
 
     # ---------------- positioning ----------------
 
@@ -74,7 +76,8 @@ class FsReader:
                         f"{loc.ip_addr or loc.hostname}:{loc.rpc_port}")
                     rep = await conn.call(RpcCode.GET_BLOCK_INFO,
                                           data=pack({"block_id": bid}))
-                    p = (unpack(rep.data) or {}).get("path")
+                    info = rep.header or unpack(rep.data) or {}
+                    p = info.get("path")
                     if p and os.path.exists(p):
                         path = p
                 except err.CurvineError as e:
@@ -90,7 +93,11 @@ class FsReader:
         n = min(n, self.len - self.pos)
         if n <= 0:
             return b""
-        out = bytearray()
+        first = await self._read_some(self.pos, n)
+        self.pos += len(first)
+        if len(first) == n or not first:
+            return first          # common case: one block segment, no copy
+        out = bytearray(first)
         while len(out) < n:
             got = await self._read_some(self.pos, n - len(out))
             if not got:
@@ -113,6 +120,32 @@ class FsReader:
             out += got
         return bytes(out)
 
+    def _mmap_for(self, block_id: int, path: str) -> mmap.mmap:
+        mm = self._mmaps.get(block_id)
+        if mm is None:
+            with open(path, "rb") as f:
+                mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+            self._mmaps[block_id] = mm
+        return mm
+
+    async def mmap_view(self, offset: int, n: int):
+        """Zero-copy numpy view over a co-located block file (short-circuit
+        fast path for device ingest: feed this straight to jax.device_put).
+        Returns None when the range isn't short-circuit readable; the view
+        is valid until the reader is closed."""
+        import numpy as np
+        located = self._locate(offset)
+        if located is None:
+            return None
+        lb, block_off = located
+        if block_off + n > lb.block.len:
+            return None
+        local = await self._local_path(lb)
+        if local is None:
+            return None
+        mm = self._mmap_for(lb.block.id, local)
+        return np.frombuffer(mm, dtype=np.uint8, count=n, offset=block_off)
+
     async def _read_some(self, offset: int, n: int) -> bytes:
         located = self._locate(offset)
         if located is None:
@@ -121,7 +154,8 @@ class FsReader:
         n = min(n, lb.block.len - block_off)
         local = await self._local_path(lb)
         if local is not None:
-            return await asyncio.to_thread(_pread_file, local, block_off, n)
+            mm = self._mmap_for(lb.block.id, local)
+            return mm[block_off:block_off + n]
         loc = self._pick_loc(lb)
         conn = await self.pool.get(
             f"{loc.ip_addr or loc.hostname}:{loc.rpc_port}")
@@ -144,10 +178,6 @@ class FsReader:
             yield data
 
     async def close(self) -> None:
-        return None
-
-
-def _pread_file(path: str, offset: int, n: int) -> bytes:
-    with open(path, "rb") as f:
-        f.seek(offset)
-        return f.read(n)
+        for mm in self._mmaps.values():
+            mm.close()
+        self._mmaps.clear()

@@ -58,7 +58,7 @@ class WorkerConf:
     tiers: list[TierConf] = field(default_factory=lambda: [TierConf()])
     heartbeat_ms: int = 3_000
     block_report_interval_ms: int = 60_000
-    io_chunk_size: int = 512 * 1024
+    io_chunk_size: int = 4 * MB
     # eviction watermarks (fraction of tier capacity)
     eviction_high_water: float = 0.95
     eviction_low_water: float = 0.80
@@ -74,8 +74,8 @@ class ClientConf:
     master_addrs: list[str] = field(default_factory=lambda: ["127.0.0.1:8995"])
     block_size: int = 64 * MB
     replicas: int = 1
-    write_chunk_size: int = 512 * 1024
-    read_chunk_size: int = 512 * 1024
+    write_chunk_size: int = 4 * MB
+    read_chunk_size: int = 4 * MB
     read_ahead_chunks: int = 4
     short_circuit: bool = True
     storage_type: str = "mem"

@@ -1,0 +1,5 @@
+"""TPU-native layer: HBM tier-0 cache, device ingest pipelines, ICI mesh
+topology, sharded loaders, ring attention, checkpoint broadcast.
+
+This package replaces the reference's GPU-adjacent data paths
+(cudaMemcpy/pinned-host streaming) with JAX/XLA-native ones."""

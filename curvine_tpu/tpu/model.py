@@ -1,0 +1,188 @@
+"""Demo training consumer: a pure-JAX decoder-only transformer LM.
+
+This is the flagship compute consumer of the cache (fed by
+curvine_tpu.tpu.loader): bf16 matmuls for the MXU, TP×DP×SP sharding via
+NamedSharding + jit (XLA inserts the collectives), ring attention
+(shard_map/ppermute) for the long-context path, optax AdamW training step.
+
+Sharding recipe (Megatron-style TP over the ``model`` axis):
+  embed [V, D]        → P(None, 'model')
+  wq/wk/wv [D, D]     → P(None, 'model')   (heads sharded)
+  wo [D, D]           → P('model', None)
+  mlp w1 [D, F]       → P(None, 'model')
+  mlp w2 [F, D]       → P('model', None)
+  activations [B,L,D] → P('data', 'seq', None)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from curvine_tpu.tpu.ring_attention import dense_attention, ring_attention_sharded
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    vocab: int = 32_000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 2048
+    max_seq: int = 2048
+    dtype: str = "bfloat16"
+    use_ring_attention: bool = False
+    remat: bool = False        # jax.checkpoint each layer (HBM for FLOPs)
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def jax_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @staticmethod
+    def tiny() -> "ModelConfig":
+        return ModelConfig(vocab=256, d_model=64, n_heads=4, n_layers=2,
+                           d_ff=128, max_seq=128)
+
+
+def init_params(rng, cfg: ModelConfig) -> dict:
+    dt = cfg.jax_dtype()
+    keys = jax.random.split(rng, 2 + cfg.n_layers)
+
+    def dense(key, fan_in, shape):
+        return (jax.random.normal(key, shape) / np.sqrt(fan_in)).astype(dt)
+
+    layers = []
+    for i in range(cfg.n_layers):
+        k = jax.random.split(keys[2 + i], 6)
+        layers.append({
+            "ln1": jnp.ones(cfg.d_model, dt),
+            "wq": dense(k[0], cfg.d_model, (cfg.d_model, cfg.d_model)),
+            "wk": dense(k[1], cfg.d_model, (cfg.d_model, cfg.d_model)),
+            "wv": dense(k[2], cfg.d_model, (cfg.d_model, cfg.d_model)),
+            "wo": dense(k[3], cfg.d_model, (cfg.d_model, cfg.d_model)),
+            "ln2": jnp.ones(cfg.d_model, dt),
+            "w1": dense(k[4], cfg.d_model, (cfg.d_model, cfg.d_ff)),
+            "w2": dense(k[5], cfg.d_ff, (cfg.d_ff, cfg.d_model)),
+        })
+    return {
+        "embed": dense(keys[0], cfg.d_model, (cfg.vocab, cfg.d_model)),
+        "pos": dense(keys[1], cfg.d_model, (cfg.max_seq, cfg.d_model)),
+        "ln_f": jnp.ones(cfg.d_model, dt),
+        "layers": layers,
+    }
+
+
+def _rmsnorm(x, scale):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale
+
+
+def _attention(x, layer, cfg: ModelConfig, mesh: Mesh | None):
+    B, L, D = x.shape
+    H, hd = cfg.n_heads, cfg.head_dim
+    q = (x @ layer["wq"]).reshape(B, L, H, hd).transpose(0, 2, 1, 3)
+    k = (x @ layer["wk"]).reshape(B, L, H, hd).transpose(0, 2, 1, 3)
+    v = (x @ layer["wv"]).reshape(B, L, H, hd).transpose(0, 2, 1, 3)
+    if cfg.use_ring_attention and mesh is not None and "seq" in mesh.axis_names:
+        o = ring_attention_sharded(q, k, v, mesh, axis_name="seq", causal=True)
+    else:
+        o = dense_attention(q, k, v, causal=True)
+    o = o.transpose(0, 2, 1, 3).reshape(B, L, D)
+    return o @ layer["wo"]
+
+
+def _block(x, layer, cfg: ModelConfig, mesh: Mesh | None):
+    x = x + _attention(_rmsnorm(x, layer["ln1"]), layer, cfg, mesh)
+    h = _rmsnorm(x, layer["ln2"])
+    h = jax.nn.gelu(h @ layer["w1"]) @ layer["w2"]
+    return x + h
+
+
+def forward(params: dict, tokens, cfg: ModelConfig,
+            mesh: Mesh | None = None):
+    """tokens [B, L] int32 → logits [B, L, V] (dtype f32)."""
+    B, L = tokens.shape
+    x = params["embed"][tokens] + params["pos"][:L]
+    block = _block
+    if cfg.remat:
+        block = jax.checkpoint(_block, static_argnums=(2,))
+    for layer in params["layers"]:
+        x = block(x, layer, cfg, mesh)
+    x = _rmsnorm(x, params["ln_f"])
+    return (x @ params["embed"].T).astype(jnp.float32)
+
+
+def loss_fn(params, tokens, cfg: ModelConfig, mesh: Mesh | None = None):
+    """Next-token cross entropy; last position predicts nothing."""
+    logits = forward(params, tokens, cfg, mesh)
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+def make_optimizer(lr: float = 3e-4):
+    return optax.adamw(lr, weight_decay=0.01)
+
+
+def make_train_step(cfg: ModelConfig, optimizer=None,
+                    mesh: Mesh | None = None):
+    optimizer = optimizer or make_optimizer()
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, mesh)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step
+
+
+# ---------------- shardings ----------------
+
+_PARAM_SPECS = {
+    "embed": P(None, "model"),
+    "pos": P(None, None),
+    "ln_f": P(None),
+    "ln1": P(None), "ln2": P(None),
+    "wq": P(None, "model"), "wk": P(None, "model"), "wv": P(None, "model"),
+    "wo": P("model", None),
+    "w1": P(None, "model"), "w2": P("model", None),
+}
+
+
+def param_spec_tree(params: dict) -> dict:
+    """PartitionSpec pytree matching init_params structure."""
+    def spec_of(path_leaf):
+        return _PARAM_SPECS.get(path_leaf, P())
+
+    return {
+        "embed": spec_of("embed"), "pos": spec_of("pos"),
+        "ln_f": spec_of("ln_f"),
+        "layers": [{k: spec_of(k) for k in layer}
+                   for layer in params["layers"]],
+    }
+
+
+def shard_params(params: dict, mesh: Mesh) -> dict:
+    specs = param_spec_tree(params)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs,
+        is_leaf=lambda x: isinstance(x, jax.Array))
+
+
+def batch_spec(mesh: Mesh) -> P:
+    """tokens [B, L]: batch over data, seq over seq (when present)."""
+    seq = "seq" if "seq" in mesh.axis_names else None
+    return P("data", seq)

@@ -12,7 +12,7 @@ import logging
 import mmap
 import os
 
-from curvine_tpu.common import errors as err
+from curvine_tpu.common import errors as err  # noqa: F401
 from curvine_tpu.common.types import FileBlocks, LocatedBlock
 from curvine_tpu.rpc import RpcCode
 from curvine_tpu.rpc.client import ConnectionPool
@@ -156,12 +156,38 @@ class FsReader:
         if local is not None:
             mm = self._mmap_for(lb.block.id, local)
             return mm[block_off:block_off + n]
-        loc = self._pick_loc(lb)
+        # failover across replica locations (local-first ordering)
+        preferred = self._pick_loc(lb)
+        locs = [preferred] + [l for l in lb.locs if l is not preferred]
+        last_err: Exception | None = None
+        for loc in locs:
+            try:
+                return await self._read_from(loc, lb.block.id, block_off, n)
+            except err.CurvineError as e:
+                log.warning("read block %d from %s:%d failed (%s), "
+                            "trying next replica", lb.block.id,
+                            loc.hostname, loc.rpc_port, e)
+                last_err = e
+        # all replicas failed: refresh locations from the master once
+        self.blocks = await self.fs.get_block_locations(self.path)
+        refreshed = self._locate(offset)
+        if refreshed is not None and refreshed[0].locs:
+            lb2, off2 = refreshed
+            for loc in lb2.locs:
+                try:
+                    return await self._read_from(loc, lb2.block.id, off2,
+                                                 min(n, lb2.block.len - off2))
+                except err.CurvineError as e:
+                    last_err = e
+        raise last_err or err.BlockNotFound(f"block {lb.block.id} unreadable")
+
+    async def _read_from(self, loc, block_id: int, offset: int,
+                         n: int) -> bytes:
         conn = await self.pool.get(
             f"{loc.ip_addr or loc.hostname}:{loc.rpc_port}")
         out = bytearray()
         async for m in conn.call_stream(RpcCode.READ_BLOCK, header={
-                "block_id": lb.block.id, "offset": block_off, "len": n,
+                "block_id": block_id, "offset": offset, "len": n,
                 "chunk_size": self.chunk_size}):
             if len(m.data):
                 out += m.data

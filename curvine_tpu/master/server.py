@@ -210,6 +210,9 @@ class MasterServer:
 
     def _set_attr(self, q):
         self.fs.set_attr(q["path"], SetAttrOpts.from_wire(q.get("opts", {})))
+        node = self.fs.tree.resolve(q["path"])
+        if node is not None:
+            self.ttl.index(node.id, node.mtime, node.storage_policy.ttl_ms)
         return {}
 
     def _symlink(self, q):

@@ -67,11 +67,19 @@ class TtlManager:
             if node.storage_policy.ttl_ms > 0:
                 self.index(node.id, node.mtime, node.storage_policy.ttl_ms)
 
-    async def run(self) -> None:
+    async def run(self, rescan_every_s: float = 30.0) -> None:
         self.rescan()
+        last_rescan = 0.0
+        ticks = 0
         while True:
             await asyncio.sleep(self.check_ms / 1000)
             try:
+                ticks += self.check_ms / 1000
+                if ticks - last_rescan >= rescan_every_s:
+                    # safety net for files whose ttl changed without an
+                    # index() hook call (e.g. journal replay paths)
+                    self.rescan()
+                    last_rescan = ticks
                 self.check(now_ms())
             except Exception:
                 log.exception("ttl checker")

@@ -1,0 +1,181 @@
+"""Worker loss, re-replication, TTL expiry, cache eviction.
+
+Mirrors reference tests: curvine-server/tests/worker_manager_test.rs,
+replication paths, ttl (meta/inode/ttl/), quota eviction."""
+
+import asyncio
+import os
+
+import pytest
+
+from curvine_tpu.common.types import (
+    JobState, SetAttrOpts, StorageType, TtlAction, now_ms,
+)
+from curvine_tpu.master.placement import IciPolicy, create_policy, ici_hops
+from curvine_tpu.testing import MiniCluster
+from curvine_tpu.worker.storage import BlockStore, TierDir
+
+MB = 1024 * 1024
+
+
+async def test_worker_loss_detection():
+    async with MiniCluster(workers=2, lost_timeout_ms=1_000) as mc:
+        c = mc.client()
+        await c.write_all("/f", os.urandom(1 * MB))
+        await mc.kill_worker(1)
+
+        async def wait_lost():
+            while len(mc.master.fs.workers.lost_workers()) < 1:
+                await asyncio.sleep(0.1)
+        await asyncio.wait_for(wait_lost(), 10)
+        info = await c.meta.master_info()
+        assert len(info.live_workers) == 1
+        assert len(info.lost_workers) == 1
+
+
+async def test_rereplication_after_worker_loss():
+    async with MiniCluster(workers=3, lost_timeout_ms=1_000) as mc:
+        mc.master.replication.scan_interval_s = 0.3
+        c = mc.client()
+        data = os.urandom(1 * MB)
+        await c.write_all("/rep", data, replicas=2)
+        fb = await c.meta.get_block_locations("/rep")
+        holder_ids = {w.worker_id for lb in fb.block_locs for w in lb.locs}
+        assert len(holder_ids) == 2
+        # kill one holder
+        victim_idx = next(i for i, w in enumerate(mc.workers)
+                          if w.worker_id in holder_ids)
+        victim_id = mc.workers[victim_idx].worker_id
+        await mc.kill_worker(victim_idx)
+
+        async def wait_lost():
+            while not mc.master.fs.workers.lost_workers():
+                await asyncio.sleep(0.1)
+        await asyncio.wait_for(wait_lost(), 10)
+
+        async def wait_healed():
+            while True:
+                fb = await c.meta.get_block_locations("/rep")
+                live = {w.worker_id for lb in fb.block_locs for w in lb.locs}
+                if len(live) >= 2 and all(
+                        len(lb.locs) >= 2 for lb in fb.block_locs):
+                    return
+                await asyncio.sleep(0.1)
+
+        await asyncio.wait_for(wait_healed(), 20)
+        assert await (await c.open("/rep")).read_all() == data
+
+
+async def test_ttl_delete_and_free():
+    async with MiniCluster(workers=1) as mc:
+        mc.master.ttl.check_ms = 100
+        c = mc.client()
+        await c.write_all("/ttl_del", b"x" * 1000)
+        await c.write_all("/ttl_free", b"y" * 1000)
+        await c.meta.set_attr("/ttl_del", SetAttrOpts(
+            ttl_ms=300, ttl_action=int(TtlAction.DELETE)))
+        await c.meta.set_attr("/ttl_free", SetAttrOpts(
+            ttl_ms=300, ttl_action=int(TtlAction.FREE)))
+
+        async def wait_expired():
+            while await c.meta.exists("/ttl_del"):
+                await asyncio.sleep(0.1)
+        await asyncio.wait_for(wait_expired(), 10)
+        # FREE keeps metadata, drops blocks
+        async def wait_freed():
+            while (await c.meta.get_block_locations("/ttl_free")).block_locs:
+                await asyncio.sleep(0.1)
+        await asyncio.wait_for(wait_freed(), 10)
+        st = await c.meta.file_status("/ttl_free")
+        assert st.len == 1000
+
+
+def test_block_store_eviction(tmp_path):
+    tier = TierDir(StorageType.MEM, str(tmp_path / "mem"), capacity=10 * MB)
+    store = BlockStore([tier], high_water=0.8, low_water=0.5)
+    # fill with 9 x 1MB blocks
+    for bid in range(1, 10):
+        info = store.create_temp(bid, size_hint=MB)
+        with open(info.path, "wb") as f:
+            f.write(b"b" * MB)
+        store.commit(bid, MB)
+    assert tier.used == 9 * MB
+    # touch block 5 so it's MRU
+    store.get(5)
+    evicted = store.maybe_evict()          # above 90% high water
+    assert evicted, "eviction should trigger"
+    assert 5 not in evicted                # MRU survived
+    assert tier.used <= 5 * MB + MB        # trimmed to ~low water
+    # evicted blocks gone from disk
+    for bid in evicted:
+        assert not store.contains(bid)
+
+
+def test_block_store_restart_recovery(tmp_path):
+    tier = TierDir(StorageType.MEM, str(tmp_path / "mem"), capacity=10 * MB)
+    store = BlockStore([tier])
+    info = store.create_temp(1, size_hint=100)
+    with open(info.path, "wb") as f:
+        f.write(b"z" * 100)
+    store.commit(1, 100)
+    # torn temp write
+    info2 = store.create_temp(2, size_hint=100)
+    with open(info2.path, "wb") as f:
+        f.write(b"t" * 10)
+
+    tier2 = TierDir(StorageType.MEM, str(tmp_path / "mem"), capacity=10 * MB)
+    store2 = BlockStore([tier2])
+    assert store2.contains(1)
+    assert not store2.contains(2)          # tmp cleaned
+    held, types = store2.report()
+    assert held == {1: 100}
+
+
+def test_placement_policies():
+    from curvine_tpu.common.types import StorageInfo, WorkerAddress, WorkerInfo
+
+    def mk(i, avail, host=None, coords=None):
+        return WorkerInfo(
+            address=WorkerAddress(worker_id=i, hostname=host or f"h{i}",
+                                  rpc_port=1000 + i),
+            storages=[StorageInfo(capacity=100, available=avail)],
+            ici_coords=coords or [])
+
+    ws = [mk(1, 10), mk(2, 90), mk(3, 50)]
+    for name in ("random", "robin", "local", "weighted", "load"):
+        p = create_policy(name)
+        chosen = p.choose(ws, 2, client_host="h3", needed=1)
+        assert len(chosen) == 2
+        assert len({c.address.worker_id for c in chosen}) == 2
+    # load-based prefers most-available
+    p = create_policy("load")
+    assert p.choose(ws, 1, needed=1)[0].address.worker_id == 2
+    # local prefers the client's host
+    p = create_policy("local")
+    assert p.choose(ws, 1, client_host="h3", needed=1)[0].address.worker_id == 3
+
+    # ici: nearest in torus hops, replicas spread across hosts
+    torus = [mk(1, 50, host="hostA", coords=[0, 0]),
+             mk(2, 50, host="hostA", coords=[0, 1]),
+             mk(3, 50, host="hostB", coords=[3, 3]),
+             mk(4, 50, host="hostC", coords=[1, 0])]
+    p = IciPolicy(mesh_shape=[4, 4])
+    chosen = p.choose(torus, 2, ici_coords=[0, 0], needed=1)
+    assert chosen[0].address.worker_id == 1          # 0 hops
+    assert chosen[1].address.hostname != "hostA"     # host spread
+    assert ici_hops([0, 0], [3, 3], [4, 4]) == 2     # torus wrap 1+1
+
+
+async def test_fs_mode_write_through():
+    from curvine_tpu.ufs import create_ufs
+    from curvine_tpu.ufs import memory as memufs
+    memufs.reset()
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.meta.mount("/wt", "mem://wtb", write_type=1)
+        await c.write_through("/wt/obj.bin", b"persisted")
+        # UFS has it
+        ufs = create_ufs("mem://wtb")
+        assert await ufs.read_all("mem://wtb/obj.bin") == b"persisted"
+        # cache has it
+        assert await (await c.open("/wt/obj.bin")).read_all() == b"persisted"

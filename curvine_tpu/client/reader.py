@@ -205,5 +205,10 @@ class FsReader:
 
     async def close(self) -> None:
         for mm in self._mmaps.values():
-            mm.close()
+            try:
+                mm.close()
+            except BufferError:
+                # zero-copy views handed out (mmap_view) are still alive;
+                # the mapping is released when the last view is dropped
+                pass
         self._mmaps.clear()

@@ -1,0 +1,104 @@
+"""Cache-fed data loaders for JAX training loops.
+
+The consumer-facing piece of the north star: WebDataset-style token shards
+live in the distributed cache (warmed from S3/UFS by load jobs); this
+loader streams them through the short-circuit mmap path into sharded
+device arrays feeding a train step.
+
+Shard format: raw little-endian token arrays (configurable dtype), one
+file per shard, e.g. ``/datasets/train/shard-00000.bin``."""
+
+from __future__ import annotations
+
+import logging
+from typing import AsyncIterator
+
+import numpy as np
+
+from curvine_tpu.client import CurvineClient
+
+log = logging.getLogger(__name__)
+
+
+class CacheShardSource:
+    """Async stream of [batch, seq_len] token batches out of cached shards."""
+
+    def __init__(self, client: CurvineClient, path: str, batch: int,
+                 seq_len: int, dtype=np.int32, shuffle_seed: int | None = None,
+                 drop_remainder: bool = True):
+        self.client = client
+        self.path = path
+        self.batch = batch
+        self.seq_len = seq_len
+        self.dtype = np.dtype(dtype)
+        self.shuffle_seed = shuffle_seed
+        self.drop_remainder = drop_remainder
+
+    async def shards(self) -> list[str]:
+        statuses = await self.client.meta.list_status(self.path)
+        files = sorted(s.path for s in statuses if not s.is_dir)
+        if self.shuffle_seed is not None:
+            rng = np.random.default_rng(self.shuffle_seed)
+            files = list(rng.permutation(files))
+        return files
+
+    async def batches(self) -> AsyncIterator[np.ndarray]:
+        tokens_per_batch = self.batch * self.seq_len
+        carry = np.empty(0, dtype=self.dtype)
+        for shard in await self.shards():
+            reader = await self.client.open(shard)
+            n_tokens = reader.len // self.dtype.itemsize
+            view = await reader.mmap_view(0, n_tokens * self.dtype.itemsize)
+            if view is not None:
+                data = view.view(self.dtype)
+            else:
+                raw = await reader.read_all()
+                data = np.frombuffer(raw, dtype=self.dtype)
+            if carry.size:
+                data = np.concatenate([carry, data])
+                carry = np.empty(0, dtype=self.dtype)
+            usable = (data.size // tokens_per_batch) * tokens_per_batch
+            for off in range(0, usable, tokens_per_batch):
+                yield data[off:off + tokens_per_batch].reshape(
+                    self.batch, self.seq_len)
+            rest = data[usable:]
+            if rest.size:
+                carry = rest.copy()     # own it before the mmap closes
+            await reader.close()
+        if carry.size and not self.drop_remainder:
+            pad = tokens_per_batch - carry.size
+            yield np.pad(carry, (0, pad)).reshape(self.batch, self.seq_len)
+
+
+async def write_token_shards(client: CurvineClient, path: str,
+                             tokens: np.ndarray, shard_tokens: int,
+                             dtype=np.int32) -> list[str]:
+    """Utility: split a token stream into cached shard files."""
+    tokens = tokens.astype(dtype)
+    await client.meta.mkdir(path)
+    out = []
+    for i, off in enumerate(range(0, tokens.size, shard_tokens)):
+        p = f"{path.rstrip('/')}/shard-{i:05d}.bin"
+        await client.write_all(p, tokens[off:off + shard_tokens].tobytes())
+        out.append(p)
+    return out
+
+
+class TpuTrainFeed:
+    """CacheShardSource → AsyncDevicePrefetcher, batch sharded over the
+    mesh 'data' (and 'seq') axes — the full cache→HBM→step pipeline."""
+
+    def __init__(self, client: CurvineClient, path: str, batch: int,
+                 seq_len: int, mesh=None, depth: int = 2, dtype=np.int32):
+        from jax.sharding import PartitionSpec as P
+        from curvine_tpu.tpu.ingest import AsyncDevicePrefetcher
+        self.source = CacheShardSource(client, path, batch, seq_len, dtype)
+        spec = None
+        if mesh is not None:
+            seq = "seq" if "seq" in mesh.axis_names else None
+            spec = P("data", seq)
+        self.prefetcher = AsyncDevicePrefetcher(
+            self.source.batches(), mesh, spec, depth=depth)
+
+    def __aiter__(self):
+        return self.prefetcher

@@ -1,0 +1,3 @@
+from curvine_tpu.cli.main import main
+
+raise SystemExit(main())

@@ -1,0 +1,459 @@
+"""`cv` command-line interface.
+
+Parity: curvine-cli/src/ (cmds/fs/* ls,mkdir,put,get,cat,rm,mv,stat,touch,
+chmod,chown,count,df,du,free,blocks; cmds/report,node,mount,umount,load,
+load_status,load_cancel,bench) plus server daemons (curvine-server bin)."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+from curvine_tpu.common.conf import ClusterConf
+from curvine_tpu.common.types import JobState, SetAttrOpts
+
+
+def _conf(args) -> ClusterConf:
+    conf = ClusterConf.load(getattr(args, "conf", None))
+    if getattr(args, "master", None):
+        conf.client.master_addrs = [args.master]
+    return conf
+
+
+def _human(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024:
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}PiB"
+
+
+def _mode_str(st) -> str:
+    kind = "d" if st.is_dir else ("l" if st.target else "-")
+    bits = "rwxrwxrwx"
+    out = "".join(b if st.mode & (1 << (8 - i)) else "-"
+                  for i, b in enumerate(bits))
+    return kind + out
+
+
+async def _client(args):
+    from curvine_tpu.client import CurvineClient
+    return CurvineClient(_conf(args))
+
+
+# ---------------- fs commands ----------------
+
+async def cmd_ls(args):
+    c = await _client(args)
+    try:
+        for st in await c.meta.list_status(args.path):
+            ts = time.strftime("%Y-%m-%d %H:%M", time.localtime(st.mtime / 1000))
+            print(f"{_mode_str(st)} {st.replicas:>2} {st.owner:>8} "
+                  f"{st.group:>8} {st.len:>12} {ts} {st.path}")
+    finally:
+        await c.close()
+
+
+async def cmd_mkdir(args):
+    c = await _client(args)
+    try:
+        await c.meta.mkdir(args.path, create_parent=True)
+        print(f"created {args.path}")
+    finally:
+        await c.close()
+
+
+async def cmd_put(args):
+    c = await _client(args)
+    try:
+        total = 0
+        t0 = time.perf_counter()
+        w = await c.create(args.dst, overwrite=args.force)
+        with open(args.src, "rb") as f:
+            while chunk := f.read(4 * 1024 * 1024):
+                await w.write(chunk)
+                total += len(chunk)
+        await w.close()
+        dt = time.perf_counter() - t0
+        print(f"put {args.src} -> {args.dst}: {_human(total)} "
+              f"in {dt:.2f}s ({_human(total / max(dt, 1e-9))}/s)")
+    finally:
+        await c.close()
+
+
+async def cmd_get(args):
+    c = await _client(args)
+    try:
+        r = await c.open(args.src)
+        t0 = time.perf_counter()
+        total = 0
+        with open(args.dst, "wb") as f:
+            async for chunk in r.chunks():
+                f.write(chunk)
+                total += len(chunk)
+        dt = time.perf_counter() - t0
+        print(f"get {args.src} -> {args.dst}: {_human(total)} "
+              f"in {dt:.2f}s ({_human(total / max(dt, 1e-9))}/s)")
+    finally:
+        await c.close()
+
+
+async def cmd_cat(args):
+    c = await _client(args)
+    try:
+        r = await c.open(args.path)
+        async for chunk in r.chunks():
+            sys.stdout.buffer.write(chunk)
+        sys.stdout.buffer.flush()
+    finally:
+        await c.close()
+
+
+async def cmd_rm(args):
+    c = await _client(args)
+    try:
+        await c.meta.delete(args.path, recursive=args.recursive)
+        print(f"deleted {args.path}")
+    finally:
+        await c.close()
+
+
+async def cmd_mv(args):
+    c = await _client(args)
+    try:
+        await c.meta.rename(args.src, args.dst)
+        print(f"renamed {args.src} -> {args.dst}")
+    finally:
+        await c.close()
+
+
+async def cmd_stat(args):
+    c = await _client(args)
+    try:
+        st = await c.meta.file_status(args.path)
+        print(json.dumps(st.to_wire(), indent=2, default=str))
+    finally:
+        await c.close()
+
+
+async def cmd_touch(args):
+    c = await _client(args)
+    try:
+        if not await c.meta.exists(args.path):
+            await c.write_all(args.path, b"")
+        else:
+            import curvine_tpu.common.types as t
+            await c.meta.set_attr(args.path, SetAttrOpts(mtime=t.now_ms()))
+        print(f"touched {args.path}")
+    finally:
+        await c.close()
+
+
+async def cmd_chmod(args):
+    c = await _client(args)
+    try:
+        await c.meta.set_attr(args.path, SetAttrOpts(mode=int(args.mode, 8)))
+    finally:
+        await c.close()
+
+
+async def cmd_chown(args):
+    c = await _client(args)
+    try:
+        owner, _, group = args.owner.partition(":")
+        await c.meta.set_attr(args.path, SetAttrOpts(
+            owner=owner or None, group=group or None))
+    finally:
+        await c.close()
+
+
+async def _du(c, path: str) -> tuple[int, int, int]:
+    st = await c.meta.file_status(path)
+    if not st.is_dir:
+        return st.len, 1, 0
+    files = dirs = size = 0
+    for child in await c.meta.list_status(path):
+        if child.is_dir:
+            s, f, d = await _du(c, child.path)
+            size += s
+            files += f
+            dirs += d + 1
+        else:
+            size += child.len
+            files += 1
+    return size, files, dirs
+
+
+async def cmd_du(args):
+    c = await _client(args)
+    try:
+        size, files, dirs = await _du(c, args.path)
+        print(f"{_human(size)}\t{args.path}")
+    finally:
+        await c.close()
+
+
+async def cmd_count(args):
+    c = await _client(args)
+    try:
+        size, files, dirs = await _du(c, args.path)
+        print(f"{dirs:>12} {files:>12} {_human(size):>12} {args.path}")
+    finally:
+        await c.close()
+
+
+async def cmd_df(args):
+    c = await _client(args)
+    try:
+        info = await c.meta.master_info()
+        used = info.capacity - info.available
+        pct = 100 * used / info.capacity if info.capacity else 0
+        print(f"Filesystem  Size  Used  Avail  Use%")
+        print(f"curvine  {_human(info.capacity)}  {_human(used)}  "
+              f"{_human(info.available)}  {pct:.0f}%")
+    finally:
+        await c.close()
+
+
+async def cmd_free(args):
+    c = await _client(args)
+    try:
+        n = await c.meta.free(args.path, recursive=args.recursive)
+        print(f"freed {n} cached files under {args.path}")
+    finally:
+        await c.close()
+
+
+async def cmd_blocks(args):
+    c = await _client(args)
+    try:
+        fb = await c.meta.get_block_locations(args.path)
+        for lb in fb.block_locs:
+            locs = ",".join(f"{l.hostname}:{l.rpc_port}" for l in lb.locs)
+            print(f"block {lb.block.id} offset={lb.offset} "
+                  f"len={lb.block.len} locs=[{locs}]")
+    finally:
+        await c.close()
+
+
+# ---------------- cluster commands ----------------
+
+async def cmd_report(args):
+    c = await _client(args)
+    try:
+        info = await c.meta.master_info()
+        print(f"Active master: {info.active_master}")
+        print(f"Inodes: {info.inode_num}  Blocks: {info.block_num}")
+        print(f"Capacity: {_human(info.capacity)}  "
+              f"Available: {_human(info.available)}")
+        print(f"Live workers: {len(info.live_workers)}  "
+              f"Lost workers: {len(info.lost_workers)}")
+        for w in info.live_workers:
+            tiers = ", ".join(
+                f"{s.storage_type.name}:{_human(s.available)}/{_human(s.capacity)}"
+                for s in w.storages)
+            coords = f" ici={w.ici_coords}" if w.ici_coords else ""
+            print(f"  worker {w.address.worker_id} "
+                  f"{w.address.hostname}:{w.address.rpc_port} [{tiers}]{coords}")
+    finally:
+        await c.close()
+
+
+async def cmd_node(args):
+    await cmd_report(args)
+
+
+async def cmd_mount(args):
+    c = await _client(args)
+    try:
+        props = dict(kv.split("=", 1) for kv in (args.prop or []))
+        m = await c.meta.mount(args.cv_path, args.ufs_path, properties=props,
+                               auto_cache=args.auto_cache)
+        print(f"mounted {m.ufs_path} at {m.cv_path} (id={m.mount_id})")
+    finally:
+        await c.close()
+
+
+async def cmd_umount(args):
+    c = await _client(args)
+    try:
+        await c.meta.umount(args.cv_path)
+        print(f"unmounted {args.cv_path}")
+    finally:
+        await c.close()
+
+
+async def cmd_mounts(args):
+    c = await _client(args)
+    try:
+        for m in await c.meta.mount_table():
+            print(f"{m.cv_path} -> {m.ufs_path} "
+                  f"(auto_cache={m.auto_cache}, write={m.write_type.name})")
+    finally:
+        await c.close()
+
+
+async def cmd_load(args):
+    c = await _client(args)
+    try:
+        job_id = await c.meta.submit_load(args.path, recursive=True,
+                                          replicas=args.replicas)
+        print(f"submitted load job {job_id}")
+        if args.wait:
+            while True:
+                job = await c.meta.job_status(job_id)
+                done = sum(1 for t in job.tasks
+                           if t.state == JobState.COMPLETED)
+                print(f"  {job.state.name}: {done}/{len(job.tasks)} tasks")
+                if job.state in (JobState.COMPLETED, JobState.FAILED,
+                                 JobState.CANCELLED):
+                    break
+                await asyncio.sleep(1)
+    finally:
+        await c.close()
+
+
+async def cmd_load_status(args):
+    c = await _client(args)
+    try:
+        job = await c.meta.job_status(args.job_id)
+        print(json.dumps(job.to_wire(), indent=2, default=str))
+    finally:
+        await c.close()
+
+
+async def cmd_load_cancel(args):
+    c = await _client(args)
+    try:
+        await c.meta.cancel_job(args.job_id)
+        print(f"cancelled {args.job_id}")
+    finally:
+        await c.close()
+
+
+async def cmd_bench(args):
+    from curvine_tpu.client import CurvineClient
+    c = CurvineClient(_conf(args))
+    try:
+        size = args.size_mb * 1024 * 1024
+        data = os.urandom(min(size, 8 * 1024 * 1024))
+        path = "/cv-bench-tmp"
+        t0 = time.perf_counter()
+        w = await c.create(path, overwrite=True)
+        written = 0
+        while written < size:
+            await w.write(data[:min(len(data), size - written)])
+            written += len(data)
+        await w.close()
+        wdt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        r = await c.open(path)
+        total = 0
+        async for chunk in r.chunks():
+            total += len(chunk)
+        rdt = time.perf_counter() - t0
+        await c.meta.delete(path)
+        print(f"write: {_human(written / wdt)}/s   read: {_human(total / rdt)}/s")
+    finally:
+        await c.close()
+
+
+# ---------------- daemons ----------------
+
+async def cmd_master(args):
+    from curvine_tpu.master import MasterServer
+    from curvine_tpu.web.server import WebServer
+    conf = _conf(args)
+    m = MasterServer(conf)
+    await m.start()
+    web = WebServer(conf.master.web_port, master=m)
+    await web.start()
+    print(f"master at {m.addr}, web at :{web.port}")
+    await asyncio.Event().wait()
+
+
+async def cmd_worker(args):
+    from curvine_tpu.worker import WorkerServer
+    conf = _conf(args)
+    w = WorkerServer(conf)
+    await w.start()
+    print(f"worker {w.worker_id} at {w.addr}")
+    await asyncio.Event().wait()
+
+
+async def cmd_fuse(args):
+    from curvine_tpu.fuse.mount import mount_and_serve
+    conf = _conf(args)
+    if args.mountpoint:
+        conf.fuse.mount_point = args.mountpoint
+    await mount_and_serve(conf)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="cv", description="curvine-tpu CLI")
+    p.add_argument("--conf", help="cluster config TOML")
+    p.add_argument("--master", help="master addr host:port")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def add(name, fn, *spec, **kw):
+        sp = sub.add_parser(name, **kw)
+        for s in spec:
+            sp.add_argument(*s[0], **s[1])
+        sp.set_defaults(fn=fn)
+        return sp
+
+    A = lambda *a, **k: (a, k)
+    add("ls", cmd_ls, A("path"))
+    add("mkdir", cmd_mkdir, A("path"))
+    add("put", cmd_put, A("src"), A("dst"),
+        A("--force", action="store_true"))
+    add("get", cmd_get, A("src"), A("dst"))
+    add("cat", cmd_cat, A("path"))
+    add("rm", cmd_rm, A("path"), A("-r", "--recursive", action="store_true"))
+    add("mv", cmd_mv, A("src"), A("dst"))
+    add("stat", cmd_stat, A("path"))
+    add("touch", cmd_touch, A("path"))
+    add("chmod", cmd_chmod, A("mode"), A("path"))
+    add("chown", cmd_chown, A("owner"), A("path"))
+    add("du", cmd_du, A("path"))
+    add("count", cmd_count, A("path"))
+    add("df", cmd_df)
+    add("free", cmd_free, A("path"),
+        A("-r", "--recursive", action="store_true"))
+    add("blocks", cmd_blocks, A("path"))
+    add("report", cmd_report)
+    add("node", cmd_node)
+    add("mount", cmd_mount, A("cv_path"), A("ufs_path"),
+        A("--auto-cache", dest="auto_cache", action="store_true"),
+        A("--prop", action="append"))
+    add("umount", cmd_umount, A("cv_path"))
+    add("mounts", cmd_mounts)
+    add("load", cmd_load, A("path"), A("--replicas", type=int, default=1),
+        A("--wait", action="store_true"))
+    add("load-status", cmd_load_status, A("job_id"))
+    add("load-cancel", cmd_load_cancel, A("job_id"))
+    add("bench", cmd_bench, A("--size-mb", type=int, default=256))
+    add("master", cmd_master)
+    add("worker", cmd_worker)
+    add("fuse", cmd_fuse, A("--mountpoint"))
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        asyncio.run(args.fn(args))
+        return 0
+    except KeyboardInterrupt:
+        return 130
+    except Exception as e:  # noqa: BLE001 — CLI boundary
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

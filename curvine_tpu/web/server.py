@@ -1,0 +1,127 @@
+"""Web server: REST API + /metrics + minimal dashboard.
+
+Parity: curvine-web/src/ (axum router: master info, worker list, browse,
+mounts, jobs; prometheus metrics; webui/)."""
+
+from __future__ import annotations
+
+import json
+import logging
+
+from aiohttp import web
+
+log = logging.getLogger(__name__)
+
+_DASH = """<!doctype html><html><head><title>curvine-tpu</title>
+<style>body{font-family:monospace;margin:2em;background:#0d1117;color:#c9d1d9}
+h1{color:#58a6ff} table{border-collapse:collapse}
+td,th{border:1px solid #30363d;padding:4px 10px;text-align:left}
+a{color:#58a6ff}</style></head><body>
+<h1>curvine-tpu</h1>
+<div id=info>loading…</div>
+<h2>workers</h2><table id=workers><tr><th>id</th><th>addr</th><th>state</th>
+<th>capacity</th><th>available</th><th>ici</th></tr></table>
+<h2>mounts</h2><table id=mounts><tr><th>cv</th><th>ufs</th><th>mode</th></tr>
+</table>
+<p><a href=/metrics>/metrics</a> · <a href=/api/info>/api/info</a> ·
+<a href=/api/browse?path=/>/api/browse</a></p>
+<script>
+const gb=n=>(n/2**30).toFixed(2)+' GiB';
+fetch('/api/info').then(r=>r.json()).then(d=>{
+ document.getElementById('info').innerHTML=
+  `inodes: ${d.inode_num} · blocks: ${d.block_num} · capacity: ${gb(d.capacity)}`+
+  ` · available: ${gb(d.available)}`;
+ const t=document.getElementById('workers');
+ for(const w of d.live_workers.concat(d.lost_workers)){
+  t.insertRow().innerHTML=`<td>${w.address.worker_id}</td>`+
+   `<td>${w.address.hostname}:${w.address.rpc_port}</td>`+
+   `<td>${w.state===0?'LIVE':'LOST'}</td>`+
+   `<td>${gb(w.storages.reduce((a,s)=>a+s.capacity,0))}</td>`+
+   `<td>${gb(w.storages.reduce((a,s)=>a+s.available,0))}</td>`+
+   `<td>${JSON.stringify(w.ici_coords)}</td>`;}});
+fetch('/api/mounts').then(r=>r.json()).then(ms=>{
+ const t=document.getElementById('mounts');
+ for(const m of ms){t.insertRow().innerHTML=
+  `<td>${m.cv_path}</td><td>${m.ufs_path}</td><td>${m.write_type}</td>`;}});
+</script></body></html>"""
+
+
+class WebServer:
+    def __init__(self, port: int, master=None, worker=None,
+                 host: str = "0.0.0.0"):
+        self.host = host
+        self.port = port
+        self.master = master
+        self.worker = worker
+        self.app = web.Application()
+        self._runner: web.AppRunner | None = None
+        r = self.app.router
+        r.add_get("/", self._dashboard)
+        r.add_get("/metrics", self._metrics)
+        r.add_get("/api/info", self._info)
+        r.add_get("/api/browse", self._browse)
+        r.add_get("/api/mounts", self._mounts)
+        r.add_get("/api/jobs", self._jobs)
+        r.add_get("/api/jobs/{job_id}", self._job)
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for s in self._runner.sites:
+            self.port = s._server.sockets[0].getsockname()[1]
+        log.info("web server on :%d", self.port)
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # ---------------- handlers ----------------
+
+    async def _dashboard(self, req):
+        return web.Response(text=_DASH, content_type="text/html")
+
+    async def _metrics(self, req):
+        src = self.master or self.worker
+        text = src.metrics.prometheus_text() if src else ""
+        return web.Response(text=text, content_type="text/plain")
+
+    def _json(self, obj):
+        return web.Response(text=json.dumps(obj, default=str),
+                            content_type="application/json")
+
+    async def _info(self, req):
+        if self.master is None:
+            return self._json({"error": "not a master"})
+        return self._json(self.master.fs.master_info(
+            self.master.addr).to_wire())
+
+    async def _browse(self, req):
+        if self.master is None:
+            return self._json({"error": "not a master"})
+        path = req.query.get("path", "/")
+        try:
+            sts = self.master.fs.list_status(path)
+            return self._json([s.to_wire() for s in sts])
+        except Exception as e:  # noqa: BLE001 — http boundary
+            return self._json({"error": str(e)})
+
+    async def _mounts(self, req):
+        if self.master is None:
+            return self._json([])
+        return self._json([m.to_wire() for m in self.master.mounts.table()])
+
+    async def _jobs(self, req):
+        if self.master is None:
+            return self._json([])
+        return self._json([j.to_wire()
+                           for j in self.master.jobs.jobs.values()])
+
+    async def _job(self, req):
+        job_id = req.match_info["job_id"]
+        try:
+            return self._json(self.master.jobs.status(job_id).to_wire())
+        except Exception as e:  # noqa: BLE001
+            return self._json({"error": str(e)})

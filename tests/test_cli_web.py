@@ -1,0 +1,112 @@
+"""CLI command + web API smoke tests against a mini-cluster.
+
+Mirrors reference: curvine-cli command surface, curvine-web router."""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from curvine_tpu.cli.main import main as cli_main
+from curvine_tpu.testing import MiniCluster
+
+
+@pytest.fixture
+def cluster_loop():
+    """Runs a mini-cluster in a dedicated background loop/thread so the
+    synchronous CLI (which owns its own asyncio.run) can talk to it."""
+    import threading
+    loop = asyncio.new_event_loop()
+    mc = MiniCluster(workers=1)
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    fut = asyncio.run_coroutine_threadsafe(mc.start(), loop)
+    fut.result(30)
+    yield mc
+    asyncio.run_coroutine_threadsafe(mc.stop(), loop).result(30)
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(5)
+
+
+def _cv(mc, *argv) -> int:
+    return cli_main(["--master", mc.master.addr, *argv])
+
+
+def test_cli_fs_flow(cluster_loop, tmp_path, capsys):
+    mc = cluster_loop
+    src = tmp_path / "in.bin"
+    src.write_bytes(os.urandom(1024 * 1024))
+    assert _cv(mc, "mkdir", "/cli") == 0
+    assert _cv(mc, "put", str(src), "/cli/f.bin") == 0
+    assert _cv(mc, "ls", "/cli") == 0
+    out = capsys.readouterr().out
+    assert "f.bin" in out
+    assert _cv(mc, "stat", "/cli/f.bin") == 0
+    st = json.loads(capsys.readouterr().out)
+    assert st["len"] == 1024 * 1024
+    dst = tmp_path / "out.bin"
+    assert _cv(mc, "get", "/cli/f.bin", str(dst)) == 0
+    assert dst.read_bytes() == src.read_bytes()
+    assert _cv(mc, "blocks", "/cli/f.bin") == 0
+    assert "block" in capsys.readouterr().out
+    assert _cv(mc, "mv", "/cli/f.bin", "/cli/g.bin") == 0
+    assert _cv(mc, "du", "/cli") == 0
+    assert _cv(mc, "df") == 0
+    assert _cv(mc, "report") == 0
+    assert "Live workers: 1" in capsys.readouterr().out
+    assert _cv(mc, "chmod", "600", "/cli/g.bin") == 0
+    assert _cv(mc, "chown", "alice:devs", "/cli/g.bin") == 0
+    assert _cv(mc, "stat", "/cli/g.bin") == 0
+    st = json.loads(capsys.readouterr().out)
+    assert st["owner"] == "alice" and st["mode"] == 0o600
+    assert _cv(mc, "rm", "-r", "/cli") == 0
+    assert _cv(mc, "ls", "/cli") == 1     # gone → error exit
+
+
+def test_cli_mounts_and_load(cluster_loop, capsys):
+    from curvine_tpu.ufs import create_ufs
+    from curvine_tpu.ufs import memory as memufs
+    mc = cluster_loop
+    memufs.reset()
+
+    async def seed():
+        ufs = create_ufs("mem://clibkt")
+        await ufs.write_all("mem://clibkt/d/a.bin", b"A" * 100)
+    asyncio.run(seed())
+
+    assert _cv(mc, "mount", "/m", "mem://clibkt") == 0
+    assert _cv(mc, "mounts") == 0
+    assert "mem://clibkt" in capsys.readouterr().out
+    assert _cv(mc, "load", "/m/d", "--wait") == 0
+    out = capsys.readouterr().out
+    assert "COMPLETED" in out
+    assert _cv(mc, "cat", "/m/d/a.bin") == 0
+    assert _cv(mc, "umount", "/m") == 0
+
+
+async def test_web_api():
+    import aiohttp
+    from curvine_tpu.web.server import WebServer
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.write_all("/w/file.bin", b"x" * 2048)
+        web = WebServer(0, master=mc.master, host="127.0.0.1")
+        await web.start()
+        try:
+            base = f"http://127.0.0.1:{web.port}"
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"{base}/api/info") as r:
+                    info = await r.json()
+                    assert info["inode_num"] >= 3
+                    assert len(info["live_workers"]) == 1
+                async with s.get(f"{base}/api/browse?path=/w") as r:
+                    ls = await r.json()
+                    assert ls[0]["name"] == "file.bin"
+                async with s.get(f"{base}/metrics") as r:
+                    text = await r.text()
+                    assert "curvine_master_" in text
+                async with s.get(base) as r:
+                    assert "curvine-tpu" in await r.text()
+        finally:
+            await web.stop()

@@ -1,0 +1,213 @@
+"""FUSE kernel protocol ABI (v7.31 wire format).
+
+Parity: curvine-fuse/src/raw/ (request/response structs mirrored from
+<linux/fuse.h>). Little-endian, 8-byte aligned structs, spoken directly
+over /dev/fuse — no libfuse."""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+KERNEL_VERSION = 7
+KERNEL_MINOR = 31
+
+
+class Op:
+    LOOKUP = 1
+    FORGET = 2
+    GETATTR = 3
+    SETATTR = 4
+    READLINK = 5
+    SYMLINK = 6
+    MKNOD = 8
+    MKDIR = 9
+    UNLINK = 10
+    RMDIR = 11
+    RENAME = 12
+    LINK = 13
+    OPEN = 14
+    READ = 15
+    WRITE = 16
+    STATFS = 17
+    RELEASE = 18
+    FSYNC = 20
+    SETXATTR = 21
+    GETXATTR = 22
+    LISTXATTR = 23
+    REMOVEXATTR = 24
+    FLUSH = 25
+    INIT = 26
+    OPENDIR = 27
+    READDIR = 28
+    RELEASEDIR = 29
+    FSYNCDIR = 30
+    GETLK = 31
+    SETLK = 32
+    SETLKW = 33
+    ACCESS = 34
+    CREATE = 35
+    INTERRUPT = 36
+    BMAP = 37
+    DESTROY = 38
+    IOCTL = 39
+    POLL = 40
+    NOTIFY_REPLY = 41
+    BATCH_FORGET = 42
+    FALLOCATE = 43
+    READDIRPLUS = 44
+    RENAME2 = 45
+    LSEEK = 46
+    COPY_FILE_RANGE = 47
+
+
+# errno values we return (negated in the out header)
+class Errno:
+    EPERM = 1
+    ENOENT = 2
+    EIO = 5
+    EAGAIN = 11
+    EACCES = 13
+    EEXIST = 17
+    ENOTDIR = 20
+    EISDIR = 21
+    EINVAL = 22
+    ENOSPC = 28
+    EROFS = 30
+    ENOSYS = 38
+    ENOTEMPTY = 39
+    ENODATA = 61
+    ESTALE = 116
+    EOPNOTSUPP = 95
+
+
+IN_HEADER = struct.Struct("<IIQQIIII")      # len,opcode,unique,nodeid,uid,gid,pid,padding
+OUT_HEADER = struct.Struct("<IiQ")          # len,error,unique
+
+# fuse_attr: ino,size,blocks,atime,mtime,ctime,atimensec,mtimensec,
+#            ctimensec,mode,nlink,uid,gid,rdev,blksize,padding
+ATTR = struct.Struct("<QQQQQQIIIIIIIIII")
+ATTR_SIZE = ATTR.size                        # 88
+
+# fuse_entry_out: nodeid,generation,entry_valid,attr_valid,
+#                 entry_valid_nsec,attr_valid_nsec + attr
+ENTRY_OUT = struct.Struct("<QQQQII")
+ENTRY_OUT_SIZE = ENTRY_OUT.size + ATTR_SIZE  # 128
+
+ATTR_OUT = struct.Struct("<QII")             # attr_valid,valid_nsec,dummy
+OPEN_OUT = struct.Struct("<QII")             # fh,open_flags,padding
+INIT_IN = struct.Struct("<IIII")             # major,minor,max_readahead,flags
+# fuse_init_out (7.23+): major,minor,max_readahead,flags,max_background,
+#   congestion_threshold,max_write,time_gran,max_pages,padding,unused[8]
+INIT_OUT = struct.Struct("<IIIIHHIIHH8I")
+GETATTR_IN = struct.Struct("<IIQ")           # flags,dummy,fh
+READ_IN = struct.Struct("<QQIIQII")          # fh,offset,size,read_flags,lock_owner,flags,padding
+WRITE_IN = struct.Struct("<QQIIQII")         # fh,offset,size,write_flags,lock_owner,flags,padding
+WRITE_OUT = struct.Struct("<II")             # size,padding
+RELEASE_IN = struct.Struct("<QIIQ")          # fh,flags,release_flags,lock_owner
+FLUSH_IN = struct.Struct("<QIIQ")            # fh,unused,padding,lock_owner
+FSYNC_IN = struct.Struct("<QII")             # fh,fsync_flags,padding
+MKDIR_IN = struct.Struct("<II")              # mode,umask
+CREATE_IN = struct.Struct("<IIII")           # flags,mode,umask,open_flags
+OPEN_IN = struct.Struct("<II")               # flags,open_flags
+RENAME2_IN = struct.Struct("<QII")           # newdir,flags,padding
+RENAME_IN = struct.Struct("<Q")              # newdir
+LINK_IN = struct.Struct("<Q")                # oldnodeid
+ACCESS_IN = struct.Struct("<II")             # mask,padding
+INTERRUPT_IN = struct.Struct("<Q")           # unique
+FORGET_IN = struct.Struct("<Q")              # nlookup
+FALLOCATE_IN = struct.Struct("<QQQII")       # fh,offset,length,mode,padding
+LSEEK_IN = struct.Struct("<QQII")            # fh,offset,whence,padding
+LSEEK_OUT = struct.Struct("<Q")              # offset
+# fuse_setattr_in: valid,padding,fh,size,lock_owner,atime,mtime,ctime,
+#   atimensec,mtimensec,ctimensec,mode,unused4,uid,gid,unused5
+SETATTR_IN = struct.Struct("<IIQQQQQQIIIIIIII")
+STATFS_OUT = struct.Struct("<QQQQQIIII6I")   # kstatfs (blocks..frsize,padding,spare[6])
+GETXATTR_IN = struct.Struct("<II")           # size,padding
+GETXATTR_OUT = struct.Struct("<II")          # size,padding
+SETXATTR_IN = struct.Struct("<II")           # size,flags
+
+DIRENT_HDR = struct.Struct("<QQII")          # ino,off,namelen,type
+
+
+class SetattrValid:
+    MODE = 1 << 0
+    UID = 1 << 1
+    GID = 1 << 2
+    SIZE = 1 << 3
+    ATIME = 1 << 4
+    MTIME = 1 << 5
+    FH = 1 << 6
+    ATIME_NOW = 1 << 7
+    MTIME_NOW = 1 << 8
+
+
+class InitFlags:
+    ASYNC_READ = 1 << 0
+    BIG_WRITES = 1 << 5
+    DO_READDIRPLUS = 1 << 13
+    READDIRPLUS_AUTO = 1 << 14
+    PARALLEL_DIROPS = 1 << 18
+    MAX_PAGES = 1 << 22
+    CACHE_SYMLINKS = 1 << 23
+
+
+S_IFDIR = 0o040000
+S_IFREG = 0o100000
+S_IFLNK = 0o120000
+DT_DIR = 4
+DT_REG = 8
+DT_LNK = 10
+
+
+@dataclass
+class InHeader:
+    length: int
+    opcode: int
+    unique: int
+    nodeid: int
+    uid: int
+    gid: int
+    pid: int
+
+    @staticmethod
+    def parse(buf: memoryview) -> "InHeader":
+        length, opcode, unique, nodeid, uid, gid, pid, _ = \
+            IN_HEADER.unpack_from(buf, 0)
+        return InHeader(length, opcode, unique, nodeid, uid, gid, pid)
+
+
+def pack_attr(ino: int, size: int, mode: int, nlink: int = 1,
+              mtime_ms: int = 0, atime_ms: int = 0, uid: int = 0,
+              gid: int = 0, blksize: int = 4096) -> bytes:
+    mt, mtn = divmod(mtime_ms, 1000)
+    at, atn = divmod(atime_ms, 1000)
+    return ATTR.pack(ino, size, (size + 511) // 512, at, mt, mt,
+                     atn * 1_000_000, mtn * 1_000_000, mtn * 1_000_000,
+                     mode, nlink, uid, gid, 0, blksize, 0)
+
+
+def pack_entry_out(nodeid: int, attr: bytes, entry_ttl_ms: int,
+                   attr_ttl_ms: int, generation: int = 0) -> bytes:
+    ev, evn = divmod(entry_ttl_ms, 1000)
+    av, avn = divmod(attr_ttl_ms, 1000)
+    return ENTRY_OUT.pack(nodeid, generation, ev, av,
+                          evn * 1_000_000, avn * 1_000_000) + attr
+
+
+def pack_reply(unique: int, payload: bytes = b"", error: int = 0) -> bytes:
+    return OUT_HEADER.pack(OUT_HEADER.size + len(payload), -error,
+                           unique) + payload
+
+
+def pack_dirent(ino: int, off: int, name: bytes, dtype: int) -> bytes:
+    ent = DIRENT_HDR.pack(ino, off, len(name), dtype) + name
+    pad = (-len(ent)) % 8
+    return ent + b"\x00" * pad
+
+
+def pack_direntplus(entry_out: bytes, ino: int, off: int, name: bytes,
+                    dtype: int) -> bytes:
+    ent = entry_out + DIRENT_HDR.pack(ino, off, len(name), dtype) + name
+    pad = (-len(ent)) % 8
+    return ent + b"\x00" * pad

@@ -1,0 +1,77 @@
+"""Mount/umount via fusermount (unprivileged) and the serve entrypoint.
+
+Parity: curvine-fuse/src/bin (cv-fuse) + session mount handling. The
+/dev/fuse fd is obtained through fusermount's _FUSE_COMMFD SCM_RIGHTS
+handshake, so no root is needed."""
+
+from __future__ import annotations
+
+import array
+import logging
+import os
+import socket
+import subprocess
+
+from curvine_tpu.common.conf import ClusterConf
+
+log = logging.getLogger(__name__)
+
+
+def fusermount_mount(mountpoint: str, fsname: str = "curvine",
+                     options: str = "") -> int:
+    """Returns the /dev/fuse fd for the new mount."""
+    os.makedirs(mountpoint, exist_ok=True)
+    recv_sock, send_sock = socket.socketpair(socket.AF_UNIX,
+                                             socket.SOCK_STREAM)
+    opts = f"rootmode=40000,user_id={os.getuid()},group_id={os.getgid()}," \
+           f"fsname={fsname},subtype=curvine"
+    if options:
+        opts += "," + options
+    env = dict(os.environ, _FUSE_COMMFD=str(send_sock.fileno()))
+    proc = subprocess.run(
+        ["fusermount", "-o", opts, "--", mountpoint],
+        env=env, pass_fds=(send_sock.fileno(),),
+        capture_output=True, text=True)
+    send_sock.close()
+    if proc.returncode != 0:
+        recv_sock.close()
+        raise OSError(f"fusermount failed: {proc.stderr.strip()}")
+    fds = array.array("i")
+    msg, ancdata, _, _ = recv_sock.recvmsg(4, socket.CMSG_LEN(4))
+    for level, ctype, data in ancdata:
+        if level == socket.SOL_SOCKET and ctype == socket.SCM_RIGHTS:
+            fds.frombytes(data[:4])
+    recv_sock.close()
+    if not fds:
+        raise OSError("fusermount did not pass a /dev/fuse fd")
+    return fds[0]
+
+
+def fusermount_umount(mountpoint: str, lazy: bool = True) -> None:
+    cmd = ["fusermount", "-u"]
+    if lazy:
+        cmd.append("-z")
+    subprocess.run(cmd + ["--", mountpoint], capture_output=True)
+
+
+async def mount_and_serve(conf: ClusterConf) -> None:
+    """cv fuse: mount the namespace and serve until unmounted."""
+    from curvine_tpu.client import CurvineClient
+    from curvine_tpu.fuse.ops import CurvineFuseFs
+    from curvine_tpu.fuse.session import FuseSession
+
+    client = CurvineClient(conf)
+    fd = fusermount_mount(conf.fuse.mount_point)
+    fs = CurvineFuseFs(client, fs_root=conf.fuse.fs_path,
+                       attr_ttl_ms=conf.fuse.attr_ttl_ms,
+                       entry_ttl_ms=conf.fuse.entry_ttl_ms,
+                       max_write=conf.fuse.max_write,
+                       uid=os.getuid(), gid=os.getgid())
+    session = FuseSession(fs, fd, max_write=conf.fuse.max_write)
+    log.info("fuse mounted at %s", conf.fuse.mount_point)
+    try:
+        await session.run()
+    finally:
+        session.stop()
+        fusermount_umount(conf.fuse.mount_point)
+        await client.close()

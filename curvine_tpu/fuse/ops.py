@@ -1,0 +1,533 @@
+"""FUSE operation handlers backed by the Curvine client.
+
+Parity: curvine-fuse/src/fs/ (CurvineFileSystem: lookup/getattr/mkdir/
+rmdir/unlink/rename/open/create/read/write/flush/release/readdir(plus)/
+statfs/xattr/symlink/link) and fs/dcache.rs (nodeid↔path table)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+from curvine_tpu.common import errors as cerr
+from curvine_tpu.common.types import FileStatus, SetAttrOpts
+from curvine_tpu.fuse import abi
+from curvine_tpu.fuse.abi import Errno, Op
+
+log = logging.getLogger(__name__)
+
+ROOT_ID = 1
+
+
+class FuseError(Exception):
+    def __init__(self, errno: int):
+        self.errno = errno
+
+
+_ERRNO_MAP = {
+    cerr.ErrorCode.FILE_NOT_FOUND: Errno.ENOENT,
+    cerr.ErrorCode.FILE_ALREADY_EXISTS: Errno.EEXIST,
+    cerr.ErrorCode.DIR_NOT_EMPTY: Errno.ENOTEMPTY,
+    cerr.ErrorCode.NOT_A_DIRECTORY: Errno.ENOTDIR,
+    cerr.ErrorCode.IS_A_DIRECTORY: Errno.EISDIR,
+    cerr.ErrorCode.INVALID_PATH: Errno.EINVAL,
+    cerr.ErrorCode.INVALID_ARGUMENT: Errno.EINVAL,
+    cerr.ErrorCode.CAPACITY_EXCEEDED: Errno.ENOSPC,
+    cerr.ErrorCode.PERMISSION_DENIED: Errno.EACCES,
+    cerr.ErrorCode.UNSUPPORTED: Errno.EOPNOTSUPP,
+    cerr.ErrorCode.LEASE_CONFLICT: Errno.EAGAIN,
+}
+
+
+def _fuse_errno(e: cerr.CurvineError) -> int:
+    return _ERRNO_MAP.get(e.code, Errno.EIO)
+
+
+class _Handle:
+    __slots__ = ("reader", "writer", "entries", "path", "lock", "pending")
+
+    def __init__(self, reader=None, writer=None, entries=None, path=""):
+        self.reader = reader
+        self.writer = writer
+        self.entries = entries
+        self.path = path
+        import asyncio
+        self.lock = asyncio.Lock()
+        # out-of-order WRITEs parked until the stream catches up
+        self.pending: dict[int, bytes] = {}
+
+
+class CurvineFuseFs:
+    def __init__(self, client, fs_root: str = "/", attr_ttl_ms: int = 1000,
+                 entry_ttl_ms: int = 1000, max_write: int = 128 * 1024,
+                 uid: int = 0, gid: int = 0):
+        self.client = client
+        self.fs_root = fs_root.rstrip("/") or ""
+        self.attr_ttl = attr_ttl_ms
+        self.entry_ttl = entry_ttl_ms
+        self.max_write = max_write
+        self.uid, self.gid = uid, gid
+        self.nodes: dict[int, str] = {ROOT_ID: self.fs_root or "/"}
+        self.ids: dict[str, int] = {self.fs_root or "/": ROOT_ID}
+        self._next_node = 2
+        self.handles: dict[int, _Handle] = {}
+        self._next_fh = 1
+        self.destroyed = False
+        # path → FsWriter for in-flight writes (getattr sees live size)
+        self._open_writers: dict[int, object] = {}
+
+    # ---------------- node table (dcache) ----------------
+
+    def node_path(self, nodeid: int) -> str:
+        path = self.nodes.get(nodeid)
+        if path is None:
+            raise FuseError(Errno.ESTALE)
+        return path
+
+    def intern(self, path: str) -> int:
+        nid = self.ids.get(path)
+        if nid is None:
+            nid = self._next_node
+            self._next_node += 1
+            self.ids[path] = nid
+            self.nodes[nid] = path
+        return nid
+
+    def _drop_path(self, path: str) -> None:
+        nid = self.ids.pop(path, None)
+        if nid is not None:
+            self.nodes.pop(nid, None)
+
+    def _child(self, nodeid: int, name: bytes) -> str:
+        base = self.node_path(nodeid)
+        n = name.decode()
+        return f"{base.rstrip('/')}/{n}" if base != "/" else f"/{n}"
+
+    # ---------------- attr helpers ----------------
+
+    def _mode_of(self, st: FileStatus) -> int:
+        if st.is_dir:
+            return abi.S_IFDIR | (st.mode or 0o755)
+        if st.target is not None:
+            return abi.S_IFLNK | 0o777
+        return abi.S_IFREG | (st.mode or 0o644)
+
+    def _attr(self, nid: int, st: FileStatus) -> bytes:
+        return abi.pack_attr(nid, st.len, self._mode_of(st), st.nlink,
+                             st.mtime, st.atime, self.uid, self.gid)
+
+    def _entry(self, path: str, st: FileStatus) -> bytes:
+        nid = self.intern(path)
+        return abi.pack_entry_out(nid, self._attr(nid, st), self.entry_ttl,
+                                  self.attr_ttl)
+
+    def _new_fh(self, handle: _Handle) -> int:
+        fh = self._next_fh
+        self._next_fh += 1
+        self.handles[fh] = handle
+        return fh
+
+    def _fh(self, fh: int) -> _Handle:
+        h = self.handles.get(fh)
+        if h is None:
+            raise FuseError(Errno.ESTALE)
+        return h
+
+    # ---------------- dispatch ----------------
+
+    async def handle(self, hdr: abi.InHeader, payload: memoryview) -> bytes | None:
+        fn = _DISPATCH.get(hdr.opcode)
+        if fn is None:
+            raise FuseError(Errno.ENOSYS)
+        try:
+            return await fn(self, hdr, payload)
+        except FuseError:
+            raise
+        except cerr.CurvineError as e:
+            raise FuseError(_fuse_errno(e)) from e
+        except Exception:
+            log.exception("fuse op %d failed", hdr.opcode)
+            raise FuseError(Errno.EIO)
+
+    # ---------------- ops ----------------
+
+    async def op_init(self, hdr, payload) -> bytes:
+        major, minor, max_readahead, flags = abi.INIT_IN.unpack_from(payload, 0)
+        log.info("fuse init: kernel %d.%d flags=%#x", major, minor, flags)
+        want = (abi.InitFlags.ASYNC_READ | abi.InitFlags.BIG_WRITES |
+                abi.InitFlags.DO_READDIRPLUS | abi.InitFlags.READDIRPLUS_AUTO |
+                abi.InitFlags.PARALLEL_DIROPS | abi.InitFlags.MAX_PAGES)
+        out_flags = flags & want
+        max_pages = max(1, self.max_write // 4096)
+        return abi.INIT_OUT.pack(abi.KERNEL_VERSION,
+                                 min(minor, abi.KERNEL_MINOR),
+                                 max_readahead, out_flags, 16, 12,
+                                 self.max_write, 1, max_pages, 0,
+                                 *([0] * 8))
+
+    async def op_destroy(self, hdr, payload) -> bytes:
+        self.destroyed = True
+        return b""
+
+    async def op_lookup(self, hdr, payload) -> bytes:
+        path = self._child(hdr.nodeid, bytes(payload).rstrip(b"\x00"))
+        st = await self.client.meta.file_status(path)
+        return self._entry(path, st)
+
+    async def op_forget(self, hdr, payload) -> None:
+        return None                      # keep dcache entries; no reply
+
+    async def op_batch_forget(self, hdr, payload) -> None:
+        return None
+
+    async def op_getattr(self, hdr, payload) -> bytes:
+        path = self.node_path(hdr.nodeid)
+        st = await self.client.meta.file_status(path)
+        w = self._open_writers.get(path)
+        if w is not None:
+            st.len = max(st.len, w.pos)     # in-flight write: live size
+        av, avn = divmod(self.attr_ttl, 1000)
+        return abi.ATTR_OUT.pack(av, avn * 1_000_000, 0) + \
+            self._attr(hdr.nodeid, st)
+
+    async def op_setattr(self, hdr, payload) -> bytes:
+        (valid, _pad, fh, size, _lock, atime, mtime, _ctime, atimen, mtimen,
+         _ctimen, mode, _u4, uid, gid, _u5) = abi.SETATTR_IN.unpack_from(
+             payload, 0)
+        path = self.node_path(hdr.nodeid)
+        opts = SetAttrOpts()
+        if valid & abi.SetattrValid.MODE:
+            opts.mode = mode & 0o7777
+        if valid & abi.SetattrValid.ATIME:
+            opts.atime = atime * 1000 + atimen // 1_000_000
+        if valid & abi.SetattrValid.MTIME:
+            opts.mtime = mtime * 1000 + mtimen // 1_000_000
+        now = int(time.time() * 1000)
+        if valid & abi.SetattrValid.ATIME_NOW:
+            opts.atime = now
+        if valid & abi.SetattrValid.MTIME_NOW:
+            opts.mtime = now
+        if any(v is not None for v in
+               (opts.mode, opts.atime, opts.mtime)):
+            await self.client.meta.set_attr(path, opts)
+        if valid & abi.SetattrValid.SIZE:
+            st = await self.client.meta.file_status(path)
+            if size == 0 and st.len != 0:
+                await self.client.write_all(path, b"")
+            elif size < st.len:
+                await self.client.meta.resize_file(path, size)
+            elif size > st.len:
+                raise FuseError(Errno.EOPNOTSUPP)
+        st = await self.client.meta.file_status(path)
+        av, avn = divmod(self.attr_ttl, 1000)
+        return abi.ATTR_OUT.pack(av, avn * 1_000_000, 0) + \
+            self._attr(hdr.nodeid, st)
+
+    async def op_mkdir(self, hdr, payload) -> bytes:
+        mode, _umask = abi.MKDIR_IN.unpack_from(payload, 0)
+        name = bytes(payload[abi.MKDIR_IN.size:]).rstrip(b"\x00")
+        path = self._child(hdr.nodeid, name)
+        st = await self.client.meta.mkdir(path, create_parent=False,
+                                          mode=mode & 0o7777)
+        return self._entry(path, st)
+
+    async def op_unlink(self, hdr, payload) -> bytes:
+        path = self._child(hdr.nodeid, bytes(payload).rstrip(b"\x00"))
+        await self.client.meta.delete(path, recursive=False)
+        self._drop_path(path)
+        return b""
+
+    op_rmdir = op_unlink
+
+    async def _rename(self, hdr, newdir: int, rest: bytes) -> bytes:
+        old_name, new_name = rest.rstrip(b"\x00").split(b"\x00", 1)
+        src = self._child(hdr.nodeid, old_name)
+        dst = self._child(newdir, new_name)
+        await self.client.meta.rename(src, dst)
+        self._drop_path(src)
+        self._drop_path(dst)
+        return b""
+
+    async def op_rename(self, hdr, payload) -> bytes:
+        (newdir,) = abi.RENAME_IN.unpack_from(payload, 0)
+        return await self._rename(hdr, newdir,
+                                  bytes(payload[abi.RENAME_IN.size:]))
+
+    async def op_rename2(self, hdr, payload) -> bytes:
+        newdir, _flags, _pad = abi.RENAME2_IN.unpack_from(payload, 0)
+        return await self._rename(hdr, newdir,
+                                  bytes(payload[abi.RENAME2_IN.size:]))
+
+    async def op_symlink(self, hdr, payload) -> bytes:
+        name, target = bytes(payload).rstrip(b"\x00").split(b"\x00", 1)
+        path = self._child(hdr.nodeid, name)
+        st = await self.client.meta.symlink(target.decode(), path)
+        return self._entry(path, st)
+
+    async def op_readlink(self, hdr, payload) -> bytes:
+        st = await self.client.meta.file_status(self.node_path(hdr.nodeid))
+        if st.target is None:
+            raise FuseError(Errno.EINVAL)
+        return st.target.encode()
+
+    async def op_link(self, hdr, payload) -> bytes:
+        (oldnode,) = abi.LINK_IN.unpack_from(payload, 0)
+        name = bytes(payload[abi.LINK_IN.size:]).rstrip(b"\x00")
+        src = self.node_path(oldnode)
+        dst = self._child(hdr.nodeid, name)
+        st = await self.client.meta.link(src, dst)
+        return self._entry(dst, st)
+
+    async def op_open(self, hdr, payload) -> bytes:
+        flags, _ = abi.OPEN_IN.unpack_from(payload, 0)
+        path = self.node_path(hdr.nodeid)
+        acc = flags & os.O_ACCMODE
+        if acc == os.O_RDONLY:
+            reader = await self.client.open(path)
+            fh = self._new_fh(_Handle(reader=reader, path=path))
+        else:
+            if flags & os.O_APPEND:
+                writer = await self.client.append(path)
+            else:
+                writer = await self.client.create(path, overwrite=True)
+            fh = self._new_fh(_Handle(writer=writer, path=path))
+            self._open_writers[path] = writer
+        return abi.OPEN_OUT.pack(fh, 0, 0)
+
+    async def op_create(self, hdr, payload) -> bytes:
+        flags, mode, _umask, _of = abi.CREATE_IN.unpack_from(payload, 0)
+        name = bytes(payload[abi.CREATE_IN.size:]).rstrip(b"\x00")
+        path = self._child(hdr.nodeid, name)
+        writer = await self.client.create(
+            path, overwrite=bool(flags & os.O_TRUNC) or True)
+        await self.client.meta.set_attr(path, SetAttrOpts(mode=mode & 0o7777))
+        st = await self.client.meta.file_status(path)
+        fh = self._new_fh(_Handle(writer=writer, path=path))
+        self._open_writers[path] = writer
+        return self._entry(path, st) + abi.OPEN_OUT.pack(fh, 0, 0)
+
+    async def op_read(self, hdr, payload) -> bytes:
+        fh, offset, size, *_ = abi.READ_IN.unpack_from(payload, 0)
+        h = self._fh(fh)
+        if h.reader is None:
+            raise FuseError(Errno.EINVAL)
+        return await h.reader.pread(offset, size)
+
+    async def op_write(self, hdr, payload) -> bytes:
+        fh, offset, size, *_ = abi.WRITE_IN.unpack_from(payload, 0)
+        data = payload[abi.WRITE_IN.size:abi.WRITE_IN.size + size]
+        h = self._fh(fh)
+        if h.writer is None:
+            raise FuseError(Errno.EINVAL)
+        # the kernel issues writes concurrently: serialize per handle and
+        # park out-of-order chunks until the stream catches up
+        async with h.lock:
+            if offset > h.writer.pos:
+                if len(h.pending) > 256:
+                    raise FuseError(Errno.EIO)
+                h.pending[offset] = bytes(data)
+                return abi.WRITE_OUT.pack(size, 0)
+            if offset < h.writer.pos:
+                # cache-mode files are sequential-write (reference semantics)
+                raise FuseError(Errno.EOPNOTSUPP)
+            await h.writer.write(data)
+            while h.writer.pos in h.pending:
+                await h.writer.write(h.pending.pop(h.writer.pos))
+        return abi.WRITE_OUT.pack(size, 0)
+
+    async def op_flush(self, hdr, payload) -> bytes:
+        """close(2) semantics: FLUSH is synchronous with close, RELEASE is
+        not — so the file is completed (visible size, committed blocks)
+        here, and RELEASE only cleans up."""
+        fh, *_ = abi.FLUSH_IN.unpack_from(payload, 0)
+        h = self.handles.get(fh)
+        if h and h.writer is not None:
+            async with h.lock:
+                if h.pending:
+                    await h.writer.abort()
+                    h.writer = None
+                    self._open_writers.pop(h.path, None)
+                    raise FuseError(Errno.EIO)
+                await h.writer.close()
+                h.writer = None
+                self._open_writers.pop(h.path, None)
+        return b""
+
+    async def op_fsync(self, hdr, payload) -> bytes:
+        fh, *_ = abi.FSYNC_IN.unpack_from(payload, 0)
+        h = self.handles.get(fh)
+        if h and h.writer is not None:
+            await h.writer.flush()
+        return b""
+
+    async def op_release(self, hdr, payload) -> bytes:
+        fh, *_ = abi.RELEASE_IN.unpack_from(payload, 0)
+        h = self.handles.pop(fh, None)
+        if h is not None:
+            if h.writer is not None:        # no FLUSH came (rare)
+                async with h.lock:
+                    if h.pending:
+                        await h.writer.abort()
+                    else:
+                        await h.writer.close()
+                    self._open_writers.pop(h.path, None)
+            if h.reader is not None:
+                await h.reader.close()
+        return b""
+
+    async def op_opendir(self, hdr, payload) -> bytes:
+        path = self.node_path(hdr.nodeid)
+        entries = await self.client.meta.list_status(path)
+        fh = self._new_fh(_Handle(entries=entries, path=path))
+        return abi.OPEN_OUT.pack(fh, 0, 0)
+
+    async def op_releasedir(self, hdr, payload) -> bytes:
+        fh, *_ = abi.RELEASE_IN.unpack_from(payload, 0)
+        self.handles.pop(fh, None)
+        return b""
+
+    def _dtype(self, st: FileStatus) -> int:
+        if st.is_dir:
+            return abi.DT_DIR
+        if st.target is not None:
+            return abi.DT_LNK
+        return abi.DT_REG
+
+    async def op_readdir(self, hdr, payload) -> bytes:
+        fh, offset, size, *_ = abi.READ_IN.unpack_from(payload, 0)
+        h = self._fh(fh)
+        out = bytearray()
+        entries = h.entries or []
+        for i in range(offset, len(entries)):
+            st = entries[i]
+            nid = self.intern(st.path)
+            ent = abi.pack_dirent(nid, i + 1, st.name.encode(),
+                                  self._dtype(st))
+            if len(out) + len(ent) > size:
+                break
+            out += ent
+        return bytes(out)
+
+    async def op_readdirplus(self, hdr, payload) -> bytes:
+        fh, offset, size, *_ = abi.READ_IN.unpack_from(payload, 0)
+        h = self._fh(fh)
+        out = bytearray()
+        entries = h.entries or []
+        for i in range(offset, len(entries)):
+            st = entries[i]
+            entry_out = self._entry(st.path, st)
+            ent = abi.pack_direntplus(entry_out, self.ids[st.path], i + 1,
+                                      st.name.encode(), self._dtype(st))
+            if len(out) + len(ent) > size:
+                break
+            out += ent
+        return bytes(out)
+
+    async def op_statfs(self, hdr, payload) -> bytes:
+        info = await self.client.meta.master_info()
+        bsize = 4096
+        blocks = max(1, info.capacity // bsize)
+        bfree = info.available // bsize
+        return abi.STATFS_OUT.pack(blocks, bfree, bfree, info.inode_num + 1024,
+                                   1024, bsize, 255, bsize, 0,
+                                   0, 0, 0, 0, 0, 0)
+
+    async def op_access(self, hdr, payload) -> bytes:
+        return b""
+
+    async def op_getxattr(self, hdr, payload) -> bytes:
+        size, _ = abi.GETXATTR_IN.unpack_from(payload, 0)
+        name = bytes(payload[abi.GETXATTR_IN.size:]).rstrip(b"\x00").decode()
+        st = await self.client.meta.file_status(self.node_path(hdr.nodeid))
+        val = st.x_attr.get(name)
+        if val is None:
+            raise FuseError(Errno.ENODATA)
+        val = val if isinstance(val, bytes) else str(val).encode()
+        if size == 0:
+            return abi.GETXATTR_OUT.pack(len(val), 0)
+        if len(val) > size:
+            raise FuseError(Errno.EINVAL)
+        return val
+
+    async def op_setxattr(self, hdr, payload) -> bytes:
+        size, _flags = abi.SETXATTR_IN.unpack_from(payload, 0)
+        rest = bytes(payload[abi.SETXATTR_IN.size:])
+        name, rest = rest.split(b"\x00", 1)
+        value = rest[:size]
+        await self.client.meta.set_attr(
+            self.node_path(hdr.nodeid),
+            SetAttrOpts(add_x_attr={name.decode(): value}))
+        return b""
+
+    async def op_listxattr(self, hdr, payload) -> bytes:
+        size, _ = abi.GETXATTR_IN.unpack_from(payload, 0)
+        st = await self.client.meta.file_status(self.node_path(hdr.nodeid))
+        blob = b"".join(k.encode() + b"\x00" for k in st.x_attr)
+        if size == 0:
+            return abi.GETXATTR_OUT.pack(len(blob), 0)
+        return blob
+
+    async def op_removexattr(self, hdr, payload) -> bytes:
+        name = bytes(payload).rstrip(b"\x00").decode()
+        await self.client.meta.set_attr(
+            self.node_path(hdr.nodeid), SetAttrOpts(remove_x_attr=[name]))
+        return b""
+
+    async def op_lseek(self, hdr, payload) -> bytes:
+        fh, offset, whence, _ = abi.LSEEK_IN.unpack_from(payload, 0)
+        h = self._fh(fh)
+        length = h.reader.len if h.reader else 0
+        SEEK_DATA, SEEK_HOLE = 3, 4
+        if whence == SEEK_DATA:
+            if offset >= length:
+                raise FuseError(Errno.EINVAL)
+            return abi.LSEEK_OUT.pack(offset)
+        if whence == SEEK_HOLE:
+            return abi.LSEEK_OUT.pack(length)
+        raise FuseError(Errno.EINVAL)
+
+    async def op_interrupt(self, hdr, payload) -> None:
+        return None
+
+    async def op_fallocate(self, hdr, payload) -> bytes:
+        raise FuseError(Errno.EOPNOTSUPP)
+
+
+_DISPATCH = {
+    Op.INIT: CurvineFuseFs.op_init,
+    Op.DESTROY: CurvineFuseFs.op_destroy,
+    Op.LOOKUP: CurvineFuseFs.op_lookup,
+    Op.FORGET: CurvineFuseFs.op_forget,
+    Op.BATCH_FORGET: CurvineFuseFs.op_batch_forget,
+    Op.GETATTR: CurvineFuseFs.op_getattr,
+    Op.SETATTR: CurvineFuseFs.op_setattr,
+    Op.MKDIR: CurvineFuseFs.op_mkdir,
+    Op.UNLINK: CurvineFuseFs.op_unlink,
+    Op.RMDIR: CurvineFuseFs.op_rmdir,
+    Op.RENAME: CurvineFuseFs.op_rename,
+    Op.RENAME2: CurvineFuseFs.op_rename2,
+    Op.SYMLINK: CurvineFuseFs.op_symlink,
+    Op.READLINK: CurvineFuseFs.op_readlink,
+    Op.LINK: CurvineFuseFs.op_link,
+    Op.OPEN: CurvineFuseFs.op_open,
+    Op.CREATE: CurvineFuseFs.op_create,
+    Op.READ: CurvineFuseFs.op_read,
+    Op.WRITE: CurvineFuseFs.op_write,
+    Op.FLUSH: CurvineFuseFs.op_flush,
+    Op.FSYNC: CurvineFuseFs.op_fsync,
+    Op.RELEASE: CurvineFuseFs.op_release,
+    Op.OPENDIR: CurvineFuseFs.op_opendir,
+    Op.RELEASEDIR: CurvineFuseFs.op_releasedir,
+    Op.READDIR: CurvineFuseFs.op_readdir,
+    Op.READDIRPLUS: CurvineFuseFs.op_readdirplus,
+    Op.STATFS: CurvineFuseFs.op_statfs,
+    Op.ACCESS: CurvineFuseFs.op_access,
+    Op.GETXATTR: CurvineFuseFs.op_getxattr,
+    Op.SETXATTR: CurvineFuseFs.op_setxattr,
+    Op.LISTXATTR: CurvineFuseFs.op_listxattr,
+    Op.REMOVEXATTR: CurvineFuseFs.op_removexattr,
+    Op.LSEEK: CurvineFuseFs.op_lseek,
+    Op.INTERRUPT: CurvineFuseFs.op_interrupt,
+    Op.FALLOCATE: CurvineFuseFs.op_fallocate,
+}

@@ -1,0 +1,99 @@
+"""FUSE session: /dev/fuse channel loop + request dispatch.
+
+Parity: curvine-fuse/src/session/ (channel readers feeding async handlers,
+replies written back to the device). A dedicated thread blocks on
+os.read(/dev/fuse) — one whole request per read — and hands requests to
+the asyncio loop; handlers run concurrently; replies are single atomic
+os.write calls."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import threading
+
+from curvine_tpu.fuse import abi
+from curvine_tpu.fuse.ops import CurvineFuseFs, FuseError
+
+log = logging.getLogger(__name__)
+
+
+class FuseSession:
+    def __init__(self, fs: CurvineFuseFs, fd: int,
+                 max_write: int = 128 * 1024):
+        self.fs = fs
+        self.fd = fd
+        self.bufsize = max_write + 64 * 1024
+        self._stop = threading.Event()
+        self._reader: threading.Thread | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self.ready = asyncio.Event()
+
+    async def run(self) -> None:
+        """Serve until unmount (ENODEV on the channel) or stop()."""
+        self._loop = asyncio.get_running_loop()
+        queue: asyncio.Queue[bytes | None] = asyncio.Queue(maxsize=64)
+
+        def read_loop():
+            while not self._stop.is_set():
+                try:
+                    buf = os.read(self.fd, self.bufsize)
+                except OSError as e:
+                    if e.errno == 19:          # ENODEV: unmounted
+                        log.info("fuse channel closed (unmount)")
+                    elif not self._stop.is_set():
+                        log.warning("fuse read error: %s", e)
+                    break
+                if not buf:
+                    break
+                fut = asyncio.run_coroutine_threadsafe(queue.put(buf),
+                                                       self._loop)
+                try:
+                    fut.result(timeout=30)
+                except Exception:
+                    break
+            asyncio.run_coroutine_threadsafe(queue.put(None), self._loop)
+
+        self._reader = threading.Thread(target=read_loop, daemon=True,
+                                        name="fuse-chan")
+        self._reader.start()
+        self.ready.set()
+        pending: set[asyncio.Task] = set()
+        try:
+            while True:
+                buf = await queue.get()
+                if buf is None or self.fs.destroyed:
+                    break
+                t = asyncio.ensure_future(self._dispatch(buf))
+                pending.add(t)
+                t.add_done_callback(pending.discard)
+        finally:
+            for t in pending:
+                t.cancel()
+
+    async def _dispatch(self, buf: bytes) -> None:
+        view = memoryview(buf)
+        hdr = abi.InHeader.parse(view)
+        payload = view[abi.IN_HEADER.size:hdr.length]
+        try:
+            result = await self.fs.handle(hdr, payload)
+            if result is None:        # FORGET-class: no reply at all
+                return
+            reply = abi.pack_reply(hdr.unique, result)
+        except FuseError as e:
+            reply = abi.pack_reply(hdr.unique, error=e.errno)
+        except asyncio.CancelledError:
+            return
+        try:
+            os.write(self.fd, reply)
+        except OSError as e:
+            if e.errno not in (2, 19):        # ENOENT: interrupted request
+                log.warning("fuse reply write failed: %s", e)
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            os.close(self.fd)
+        except OSError:
+            pass

@@ -1,0 +1,3 @@
+from curvine_tpu.fault.runtime import FaultInjector, FaultSpec
+
+__all__ = ["FaultInjector", "FaultSpec"]

@@ -1,0 +1,71 @@
+"""HTTP control plane for fault injection.
+
+Parity: curvine-fault/src/http_control.rs + http_server.rs.
+  GET    /faults           list armed faults
+  POST   /faults           arm a fault (JSON FaultSpec fields)
+  DELETE /faults/{id}      disarm
+  DELETE /faults           disarm all
+  GET    /faults/log       injection event log"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+from aiohttp import web
+
+from curvine_tpu.fault.runtime import FaultInjector, FaultSpec
+
+
+class FaultControlServer:
+    def __init__(self, injector: FaultInjector, port: int = 0,
+                 host: str = "127.0.0.1"):
+        self.injector = injector
+        self.host = host
+        self.port = port
+        self.app = web.Application()
+        self.app.router.add_get("/faults", self._list)
+        self.app.router.add_post("/faults", self._add)
+        self.app.router.add_delete("/faults/{fault_id}", self._remove)
+        self.app.router.add_delete("/faults", self._clear)
+        self.app.router.add_get("/faults/log", self._log)
+        self._runner: web.AppRunner | None = None
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for s in self._runner.sites:
+            self.port = s._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    def _json(self, obj, status=200):
+        return web.Response(text=json.dumps(obj), status=status,
+                            content_type="application/json")
+
+    async def _list(self, req):
+        return self._json([dataclasses.asdict(f)
+                           for f in self.injector.faults.values()])
+
+    async def _add(self, req):
+        body = await req.json()
+        allowed = {f.name for f in dataclasses.fields(FaultSpec)} \
+            - {"fault_id", "hits"}
+        spec = FaultSpec(**{k: v for k, v in body.items() if k in allowed})
+        fid = self.injector.add(spec)
+        return self._json({"fault_id": fid}, status=201)
+
+    async def _remove(self, req):
+        self.injector.remove(int(req.match_info["fault_id"]))
+        return self._json({})
+
+    async def _clear(self, req):
+        self.injector.clear()
+        return self._json({})
+
+    async def _log(self, req):
+        return self._json(self.injector.log[-1000:])

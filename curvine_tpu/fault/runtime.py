@@ -1,0 +1,95 @@
+"""Fault injection runtime.
+
+Parity: curvine-fault/src/ (catalog.rs fault kinds, runtime.rs injection,
+controller.rs lifecycle). Faults are installed onto RpcServer.fault_hook
+and act on matching requests: added latency, dropped requests (client
+sees a timeout), or injected errors. Used by resilience tests and the
+`/faults` HTTP control plane (curvine_tpu.fault.http)."""
+
+from __future__ import annotations
+
+import asyncio
+import fnmatch
+import itertools
+import logging
+import random
+import time
+from dataclasses import dataclass, field
+
+from curvine_tpu.common.errors import CurvineError, ErrorCode
+
+log = logging.getLogger(__name__)
+
+KINDS = ("delay", "drop", "error")
+
+
+@dataclass
+class FaultSpec:
+    kind: str                       # delay | drop | error
+    target: str = "*"               # server name glob: master|worker|*
+    codes: list[int] = field(default_factory=list)   # RpcCodes; [] = all
+    probability: float = 1.0
+    delay_ms: int = 0               # for kind=delay
+    error_code: int = int(ErrorCode.IO)
+    error_msg: str = "injected fault"
+    max_hits: int = 0               # 0 = unlimited
+    fault_id: int = 0
+    hits: int = 0
+
+    def matches(self, server_name: str, code: int) -> bool:
+        if self.max_hits and self.hits >= self.max_hits:
+            return False
+        if not fnmatch.fnmatch(server_name, self.target):
+            return False
+        return not self.codes or code in self.codes
+
+
+class FaultInjector:
+    """Install on one or more RpcServers; manage active faults."""
+
+    def __init__(self) -> None:
+        self.faults: dict[int, FaultSpec] = {}
+        self._ids = itertools.count(1)
+        self.log: list[dict] = []
+
+    def install(self, *servers) -> "FaultInjector":
+        for s in servers:
+            s.fault_hook = self.hook
+        return self
+
+    def uninstall(self, *servers) -> None:
+        for s in servers:
+            s.fault_hook = None
+
+    def add(self, spec: FaultSpec) -> int:
+        if spec.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {spec.kind!r}")
+        spec.fault_id = next(self._ids)
+        self.faults[spec.fault_id] = spec
+        log.info("fault %d armed: %s", spec.fault_id, spec)
+        return spec.fault_id
+
+    def remove(self, fault_id: int) -> None:
+        self.faults.pop(fault_id, None)
+
+    def clear(self) -> None:
+        self.faults.clear()
+
+    async def hook(self, server_name: str, msg) -> bool:
+        """Returns False to drop the request."""
+        for spec in list(self.faults.values()):
+            if not spec.matches(server_name, msg.code):
+                continue
+            if random.random() > spec.probability:
+                continue
+            spec.hits += 1
+            self.log.append({"ts": time.time(), "fault": spec.fault_id,
+                             "kind": spec.kind, "server": server_name,
+                             "code": msg.code})
+            if spec.kind == "delay":
+                await asyncio.sleep(spec.delay_ms / 1000)
+            elif spec.kind == "drop":
+                return False
+            elif spec.kind == "error":
+                raise CurvineError.from_wire(spec.error_code, spec.error_msg)
+        return True

@@ -67,6 +67,9 @@ class RpcServer:
         self._handlers: dict[int, Handler] = {}
         self._server: asyncio.base_events.Server | None = None
         self._conns: set[ServerConn] = set()
+        # optional fault-injection hook (curvine_tpu.fault): called per
+        # request, may sleep, raise, or ask for the request to be dropped
+        self.fault_hook = None
 
     def register(self, code: int, handler: Handler) -> None:
         self._handlers[int(code)] = handler
@@ -129,6 +132,9 @@ class RpcServer:
     async def _dispatch(self, msg: Message, conn: ServerConn) -> None:
         handler = self._handlers.get(msg.code)
         try:
+            if self.fault_hook is not None:
+                if not await self.fault_hook(self.name, msg):
+                    return          # fault: drop the request silently
             if handler is None:
                 raise CurvineError(f"no handler for code {msg.code}")
             result = await handler(msg, conn)

@@ -1,0 +1,3 @@
+from curvine_tpu.sdk.filesystem import CurvineFileSystem, CurvineFile
+
+__all__ = ["CurvineFileSystem", "CurvineFile"]

@@ -1,0 +1,79 @@
+"""Framework dataset adapters over the cache.
+
+Parity: the reference's SDK integration points (libsdk consumed by
+PyTorch/Ray loaders). Provides:
+  * CurvineIterableDataset — torch.utils.data.IterableDataset streaming
+    cached shards (worker-sharded for num_workers > 1);
+  * jax_batches — synchronous numpy batch iterator for JAX input
+    pipelines (pair with curvine_tpu.tpu.ingest.DevicePrefetcher).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from curvine_tpu.sdk.filesystem import CurvineFileSystem
+
+
+def _list_shards(fs: CurvineFileSystem, path: str) -> list[str]:
+    return sorted(s.path for s in fs.list_status(path) if not s.is_dir)
+
+
+def jax_batches(fs: CurvineFileSystem, path: str, batch: int, seq_len: int,
+                dtype=np.int32, shuffle_seed: int | None = None):
+    """Yield [batch, seq_len] numpy token batches from cached shards."""
+    dtype = np.dtype(dtype)
+    shards = _list_shards(fs, path)
+    if shuffle_seed is not None:
+        shards = list(np.random.default_rng(shuffle_seed).permutation(shards))
+    per_batch = batch * seq_len
+    carry = np.empty(0, dtype=dtype)
+    for shard in shards:
+        data = np.frombuffer(fs.read_all(shard), dtype=dtype)
+        if carry.size:
+            data = np.concatenate([carry, data])
+        usable = (data.size // per_batch) * per_batch
+        for off in range(0, usable, per_batch):
+            yield data[off:off + per_batch].reshape(batch, seq_len)
+        carry = data[usable:].copy()
+
+
+try:
+    import torch
+    from torch.utils.data import IterableDataset, get_worker_info
+
+    class CurvineIterableDataset(IterableDataset):
+        """Streams samples from cached shard files; shards are split
+        across DataLoader workers."""
+
+        def __init__(self, master: str, path: str, sample_bytes: int,
+                     dtype=np.uint8, transform=None):
+            super().__init__()
+            self.master = master
+            self.path = path
+            self.sample_bytes = sample_bytes
+            self.dtype = np.dtype(dtype)
+            self.transform = transform
+
+        def __iter__(self):
+            fs = CurvineFileSystem(master=self.master)
+            try:
+                shards = _list_shards(fs, self.path)
+                info = get_worker_info()
+                if info is not None:
+                    shards = shards[info.id::info.num_workers]
+                for shard in shards:
+                    data = fs.read_all(shard)
+                    n = len(data) // self.sample_bytes
+                    for i in range(n):
+                        raw = data[i * self.sample_bytes:
+                                   (i + 1) * self.sample_bytes]
+                        sample = torch.from_numpy(
+                            np.frombuffer(raw, dtype=self.dtype).copy())
+                        yield self.transform(sample) if self.transform \
+                            else sample
+            finally:
+                fs.close()
+
+except ImportError:  # pragma: no cover — torch is baked into this image
+    CurvineIterableDataset = None  # type: ignore[assignment]

@@ -1,0 +1,3 @@
+from curvine_tpu.vector.table import VectorTable
+
+__all__ = ["VectorTable"]

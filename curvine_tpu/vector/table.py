@@ -1,0 +1,179 @@
+"""Vector tables on the distributed cache.
+
+Parity: curvine-lancedb/ (Lance columnar tables cached by Curvine, scanned
+for embedding lookup). TPU-native rework: row groups are fixed-schema
+columnar blobs cached as ordinary files (so they ride the short-circuit
+mmap path), and KNN search runs as one bf16 matmul on the TPU — the MXU
+does the scan, not a CPU ANN index.
+
+Layout under `<path>/`:
+  schema.json                  {"dim": D, "columns": {...}, "row_groups": N}
+  rg-00000.vec ...             row groups: [n, D] float32 + packed columns
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from curvine_tpu.client import CurvineClient
+from curvine_tpu.common import errors as err
+
+_DTYPES = {"f32": np.float32, "i32": np.int32, "i64": np.int64}
+
+
+class VectorTable:
+    def __init__(self, client: CurvineClient, path: str, dim: int,
+                 columns: dict[str, str], row_groups: int):
+        self.client = client
+        self.path = path.rstrip("/")
+        self.dim = dim
+        self.columns = columns
+        self.row_groups = row_groups
+
+    # ---------------- lifecycle ----------------
+
+    @staticmethod
+    async def create(client: CurvineClient, path: str, dim: int,
+                     columns: dict[str, str] | None = None) -> "VectorTable":
+        columns = columns or {}
+        for name, dt in columns.items():
+            if dt not in _DTYPES:
+                raise err.InvalidArgument(f"column {name}: bad dtype {dt}")
+        t = VectorTable(client, path, dim, columns, 0)
+        await client.meta.mkdir(path)
+        await t._write_schema()
+        return t
+
+    @staticmethod
+    async def open(client: CurvineClient, path: str) -> "VectorTable":
+        raw = await (await client.open(f"{path.rstrip('/')}/schema.json")
+                     ).read_all()
+        s = json.loads(raw)
+        return VectorTable(client, path, s["dim"], s["columns"],
+                           s["row_groups"])
+
+    async def _write_schema(self) -> None:
+        await self.client.write_all(
+            f"{self.path}/schema.json",
+            json.dumps({"dim": self.dim, "columns": self.columns,
+                        "row_groups": self.row_groups}).encode())
+
+    # ---------------- append / scan ----------------
+
+    async def append(self, vectors: np.ndarray,
+                     columns: dict[str, np.ndarray] | None = None) -> int:
+        """Append one row group; returns its index."""
+        columns = columns or {}
+        vectors = np.ascontiguousarray(vectors, dtype=np.float32)
+        if vectors.ndim != 2 or vectors.shape[1] != self.dim:
+            raise err.InvalidArgument(
+                f"vectors must be [n, {self.dim}], got {vectors.shape}")
+        n = vectors.shape[0]
+        parts = [np.int64(n).tobytes(), vectors.tobytes()]
+        for name, dt in self.columns.items():
+            col = np.ascontiguousarray(columns[name], dtype=_DTYPES[dt])
+            if col.shape[0] != n:
+                raise err.InvalidArgument(f"column {name} length mismatch")
+            parts.append(col.tobytes())
+        rg = self.row_groups
+        await self.client.write_all(f"{self.path}/rg-{rg:05d}.vec",
+                                    b"".join(parts))
+        self.row_groups += 1
+        await self._write_schema()
+        return rg
+
+    async def read_group(self, rg: int) -> tuple[np.ndarray, dict]:
+        reader = await self.client.open(f"{self.path}/rg-{rg:05d}.vec")
+        view = await reader.mmap_view(0, reader.len)
+        if view is None:
+            view = np.frombuffer(await reader.read_all(), dtype=np.uint8)
+        n = int(view[:8].view(np.int64)[0])
+        off = 8
+        vec_bytes = n * self.dim * 4
+        vectors = view[off:off + vec_bytes].view(np.float32).reshape(
+            n, self.dim)
+        off += vec_bytes
+        cols = {}
+        for name, dt in self.columns.items():
+            dtype = np.dtype(_DTYPES[dt])
+            cols[name] = view[off:off + n * dtype.itemsize].view(dtype)
+            off += n * dtype.itemsize
+        return vectors, cols
+
+    async def scan(self):
+        """Async iterator over (vectors, columns) per row group."""
+        for rg in range(self.row_groups):
+            yield await self.read_group(rg)
+
+    async def count(self) -> int:
+        total = 0
+        async for vectors, _ in self.scan():
+            total += vectors.shape[0]
+        return total
+
+    # ---------------- TPU knn ----------------
+
+    async def knn(self, query: np.ndarray, k: int = 10,
+                  metric: str = "cosine", device=None):
+        """Top-k nearest rows to `query` [D] or [Q, D]. The scan is a
+        single [Q, D] × [D, N] matmul per row group on the device (MXU),
+        with partial top-k merged across groups."""
+        import jax
+        import jax.numpy as jnp
+
+        query = np.atleast_2d(np.asarray(query, dtype=np.float32))
+        if query.shape[1] != self.dim:
+            raise err.InvalidArgument(f"query dim {query.shape[1]} != {self.dim}")
+        dev = device if device is not None else jax.devices()[0]
+        q = jax.device_put(query, dev)
+        if metric == "cosine":
+            q = q / jnp.linalg.norm(q, axis=1, keepdims=True).clip(1e-12)
+
+        best_scores = None
+        best_ids = None
+        row_base = 0
+        async for vectors, _cols in self.scan():
+            v = jax.device_put(vectors, dev)
+            if metric == "cosine":
+                v = v / jnp.linalg.norm(v, axis=1, keepdims=True).clip(1e-12)
+                scores = q @ v.T
+            elif metric == "l2":
+                scores = -(jnp.sum(q * q, 1)[:, None]
+                           - 2 * q @ v.T + jnp.sum(v * v, 1)[None, :])
+            else:
+                raise err.InvalidArgument(f"metric {metric!r}")
+            kk = min(k, scores.shape[1])
+            s, i = jax.lax.top_k(scores, kk)
+            i = i + row_base
+            row_base += vectors.shape[0]
+            if best_scores is None:
+                best_scores, best_ids = s, i
+            else:
+                cat_s = jnp.concatenate([best_scores, s], axis=1)
+                cat_i = jnp.concatenate([best_ids, i], axis=1)
+                kk = min(k, cat_s.shape[1])
+                best_scores, sel = jax.lax.top_k(cat_s, kk)
+                best_ids = jnp.take_along_axis(cat_i, sel, axis=1)
+        if best_scores is None:
+            raise err.FileNotFound(f"table {self.path} is empty")
+        return np.asarray(best_ids), np.asarray(best_scores)
+
+    async def take(self, row_ids: np.ndarray) -> tuple[np.ndarray, dict]:
+        """Materialize rows by global row id."""
+        row_ids = np.asarray(row_ids).reshape(-1)
+        out_vecs = np.zeros((row_ids.size, self.dim), dtype=np.float32)
+        out_cols = {name: np.zeros(row_ids.size, dtype=_DTYPES[dt])
+                    for name, dt in self.columns.items()}
+        base = 0
+        async for vectors, cols in self.scan():
+            n = vectors.shape[0]
+            mask = (row_ids >= base) & (row_ids < base + n)
+            if mask.any():
+                local = row_ids[mask] - base
+                out_vecs[mask] = vectors[local]
+                for name in self.columns:
+                    out_cols[name][mask] = cols[name][local]
+            base += n
+        return out_vecs, out_cols

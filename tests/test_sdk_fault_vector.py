@@ -1,0 +1,178 @@
+"""SDK (sync API + datasets), fault injection, vector tables.
+
+Mirrors reference: curvine-libsdk/tests/, curvine-fault/tests/,
+curvine-lancedb/tests/."""
+
+import asyncio
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from curvine_tpu.common import errors as cerr
+from curvine_tpu.fault import FaultInjector, FaultSpec
+from curvine_tpu.rpc import RpcCode
+from curvine_tpu.testing import MiniCluster
+
+CPU = jax.devices("cpu")[0]
+
+
+@pytest.fixture
+def cluster_loop():
+    loop = asyncio.new_event_loop()
+    mc = MiniCluster(workers=1)
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    asyncio.run_coroutine_threadsafe(mc.start(), loop).result(30)
+    yield mc
+    asyncio.run_coroutine_threadsafe(mc.stop(), loop).result(30)
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(5)
+
+
+def test_sdk_filesystem(cluster_loop):
+    from curvine_tpu.sdk import CurvineFileSystem
+    mc = cluster_loop
+    with CurvineFileSystem(master=mc.master.addr) as fs:
+        fs.mkdir("/sdk/dir")
+        assert fs.exists("/sdk/dir")
+        with fs.open("/sdk/f.bin", "wb") as f:
+            f.write(b"alpha")
+            f.write(b"beta")
+        st = fs.get_status("/sdk/f.bin")
+        assert st.len == 9
+        with fs.open("/sdk/f.bin", "rb") as f:
+            assert f.read(5) == b"alpha"
+            assert f.read() == b"beta"
+            f.seek(0)
+            assert f.read() == b"alphabeta"
+            assert f.pread(5, 4) == b"beta"
+        with fs.open("/sdk/f.bin", "ab") as f:
+            f.write(b"!")
+        assert fs.read_all("/sdk/f.bin") == b"alphabeta!"
+        names = [s.name for s in fs.list_status("/sdk")]
+        assert sorted(names) == ["dir", "f.bin"]
+        fs.rename("/sdk/f.bin", "/sdk/g.bin")
+        fs.delete("/sdk", recursive=True)
+        assert not fs.exists("/sdk")
+        info = fs.master_info()
+        assert len(info.live_workers) == 1
+
+
+def test_sdk_torch_dataset(cluster_loop):
+    from curvine_tpu.sdk import CurvineFileSystem
+    from curvine_tpu.sdk.datasets import CurvineIterableDataset, jax_batches
+    import torch
+    mc = cluster_loop
+    with CurvineFileSystem(master=mc.master.addr) as fs:
+        fs.mkdir("/ds")
+        samples = np.arange(64 * 16, dtype=np.uint8).reshape(64, 16)
+        fs.write_all("/ds/shard-0.bin", samples[:32].tobytes())
+        fs.write_all("/ds/shard-1.bin", samples[32:].tobytes())
+
+    ds = CurvineIterableDataset(mc.master.addr, "/ds", sample_bytes=16)
+    loader = torch.utils.data.DataLoader(ds, batch_size=8, num_workers=0)
+    batches = list(loader)
+    assert len(batches) == 8
+    got = torch.cat(batches).numpy()
+    assert np.array_equal(got, samples)
+
+    with CurvineFileSystem(master=mc.master.addr) as fs:
+        fs.write_all("/ds2/t.bin",
+                     np.arange(1024, dtype=np.int32).tobytes())
+        out = list(jax_batches(fs, "/ds2", batch=2, seq_len=64))
+        assert all(b.shape == (2, 64) for b in out)
+        assert len(out) == 8
+
+
+async def test_fault_injection_delay_error_drop():
+    async with MiniCluster(workers=1) as mc:
+        inj = FaultInjector().install(mc.master.rpc)
+        c = mc.client()
+        # error injection on FILE_STATUS
+        fid = inj.add(FaultSpec(kind="error", codes=[int(RpcCode.FILE_STATUS)],
+                                error_code=int(cerr.ErrorCode.IO)))
+        await c.write_all("/ok", b"x")
+        with pytest.raises(cerr.CurvineError):
+            await c.meta.file_status("/ok")
+        inj.remove(fid)
+        assert (await c.meta.file_status("/ok")).len == 1
+
+        # delay injection is observable
+        import time
+        inj.add(FaultSpec(kind="delay", codes=[int(RpcCode.EXISTS)],
+                          delay_ms=300))
+        t0 = time.perf_counter()
+        await c.meta.exists("/ok")
+        assert time.perf_counter() - t0 >= 0.28
+        inj.clear()
+
+        # drop: client request times out, then retries succeed after clear
+        fid = inj.add(FaultSpec(kind="drop", codes=[int(RpcCode.EXISTS)],
+                                max_hits=1))
+        c.conf.client.rpc_timeout_ms = 500
+        c.meta.pool.timeout_ms = 500
+        for conns in c.meta.pool._conns.values():
+            for conn in conns:
+                conn.timeout = 0.5
+        assert await c.meta.exists("/ok")   # one drop, retry succeeds
+        assert inj.log and inj.log[-1]["kind"] == "drop"
+
+
+async def test_fault_http_control():
+    import aiohttp
+    from curvine_tpu.fault.http import FaultControlServer
+    async with MiniCluster(workers=1) as mc:
+        inj = FaultInjector().install(mc.master.rpc)
+        ctl = FaultControlServer(inj)
+        await ctl.start()
+        try:
+            base = f"http://127.0.0.1:{ctl.port}"
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"{base}/faults", json={
+                        "kind": "delay", "delay_ms": 10}) as r:
+                    assert r.status == 201
+                    fid = (await r.json())["fault_id"]
+                async with s.get(f"{base}/faults") as r:
+                    faults = await r.json()
+                    assert len(faults) == 1
+                async with s.delete(f"{base}/faults/{fid}") as r:
+                    assert r.status == 200
+                async with s.get(f"{base}/faults") as r:
+                    assert await r.json() == []
+        finally:
+            await ctl.stop()
+
+
+async def test_vector_table():
+    from curvine_tpu.vector import VectorTable
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        dim = 32
+        t = await VectorTable.create(c, "/vec/emb", dim,
+                                     columns={"doc_id": "i64"})
+        rng = np.random.default_rng(0)
+        v1 = rng.normal(size=(100, dim)).astype(np.float32)
+        v2 = rng.normal(size=(50, dim)).astype(np.float32)
+        await t.append(v1, {"doc_id": np.arange(100, dtype=np.int64)})
+        await t.append(v2, {"doc_id": np.arange(100, 150, dtype=np.int64)})
+        assert await t.count() == 150
+
+        # reopen and knn: query = row 120 exactly → top hit is itself
+        t2 = await VectorTable.open(c, "/vec/emb")
+        assert t2.row_groups == 2
+        ids, scores = await t2.knn(v2[20], k=5, device=CPU)
+        assert ids[0, 0] == 120
+        assert scores[0, 0] == pytest.approx(1.0, abs=1e-5)
+
+        # l2 metric, batch queries
+        ids, _ = await t2.knn(np.stack([v1[3], v2[7]]), k=3, metric="l2",
+                              device=CPU)
+        assert ids[0, 0] == 3 and ids[1, 0] == 107
+
+        # take() returns the right columns
+        vecs, cols = await t2.take(np.array([120, 3]))
+        assert cols["doc_id"].tolist() == [120, 3]
+        assert np.allclose(vecs[0], v2[20])

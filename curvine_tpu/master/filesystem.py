@@ -41,6 +41,7 @@ class MasterFilesystem:
         self.pending_deletes: dict[int, set[int]] = {}
         self.mounts = None          # set by MountManager
         self.on_worker_lost = None  # hook: ReplicationManager
+        self.on_mutation = None     # hook: RaftLite journal replication
         self.start_ms = now_ms()
 
     # ==================== journal plumbing ====================
@@ -63,7 +64,9 @@ class MasterFilesystem:
     def _log(self, op: str, args: dict):
         result = self._apply(op, args)
         if self.journal is not None:
-            self.journal.append(op, args)
+            seq = self.journal.append(op, args)
+            if self.on_mutation is not None:
+                self.on_mutation(seq, op, args)
             self._entries_since_snapshot += 1
             if self._entries_since_snapshot >= self.snapshot_interval:
                 self.checkpoint()

@@ -1,0 +1,259 @@
+"""Master HA: raft-lite journal replication.
+
+Parity: curvine-common/src/raft/ (raft_node, raft_journal, snapshot/) —
+the reference replicates master metadata through the raft crate. This is
+a compact re-implementation over our RPC fabric with the same observable
+behavior: leader election (highest journal seq wins, majority votes,
+term-monotonic), journal-entry streaming to followers, snapshot catch-up
+for lagging peers, NOT_LEADER redirects that the client already follows.
+
+Simplification vs full Raft (documented): the leader applies+journals
+locally before majority acknowledgment, so an acked write can be lost if
+the leader dies before any follower received it. The reference's raft
+commit rule closes that window; tightening this is tracked for a later
+round."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+
+import msgpack
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.rpc import Message, RpcCode, RpcServer, ServerConn
+from curvine_tpu.rpc.client import ConnectionPool
+from curvine_tpu.rpc.frame import pack, unpack
+
+log = logging.getLogger(__name__)
+
+FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+
+class RaftLite:
+    def __init__(self, node_id: int, peers: dict[int, str], fs,
+                 rpc: RpcServer, election_timeout_ms: tuple[int, int] =
+                 (600, 1200), heartbeat_ms: int = 150):
+        self.node_id = node_id
+        self.peers = dict(peers)            # id -> addr (excluding self)
+        self.fs = fs
+        self.rpc = rpc
+        self.role = FOLLOWER
+        self.term = 0
+        self.voted_for: int | None = None
+        self.leader_id: int | None = None
+        self.election_timeout = election_timeout_ms
+        self.heartbeat_ms = heartbeat_ms
+        self.pool = ConnectionPool(size=1, timeout_ms=2_000)
+        self._last_heard = 0.0
+        self._bg: list[asyncio.Task] = []
+        self._repl_queues: dict[int, asyncio.Queue] = {}
+        rpc.register(RpcCode.RAFT_VOTE, self._h_vote)
+        rpc.register(RpcCode.RAFT_APPEND, self._h_append)
+        rpc.register(RpcCode.RAFT_SNAPSHOT, self._h_snapshot)
+
+    # ---------------- lifecycle ----------------
+
+    @property
+    def is_leader(self) -> bool:
+        return self.role == LEADER
+
+    @property
+    def quorum(self) -> int:
+        return (len(self.peers) + 1) // 2 + 1
+
+    def last_seq(self) -> int:
+        return self.fs.journal.seq if self.fs.journal else 0
+
+    async def start(self) -> None:
+        self._touch()
+        self._bg.append(asyncio.ensure_future(self._election_loop()))
+
+    async def stop(self) -> None:
+        for t in self._bg:
+            t.cancel()
+        self._bg.clear()
+        await self.pool.close()
+
+    def _touch(self) -> None:
+        self._last_heard = asyncio.get_event_loop().time()
+
+    # ---------------- election ----------------
+
+    async def _election_loop(self) -> None:
+        while True:
+            timeout = random.uniform(*self.election_timeout) / 1000
+            await asyncio.sleep(timeout / 4)
+            if self.role == LEADER:
+                continue
+            now = asyncio.get_event_loop().time()
+            if now - self._last_heard < timeout:
+                continue
+            await self._run_election()
+
+    async def _run_election(self) -> None:
+        self.role = CANDIDATE
+        self.term += 1
+        self.voted_for = self.node_id
+        self.leader_id = None
+        votes = 1
+        log.info("node %d: starting election term %d (last_seq=%d)",
+                 self.node_id, self.term, self.last_seq())
+
+        async def ask(pid: int, addr: str) -> bool:
+            try:
+                conn = await self.pool.get(addr)
+                rep = await conn.call(RpcCode.RAFT_VOTE, data=pack({
+                    "term": self.term, "candidate": self.node_id,
+                    "last_seq": self.last_seq()}), timeout=1.0)
+                body = unpack(rep.data) or {}
+                if body.get("term", 0) > self.term:
+                    self._step_down(body["term"])
+                return bool(body.get("granted"))
+            except Exception:
+                return False
+
+        results = await asyncio.gather(
+            *(ask(pid, addr) for pid, addr in self.peers.items()))
+        votes += sum(results)
+        if self.role != CANDIDATE:
+            return
+        if votes >= self.quorum:
+            await self._become_leader()
+        else:
+            self.role = FOLLOWER
+            self._touch()
+
+    def _step_down(self, term: int) -> None:
+        if term > self.term:
+            self.term = term
+            self.voted_for = None
+        if self.role == LEADER:
+            log.info("node %d: stepping down in term %d", self.node_id, term)
+            for t in self._bg[1:]:
+                t.cancel()
+            del self._bg[1:]
+        self.role = FOLLOWER
+        self._touch()
+
+    async def _become_leader(self) -> None:
+        log.info("node %d: leader for term %d", self.node_id, self.term)
+        self.role = LEADER
+        self.leader_id = self.node_id
+        self._repl_queues = {pid: asyncio.Queue() for pid in self.peers}
+        for pid, addr in self.peers.items():
+            self._bg.append(asyncio.ensure_future(
+                self._replicate_loop(pid, addr)))
+
+    # ---------------- replication (leader) ----------------
+
+    def on_mutation(self, seq: int, op: str, args: dict) -> None:
+        """Called by MasterFilesystem._log after a local apply+journal."""
+        if self.role != LEADER:
+            return
+        for q in self._repl_queues.values():
+            q.put_nowait((seq, op, args))
+
+    async def _replicate_loop(self, pid: int, addr: str) -> None:
+        """Per-follower: heartbeats + journal entry stream + catch-up."""
+        follower_seq = -1     # unknown until first ack
+        while self.role == LEADER:
+            batch: list = []
+            q = self._repl_queues[pid]
+            try:
+                entry = await asyncio.wait_for(
+                    q.get(), self.heartbeat_ms / 1000)
+                batch.append(entry)
+                while not q.empty() and len(batch) < 256:
+                    batch.append(q.get_nowait())
+            except asyncio.TimeoutError:
+                pass          # heartbeat
+            try:
+                conn = await self.pool.get(addr)
+                rep = await conn.call(RpcCode.RAFT_APPEND, data=pack({
+                    "term": self.term, "leader": self.node_id,
+                    "entries": [[s, o, a] for s, o, a in batch],
+                    "leader_seq": self.last_seq()}), timeout=2.0)
+                body = unpack(rep.data) or {}
+                if body.get("term", 0) > self.term:
+                    self._step_down(body["term"])
+                    return
+                follower_seq = body.get("applied_seq", follower_seq)
+                if body.get("need_snapshot"):
+                    await self._send_snapshot(addr)
+            except Exception as e:
+                log.debug("replicate to %d failed: %s", pid, e)
+                await asyncio.sleep(0.2)
+
+    async def _send_snapshot(self, addr: str) -> None:
+        state = self.fs._snapshot_state()
+        conn = await self.pool.get(addr)
+        await conn.call(RpcCode.RAFT_SNAPSHOT, data=msgpack.packb({
+            "term": self.term, "leader": self.node_id,
+            "seq": self.last_seq(), "state": state}, use_bin_type=True),
+            timeout=30.0)
+        log.info("snapshot (seq=%d) sent to %s", self.last_seq(), addr)
+
+    # ---------------- handlers (follower) ----------------
+
+    async def _h_vote(self, msg: Message, conn: ServerConn):
+        q = unpack(msg.data) or {}
+        term, candidate, last_seq = q["term"], q["candidate"], q["last_seq"]
+        if term > self.term:
+            self._step_down(term)
+        granted = (term >= self.term
+                   and self.voted_for in (None, candidate)
+                   and last_seq >= self.last_seq())
+        if granted:
+            self.voted_for = candidate
+            self._touch()
+        return {}, pack({"granted": granted, "term": self.term})
+
+    async def _h_append(self, msg: Message, conn: ServerConn):
+        q = unpack(msg.data) or {}
+        term = q["term"]
+        if term < self.term:
+            return {}, pack({"term": self.term, "applied_seq": self.last_seq()})
+        if term > self.term or self.role != FOLLOWER:
+            self._step_down(term)
+        self.leader_id = q["leader"]
+        self._touch()
+        need_snapshot = False
+        for seq, op, args in q.get("entries", []):
+            if seq <= self.last_seq():
+                continue                      # already have it
+            if seq != self.last_seq() + 1:
+                need_snapshot = True          # gap: ask for catch-up
+                break
+            try:
+                self.fs._apply(op, args)
+            except err.CurvineError as e:
+                log.warning("follower apply %s failed: %s", op, e)
+            if self.fs.journal:
+                self.fs.journal.append(op, args)
+        if not need_snapshot and q.get("leader_seq", 0) > self.last_seq():
+            need_snapshot = True
+        return {}, pack({"term": self.term, "applied_seq": self.last_seq(),
+                         "need_snapshot": need_snapshot})
+
+    async def _h_snapshot(self, msg: Message, conn: ServerConn):
+        q = msgpack.unpackb(bytes(msg.data), raw=False, strict_map_key=False)
+        if q["term"] < self.term:
+            return {}, pack({"term": self.term})
+        self._touch()
+        self.fs._load_snapshot(q["state"])
+        if self.fs.journal:
+            self.fs.journal.seq = q["seq"]
+            self.fs.journal.write_snapshot(q["state"])
+        log.info("node %d: installed snapshot at seq %d", self.node_id,
+                 q["seq"])
+        return {}, pack({"term": self.term, "applied_seq": self.last_seq()})
+
+    # ---------------- client gate ----------------
+
+    def check_leader(self) -> None:
+        if self.role != LEADER:
+            raise err.NotLeader(
+                f"node {self.node_id} is {self.role}; "
+                f"leader is {self.leader_id}")

@@ -45,6 +45,13 @@ class MasterServer:
         self.ttl = TtlManager(self.fs, check_ms=mc.ttl_check_ms)
         self.retry_cache = RetryCache(mc.retry_cache_size, mc.retry_cache_ttl_ms)
         self.rpc = RpcServer(mc.hostname, mc.rpc_port, "master")
+        self.raft = None
+        if mc.raft_peers:
+            from curvine_tpu.master.ha import RaftLite
+            peers = {i + 1: addr for i, addr in enumerate(mc.raft_peers)
+                     if i + 1 != mc.raft_node_id}
+            self.raft = RaftLite(mc.raft_node_id, peers, self.fs, self.rpc)
+            self.fs.on_mutation = self.raft.on_mutation
         self._register_handlers()
         self._bg: list[asyncio.Task] = []
 
@@ -55,6 +62,8 @@ class MasterServer:
     async def start(self) -> None:
         self.fs.recover()
         await self.rpc.start()
+        if self.raft is not None:
+            await self.raft.start()
         self._bg.append(asyncio.ensure_future(self._heartbeat_checker()))
         self._bg.append(asyncio.ensure_future(self.ttl.run()))
         self._bg.append(asyncio.ensure_future(self.replication.run()))
@@ -62,6 +71,8 @@ class MasterServer:
         log.info("master started at %s", self.addr)
 
     async def stop(self) -> None:
+        if self.raft is not None:
+            await self.raft.stop()
         for t in self._bg:
             t.cancel()
         self._bg.clear()
@@ -127,6 +138,8 @@ class MasterServer:
         async def handler(msg: Message, conn: ServerConn):
             req = unpack(msg.data) or {}
             with metrics.timer(f"rpc.{fn.__name__.lstrip('_')}"):
+                if mutate and self.raft is not None:
+                    self.raft.check_leader()
                 if mutate:
                     key = (req.get("client_id"), req.get("call_id"))
                     if key[0] is not None and key[1] is not None:

@@ -80,11 +80,16 @@ class Connection:
                 pass
 
     async def send(self, msg: Message) -> None:
-        if self.closed or self._writer is None:
+        if self.closed or self._writer is None or self._writer.is_closing():
             raise ConnectError(f"connection {self.addr} is closed")
         async with self._wlock:
-            write_frame(self._writer, msg)
-            await self._writer.drain()
+            try:
+                write_frame(self._writer, msg)
+                await self._writer.drain()
+            except (ConnectionError, RuntimeError, TypeError) as e:
+                # transport torn down mid-write
+                self.closed = True
+                raise ConnectError(f"send to {self.addr}: {e}") from e
 
     def register(self, req_id: int) -> asyncio.Queue:
         q: asyncio.Queue = asyncio.Queue()

@@ -1,0 +1,199 @@
+// curvine_tpu native helpers: checksums + block-file IO.
+//
+// Parity: the reference's Rust data plane (crc32fast, murmur3 in
+// Cargo.toml; orpc zero-copy file IO). Exposed as a small C ABI consumed
+// via ctypes (curvine_tpu/common/native.py); every entry point has a
+// pure-Python fallback so the framework runs without the .so.
+
+#include <cstdint>
+#include <cstddef>
+#include <cstring>
+#include <fcntl.h>
+#include <unistd.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------------
+// CRC32C (Castagnoli), slice-by-8. Polynomial 0x1EDC6F41 (reflected
+// 0x82F63B78) — matches crc32c used by the reference's block checksums.
+// ---------------------------------------------------------------------
+
+static uint32_t crc32c_table[8][256];
+static bool crc32c_init_done = false;
+
+static void crc32c_init() {
+    if (crc32c_init_done) return;
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t crc = i;
+        for (int j = 0; j < 8; j++)
+            crc = (crc >> 1) ^ ((crc & 1) ? 0x82F63B78u : 0);
+        crc32c_table[0][i] = crc;
+    }
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t crc = crc32c_table[0][i];
+        for (int k = 1; k < 8; k++) {
+            crc = crc32c_table[0][crc & 0xFF] ^ (crc >> 8);
+            crc32c_table[k][i] = crc;
+        }
+    }
+    crc32c_init_done = true;
+}
+
+uint32_t cv_crc32c(const uint8_t* data, size_t len, uint32_t seed) {
+    crc32c_init();
+    uint32_t crc = ~seed;
+    // align to 8 bytes
+    while (len && (reinterpret_cast<uintptr_t>(data) & 7)) {
+        crc = crc32c_table[0][(crc ^ *data++) & 0xFF] ^ (crc >> 8);
+        len--;
+    }
+    while (len >= 8) {
+        uint64_t word;
+        memcpy(&word, data, 8);
+        word ^= crc;
+        crc = crc32c_table[7][word & 0xFF] ^
+              crc32c_table[6][(word >> 8) & 0xFF] ^
+              crc32c_table[5][(word >> 16) & 0xFF] ^
+              crc32c_table[4][(word >> 24) & 0xFF] ^
+              crc32c_table[3][(word >> 32) & 0xFF] ^
+              crc32c_table[2][(word >> 40) & 0xFF] ^
+              crc32c_table[1][(word >> 48) & 0xFF] ^
+              crc32c_table[0][(word >> 56) & 0xFF];
+        data += 8;
+        len -= 8;
+    }
+    while (len--) {
+        crc = crc32c_table[0][(crc ^ *data++) & 0xFF] ^ (crc >> 8);
+    }
+    return ~crc;
+}
+
+// ---------------------------------------------------------------------
+// xxHash64 — fast content fingerprinting (dedup scans, cache keys).
+// ---------------------------------------------------------------------
+
+static const uint64_t P1 = 11400714785074694791ULL;
+static const uint64_t P2 = 14029467366897019727ULL;
+static const uint64_t P3 = 1609587929392839161ULL;
+static const uint64_t P4 = 9650029242287828579ULL;
+static const uint64_t P5 = 2870177450012600261ULL;
+
+static inline uint64_t rotl64(uint64_t x, int r) {
+    return (x << r) | (x >> (64 - r));
+}
+
+static inline uint64_t read64(const uint8_t* p) {
+    uint64_t v; memcpy(&v, p, 8); return v;
+}
+static inline uint32_t read32(const uint8_t* p) {
+    uint32_t v; memcpy(&v, p, 4); return v;
+}
+
+uint64_t cv_xxh64(const uint8_t* data, size_t len, uint64_t seed) {
+    const uint8_t* end = data + len;
+    uint64_t h;
+    if (len >= 32) {
+        uint64_t v1 = seed + P1 + P2, v2 = seed + P2,
+                 v3 = seed, v4 = seed - P1;
+        const uint8_t* limit = end - 32;
+        do {
+            v1 = rotl64(v1 + read64(data) * P2, 31) * P1; data += 8;
+            v2 = rotl64(v2 + read64(data) * P2, 31) * P1; data += 8;
+            v3 = rotl64(v3 + read64(data) * P2, 31) * P1; data += 8;
+            v4 = rotl64(v4 + read64(data) * P2, 31) * P1; data += 8;
+        } while (data <= limit);
+        h = rotl64(v1, 1) + rotl64(v2, 7) + rotl64(v3, 12) + rotl64(v4, 18);
+        v1 *= P2; v1 = rotl64(v1, 31); v1 *= P1; h ^= v1; h = h * P1 + P4;
+        v2 *= P2; v2 = rotl64(v2, 31); v2 *= P1; h ^= v2; h = h * P1 + P4;
+        v3 *= P2; v3 = rotl64(v3, 31); v3 *= P1; h ^= v3; h = h * P1 + P4;
+        v4 *= P2; v4 = rotl64(v4, 31); v4 *= P1; h ^= v4; h = h * P1 + P4;
+    } else {
+        h = seed + P5;
+    }
+    h += (uint64_t)len;
+    while (data + 8 <= end) {
+        uint64_t k = read64(data);
+        k *= P2; k = rotl64(k, 31); k *= P1;
+        h ^= k; h = rotl64(h, 27) * P1 + P4;
+        data += 8;
+    }
+    if (data + 4 <= end) {
+        h ^= (uint64_t)read32(data) * P1;
+        h = rotl64(h, 23) * P2 + P3;
+        data += 4;
+    }
+    while (data < end) {
+        h ^= (*data++) * P5;
+        h = rotl64(h, 11) * P1;
+    }
+    h ^= h >> 33; h *= P2; h ^= h >> 29; h *= P3; h ^= h >> 32;
+    return h;
+}
+
+// ---------------------------------------------------------------------
+// Block-file IO: full-range pread/pwrite with sequential readahead
+// hints — the worker's tier-file hot path.
+// ---------------------------------------------------------------------
+
+int64_t cv_read_file(const char* path, uint64_t offset, uint8_t* buf,
+                     uint64_t len) {
+    int fd = open(path, O_RDONLY);
+    if (fd < 0) return -1;
+#ifdef POSIX_FADV_SEQUENTIAL
+    posix_fadvise(fd, (off_t)offset, (off_t)len, POSIX_FADV_SEQUENTIAL);
+#endif
+    uint64_t done = 0;
+    while (done < len) {
+        ssize_t n = pread(fd, buf + done, len - done, (off_t)(offset + done));
+        if (n < 0) { close(fd); return -1; }
+        if (n == 0) break;
+        done += (uint64_t)n;
+    }
+    close(fd);
+    return (int64_t)done;
+}
+
+int64_t cv_write_file(const char* path, const uint8_t* buf, uint64_t len,
+                      int do_fsync) {
+    int fd = open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    if (fd < 0) return -1;
+    uint64_t done = 0;
+    while (done < len) {
+        ssize_t n = write(fd, buf + done, len - done);
+        if (n < 0) { close(fd); return -1; }
+        done += (uint64_t)n;
+    }
+    if (do_fsync) fsync(fd);
+    close(fd);
+    return (int64_t)done;
+}
+
+// checksum a block file without materializing it in Python
+int64_t cv_checksum_file(const char* path, uint64_t offset, uint64_t len,
+                         uint32_t* out_crc) {
+    int fd = open(path, O_RDONLY);
+    if (fd < 0) return -1;
+#ifdef POSIX_FADV_SEQUENTIAL
+    posix_fadvise(fd, (off_t)offset, (off_t)len, POSIX_FADV_SEQUENTIAL);
+#endif
+    const size_t CHUNK = 1 << 20;
+    uint8_t* buf = new uint8_t[CHUNK];
+    uint32_t crc = 0;
+    uint64_t done = 0;
+    while (len == 0 || done < len) {
+        size_t want = CHUNK;
+        if (len && len - done < want) want = (size_t)(len - done);
+        if (want == 0) break;
+        ssize_t n = pread(fd, buf, want, (off_t)(offset + done));
+        if (n < 0) { delete[] buf; close(fd); return -1; }
+        if (n == 0) break;
+        crc = cv_crc32c(buf, (size_t)n, crc);
+        done += (uint64_t)n;
+    }
+    delete[] buf;
+    close(fd);
+    *out_crc = crc;
+    return (int64_t)done;
+}
+
+}  // extern "C"

@@ -1,0 +1,123 @@
+"""ctypes bindings for the native C++ helpers (csrc/native.cc).
+
+The .so is built on demand (make in csrc/); every function has a pure-
+Python fallback so nothing hard-depends on a compiler at runtime."""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import zlib
+
+log = logging.getLogger(__name__)
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "csrc")
+_SO = os.path.join(_CSRC, "build", "libcurvine_native.so")
+
+_lib = None
+_tried = False
+
+
+def _load():
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_SO) and os.path.exists(
+            os.path.join(_CSRC, "Makefile")):
+        try:
+            subprocess.run(["make", "-C", _CSRC], capture_output=True,
+                           timeout=120, check=True)
+        except Exception as e:  # noqa: BLE001 — fall back to pure Python
+            log.debug("native build failed: %s", e)
+    if os.path.exists(_SO):
+        try:
+            lib = ctypes.CDLL(_SO)
+            lib.cv_crc32c.restype = ctypes.c_uint32
+            lib.cv_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                      ctypes.c_uint32]
+            lib.cv_xxh64.restype = ctypes.c_uint64
+            lib.cv_xxh64.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                     ctypes.c_uint64]
+            lib.cv_read_file.restype = ctypes.c_int64
+            lib.cv_read_file.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                         ctypes.c_char_p, ctypes.c_uint64]
+            lib.cv_write_file.restype = ctypes.c_int64
+            lib.cv_write_file.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                          ctypes.c_uint64, ctypes.c_int]
+            lib.cv_checksum_file.restype = ctypes.c_int64
+            lib.cv_checksum_file.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_uint32)]
+            _lib = lib
+            log.info("native helpers loaded: %s", _SO)
+        except OSError as e:
+            log.warning("native load failed: %s", e)
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def crc32c(data, seed: int = 0) -> int:
+    lib = _load()
+    if lib is not None:
+        buf = bytes(data) if not isinstance(data, bytes) else data
+        return lib.cv_crc32c(buf, len(buf), seed)
+    return _crc32c_py(data, seed)
+
+
+def xxh64(data, seed: int = 0) -> int:
+    lib = _load()
+    if lib is not None:
+        buf = bytes(data) if not isinstance(data, bytes) else data
+        return lib.cv_xxh64(buf, len(buf), seed)
+    # fallback: not xxh64, but a stable 64-bit fingerprint
+    return (zlib.crc32(data) << 32) | zlib.adler32(data)
+
+
+def checksum_file(path: str, offset: int = 0, length: int = 0) -> int | None:
+    """CRC32C of a file range computed natively; None when unavailable."""
+    lib = _load()
+    if lib is None:
+        try:
+            with open(path, "rb") as f:
+                f.seek(offset)
+                data = f.read(length or None)
+            return _crc32c_py(data, 0)
+        except OSError:
+            return None
+    out = ctypes.c_uint32(0)
+    n = lib.cv_checksum_file(path.encode(), offset, length,
+                             ctypes.byref(out))
+    return out.value if n >= 0 else None
+
+
+# ---------------- pure-python crc32c (table, slow; correctness ref) ----
+
+_PY_TABLE: list[int] | None = None
+
+
+def _table() -> list[int]:
+    global _PY_TABLE
+    if _PY_TABLE is None:
+        t = []
+        for i in range(256):
+            crc = i
+            for _ in range(8):
+                crc = (crc >> 1) ^ 0x82F63B78 if crc & 1 else crc >> 1
+            t.append(crc)
+        _PY_TABLE = t
+    return _PY_TABLE
+
+
+def _crc32c_py(data, seed: int = 0) -> int:
+    t = _table()
+    crc = seed ^ 0xFFFFFFFF
+    for b in bytes(data):
+        crc = t[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF

@@ -71,6 +71,7 @@ class WorkerServer:
         self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._bg.append(asyncio.ensure_future(self._report_loop()))
         self._bg.append(asyncio.ensure_future(self._eviction_loop()))
+        self._bg.append(asyncio.ensure_future(self._scrub_loop()))
         log.info("worker %d started at %s", self.worker_id, self.addr)
 
     async def stop(self) -> None:
@@ -135,6 +136,21 @@ class WorkerServer:
                     self.metrics.inc("blocks.evicted", len(evicted))
             except Exception:
                 log.exception("eviction loop")
+
+    async def _scrub_loop(self, interval_s: float = 60.0) -> None:
+        """Periodic checksum scrub; corrupt blocks get dropped and the
+        master is told so re-replication can heal them."""
+        while True:
+            await asyncio.sleep(interval_s)
+            try:
+                corrupt = await asyncio.to_thread(self.store.scrub)
+                if corrupt:
+                    self.metrics.inc("blocks.corrupt", len(corrupt))
+                    mc = await self._master_conn()
+                    await mc.call(RpcCode.REPORT_UNDER_REPLICATED_BLOCKS,
+                                  data=pack({"block_ids": corrupt}))
+            except Exception:
+                log.exception("scrub loop")
 
     # ---------------- handlers ----------------
 

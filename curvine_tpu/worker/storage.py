@@ -31,6 +31,7 @@ class BlockInfo:
     len: int = 0
     state: BlockState = BlockState.TEMP
     atime: float = field(default_factory=time.time)
+    crc32c: int | None = None     # content checksum recorded at commit
 
     @property
     def path(self) -> str:
@@ -142,7 +143,36 @@ class BlockStore:
             info.len = length
             os.replace(tmp, info.path)
             info.tier.used += length
-            return info
+        from curvine_tpu.common import native
+        info.crc32c = native.checksum_file(info.path)
+        return info
+
+    def verify(self, block_id: int) -> bool:
+        """Re-checksum a committed block against its commit-time crc32c."""
+        from curvine_tpu.common import native
+        info = self.get(block_id, touch=False)
+        if info.state != BlockState.COMMITTED or info.crc32c is None:
+            return True
+        return native.checksum_file(info.path) == info.crc32c
+
+    def scrub(self, limit: int = 16) -> list[int]:
+        """Verify up to `limit` least-recently-verified blocks; corrupt
+        blocks are dropped (the master re-replicates them). Parity: the
+        reference's abnormal-data detection on the worker data path."""
+        with self._lock:
+            candidates = [b.block_id for b in self.blocks.values()
+                          if b.state == BlockState.COMMITTED
+                          and b.crc32c is not None][:limit]
+        corrupt = []
+        for bid in candidates:
+            try:
+                if not self.verify(bid):
+                    log.error("block %d failed checksum scrub; dropping", bid)
+                    self.delete(bid)
+                    corrupt.append(bid)
+            except err.CurvineError:
+                continue
+        return corrupt
 
     def get(self, block_id: int, touch: bool = True) -> BlockInfo:
         with self._lock:

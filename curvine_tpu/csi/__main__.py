@@ -1,0 +1,21 @@
+"""python -m curvine_tpu.csi — run the CSI driver."""
+import argparse
+import time
+
+from curvine_tpu.common.conf import ClusterConf
+from curvine_tpu.csi.driver import CsiDriver
+
+p = argparse.ArgumentParser()
+p.add_argument("--endpoint", default="unix:///tmp/curvine-csi.sock")
+p.add_argument("--conf", default=None)
+p.add_argument("--node-id", default=None)
+args = p.parse_args()
+
+driver = CsiDriver(conf=ClusterConf.load(args.conf), endpoint=args.endpoint,
+                   node_id=args.node_id)
+driver.start()
+try:
+    while True:
+        time.sleep(3600)
+except KeyboardInterrupt:
+    driver.stop()

@@ -1,0 +1,220 @@
+"""CSI driver: Identity / Controller / Node gRPC services.
+
+Parity: curvine-csi (Go). Volumes are directories in the Curvine
+namespace (`/csi-volumes/<id>` by default) — CreateVolume is a mkdir
+(millisecond provisioning, no cloud API), NodePublishVolume is a FUSE
+mount of that subtree at the kubelet target path.
+
+gRPC servicing uses generic method handlers (no grpc_tools codegen in
+this image); message classes come from `protoc --python_out` of the
+spec-field-compatible csi.proto next to this file."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import socket
+import threading
+from concurrent import futures
+
+import grpc
+
+from curvine_tpu.common.conf import ClusterConf
+from curvine_tpu.csi import csi_pb2 as pb
+
+log = logging.getLogger(__name__)
+
+DRIVER_NAME = "tpu.curvine.csi"
+VERSION = "0.1.0"
+VOLUME_ROOT = "/csi-volumes"
+
+
+class _Bridge:
+    """Sync gRPC servicer thread → asyncio curvine client."""
+
+    def __init__(self, conf: ClusterConf):
+        self.conf = conf
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self.loop.run_forever,
+                                       daemon=True, name="csi-bridge")
+        self.thread.start()
+        from curvine_tpu.client import CurvineClient
+
+        async def make():
+            return CurvineClient(conf)
+        self.client = self.run(make())
+
+    def run(self, coro, timeout: float = 60):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop).result(timeout)
+
+    def close(self):
+        self.run(self.client.close())
+        self.loop.call_soon_threadsafe(self.loop.stop)
+
+
+class CsiDriver:
+    def __init__(self, conf: ClusterConf | None = None,
+                 endpoint: str = "unix:///tmp/curvine-csi.sock",
+                 node_id: str | None = None):
+        self.conf = conf or ClusterConf()
+        self.endpoint = endpoint
+        self.node_id = node_id or socket.gethostname()
+        self.bridge = _Bridge(self.conf)
+        self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        self._mounted: dict[str, object] = {}   # target_path → session
+        for name, methods in self._services().items():
+            handlers = {
+                m: grpc.unary_unary_rpc_method_handler(
+                    fn, request_deserializer=req.FromString,
+                    response_serializer=lambda resp: resp.SerializeToString())
+                for m, (fn, req) in methods.items()
+            }
+            self.server.add_generic_rpc_handlers(
+                (grpc.method_handlers_generic_handler(name, handlers),))
+        self.server.add_insecure_port(self.endpoint)
+
+    def start(self) -> None:
+        self.server.start()
+        log.info("csi driver %s serving at %s", DRIVER_NAME, self.endpoint)
+
+    def stop(self) -> None:
+        self.server.stop(grace=1)
+        self.bridge.close()
+
+    # ---------------- service table ----------------
+
+    def _services(self):
+        return {
+            "csi.v1.Identity": {
+                "GetPluginInfo": (self.get_plugin_info,
+                                  pb.GetPluginInfoRequest),
+                "GetPluginCapabilities": (self.get_plugin_capabilities,
+                                          pb.GetPluginCapabilitiesRequest),
+                "Probe": (self.probe, pb.ProbeRequest),
+            },
+            "csi.v1.Controller": {
+                "CreateVolume": (self.create_volume, pb.CreateVolumeRequest),
+                "DeleteVolume": (self.delete_volume, pb.DeleteVolumeRequest),
+                "ValidateVolumeCapabilities": (
+                    self.validate_volume_capabilities,
+                    pb.ValidateVolumeCapabilitiesRequest),
+                "ControllerGetCapabilities": (
+                    self.controller_get_capabilities,
+                    pb.ControllerGetCapabilitiesRequest),
+            },
+            "csi.v1.Node": {
+                "NodeStageVolume": (self.node_stage, pb.NodeStageVolumeRequest),
+                "NodeUnstageVolume": (self.node_unstage,
+                                      pb.NodeUnstageVolumeRequest),
+                "NodePublishVolume": (self.node_publish,
+                                      pb.NodePublishVolumeRequest),
+                "NodeUnpublishVolume": (self.node_unpublish,
+                                        pb.NodeUnpublishVolumeRequest),
+                "NodeGetCapabilities": (self.node_get_capabilities,
+                                        pb.NodeGetCapabilitiesRequest),
+                "NodeGetInfo": (self.node_get_info, pb.NodeGetInfoRequest),
+            },
+        }
+
+    # ---------------- Identity ----------------
+
+    def get_plugin_info(self, req, ctx):
+        return pb.GetPluginInfoResponse(name=DRIVER_NAME,
+                                        vendor_version=VERSION)
+
+    def get_plugin_capabilities(self, req, ctx):
+        cap = pb.PluginCapability(
+            service=pb.PluginCapability.Service(
+                type=pb.PluginCapability.Service.CONTROLLER_SERVICE))
+        return pb.GetPluginCapabilitiesResponse(capabilities=[cap])
+
+    def probe(self, req, ctx):
+        try:
+            self.bridge.run(self.bridge.client.meta.master_info(), timeout=5)
+            ready = True
+        except Exception:  # noqa: BLE001 — probe reports, never raises
+            ready = False
+        resp = pb.ProbeResponse()
+        resp.ready.value = ready
+        return resp
+
+    # ---------------- Controller ----------------
+
+    def _vol_path(self, volume_id: str) -> str:
+        return f"{VOLUME_ROOT}/{volume_id}"
+
+    def create_volume(self, req, ctx):
+        volume_id = req.name or "vol"
+        path = self._vol_path(volume_id)
+        self.bridge.run(self.bridge.client.meta.mkdir(path))
+        cap = req.capacity_range.required_bytes or 0
+        log.info("csi created volume %s at %s", volume_id, path)
+        return pb.CreateVolumeResponse(volume=pb.Volume(
+            capacity_bytes=cap, volume_id=volume_id,
+            volume_context={"path": path}))
+
+    def delete_volume(self, req, ctx):
+        path = self._vol_path(req.volume_id)
+        try:
+            self.bridge.run(self.bridge.client.meta.delete(path,
+                                                           recursive=True))
+        except Exception as e:  # noqa: BLE001 — idempotent delete
+            log.debug("delete volume %s: %s", req.volume_id, e)
+        return pb.DeleteVolumeResponse()
+
+    def validate_volume_capabilities(self, req, ctx):
+        confirmed = pb.ValidateVolumeCapabilitiesResponse.Confirmed(
+            volume_capabilities=list(req.volume_capabilities))
+        return pb.ValidateVolumeCapabilitiesResponse(confirmed=confirmed)
+
+    def controller_get_capabilities(self, req, ctx):
+        cap = pb.ControllerServiceCapability(
+            rpc=pb.ControllerServiceCapability.RPC(
+                type=pb.ControllerServiceCapability.RPC.CREATE_DELETE_VOLUME))
+        return pb.ControllerGetCapabilitiesResponse(capabilities=[cap])
+
+    # ---------------- Node ----------------
+
+    def node_stage(self, req, ctx):
+        return pb.NodeStageVolumeResponse()
+
+    def node_unstage(self, req, ctx):
+        return pb.NodeUnstageVolumeResponse()
+
+    def node_publish(self, req, ctx):
+        """FUSE-mount the volume subtree at the kubelet target path."""
+        from curvine_tpu.fuse.mount import fusermount_mount
+        from curvine_tpu.fuse.ops import CurvineFuseFs
+        from curvine_tpu.fuse.session import FuseSession
+        import os
+
+        target = req.target_path
+        subtree = req.volume_context.get("path",
+                                         self._vol_path(req.volume_id))
+
+        async def mount():
+            fd = fusermount_mount(target)
+            fs = CurvineFuseFs(self.bridge.client, fs_root=subtree,
+                               uid=os.getuid(), gid=os.getgid())
+            session = FuseSession(fs, fd)
+            asyncio.ensure_future(session.run())
+            return session
+
+        self._mounted[target] = self.bridge.run(mount())
+        log.info("csi published %s at %s", subtree, target)
+        return pb.NodePublishVolumeResponse()
+
+    def node_unpublish(self, req, ctx):
+        from curvine_tpu.fuse.mount import fusermount_umount
+        session = self._mounted.pop(req.target_path, None)
+        fusermount_umount(req.target_path)
+        if session is not None:
+            session.stop()
+        return pb.NodeUnpublishVolumeResponse()
+
+    def node_get_capabilities(self, req, ctx):
+        return pb.NodeGetCapabilitiesResponse(capabilities=[])
+
+    def node_get_info(self, req, ctx):
+        return pb.NodeGetInfoResponse(node_id=self.node_id,
+                                      max_volumes_per_node=0)

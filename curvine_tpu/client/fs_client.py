@@ -160,6 +160,47 @@ class FsClient:
         rep = await self.call(RpcCode.GET_MASTER_INFO, {})
         return MasterInfo.from_wire(rep["info"])
 
+    async def list_options(self, path: str, pattern: str | None = None,
+                           dirs_only: bool = False, files_only: bool = False,
+                           offset: int = 0, limit: int = 0
+                           ) -> tuple[list[FileStatus], int]:
+        rep = await self.call(RpcCode.LIST_OPTIONS, {
+            "path": path, "pattern": pattern, "dirs_only": dirs_only,
+            "files_only": files_only, "offset": offset, "limit": limit})
+        return ([FileStatus.from_wire(s) for s in rep["statuses"]],
+                rep["total"])
+
+    async def set_lock(self, path: str, kind: str = "exclusive",
+                       ttl_ms: int = 60_000) -> dict:
+        rep = await self.call(RpcCode.SET_LOCK, {
+            "path": path, "owner": self.client_id, "kind": kind,
+            "ttl_ms": ttl_ms}, mutate=True)
+        return rep["lock"]
+
+    async def release_lock(self, path: str) -> bool:
+        rep = await self.call(RpcCode.SET_LOCK, {
+            "path": path, "owner": self.client_id, "release": True},
+            mutate=True)
+        return rep.get("released", False)
+
+    async def get_lock(self, path: str) -> list[dict]:
+        return (await self.call(RpcCode.GET_LOCK, {"path": path}))["locks"]
+
+    async def list_locks(self) -> list[dict]:
+        return (await self.call(RpcCode.LIST_LOCK, {}))["locks"]
+
+    async def assign_worker(self, exclude: list[int] | None = None,
+                            ici_coords: list[int] | None = None):
+        from curvine_tpu.common.types import WorkerAddress
+        rep = await self.call(RpcCode.ASSIGN_WORKER, {
+            "client_host": self.client_host,
+            "exclude_workers": exclude or [],
+            "ici_coords": ici_coords or []})
+        return WorkerAddress.from_wire(rep["worker"])
+
+    async def report_metrics(self, counters: dict) -> None:
+        await self.call(RpcCode.METRICS_REPORT, {"counters": counters})
+
     # ---------------- mounts / jobs ----------------
 
     async def mount(self, cv_path: str, ufs_path: str,

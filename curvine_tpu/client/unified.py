@@ -79,6 +79,55 @@ class CurvineClient:
     async def read_all(self, path: str) -> bytes:
         return await self.unified_read(path)
 
+    async def write_files_batch(self, files: dict[str, bytes],
+                                storage_type: str | None = None) -> None:
+        """Small-file fast path: one metadata round trip per phase and one
+        batched block upload per worker (create/add/write/complete all
+        batched). Parity: CreateFilesBatch/AddBlocksBatch/WriteBlocksBatch/
+        CompleteFilesBatch codes."""
+        from curvine_tpu.rpc import RpcCode
+        from curvine_tpu.rpc.frame import pack, unpack
+        if not files:
+            return
+        cc = self.conf.client
+        st = _TIERS.get(storage_type or cc.storage_type, StorageType.MEM)
+        paths = list(files)
+        await self.meta.call(RpcCode.CREATE_FILES_BATCH, {"requests": [
+            {"path": p, "overwrite": True, "block_size": cc.block_size,
+             "replicas": 1, "client_name": self.meta.client_id}
+            for p in paths]}, mutate=True)
+        rep = await self.meta.call(RpcCode.ADD_BLOCKS_BATCH, {"requests": [
+            {"path": p, "client_host": self.meta.client_host,
+             "commit_blocks": [], "exclude_workers": []}
+            for p in paths]}, mutate=True)
+        from curvine_tpu.common.types import LocatedBlock
+        located = [LocatedBlock.from_wire(r["block"])
+                   for r in rep["responses"]]
+        # group uploads per worker
+        by_worker: dict[str, list[tuple[str, LocatedBlock]]] = {}
+        for p, lb in zip(paths, located):
+            loc = lb.locs[0]
+            addr = f"{loc.ip_addr or loc.hostname}:{loc.rpc_port}"
+            by_worker.setdefault(addr, []).append((p, lb))
+        worker_of: dict[str, int] = {}
+        for addr, items in by_worker.items():
+            conn = await self.pool.get(addr)
+            body = {"blocks": [
+                {"block_id": lb.block.id, "storage_type": int(st),
+                 "data": files[p]} for p, lb in items]}
+            ack = await conn.call(RpcCode.WRITE_BLOCKS_BATCH, data=pack(body))
+            for r in (unpack(ack.data) or {}).get("results", []):
+                worker_of[r["block_id"]] = r["worker_id"]
+        await self.meta.call(RpcCode.COMPLETE_FILES_BATCH, {"requests": [
+            {"path": p, "len": len(files[p]),
+             "client_name": self.meta.client_id,
+             "commit_blocks": [{
+                 "block_id": lb.block.id, "block_len": len(files[p]),
+                 "worker_ids": [worker_of.get(lb.block.id,
+                                              lb.locs[0].worker_id)],
+                 "storage_type": int(st)}]}
+            for p, lb in zip(paths, located)]}, mutate=True)
+
     # ---------------- unified (cache + UFS) ----------------
 
     async def _ufs_for(self, path: str):

@@ -1,0 +1,78 @@
+"""Path lock metadata.
+
+Parity: curvine-server/src/master/meta/lock_meta.rs + RpcCodes GetLock/
+SetLock/ListLock — advisory named locks on namespace paths (used by
+clients coordinating exclusive writers / loaders) with TTL expiry."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.common.types import now_ms
+
+
+@dataclass
+class LockInfo:
+    path: str
+    owner: str
+    kind: str = "exclusive"       # exclusive | shared
+    create_ms: int = field(default_factory=now_ms)
+    ttl_ms: int = 60_000
+
+    @property
+    def expired(self) -> bool:
+        return self.ttl_ms > 0 and now_ms() > self.create_ms + self.ttl_ms
+
+    def to_wire(self) -> dict:
+        return {"path": self.path, "owner": self.owner, "kind": self.kind,
+                "create_ms": self.create_ms, "ttl_ms": self.ttl_ms}
+
+
+class LockManager:
+    def __init__(self) -> None:
+        self.locks: dict[str, list[LockInfo]] = {}
+
+    def _gc(self, path: str) -> list[LockInfo]:
+        holders = [l for l in self.locks.get(path, []) if not l.expired]
+        if holders:
+            self.locks[path] = holders
+        else:
+            self.locks.pop(path, None)
+        return holders
+
+    def set_lock(self, path: str, owner: str, kind: str = "exclusive",
+                 ttl_ms: int = 60_000) -> LockInfo:
+        holders = self._gc(path)
+        for h in holders:
+            if h.owner == owner:
+                h.create_ms = now_ms()      # refresh own lease
+                h.ttl_ms = ttl_ms
+                h.kind = kind
+                return h
+        if holders and (kind == "exclusive"
+                        or any(h.kind == "exclusive" for h in holders)):
+            raise err.LeaseConflict(
+                f"{path} locked by {holders[0].owner} ({holders[0].kind})")
+        info = LockInfo(path=path, owner=owner, kind=kind, ttl_ms=ttl_ms)
+        self.locks.setdefault(path, []).append(info)
+        return info
+
+    def get_lock(self, path: str) -> list[LockInfo]:
+        return self._gc(path)
+
+    def release(self, path: str, owner: str) -> bool:
+        holders = [l for l in self._gc(path) if l.owner != owner]
+        if len(holders) == len(self.locks.get(path, [])):
+            return False
+        if holders:
+            self.locks[path] = holders
+        else:
+            self.locks.pop(path, None)
+        return True
+
+    def list_locks(self) -> list[LockInfo]:
+        out = []
+        for path in list(self.locks):
+            out.extend(self._gc(path))
+        return out

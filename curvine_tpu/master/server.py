@@ -43,6 +43,8 @@ class MasterServer:
         self.replication = ReplicationManager(self.fs)
         self.fs.on_worker_lost = self.replication.on_worker_lost
         self.ttl = TtlManager(self.fs, check_ms=mc.ttl_check_ms)
+        from curvine_tpu.master.locks import LockManager
+        self.locks = LockManager()
         self.retry_cache = RetryCache(mc.retry_cache_size, mc.retry_cache_ttl_ms)
         self.rpc = RpcServer(mc.hostname, mc.rpc_port, "master")
         self.raft = None
@@ -115,6 +117,12 @@ class MasterServer:
         r(C.CREATE_FILES_BATCH, self._h(self._create_files_batch, mutate=True))
         r(C.ADD_BLOCKS_BATCH, self._h(self._add_blocks_batch, mutate=True))
         r(C.COMPLETE_FILES_BATCH, self._h(self._complete_files_batch, mutate=True))
+        r(C.LIST_OPTIONS, self._h(self._list_options))
+        r(C.GET_LOCK, self._h(self._get_lock))
+        r(C.SET_LOCK, self._h(self._set_lock))
+        r(C.LIST_LOCK, self._h(self._list_lock))
+        r(C.ASSIGN_WORKER, self._h(self._assign_worker))
+        r(C.METRICS_REPORT, self._h(self._metrics_report))
         # worker plane
         r(C.WORKER_HEARTBEAT, self._h(self._worker_heartbeat))
         r(C.WORKER_BLOCK_REPORT, self._h(self._worker_block_report))
@@ -240,6 +248,60 @@ class MasterServer:
 
     def _free(self, q):
         return {"freed": self.fs.free(q["path"], q.get("recursive", False))}
+
+    def _list_options(self, q):
+        """Filtered/paged listing. Parity: list_options in filesystem.rs —
+        supports glob filtering, dirs-only/files-only, offset+limit."""
+        import fnmatch
+        statuses = self.fs.list_status(q["path"])
+        pattern = q.get("pattern")
+        if pattern:
+            statuses = [s for s in statuses
+                        if fnmatch.fnmatch(s.name, pattern)]
+        if q.get("dirs_only"):
+            statuses = [s for s in statuses if s.is_dir]
+        if q.get("files_only"):
+            statuses = [s for s in statuses if not s.is_dir]
+        offset = q.get("offset", 0)
+        limit = q.get("limit", 0)
+        total = len(statuses)
+        if limit:
+            statuses = statuses[offset:offset + limit]
+        elif offset:
+            statuses = statuses[offset:]
+        return {"statuses": [s.to_wire() for s in statuses], "total": total}
+
+    def _get_lock(self, q):
+        return {"locks": [l.to_wire()
+                          for l in self.locks.get_lock(q["path"])]}
+
+    def _set_lock(self, q):
+        if q.get("release"):
+            return {"released": self.locks.release(q["path"], q["owner"])}
+        info = self.locks.set_lock(q["path"], q["owner"],
+                                   kind=q.get("kind", "exclusive"),
+                                   ttl_ms=q.get("ttl_ms", 60_000))
+        return {"lock": info.to_wire()}
+
+    def _list_lock(self, q):
+        return {"locks": [l.to_wire() for l in self.locks.list_locks()]}
+
+    def _assign_worker(self, q):
+        """Pick a worker for a client (short-circuit target / load work).
+        Parity: RpcCode::AssignWorker."""
+        chosen = self.fs.policy.choose(
+            self.fs.workers.live_workers(), 1,
+            client_host=q.get("client_host", ""),
+            exclude=set(q.get("exclude_workers", [])),
+            ici_coords=q.get("ici_coords"))
+        return {"worker": chosen[0].address.to_wire()}
+
+    def _metrics_report(self, q):
+        """Clients push counters; aggregated into master metrics.
+        Parity: RpcCode::MetricsReport."""
+        for name, value in (q.get("counters") or {}).items():
+            self.metrics.inc(f"client.{name}", value)
+        return {}
 
     def _create_files_batch(self, q):
         return {"responses": [self._create_file(r) for r in q["requests"]]}

@@ -33,6 +33,11 @@ def worker_id_for(hostname: str, port: int) -> int:
     return zlib.crc32(f"{hostname}:{port}".encode()) & 0x7FFFFFFF
 
 
+def _write_file_bytes(path: str, data: bytes) -> None:
+    with open(path, "wb") as f:
+        f.write(data)
+
+
 class WorkerServer:
     def __init__(self, conf: ClusterConf | None = None,
                  worker_id: int | None = None):
@@ -160,6 +165,7 @@ class WorkerServer:
         r(RpcCode.READ_BLOCK, self._read_block)
         r(RpcCode.DELETE_BLOCK, self._delete_block)
         r(RpcCode.GET_BLOCK_INFO, self._get_block_info)
+        r(RpcCode.WRITE_BLOCKS_BATCH, self._write_blocks_batch)
         r(RpcCode.SUBMIT_BLOCK_REPLICATION_JOB, self._replicate_block)
         r(RpcCode.SUBMIT_TASK, self._submit_task)
 
@@ -235,6 +241,30 @@ class WorkerServer:
         finally:
             await asyncio.to_thread(f.close)
         return None
+
+    async def _write_blocks_batch(self, msg: Message, conn: ServerConn):
+        """Many small blocks in one request — the small-file fast path.
+        Parity: worker/handler/batch_write_handler.rs. Body: msgpack
+        {"blocks": [{block_id, storage_type, data}]}."""
+        q = unpack(msg.data) or {}
+        results = []
+        for b in q.get("blocks", []):
+            data = b["data"]
+            info = self.store.create_temp(
+                b["block_id"], StorageType(b.get("storage_type",
+                                                 int(StorageType.MEM))),
+                len(data))
+            try:
+                await asyncio.to_thread(_write_file_bytes, info.path, data)
+                self.store.commit(b["block_id"], len(data))
+                results.append({"block_id": b["block_id"], "len": len(data),
+                                "worker_id": self.worker_id})
+            except Exception:
+                self.store.delete(b["block_id"])
+                raise
+        self.metrics.inc("bytes.written",
+                         sum(r["len"] for r in results))
+        return {"results": results}
 
     async def _delete_block(self, msg: Message, conn: ServerConn):
         q = unpack(msg.data) or {}

@@ -1,0 +1,73 @@
+"""Parity extras: locks, list_options, assign_worker, metrics report,
+small-file batch writes."""
+
+import asyncio
+import os
+
+import pytest
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.testing import MiniCluster
+
+
+async def test_locks():
+    async with MiniCluster(workers=1) as mc:
+        c1 = mc.client()
+        c2 = mc.client()
+        await c1.write_all("/locked.bin", b"x")
+        lock = await c1.meta.set_lock("/locked.bin")
+        assert lock["owner"] == c1.meta.client_id
+        # second client blocked
+        with pytest.raises(err.LeaseConflict):
+            await c2.meta.set_lock("/locked.bin")
+        # shared locks coexist
+        await c1.meta.set_lock("/shared.bin", kind="shared")
+        await c2.meta.set_lock("/shared.bin", kind="shared")
+        assert len(await c1.meta.get_lock("/shared.bin")) == 2
+        # release frees it
+        assert await c1.meta.release_lock("/locked.bin")
+        got = await c2.meta.set_lock("/locked.bin")
+        assert got["owner"] == c2.meta.client_id
+        assert len(await c1.meta.list_locks()) == 3
+        # ttl expiry
+        await c1.meta.set_lock("/ttl.bin", ttl_ms=50)
+        await asyncio.sleep(0.1)
+        assert await c1.meta.get_lock("/ttl.bin") == []
+
+
+async def test_list_options():
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.meta.mkdir("/lo/sub")
+        for i in range(10):
+            await c.write_all(f"/lo/f{i:02d}.bin", b"x")
+            await c.write_all(f"/lo/g{i:02d}.dat", b"y")
+        sts, total = await c.meta.list_options("/lo", pattern="f*.bin")
+        assert total == 10 and all(s.name.startswith("f") for s in sts)
+        sts, total = await c.meta.list_options("/lo", dirs_only=True)
+        assert [s.name for s in sts] == ["sub"]
+        sts, total = await c.meta.list_options("/lo", files_only=True,
+                                               offset=5, limit=5)
+        assert total == 20 and len(sts) == 5
+
+
+async def test_assign_worker_and_metrics():
+    async with MiniCluster(workers=2) as mc:
+        c = mc.client()
+        w = await c.meta.assign_worker()
+        assert w.rpc_port in {wk.rpc.port for wk in mc.workers}
+        w2 = await c.meta.assign_worker(exclude=[w.worker_id])
+        assert w2.worker_id != w.worker_id
+        await c.meta.report_metrics({"reads": 5, "bytes": 1024})
+        assert mc.master.metrics.counters["client.reads"] == 5
+
+
+async def test_write_files_batch():
+    async with MiniCluster(workers=2) as mc:
+        c = mc.client()
+        files = {f"/batch/f{i}.bin": os.urandom(1000 + i) for i in range(20)}
+        await c.write_files_batch(files)
+        for p, data in files.items():
+            st = await c.meta.file_status(p)
+            assert st.is_complete and st.len == len(data)
+            assert await (await c.open(p)).read_all() == data

@@ -9,7 +9,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import mmap
 import os
 
 from curvine_tpu.common import errors as err  # noqa: F401
@@ -34,7 +33,7 @@ class FsReader:
         self.pos = 0
         self.len = file_blocks.status.len
         self._local_paths: dict[int, str | None] = {}
-        self._mmaps: dict[int, mmap.mmap] = {}
+        self._local_fds: dict[int, int] = {}
 
     # ---------------- positioning ----------------
 
@@ -120,19 +119,54 @@ class FsReader:
             out += got
         return bytes(out)
 
-    def _mmap_for(self, block_id: int, path: str) -> mmap.mmap:
-        mm = self._mmaps.get(block_id)
-        if mm is None:
-            with open(path, "rb") as f:
-                mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
-            self._mmaps[block_id] = mm
-        return mm
+    async def pread_view(self, offset: int, n: int):
+        """Positional read returning a numpy uint8 buffer — the fast path:
+        co-located segments are preadv'd straight into the output buffer
+        (aligned allocation → THP-friendly, no intermediate bytes objects);
+        remote segments stream into the same buffer. Use for device ingest
+        and FUSE reads; `pread` stays for bytes consumers."""
+        import numpy as np
+        n = max(0, min(n, self.len - offset))
+        out = np.empty(n, dtype=np.uint8)
+        filled = 0
+        while filled < n:
+            located = self._locate(offset + filled)
+            if located is None:
+                break
+            lb, block_off = located
+            seg = min(n - filled, lb.block.len - block_off)
+            local = await self._local_path(lb)
+            if local is not None:
+                fd = self._fd_for(lb.block.id, local)
+                got = os.preadv(fd, [memoryview(out[filled:filled + seg])],
+                                block_off)
+                if got < seg:
+                    out = out[:filled + max(0, got)]
+                    break
+            else:
+                data = await self._read_some(offset + filled, seg)
+                if not data:
+                    out = out[:filled]
+                    break
+                seg = len(data)
+                out[filled:filled + seg] = np.frombuffer(data, dtype=np.uint8)
+            filled += seg
+        return out[:filled]
+
+    def _fd_for(self, block_id: int, path: str) -> int:
+        fd = self._local_fds.get(block_id)
+        if fd is None:
+            fd = os.open(path, os.O_RDONLY)
+            self._local_fds[block_id] = fd
+        return fd
 
     async def mmap_view(self, offset: int, n: int):
-        """Zero-copy numpy view over a co-located block file (short-circuit
-        fast path for device ingest: feed this straight to jax.device_put).
-        Returns None when the range isn't short-circuit readable; the view
-        is valid until the reader is closed."""
+        """Short-circuit read of a co-located block range into a fresh
+        numpy buffer — one preadv from the page cache, handed straight to
+        jax.device_put with no further Python copies. (Named for the
+        original mmap implementation; fd+preadv beats mmap here because
+        per-page fault cost dwarfs the copy on virtualized hosts.)
+        Returns None when the range isn't short-circuit readable."""
         import numpy as np
         located = self._locate(offset)
         if located is None:
@@ -143,8 +177,12 @@ class FsReader:
         local = await self._local_path(lb)
         if local is None:
             return None
-        mm = self._mmap_for(lb.block.id, local)
-        return np.frombuffer(mm, dtype=np.uint8, count=n, offset=block_off)
+        fd = self._fd_for(lb.block.id, local)
+        buf = np.empty(n, dtype=np.uint8)
+        got = os.preadv(fd, [memoryview(buf)], block_off)
+        if got != n:
+            return None
+        return buf
 
     async def _read_some(self, offset: int, n: int) -> bytes:
         located = self._locate(offset)
@@ -154,8 +192,8 @@ class FsReader:
         n = min(n, lb.block.len - block_off)
         local = await self._local_path(lb)
         if local is not None:
-            mm = self._mmap_for(lb.block.id, local)
-            return mm[block_off:block_off + n]
+            fd = self._fd_for(lb.block.id, local)
+            return os.pread(fd, n, block_off)
         # failover across replica locations (local-first ordering)
         preferred = self._pick_loc(lb)
         locs = [preferred] + [l for l in lb.locs if l is not preferred]
@@ -204,11 +242,9 @@ class FsReader:
             yield data
 
     async def close(self) -> None:
-        for mm in self._mmaps.values():
+        for fd in self._local_fds.values():
             try:
-                mm.close()
-            except BufferError:
-                # zero-copy views handed out (mmap_view) are still alive;
-                # the mapping is released when the last view is dropped
+                os.close(fd)
+            except OSError:
                 pass
-        self._mmaps.clear()
+        self._local_fds.clear()

@@ -49,15 +49,38 @@ class FsWriter:
             if self._block is None:
                 await self._next_block()
             room = self.block_size - self._block_written - len(self._buf)
-            take = min(room, len(view), self.chunk_size * 8)
-            self._buf += view[:take]
-            view = view[take:]
-            while len(self._buf) >= self.chunk_size:
-                await self._flush_chunk(self.chunk_size)
+            if self._buf:
+                # top up the partial buffer to one chunk, flush it
+                take = min(room, len(view), self.chunk_size - len(self._buf))
+                self._buf += view[:take]
+                view = view[take:]
+                room -= take
+                if len(self._buf) >= self.chunk_size or room == 0:
+                    await self._flush_chunk(None)
+            else:
+                # fast path: send chunk-size slices straight from the
+                # caller's buffer — no intermediate copies
+                take = min(room, len(view))
+                sendable = view[:take]
+                while len(sendable) >= self.chunk_size:
+                    await self._send_chunk(sendable[:self.chunk_size])
+                    sendable = sendable[self.chunk_size:]
+                if len(sendable):
+                    if self._block_written + len(sendable) == self.block_size:
+                        await self._send_chunk(sendable)   # completes block
+                    else:
+                        self._buf += sendable
+                view = view[take:]
             if self._block_written + len(self._buf) >= self.block_size:
                 await self._seal_block()
         self.pos += total
         return total
+
+    async def _send_chunk(self, chunk) -> None:
+        self._block_crc = zlib.crc32(chunk, self._block_crc)
+        for up in self._uploads:
+            await up.send_chunk(chunk)
+        self._block_written += len(chunk)
 
     async def _next_block(self) -> None:
         self._block = await self.fs.add_block(
@@ -83,10 +106,7 @@ class FsWriter:
             return
         chunk = bytes(self._buf[:n])
         del self._buf[:n]
-        self._block_crc = zlib.crc32(chunk, self._block_crc)
-        for up in self._uploads:
-            await up.send_chunk(chunk)
-        self._block_written += n
+        await self._send_chunk(chunk)
 
     async def _seal_block(self) -> None:
         if self._block is None:

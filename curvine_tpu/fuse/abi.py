@@ -200,6 +200,11 @@ def pack_reply(unique: int, payload: bytes = b"", error: int = 0) -> bytes:
                            unique) + payload
 
 
+def pack_reply_header(unique: int, payload_len: int, error: int = 0) -> bytes:
+    """Header alone — pair with writev to emit large payloads uncopied."""
+    return OUT_HEADER.pack(OUT_HEADER.size + payload_len, -error, unique)
+
+
 def pack_dirent(ino: int, off: int, name: bytes, dtype: int) -> bytes:
     ent = DIRENT_HDR.pack(ino, off, len(name), dtype) + name
     pad = (-len(ent)) % 8

@@ -307,12 +307,14 @@ class CurvineFuseFs:
         self._open_writers[path] = writer
         return self._entry(path, st) + abi.OPEN_OUT.pack(fh, 0, 0)
 
-    async def op_read(self, hdr, payload) -> bytes:
+    async def op_read(self, hdr, payload):
         fh, offset, size, *_ = abi.READ_IN.unpack_from(payload, 0)
         h = self._fh(fh)
         if h.reader is None:
             raise FuseError(Errno.EINVAL)
-        return await h.reader.pread(offset, size)
+        # numpy buffer (preadv fast path); the session writes it with
+        # writev so it never gets copied into a bytes object
+        return await h.reader.pread_view(offset, size)
 
     async def op_write(self, hdr, payload) -> bytes:
         fh, offset, size, *_ = abi.WRITE_IN.unpack_from(payload, 0)

@@ -76,17 +76,22 @@ class FuseSession:
         view = memoryview(buf)
         hdr = abi.InHeader.parse(view)
         payload = view[abi.IN_HEADER.size:hdr.length]
+        bufs: list | None = None
         try:
             result = await self.fs.handle(hdr, payload)
             if result is None:        # FORGET-class: no reply at all
                 return
-            reply = abi.pack_reply(hdr.unique, result)
+            if isinstance(result, (bytes, bytearray)):
+                bufs = [abi.pack_reply_header(hdr.unique, len(result)), result]
+            else:                     # buffer view (numpy): avoid the copy
+                view = memoryview(result)
+                bufs = [abi.pack_reply_header(hdr.unique, view.nbytes), view]
         except FuseError as e:
-            reply = abi.pack_reply(hdr.unique, error=e.errno)
+            bufs = [abi.pack_reply(hdr.unique, error=e.errno)]
         except asyncio.CancelledError:
             return
         try:
-            os.write(self.fd, reply)
+            os.writev(self.fd, bufs)
         except OSError as e:
             if e.errno not in (2, 19):        # ENOENT: interrupted request
                 log.warning("fuse reply write failed: %s", e)

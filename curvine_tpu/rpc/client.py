@@ -37,8 +37,11 @@ class Connection:
     async def connect(self) -> "Connection":
         host, port = self.addr.rsplit(":", 1)
         try:
+            # 8 MiB stream buffer: block chunks are 4 MiB; the default
+            # 64 KiB limit forces flow-control stalls every chunk
             self._reader, self._writer = await asyncio.wait_for(
-                asyncio.open_connection(host, int(port)), self.timeout)
+                asyncio.open_connection(host, int(port), limit=8 * 1024 * 1024),
+                self.timeout)
         except (OSError, asyncio.TimeoutError) as e:
             raise ConnectError(f"connect {self.addr}: {e}") from e
         self._reader_task = asyncio.ensure_future(self._read_loop())

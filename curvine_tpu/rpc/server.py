@@ -82,7 +82,8 @@ class RpcServer:
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
-            self._on_conn, self.host, self.port, reuse_address=True)
+            self._on_conn, self.host, self.port, reuse_address=True,
+            limit=8 * 1024 * 1024)
         if self.port == 0:
             self.port = self._server.sockets[0].getsockname()[1]
         log.info("%s server listening on %s:%d", self.name, self.host, self.port)

@@ -179,15 +179,22 @@ class WorkerServer:
         stream = conn.open_stream(msg.req_id)
         crc = 0
         total = 0
+        # MEM-tier files live on tmpfs: a 4 MiB write is a memcpy, cheaper
+        # inline than a to_thread round trip (this box: ~2x throughput)
+        inline_io = info.tier.storage_type <= StorageType.MEM
         try:
-            f = await asyncio.to_thread(open, info.path, "wb")
+            f = open(info.path, "wb") if inline_io else \
+                await asyncio.to_thread(open, info.path, "wb")
             try:
                 while True:
                     m = await stream.get()
                     if len(m.data):
                         crc = zlib.crc32(m.data, crc)
                         total += len(m.data)
-                        await asyncio.to_thread(f.write, m.data)
+                        if inline_io:
+                            f.write(m.data)
+                        else:
+                            await asyncio.to_thread(f.write, m.data)
                     if m.is_eof:
                         want = m.header.get("crc32")
                         if want is not None and want != crc:
@@ -196,8 +203,9 @@ class WorkerServer:
                                 f"{crc:#x} != {want:#x}")
                         break
             finally:
-                await asyncio.to_thread(f.close)
-            self.store.commit(block_id, total)
+                f.close()
+            self.store.commit(block_id, total, checksum=crc,
+                              checksum_algo="crc32")
             self.metrics.inc("bytes.written", total)
             return {"block_id": block_id, "len": total, "crc32": crc,
                     "worker_id": self.worker_id}

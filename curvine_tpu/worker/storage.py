@@ -32,6 +32,7 @@ class BlockInfo:
     state: BlockState = BlockState.TEMP
     atime: float = field(default_factory=time.time)
     crc32c: int | None = None     # content checksum recorded at commit
+    crc_algo: str = "crc32c"      # crc32 (wire/zlib) or crc32c (native)
 
     @property
     def path(self) -> str:
@@ -133,7 +134,11 @@ class BlockStore:
             self.blocks[block_id] = info
             return info
 
-    def commit(self, block_id: int, length: int) -> BlockInfo:
+    def commit(self, block_id: int, length: int,
+               checksum: int | None = None,
+               checksum_algo: str = "crc32") -> BlockInfo:
+        """`checksum` is the streaming checksum already computed on the
+        write path (no re-read); absent → computed natively from disk."""
         with self._lock:
             info = self._get_locked(block_id)
             if info.state == BlockState.COMMITTED:
@@ -143,16 +148,28 @@ class BlockStore:
             info.len = length
             os.replace(tmp, info.path)
             info.tier.used += length
-        from curvine_tpu.common import native
-        info.crc32c = native.checksum_file(info.path)
+        if checksum is not None:
+            info.crc32c = checksum
+            info.crc_algo = checksum_algo
+        else:
+            from curvine_tpu.common import native
+            info.crc32c = native.checksum_file(info.path)
+            info.crc_algo = "crc32c"
         return info
 
     def verify(self, block_id: int) -> bool:
-        """Re-checksum a committed block against its commit-time crc32c."""
+        """Re-checksum a committed block against its commit-time value."""
+        import zlib
         from curvine_tpu.common import native
         info = self.get(block_id, touch=False)
         if info.state != BlockState.COMMITTED or info.crc32c is None:
             return True
+        if info.crc_algo == "crc32":
+            with open(info.path, "rb") as f:
+                crc = 0
+                while chunk := f.read(1 << 20):
+                    crc = zlib.crc32(chunk, crc)
+            return crc == info.crc32c
         return native.checksum_file(info.path) == info.crc32c
 
     def scrub(self, limit: int = 16) -> list[int]:

@@ -78,7 +78,7 @@ async def test_ops_without_kernel():
         data = await fs.op_read(
             hdr(abi.Op.READ, nodeid=nodeid),
             memoryview(abi.READ_IN.pack(fh, 0, 4096, 0, 0, 0, 0)))
-        assert data == b"hi fuse"
+        assert bytes(data) == b"hi fuse"
         await fs.op_release(hdr(abi.Op.RELEASE, nodeid=nodeid),
                             memoryview(abi.RELEASE_IN.pack(fh, 0, 0, 0)))
 

@@ -317,6 +317,25 @@ async def cmd_load(args):
         await c.close()
 
 
+async def cmd_export(args):
+    c = await _client(args)
+    try:
+        job_id = await c.meta.submit_export(args.path)
+        print(f"submitted export job {job_id}")
+        if args.wait:
+            while True:
+                job = await c.meta.job_status(job_id)
+                done = sum(1 for t in job.tasks
+                           if t.state == JobState.COMPLETED)
+                print(f"  {job.state.name}: {done}/{len(job.tasks)} tasks")
+                if job.state in (JobState.COMPLETED, JobState.FAILED,
+                                 JobState.CANCELLED):
+                    break
+                await asyncio.sleep(1)
+    finally:
+        await c.close()
+
+
 async def cmd_load_status(args):
     c = await _client(args)
     try:
@@ -434,6 +453,7 @@ def build_parser() -> argparse.ArgumentParser:
     add("mounts", cmd_mounts)
     add("load", cmd_load, A("path"), A("--replicas", type=int, default=1),
         A("--wait", action="store_true"))
+    add("export", cmd_export, A("path"), A("--wait", action="store_true"))
     add("load-status", cmd_load_status, A("job_id"))
     add("load-cancel", cmd_load_cancel, A("job_id"))
     add("bench", cmd_bench, A("--size-mb", type=int, default=256))

@@ -223,12 +223,19 @@ class FsClient:
         rep = await self.call(RpcCode.GET_MOUNT_INFO, {"path": path})
         return MountInfo.from_wire(rep["mount"]) if rep.get("mount") else None
 
-    async def submit_load(self, path: str, recursive: bool = True,
-                          replicas: int = 1) -> str:
+    async def submit_job(self, kind: str, path: str, recursive: bool = True,
+                         replicas: int = 1) -> str:
         rep = await self.call(RpcCode.SUBMIT_JOB, {
-            "kind": "load", "path": path, "recursive": recursive,
+            "kind": kind, "path": path, "recursive": recursive,
             "replicas": replicas}, mutate=True)
         return rep["job_id"]
+
+    async def submit_load(self, path: str, recursive: bool = True,
+                          replicas: int = 1) -> str:
+        return await self.submit_job("load", path, recursive, replicas)
+
+    async def submit_export(self, path: str, recursive: bool = True) -> str:
+        return await self.submit_job("export", path, recursive)
 
     async def job_status(self, job_id: str) -> JobInfo:
         rep = await self.call(RpcCode.GET_JOB_STATUS, {"job_id": job_id})

@@ -189,6 +189,16 @@ class CurvineClient:
             raise
         return total
 
+    async def export_to_ufs(self, path: str) -> int:
+        """Persist one cached file out to its mounted UFS location."""
+        mount, ufs, uri = await self._ufs_for(path)
+        r = await self.open(path)
+        try:
+            total = await ufs.write(uri, r.chunks())
+        finally:
+            await r.close()
+        return total
+
     async def write_through(self, path: str, data: bytes) -> None:
         """WriteType.FS: persist to UFS and cache."""
         mount, ufs, uri = await self._ufs_for(path)

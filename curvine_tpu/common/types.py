@@ -283,6 +283,7 @@ class TaskInfo(Wire):
     job_id: str = ""
     worker_id: int = 0
     path: str = ""
+    kind: str = "load"          # load (ufs→cache) | export (cache→ufs)
     state: JobState = JobState.PENDING
     message: str = ""
     total_len: int = 0

@@ -34,13 +34,50 @@ class JobManager:
 
     def submit(self, kind: str, path: str, recursive: bool = True,
                replicas: int = 1) -> JobInfo:
-        if kind != "load":
+        if kind not in ("load", "export"):
             raise err.Unsupported(f"job kind {kind!r}")
         job = JobInfo(job_id=uuid.uuid4().hex[:16], kind=kind, path=path,
                       state=JobState.PENDING, create_ms=now_ms())
         self.jobs[job.job_id] = job
-        asyncio.ensure_future(self._plan_load(job, recursive, replicas))
+        if kind == "load":
+            asyncio.ensure_future(self._plan_load(job, recursive, replicas))
+        else:
+            asyncio.ensure_future(self._plan_export(job, recursive))
         return job
+
+    async def _plan_export(self, job: JobInfo, recursive: bool) -> None:
+        """Enumerate cached files under job.path → one export task each.
+        Parity: curvine-cli/src/cmds/export.rs job flow."""
+        try:
+            self.mounts.resolve(job.path)   # must be under a mount
+            files: list = []
+
+            def walk(path: str) -> None:
+                for st in self.fs.list_status(path):
+                    if st.is_dir:
+                        if recursive:
+                            walk(st.path)
+                    else:
+                        files.append(st)
+
+            st = self.fs.file_status(job.path)
+            if st.is_dir:
+                walk(job.path)
+            else:
+                files.append(st)
+            for f in files:
+                task = TaskInfo(task_id=uuid.uuid4().hex[:16],
+                                job_id=job.job_id, path=f.path,
+                                kind="export", total_len=f.len)
+                job.tasks.append(task)
+                await self._pending.put(task)
+            job.state = JobState.RUNNING if files else JobState.COMPLETED
+            if not files:
+                job.finish_ms = now_ms()
+        except Exception as e:  # noqa: BLE001 — job fails with message
+            log.warning("export job %s planning failed: %s", job.job_id, e)
+            job.state = JobState.FAILED
+            job.message = str(e)
 
     async def _plan_load(self, job: JobInfo, recursive: bool,
                          replicas: int) -> None:

@@ -338,12 +338,16 @@ class WorkerServer:
         return {"accepted": True}
 
     async def _run_load_task(self, task: TaskInfo) -> None:
-        """UFS → cache transfer. Parity: worker/task/load_task_runner.rs."""
+        """UFS ↔ cache transfer. Parity: worker/task/load_task_runner.rs
+        (load) + the export job flow (cache → UFS)."""
         from curvine_tpu.client import CurvineClient
         async with self._task_sem:
             client = CurvineClient(self.conf)
             try:
-                n = await client.load_from_ufs(task.path)
+                if task.kind == "export":
+                    n = await client.export_to_ufs(task.path)
+                else:
+                    n = await client.load_from_ufs(task.path)
                 task.state = JobState.COMPLETED
                 task.loaded_len = n
             except Exception as e:  # noqa: BLE001

@@ -149,3 +149,26 @@ async def test_load_job_cancel_and_missing():
         assert job.state == JobState.FAILED
         with pytest.raises(err.JobNotFound):
             await c.meta.job_status("nope")
+
+
+async def test_export_job():
+    """Reverse of load: cached files written out to the mounted UFS."""
+    memufs.reset()
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.meta.mount("/exp", "mem://expbkt")
+        await c.write_all("/exp/out/a.bin", b"A" * 500)
+        await c.write_all("/exp/out/b.bin", b"B" * 700)
+        job_id = await c.meta.submit_export("/exp/out")
+
+        async def wait_done():
+            while True:
+                job = await c.meta.job_status(job_id)
+                if job.state in (JobState.COMPLETED, JobState.FAILED):
+                    return job
+                await asyncio.sleep(0.05)
+        job = await asyncio.wait_for(wait_done(), 15)
+        assert job.state == JobState.COMPLETED, job.message
+        ufs = create_ufs("mem://expbkt")
+        assert await ufs.read_all("mem://expbkt/out/a.bin") == b"A" * 500
+        assert await ufs.read_all("mem://expbkt/out/b.bin") == b"B" * 700

@@ -1,0 +1,58 @@
+"""Structured logging setup.
+
+Parity: the reference's tracing/tracing-subscriber stack (per-component
+levels, rolling files). Wraps stdlib logging: component-scoped levels via
+CURVINE_LOG (e.g. ``info,curvine_tpu.rpc=debug``), optional rotating file
+output, single-line structured format."""
+
+from __future__ import annotations
+
+import logging
+import logging.handlers
+import os
+import sys
+
+_FORMAT = ("%(asctime)s.%(msecs)03d %(levelname).1s "
+           "%(name)s %(message)s")
+_DATEFMT = "%Y-%m-%d %H:%M:%S"
+
+
+def setup(spec: str | None = None, log_file: str | None = None,
+          rotate_mb: int = 64, backups: int = 4) -> None:
+    """Configure root + per-component levels.
+
+    spec: ``<default-level>[,<logger>=<level>...]``; falls back to the
+    CURVINE_LOG env var, then "info"."""
+    spec = spec or os.environ.get("CURVINE_LOG", "info")
+    parts = [p.strip() for p in spec.split(",") if p.strip()]
+    default = parts[0] if parts and "=" not in parts[0] else "info"
+
+    handlers: list[logging.Handler] = [logging.StreamHandler(sys.stderr)]
+    if log_file:
+        os.makedirs(os.path.dirname(log_file) or ".", exist_ok=True)
+        handlers.append(logging.handlers.RotatingFileHandler(
+            log_file, maxBytes=rotate_mb * 1024 * 1024, backupCount=backups))
+    fmt = logging.Formatter(_FORMAT, datefmt=_DATEFMT)
+    root = logging.getLogger()
+    root.handlers.clear()
+    for h in handlers:
+        h.setFormatter(fmt)
+        root.addHandler(h)
+    root.setLevel(default.upper())
+    for p in parts:
+        if "=" in p:
+            name, _, level = p.partition("=")
+            logging.getLogger(name).setLevel(level.upper())
+
+
+class audit:
+    """Master audit log (reference: master audit logging). One line per
+    namespace mutation when enabled."""
+
+    logger = logging.getLogger("curvine.audit")
+
+    @classmethod
+    def log(cls, op: str, path: str, client: str = "", ok: bool = True,
+            detail: str = "") -> None:
+        cls.logger.info("audit op=%s path=%s client=%s ok=%s %s",
+                        op, path, client, ok, detail)

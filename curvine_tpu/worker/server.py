@@ -53,6 +53,15 @@ class WorkerServer:
         self.peer_pool = ConnectionPool(size=2)
         self.worker_id = worker_id if worker_id is not None else 0
         self.chunk_size = wc.io_chunk_size
+        # HBM tier-0: device-resident block cache for workers co-located
+        # with a TPU (in-process consumers get on-device fetches)
+        self.hbm = None
+        if wc.hbm_capacity > 0:
+            try:
+                from curvine_tpu.tpu.hbm import HbmTier
+                self.hbm = HbmTier(wc.hbm_capacity)
+            except Exception as e:  # noqa: BLE001 — no device available
+                log.warning("hbm tier disabled: %s", e)
         self._bg: list[asyncio.Task] = []
         self._task_sem = asyncio.Semaphore(wc.task_parallelism)
         self._register_handlers()
@@ -93,7 +102,15 @@ class WorkerServer:
         return await self.master_pool.get(self.conf.client.master_addrs[0])
 
     def _info(self) -> WorkerInfo:
-        return WorkerInfo(address=self.address, storages=self.store.storages(),
+        storages = self.store.storages()
+        if self.hbm is not None:
+            from curvine_tpu.common.types import StorageInfo
+            storages.insert(0, StorageInfo(
+                storage_type=StorageType.HBM, dir_id="hbm:0",
+                capacity=self.hbm.capacity,
+                available=self.hbm.capacity - self.hbm.used,
+                block_num=len(self.hbm._blocks)))
+        return WorkerInfo(address=self.address, storages=storages,
                           last_heartbeat_ms=now_ms(),
                           ici_coords=list(self.conf.worker.ici_coords))
 
@@ -166,6 +183,8 @@ class WorkerServer:
         r(RpcCode.DELETE_BLOCK, self._delete_block)
         r(RpcCode.GET_BLOCK_INFO, self._get_block_info)
         r(RpcCode.WRITE_BLOCKS_BATCH, self._write_blocks_batch)
+        r(RpcCode.HBM_PIN, self._hbm_pin)
+        r(RpcCode.HBM_UNPIN, self._hbm_unpin)
         r(RpcCode.SUBMIT_BLOCK_REPLICATION_JOB, self._replicate_block)
         r(RpcCode.SUBMIT_TASK, self._submit_task)
 
@@ -330,6 +349,34 @@ class WorkerServer:
         except Exception as e:
             log.warning("replication result report failed: %s", e)
         return {"success": ok, "message": message}
+
+    async def _hbm_pin(self, msg: Message, conn: ServerConn):
+        """Pin a cached block into the HBM tier-0 (device-resident).
+        In-process consumers (sdk/tpu loaders embedded on the TPU VM) then
+        fetch it as an on-device array via `hbm.get`."""
+        q = unpack(msg.data) or {}
+        if self.hbm is None:
+            raise err.Unsupported("hbm tier not enabled on this worker")
+        block_id = q["block_id"]
+        info = self.store.get(block_id)
+        import numpy as np
+        buf = np.empty(info.len, dtype=np.uint8)
+        fd = os.open(info.path, os.O_RDONLY)
+        try:
+            os.preadv(fd, [memoryview(buf)], 0)
+        finally:
+            os.close(fd)
+        arr = await asyncio.to_thread(self.hbm.put, block_id, buf)
+        self.metrics.gauge("hbm.used", self.hbm.used)
+        return {"block_id": block_id, "len": int(arr.nbytes),
+                "hbm": self.hbm.stats()}
+
+    async def _hbm_unpin(self, msg: Message, conn: ServerConn):
+        q = unpack(msg.data) or {}
+        if self.hbm is not None:
+            self.hbm.drop(q["block_id"])
+            self.metrics.gauge("hbm.used", self.hbm.used)
+        return {}
 
     async def _submit_task(self, msg: Message, conn: ServerConn):
         q = unpack(msg.data) or {}

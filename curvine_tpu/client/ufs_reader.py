@@ -1,0 +1,55 @@
+"""Reader over an uncached UFS object (FsReader-compatible surface).
+
+Backs the unified read path for files that exist under a mount but have
+no cached blocks yet: ranged reads go straight to the under-store."""
+
+from __future__ import annotations
+
+
+class UfsReader:
+    def __init__(self, ufs, uri: str, length: int, chunk_size: int = 4 * 1024 * 1024):
+        self.ufs = ufs
+        self.uri = uri
+        self.len = length
+        self.chunk_size = chunk_size
+        self.pos = 0
+
+    def seek(self, pos: int) -> None:
+        self.pos = max(0, min(pos, self.len))
+
+    async def read(self, n: int = -1) -> bytes:
+        if n < 0:
+            n = self.len - self.pos
+        data = await self.pread(self.pos, n)
+        self.pos += len(data)
+        return data
+
+    async def read_all(self) -> bytes:
+        self.seek(0)
+        return await self.read(self.len)
+
+    async def pread(self, offset: int, n: int) -> bytes:
+        n = max(0, min(n, self.len - offset))
+        if n == 0:
+            return b""
+        out = bytearray()
+        async for chunk in self.ufs.read(self.uri, offset=offset, length=n):
+            out += chunk
+        return bytes(out)
+
+    async def pread_view(self, offset: int, n: int):
+        import numpy as np
+        return np.frombuffer(await self.pread(offset, n), dtype=np.uint8)
+
+    async def mmap_view(self, offset: int, n: int):
+        return None      # no local block files to map
+
+    async def chunks(self, chunk_size: int | None = None):
+        chunk_size = chunk_size or self.chunk_size
+        self.seek(0)
+        async for chunk in self.ufs.read(self.uri, chunk_size=chunk_size):
+            self.pos += len(chunk)
+            yield chunk
+
+    async def close(self) -> None:
+        return None

@@ -163,13 +163,20 @@ class CurvineClient:
         covered = sum(lb.block.len for lb in fb.block_locs if lb.locs)
         return covered >= st.len
 
-    async def unified_open(self, path: str) -> FsReader:
-        """Open preferring cache; UFS data is materialized through a local
-        buffer reader when not cached."""
+    async def unified_open(self, path: str):
+        """Open preferring cache; uncached files under a mount stream
+        directly from the UFS (FsReader-compatible UfsReader)."""
         st = await self.meta.file_status(path)
-        if await self._has_cached_blocks(path, st):
+        try:
+            cached = st.len == 0 or await self._has_cached_blocks(path, st)
+        except err.FileNotFound:
+            cached = False      # UFS-only object: no inode yet
+        if cached:
             return await self.open(path)
-        raise err.Uncompleted(f"{path} not fully cached; use unified_read")
+        from curvine_tpu.client.ufs_reader import UfsReader
+        mount, ufs, uri = await self._ufs_for(path)
+        return UfsReader(ufs, uri, st.len,
+                         chunk_size=self.conf.client.read_chunk_size)
 
     async def load_from_ufs(self, path: str, replicas: int | None = None) -> int:
         """Warm one file: UFS → cache (the worker-side of load tasks)."""

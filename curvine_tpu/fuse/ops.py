@@ -284,7 +284,9 @@ class CurvineFuseFs:
         path = self.node_path(hdr.nodeid)
         acc = flags & os.O_ACCMODE
         if acc == os.O_RDONLY:
-            reader = await self.client.open(path)
+            # unified: cached files use block readers, uncached mounted
+            # files stream from the UFS
+            reader = await self.client.unified_open(path)
             fh = self._new_fh(_Handle(reader=reader, path=path))
         else:
             if flags & os.O_APPEND:

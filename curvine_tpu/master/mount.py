@@ -103,6 +103,57 @@ class MountManager:
                 return info, (info.cv_path + rel) or "/"
         raise err.MountNotFound(f"no mount covers {ufs_uri}")
 
+    # ---------- UFS metadata passthrough ----------
+
+    def _ufs_for(self, path: str):
+        from curvine_tpu.ufs import create_ufs
+        info = self.get_mount(path)
+        if info is None:
+            return None, None, None
+        rel = path[len(info.cv_path):] if info.cv_path != "/" else path
+        return info, create_ufs(info.ufs_path,
+                                properties=info.properties), \
+            info.ufs_path + rel
+
+    def _synth_status(self, cv_path: str, ufs_st) :
+        """UFS object → FileStatus (state=UFS, not cached)."""
+        from curvine_tpu.common.types import (
+            FileStatus, StoragePolicy, StorageState, StorageType,
+        )
+        return FileStatus(
+            id=0, path=cv_path, name=cv_path.rsplit("/", 1)[-1],
+            is_dir=ufs_st.is_dir, mtime=ufs_st.mtime, atime=ufs_st.mtime,
+            is_complete=True, len=ufs_st.len,
+            storage_policy=StoragePolicy(storage_type=StorageType.UFS,
+                                         state=StorageState.UFS))
+
+    async def ufs_status(self, path: str):
+        """FileStatus for an uncached object under a mount, else None."""
+        info, ufs, uri = self._ufs_for(path)
+        if info is None:
+            return None
+        try:
+            st = await ufs.stat(uri)
+        except Exception:  # noqa: BLE001 — UFS outage ≠ namespace error
+            return None
+        return self._synth_status(path, st) if st is not None else None
+
+    async def ufs_list(self, path: str):
+        """Children of a mounted dir as synthesized FileStatus entries."""
+        info, ufs, uri = self._ufs_for(path)
+        if info is None:
+            return []
+        try:
+            entries = await ufs.list(uri)
+        except Exception:  # noqa: BLE001
+            return []
+        out = []
+        for st in entries:
+            name = st.path.rstrip("/").rsplit("/", 1)[-1]
+            cv = f"{path.rstrip('/')}/{name}" if path != "/" else f"/{name}"
+            out.append(self._synth_status(cv, st))
+        return out
+
     # ---------- snapshot ----------
     def snapshot_state(self) -> list[dict]:
         return [m.to_wire() for m in self._mounts.values()]

@@ -143,6 +143,14 @@ class MasterServer:
 
     def _h(self, fn, mutate: bool = False):
         metrics = self.metrics
+        import inspect
+
+        async def call(req):
+            rep = fn(req)
+            if inspect.isawaitable(rep):
+                rep = await rep
+            return rep
+
         async def handler(msg: Message, conn: ServerConn):
             req = unpack(msg.data) or {}
             with metrics.timer(f"rpc.{fn.__name__.lstrip('_')}"):
@@ -154,11 +162,11 @@ class MasterServer:
                         cached = self.retry_cache.get(key)
                         if cached is not None:
                             return {}, cached
-                        rep = fn(req)
+                        rep = await call(req)
                         data = pack(rep)
                         self.retry_cache.put(key, data)
                         return {}, data
-                rep = fn(req)
+                rep = await call(req)
             return {}, pack(rep)
         return handler
 
@@ -193,14 +201,37 @@ class MasterServer:
         fb = self.fs.append_file(q["path"], client_name=q.get("client_name", ""))
         return {"file_blocks": fb.to_wire()}
 
-    def _file_status(self, q):
-        return {"status": self.fs.file_status(q["path"]).to_wire()}
+    async def _file_status(self, q):
+        from curvine_tpu.common import errors as cerr
+        try:
+            return {"status": self.fs.file_status(q["path"]).to_wire()}
+        except cerr.FileNotFound:
+            st = await self.mounts.ufs_status(q["path"])
+            if st is None:
+                raise
+            return {"status": st.to_wire()}
 
-    def _list_status(self, q):
-        return {"statuses": [s.to_wire() for s in self.fs.list_status(q["path"])]}
+    async def _list_status(self, q):
+        """Cached entries merged with the mounted UFS listing (unified
+        metadata view — UFS objects appear before they are ever cached).
+        Parity: reference sync_ufs_meta / unified listing."""
+        from curvine_tpu.common import errors as cerr
+        path = q["path"]
+        try:
+            cached = self.fs.list_status(path)
+        except cerr.FileNotFound:
+            if await self.mounts.ufs_status(path) is None:
+                raise
+            cached = []
+        merged = {s.name: s for s in await self.mounts.ufs_list(path)}
+        merged.update({s.name: s for s in cached})
+        return {"statuses": [merged[k].to_wire() for k in sorted(merged)]}
 
-    def _exists(self, q):
-        return {"exists": self.fs.exists(q["path"])}
+    async def _exists(self, q):
+        if self.fs.exists(q["path"]):
+            return {"exists": True}
+        st = await self.mounts.ufs_status(q["path"])
+        return {"exists": st is not None}
 
     def _rename(self, q):
         return {"result": self.fs.rename(q["src"], q["dst"])}

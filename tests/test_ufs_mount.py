@@ -172,3 +172,27 @@ async def test_export_job():
         ufs = create_ufs("mem://expbkt")
         assert await ufs.read_all("mem://expbkt/out/a.bin") == b"A" * 500
         assert await ufs.read_all("mem://expbkt/out/b.bin") == b"B" * 700
+
+
+async def test_ufs_metadata_passthrough():
+    """ls/stat/read of UFS objects that were never cached."""
+    memufs.reset()
+    ufs = create_ufs("mem://meta")
+    await ufs.write_all("mem://meta/raw/x.bin", b"X" * 300)
+    await ufs.write_all("mem://meta/raw/deep/y.bin", b"Y" * 400)
+
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.meta.mount("/m", "mem://meta")
+        # stat an uncached object
+        st = await c.meta.file_status("/m/raw/x.bin")
+        assert st.len == 300 and st.is_complete
+        assert await c.meta.exists("/m/raw/deep/y.bin")
+        # listing merges cached + UFS entries
+        await c.write_all("/m/raw/cached.bin", b"C")
+        names = {s.name for s in await c.meta.list_status("/m/raw")}
+        assert names == {"x.bin", "deep", "cached.bin"}
+        # unified_open streams uncached data from UFS
+        r = await c.unified_open("/m/raw/x.bin")
+        assert await r.read_all() == b"X" * 300
+        assert await r.pread(10, 5) == b"X" * 5

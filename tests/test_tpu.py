@@ -175,3 +175,21 @@ def test_pallas_checksum_interpret():
     swapped[0], swapped[4] = swapped[4], swapped[0]
     assert block_checksum_host(swapped.tobytes()) != \
         block_checksum_host(data.tobytes())
+
+
+def test_ici_block_transfer():
+    """HBM replica movement: scatter/gather/broadcast over the mesh."""
+    from curvine_tpu.tpu import ici_transfer as it
+    from curvine_tpu.tpu.mesh import make_mesh
+    mesh = make_mesh(devices=CPUS, axis_names=("x",))
+    data = np.random.default_rng(0).integers(0, 255, MB + 5, dtype=np.uint8)
+    sc = it.scatter_block(data, mesh)
+    assert not sc.sharding.is_fully_replicated
+    assert sc.addressable_shards[0].data.shape[0] == (data.size + 3) // 8
+    rep = it.gather_block(sc, mesh)
+    assert rep.sharding.is_fully_replicated
+    assert np.array_equal(np.asarray(rep)[:data.size], data)
+    b = it.broadcast_block(data, mesh)
+    assert np.array_equal(np.asarray(b)[:data.size], data)
+    arrs = it.replicate_to_devices(jax.device_put(data, CPUS[0]), CPUS[:4])
+    assert len(arrs) == 4

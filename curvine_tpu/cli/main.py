@@ -384,9 +384,11 @@ async def cmd_bench(args):
 # ---------------- daemons ----------------
 
 async def cmd_master(args):
+    from curvine_tpu.common.logging import setup as log_setup
     from curvine_tpu.master import MasterServer
     from curvine_tpu.web.server import WebServer
     conf = _conf(args)
+    log_setup(log_file=os.path.join(conf.data_dir, "logs", "master.log"))
     m = MasterServer(conf)
     await m.start()
     web = WebServer(conf.master.web_port, master=m)
@@ -396,8 +398,10 @@ async def cmd_master(args):
 
 
 async def cmd_worker(args):
+    from curvine_tpu.common.logging import setup as log_setup
     from curvine_tpu.worker import WorkerServer
     conf = _conf(args)
+    log_setup(log_file=os.path.join(conf.data_dir, "logs", "worker.log"))
     w = WorkerServer(conf)
     await w.start()
     print(f"worker {w.worker_id} at {w.addr}")

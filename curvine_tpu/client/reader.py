@@ -144,14 +144,32 @@ class FsReader:
                     out = out[:filled + max(0, got)]
                     break
             else:
-                data = await self._read_some(offset + filled, seg)
-                if not data:
+                # remote: stream chunks straight into the output buffer
+                got = await self._readinto_remote(
+                    lb, block_off, memoryview(out[filled:filled + seg]))
+                if got <= 0:
                     out = out[:filled]
                     break
-                seg = len(data)
-                out[filled:filled + seg] = np.frombuffer(data, dtype=np.uint8)
+                seg = got
             filled += seg
         return out[:filled]
+
+    async def _readinto_remote(self, lb: LocatedBlock, block_off: int,
+                               sink: memoryview) -> int:
+        preferred = self._pick_loc(lb)
+        locs = [preferred] + [l for l in lb.locs if l is not preferred]
+        last_err: Exception | None = None
+        for loc in locs:
+            try:
+                conn = await self.pool.get(
+                    f"{loc.ip_addr or loc.hostname}:{loc.rpc_port}")
+                return await conn.call_readinto(
+                    RpcCode.READ_BLOCK, sink, header={
+                        "block_id": lb.block.id, "offset": block_off,
+                        "len": len(sink), "chunk_size": self.chunk_size})
+            except err.CurvineError as e:
+                last_err = e
+        raise last_err or err.BlockNotFound(f"block {lb.block.id} unreadable")
 
     def _fd_for(self, block_id: int, path: str) -> int:
         fd = self._local_fds.get(block_id)

@@ -61,8 +61,13 @@ class MasterFilesystem:
             log.info("recovered namespace: %d inodes, %d blocks, seq=%d",
                      self.tree.count(), self.blocks.count(), self.journal.seq)
 
+    audit_log = False   # set from MasterConf.audit_log
+
     def _log(self, op: str, args: dict):
         result = self._apply(op, args)
+        if self.audit_log:
+            from curvine_tpu.common.logging import audit
+            audit.log(op, str(args.get("path", args.get("src", ""))))
         if self.journal is not None:
             seq = self.journal.append(op, args)
             if self.on_mutation is not None:

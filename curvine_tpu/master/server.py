@@ -36,6 +36,7 @@ class MasterServer:
             journal=j, placement=mc.block_placement_policy,
             lost_timeout_ms=mc.worker_lost_timeout_ms,
             snapshot_interval=mc.snapshot_interval_entries)
+        self.fs.audit_log = mc.audit_log
         self.mounts = MountManager(self.fs)
         self.fs.mounts = self.mounts
         self.metrics = MetricsRegistry("master")

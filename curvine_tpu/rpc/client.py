@@ -1,7 +1,14 @@
 """RPC client: connection, response routing, pool, retry policy.
 
 Parity: orpc/src/client/ (ClusterConnector/conn pool) and
-orpc/src/io/retry/ (exponential backoff, retryable error classification)."""
+orpc/src/io/retry/ (exponential backoff, retryable error classification).
+
+The connection runs on a raw non-blocking socket (loop.sock_* APIs, no
+asyncio streams): frame payloads are received with recv_into, and a
+caller-registered *sink* buffer lets block-read streams land directly in
+the destination (numpy/HBM staging) buffer — no intermediate bytes
+objects, which matters doubly on virtualized hosts where first-touch
+page faults dominate large allocations."""
 
 from __future__ import annotations
 
@@ -9,16 +16,28 @@ import asyncio
 import itertools
 import logging
 import random
+import socket
+from dataclasses import dataclass
 from typing import Any, AsyncIterator
 
 from curvine_tpu.common.errors import ConnectError, CurvineError, RpcTimeout
 from curvine_tpu.rpc.frame import (
-    Flags, Message, pack, read_frame, unpack, write_frame,
+    FIXED_LEN, LEN_PREFIX, MAX_FRAME, Flags, Message, pack, unpack,
 )
+from curvine_tpu.rpc import frame as frame_mod
 
 log = logging.getLogger(__name__)
 
 _req_ids = itertools.count(1)
+
+
+@dataclass
+class _Sink:
+    """Destination buffer for a streaming read; chunk payloads are
+    scattered into `view` at `filled`."""
+
+    view: memoryview
+    filled: int = 0
 
 
 class Connection:
@@ -27,42 +46,90 @@ class Connection:
     def __init__(self, addr: str, timeout_ms: int = 30_000):
         self.addr = addr
         self.timeout = timeout_ms / 1000
-        self._reader: asyncio.StreamReader | None = None
-        self._writer: asyncio.StreamWriter | None = None
+        self._sock: socket.socket | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
         self._waiters: dict[int, asyncio.Queue] = {}
+        self._sinks: dict[int, _Sink] = {}
         self._reader_task: asyncio.Task | None = None
         self._wlock = asyncio.Lock()
         self.closed = False
 
     async def connect(self) -> "Connection":
         host, port = self.addr.rsplit(":", 1)
+        self._loop = asyncio.get_running_loop()
         try:
-            # 8 MiB stream buffer: block chunks are 4 MiB; the default
-            # 64 KiB limit forces flow-control stalls every chunk
-            self._reader, self._writer = await asyncio.wait_for(
-                asyncio.open_connection(host, int(port), limit=8 * 1024 * 1024),
-                self.timeout)
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            sock.setblocking(False)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            await asyncio.wait_for(
+                self._loop.sock_connect(sock, (host, int(port))), self.timeout)
         except (OSError, asyncio.TimeoutError) as e:
             raise ConnectError(f"connect {self.addr}: {e}") from e
+        self._sock = sock
         self._reader_task = asyncio.ensure_future(self._read_loop())
         return self
 
+    # ---------------- receive plumbing ----------------
+
+    async def _recv_into(self, view: memoryview) -> None:
+        sock, loop = self._sock, self._loop
+        assert sock is not None and loop is not None
+        off = 0
+        n = len(view)
+        while off < n:
+            got = await loop.sock_recv_into(sock, view[off:])
+            if got == 0:
+                raise ConnectionResetError("peer closed")
+            off += got
+
     async def _read_loop(self) -> None:
-        assert self._reader is not None
+        prefix = bytearray(4)
+        fixed = bytearray(FIXED_LEN)
         try:
             while True:
-                msg = await read_frame(self._reader)
-                q = self._waiters.get(msg.req_id)
+                await self._recv_into(memoryview(prefix))
+                (total,) = LEN_PREFIX.unpack(prefix)
+                if total > MAX_FRAME or total < FIXED_LEN:
+                    raise CurvineError(f"bad frame length {total}")
+                await self._recv_into(memoryview(fixed))
+                version, code, req_id, status, flags, hdr_len = \
+                    frame_mod._FIXED.unpack(fixed)
+                header: dict = {}
+                if hdr_len:
+                    hdr_buf = bytearray(hdr_len)
+                    await self._recv_into(memoryview(hdr_buf))
+                    import msgpack
+                    header = msgpack.unpackb(bytes(hdr_buf), raw=False,
+                                             strict_map_key=False)
+                data_len = total - FIXED_LEN - hdr_len
+                sink = self._sinks.get(req_id)
+                data: bytes = b""
+                if data_len:
+                    if (sink is not None and status == 0
+                            and sink.filled + data_len <= len(sink.view)):
+                        await self._recv_into(
+                            sink.view[sink.filled:sink.filled + data_len])
+                        sink.filled += data_len
+                    else:
+                        buf = bytearray(data_len)
+                        await self._recv_into(memoryview(buf))
+                        data = bytes(buf)
+                msg = Message(code=code, req_id=req_id, status=status,
+                              flags=flags, header=header, data=data)
+                q = self._waiters.get(req_id)
                 if q is not None:
-                    # own the buffer: the next read reuses the frame memory
-                    msg.data = bytes(msg.data)
-                    q.put_nowait(msg)
+                    # streaming chunks landed in a sink don't need delivery
+                    if not (sink is not None and msg.is_chunk
+                            and status == 0):
+                        q.put_nowait(msg)
                 else:
-                    log.debug("drop orphan frame req_id=%d", msg.req_id)
-        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+                    log.debug("drop orphan frame req_id=%d", req_id)
+        except (ConnectionResetError, OSError):
             pass
         except asyncio.CancelledError:
             pass
+        except Exception:
+            log.exception("connection %s read loop", self.addr)
         finally:
             self.closed = True
             err = Message(status=1, header={"error_code": 26,
@@ -75,22 +142,25 @@ class Connection:
         self.closed = True
         if self._reader_task:
             self._reader_task.cancel()
-        if self._writer:
-            self._writer.close()
+        if self._sock is not None:
             try:
-                await self._writer.wait_closed()
-            except Exception:
+                self._sock.close()
+            except OSError:
                 pass
+            self._sock = None
+
+    # ---------------- send plumbing ----------------
 
     async def send(self, msg: Message) -> None:
-        if self.closed or self._writer is None or self._writer.is_closing():
+        if self.closed or self._sock is None:
             raise ConnectError(f"connection {self.addr} is closed")
+        bufs = msg.encode()
         async with self._wlock:
             try:
-                write_frame(self._writer, msg)
-                await self._writer.drain()
-            except (ConnectionError, RuntimeError, TypeError) as e:
-                # transport torn down mid-write
+                assert self._loop is not None
+                for b in bufs:
+                    await self._loop.sock_sendall(self._sock, b)
+            except (OSError, RuntimeError) as e:
                 self.closed = True
                 raise ConnectError(f"send to {self.addr}: {e}") from e
 
@@ -101,6 +171,9 @@ class Connection:
 
     def unregister(self, req_id: int) -> None:
         self._waiters.pop(req_id, None)
+        self._sinks.pop(req_id, None)
+
+    # ---------------- request patterns ----------------
 
     async def call(self, code: int, header: dict | None = None,
                    data: bytes | memoryview = b"",
@@ -137,6 +210,35 @@ class Connection:
                 yield rep
                 if rep.is_eof:
                     return
+        finally:
+            self.unregister(req_id)
+
+    async def call_readinto(self, code: int, sink: memoryview,
+                            header: dict | None = None,
+                            timeout: float | None = None) -> int:
+        """Streaming read whose chunk payloads are scattered straight into
+        `sink`; returns bytes filled (the zero-copy remote-read path)."""
+        req_id = next(_req_ids)
+        q = self.register(req_id)
+        state = _Sink(view=sink)
+        self._sinks[req_id] = state
+        try:
+            await self.send(Message(code=int(code), req_id=req_id,
+                                    header=header or {}))
+            while True:
+                try:
+                    rep: Message = await asyncio.wait_for(
+                        q.get(), timeout or self.timeout)
+                except asyncio.TimeoutError as e:
+                    raise RpcTimeout(
+                        f"readinto rpc {code} to {self.addr} timed out") from e
+                rep.check()
+                if len(rep.data):       # overflow chunk delivered inline
+                    n = min(len(rep.data), len(sink) - state.filled)
+                    sink[state.filled:state.filled + n] = rep.data[:n]
+                    state.filled += n
+                if rep.is_eof:
+                    return state.filled
         finally:
             self.unregister(req_id)
 
@@ -193,11 +295,24 @@ class ConnectionPool:
             conns = self._conns.setdefault(addr, [])
             conns[:] = [c for c in conns if not c.closed]
             if len(conns) < self.size:
-                conn = await Connection(addr, self.timeout_ms).connect()
+                conn = await self._dial(addr)
                 conns.append(conn)
                 return conn
             i = self._rr[addr] = (self._rr.get(addr, -1) + 1) % len(conns)
             return conns[i]
+
+    async def _dial(self, addr: str, attempts: int = 3) -> Connection:
+        # transient connect failures (sandboxed loopback occasionally
+        # returns ENOENT) are retried here so every caller benefits
+        last: Exception | None = None
+        for i in range(attempts):
+            try:
+                return await Connection(addr, self.timeout_ms).connect()
+            except ConnectError as e:
+                last = e
+                await asyncio.sleep(0.05 * (2 ** i))
+        assert last is not None
+        raise last
 
     async def close(self) -> None:
         async with self._lock:

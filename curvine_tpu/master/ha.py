@@ -114,14 +114,26 @@ class RaftLite:
             except Exception:
                 return False
 
-        results = await asyncio.gather(
-            *(ask(pid, addr) for pid, addr in self.peers.items()))
-        votes += sum(results)
-        if self.role != CANDIDATE:
-            return
-        if votes >= self.quorum:
-            await self._become_leader()
-        else:
+        # Tally votes as they land: waiting on slow/dead peers must not
+        # delay a quorum win (a rival's next-term request would demote us
+        # first and elections would live-lock).
+        term_at_start = self.term
+        tasks = [asyncio.ensure_future(ask(pid, addr))
+                 for pid, addr in self.peers.items()]
+        try:
+            for fut in asyncio.as_completed(tasks):
+                granted = await fut
+                if self.role != CANDIDATE or self.term != term_at_start:
+                    return
+                if granted:
+                    votes += 1
+                if votes >= self.quorum:
+                    await self._become_leader()
+                    return
+        finally:
+            for t in tasks:
+                t.cancel()
+        if self.role == CANDIDATE:
             self.role = FOLLOWER
             self._touch()
 
@@ -184,6 +196,10 @@ class RaftLite:
                     await self._send_snapshot(addr)
             except Exception as e:
                 log.debug("replicate to %d failed: %s", pid, e)
+                # don't lose the batch: requeue it for the next round
+                # (followers dedupe by seq)
+                for entry in batch:
+                    q.put_nowait(entry)
                 await asyncio.sleep(0.2)
 
     async def _send_snapshot(self, addr: str) -> None:

@@ -294,12 +294,17 @@ class ConnectionPool:
         async with self._lock:
             conns = self._conns.setdefault(addr, [])
             conns[:] = [c for c in conns if not c.closed]
+            if len(conns) >= self.size:
+                i = self._rr[addr] = (self._rr.get(addr, -1) + 1) % len(conns)
+                return conns[i]
+        # dial outside the lock: slow/retrying connects must not stall
+        # other addresses
+        conn = await self._dial(addr)
+        async with self._lock:
+            conns = self._conns.setdefault(addr, [])
             if len(conns) < self.size:
-                conn = await self._dial(addr)
                 conns.append(conn)
-                return conn
-            i = self._rr[addr] = (self._rr.get(addr, -1) + 1) % len(conns)
-            return conns[i]
+            return conn
 
     async def _dial(self, addr: str, attempts: int = 3) -> Connection:
         # transient connect failures (sandboxed loopback occasionally

@@ -4,5 +4,6 @@ from curvine_tpu.ufs.base import Ufs, UfsStatus, create_ufs, register_scheme
 import curvine_tpu.ufs.local   # noqa: F401  (file://)
 import curvine_tpu.ufs.memory  # noqa: F401  (mem://)
 import curvine_tpu.ufs.s3      # noqa: F401  (s3://, env-gated)
+import curvine_tpu.ufs.stubs   # noqa: F401  (oss/cos/gcs/azblob/hdfs)
 
 __all__ = ["Ufs", "UfsStatus", "create_ufs", "register_scheme"]

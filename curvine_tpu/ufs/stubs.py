@@ -1,0 +1,42 @@
+"""Scheme stubs for object stores that need environment-specific backends.
+
+Parity: curvine-ufs optional opendal services (oss/gcs/azblob/hdfs/...).
+Each scheme is registered so mounts/type-checking work everywhere; actual
+IO raises a clear gating error until a backend (credentials + network)
+is wired via mount properties. S3-compatible endpoints can usually be
+served today by the s3:// adapter with `s3.endpoint_url`."""
+
+from __future__ import annotations
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.ufs.base import Ufs, register_scheme
+from curvine_tpu.ufs.s3 import S3Ufs
+
+
+def _gated(scheme: str, hint: str):
+    class GatedUfs(Ufs):
+        def __init__(self, properties=None):
+            super().__init__(properties)
+            # S3-compatible services ride the SigV4 adapter when an
+            # endpoint is configured
+            if properties and properties.get("s3.endpoint_url"):
+                self.__class__ = S3Ufs          # type: ignore[assignment]
+                S3Ufs.__init__(self, properties)
+                return
+            raise err.UfsError(
+                f"{scheme}:// backend is environment-gated: {hint}")
+    GatedUfs.scheme = scheme
+    return GatedUfs
+
+
+register_scheme("oss", _gated(
+    "oss", "set s3.endpoint_url to the OSS S3-compatible endpoint"))
+register_scheme("cos", _gated(
+    "cos", "set s3.endpoint_url to the COS S3-compatible endpoint"))
+register_scheme("gcs", _gated(
+    "gcs", "set s3.endpoint_url to the GCS interoperability endpoint"))
+register_scheme("azblob", _gated(
+    "azblob", "Azure Blob needs an azblob backend (not bundled)"))
+register_scheme("hdfs", _gated(
+    "hdfs", "HDFS needs a JVM/webhdfs bridge (not bundled); "
+            "use webhdfs via s3.endpoint_url-style gateway if available"))

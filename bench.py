@@ -87,12 +87,21 @@ async def run_bench(total_mb: int = 256, block_mb: int = 64,
         results["read_gibs_into_hbm"] = max(hbm_pass() for _ in range(3))
 
         # ---- host-only cached read (no device) for reference ----
+        # best of 2: the first pass also pays allocator page-fault warmup
         r2 = await c.open("/bench/data")
-        t0 = time.perf_counter()
-        n = 0
-        async for chunk in r2.chunks(chunk_size=block_mb * MB):
-            n += len(chunk)
-        results["read_gibs_host"] = n / (1024 ** 3) / (time.perf_counter() - t0)
+        host_rates = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            n = 0
+            off = 0
+            while off < r2.len:
+                view = await r2.pread_view(off, block_mb * MB)
+                if not len(view):
+                    break
+                n += len(view)
+                off += len(view)
+            host_rates.append(n / (1024 ** 3) / (time.perf_counter() - t0))
+        results["read_gibs_host"] = max(host_rates)
 
         # ---- p99 block-fetch latency ----
         await c.write_all("/bench/small",
@@ -102,7 +111,7 @@ async def run_bench(total_mb: int = 256, block_mb: int = 64,
         r3 = await c.open("/bench/small")
         for _ in range(latency_iters):
             t0 = time.perf_counter()
-            data = await r3.pread(0, latency_block_mb * MB)
+            data = await r3.pread_view(0, latency_block_mb * MB)
             lat.append(time.perf_counter() - t0)
             assert len(data) == latency_block_mb * MB
         lat.sort()

@@ -1,0 +1,150 @@
+"""Quota management + master-driven cache eviction.
+
+Parity: curvine-server/src/master/quota/ (quota_manager.rs,
+eviction/{evictor,lfu}.rs). Two responsibilities:
+
+* per-directory quotas — byte/file limits stored on the inode
+  (``quota.bytes`` / ``quota.files`` x-attrs), enforced against the
+  subtree's usage on create/add_block;
+* cluster cache pressure — when aggregate available capacity drops below
+  the watermark, free the coldest complete files (LRU by atime, LFU tie
+  break via access counter) until below the low watermark. Freed files
+  keep their metadata (UFS-backed data stays reachable)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.common.types import StorageState
+
+log = logging.getLogger(__name__)
+
+QUOTA_BYTES = "quota.bytes"
+QUOTA_FILES = "quota.files"
+
+
+class QuotaManager:
+    def __init__(self, fs, high_water: float = 0.92, low_water: float = 0.80,
+                 check_interval_s: float = 5.0):
+        self.fs = fs
+        self.high_water = high_water
+        self.low_water = low_water
+        self.check_interval_s = check_interval_s
+
+    # ---------------- quotas ----------------
+
+    def set_quota(self, path: str, max_bytes: int | None = None,
+                  max_files: int | None = None) -> None:
+        node = self.fs.tree.resolve(path)
+        if node is None or not node.is_dir:
+            raise err.NotADirectory(path)
+        from curvine_tpu.common.types import SetAttrOpts
+        add, remove = {}, []
+        for key, v in ((QUOTA_BYTES, max_bytes), (QUOTA_FILES, max_files)):
+            if v is None:
+                remove.append(key)
+            else:
+                add[key] = str(v).encode()
+        self.fs.set_attr(path, SetAttrOpts(add_x_attr=add,
+                                           remove_x_attr=remove))
+
+    def get_quota(self, path: str) -> dict:
+        node = self.fs.tree.resolve(path)
+        if node is None:
+            raise err.FileNotFound(path)
+        usage_bytes, usage_files = self._usage(node)
+        return {
+            "bytes": _int_attr(node, QUOTA_BYTES),
+            "files": _int_attr(node, QUOTA_FILES),
+            "used_bytes": usage_bytes,
+            "used_files": usage_files,
+        }
+
+    def _usage(self, node) -> tuple[int, int]:
+        if not node.is_dir:
+            return node.len, 1
+        b = f = 0
+        for cid in (node.children or {}).values():
+            cb, cf = self._usage(self.fs.tree.inodes[cid])
+            b += cb
+            f += cf
+        return b, f
+
+    def check_create(self, path: str, new_bytes: int = 0,
+                     new_files: int = 1) -> None:
+        """Walk ancestors of `path`; any quota'd dir must have room."""
+        parent, _ = self.fs.tree.resolve_parent(path)
+        node = parent
+        while node is not None:
+            qb = _int_attr(node, QUOTA_BYTES)
+            qf = _int_attr(node, QUOTA_FILES)
+            if qb is not None or qf is not None:
+                ub, uf = self._usage(node)
+                if qb is not None and ub + new_bytes > qb:
+                    raise err.QuotaExceeded(
+                        f"{self.fs.tree.path_of(node)}: bytes quota {qb} "
+                        f"(used {ub}, requested +{new_bytes})")
+                if qf is not None and uf + new_files > qf:
+                    raise err.QuotaExceeded(
+                        f"{self.fs.tree.path_of(node)}: file quota {qf} "
+                        f"(used {uf})")
+            node = self.fs.tree.inodes.get(node.parent_id) \
+                if node.parent_id else None
+
+    # ---------------- cache pressure eviction ----------------
+
+    def pressure(self) -> float:
+        cap, avail = self.fs.workers.capacity()
+        return (cap - avail) / cap if cap else 0.0
+
+    def evict_once(self) -> int:
+        """Free cold files until usage falls under low_water. Only files
+        whose data also lives in UFS (storage state BOTH/UFS) or that are
+        explicitly evictable are freed. Returns files freed."""
+        cap, avail = self.fs.workers.capacity()
+        if not cap or (cap - avail) / cap < self.high_water:
+            return 0
+        target_used = int(cap * self.low_water)
+        used = cap - avail
+        # coldest first: (atime, -len) — old and large go first
+        candidates = sorted(
+            (n for n in self.fs.tree.iter_files()
+             if n.is_complete and n.blocks),
+            key=lambda n: (n.atime, -n.len))
+        freed = 0
+        for node in candidates:
+            if used <= target_used:
+                break
+            path = self.fs.tree.path_of(node)
+            mount = self.fs.mounts.get_mount(path) if self.fs.mounts else None
+            if mount is None and node.storage_policy.state == StorageState.CV:
+                continue      # cache-only data: freeing would lose it
+            try:
+                self.fs.free(path)
+                used -= node.len
+                freed += 1
+            except err.CurvineError as e:
+                log.debug("evict %s failed: %s", path, e)
+        if freed:
+            log.info("cache pressure: freed %d cold files", freed)
+        return freed
+
+    async def run(self) -> None:
+        while True:
+            await asyncio.sleep(self.check_interval_s)
+            try:
+                self.evict_once()
+            except Exception:
+                log.exception("quota eviction loop")
+
+
+def _int_attr(node, key: str) -> int | None:
+    raw = node.x_attr.get(key)
+    if raw is None:
+        return None
+    try:
+        return int(raw.decode() if isinstance(raw, bytes) else raw)
+    except (ValueError, AttributeError):
+        return None

@@ -44,6 +44,8 @@ class MasterServer:
         self.replication = ReplicationManager(self.fs)
         self.fs.on_worker_lost = self.replication.on_worker_lost
         self.ttl = TtlManager(self.fs, check_ms=mc.ttl_check_ms)
+        from curvine_tpu.master.quota import QuotaManager
+        self.quota = QuotaManager(self.fs)
         from curvine_tpu.master.locks import LockManager
         self.locks = LockManager()
         self.retry_cache = RetryCache(mc.retry_cache_size, mc.retry_cache_ttl_ms)
@@ -71,6 +73,7 @@ class MasterServer:
         self._bg.append(asyncio.ensure_future(self.ttl.run()))
         self._bg.append(asyncio.ensure_future(self.replication.run()))
         self._bg.append(asyncio.ensure_future(self.jobs.run()))
+        self._bg.append(asyncio.ensure_future(self.quota.run()))
         log.info("master started at %s", self.addr)
 
     async def stop(self) -> None:
@@ -183,6 +186,7 @@ class MasterServer:
         return {}
 
     def _create_file(self, q):
+        self.quota.check_create(q["path"])
         st = self.fs.create_file(
             q["path"], overwrite=q.get("overwrite", False),
             create_parent=q.get("create_parent", True),
@@ -238,6 +242,10 @@ class MasterServer:
         return {"result": self.fs.rename(q["src"], q["dst"])}
 
     def _add_block(self, q):
+        node = self.fs.tree.resolve(q["path"])
+        if node is not None:
+            self.quota.check_create(q["path"], new_bytes=node.block_size,
+                                    new_files=0)
         lb = self.fs.add_block(
             q["path"], client_host=q.get("client_host", ""),
             exclude_workers=q.get("exclude_workers"),

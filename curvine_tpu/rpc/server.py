@@ -1,41 +1,59 @@
-"""Asyncio RPC server with handler registry and streaming support.
+"""RPC server: raw-socket loop, handler registry, streaming support.
 
 Parity: orpc/src/server/ + orpc/src/handler/. Handlers are registered per
 RpcCode. A handler may:
   * return a (header, data) tuple / dict / None → single response frame;
-  * call ``conn.send`` itself for streaming responses and return None after
-    sending an EOF frame;
-  * consume an inbound stream via ``conn.open_stream(req_id)`` for chunked
-    uploads (WriteBlock)."""
+  * call ``conn.send`` itself for streaming responses and return None
+    after sending an EOF frame;
+  * consume an inbound chunk stream either via ``conn.open_stream``
+    (queue of copied Messages) or — zero-copy — ``conn.set_stream_sink``
+    (an async callback invoked inline from the receive loop with a view
+    into the connection's reusable buffer).
+
+The receive path allocates nothing per frame: payloads land in one
+grow-only buffer per connection (first-touch page faults are paid once),
+which is what makes multi-GiB/s upload streams possible in Python."""
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import socket
 from typing import Awaitable, Callable
 
 from curvine_tpu.common.errors import CurvineError
 from curvine_tpu.rpc.frame import (
-    Flags, Message, error_for, read_frame, response_for, write_frame,
+    FIXED_LEN, LEN_PREFIX, MAX_FRAME, Flags, Message, error_for,
+    response_for,
 )
+from curvine_tpu.rpc import frame as frame_mod
 
 log = logging.getLogger(__name__)
 
 Handler = Callable[[Message, "ServerConn"], Awaitable[object]]
+# async fn(header: dict, view: memoryview, is_eof: bool) -> None
+StreamSink = Callable[[dict, memoryview, bool], Awaitable[None]]
 
 
 class ServerConn:
-    """One accepted connection; routes chunk frames to open streams."""
+    """One accepted connection; single receive loop, serialized sends."""
 
-    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
-        self.reader = reader
-        self.writer = writer
-        self.peer = writer.get_extra_info("peername")
+    def __init__(self, sock: socket.socket, loop: asyncio.AbstractEventLoop):
+        self.sock = sock
+        self.loop = loop
+        try:
+            self.peer = sock.getpeername()
+        except OSError:
+            self.peer = None
         self._streams: dict[int, asyncio.Queue] = {}
+        self._sinks: dict[int, StreamSink] = {}
         self._wlock = asyncio.Lock()
+        self._buf = bytearray(256 * 1024)   # grow-only payload buffer
+        self.closed = False
+
+    # -------- inbound streams --------
 
     def open_stream(self, req_id: int, maxsize: int = 256) -> asyncio.Queue:
-        # get-or-create: chunk frames may beat the handler task here.
         q = self._streams.get(req_id)
         if q is None:
             q = self._streams[req_id] = asyncio.Queue(maxsize=maxsize)
@@ -43,20 +61,54 @@ class ServerConn:
 
     def close_stream(self, req_id: int) -> None:
         self._streams.pop(req_id, None)
+        self._sinks.pop(req_id, None)
+
+    def set_stream_sink(self, req_id: int, sink: StreamSink) -> None:
+        """Zero-copy upload consumption: `sink` runs inline in the receive
+        loop with a view into the reusable buffer (valid only during the
+        call). Chunks that raced ahead of registration (they were queued)
+        are replayed into the sink first."""
+        self._sinks[req_id] = sink
+        q = self._streams.get(req_id)
+        if q is not None and not q.empty():
+            asyncio.ensure_future(self._drain_queue_into_sink(req_id))
+
+    async def _drain_queue_into_sink(self, req_id: int) -> None:
+        q = self._streams.get(req_id)
+        sink = self._sinks.get(req_id)
+        while q is not None and sink is not None and not q.empty():
+            m = q.get_nowait()
+            try:
+                await sink(m.header, memoryview(m.data), m.is_eof)
+            except Exception:
+                log.exception("stream sink (drain)")
+                self.close_stream(req_id)
+                return
+            sink = self._sinks.get(req_id)
+
+    # -------- io --------
 
     async def send(self, msg: Message) -> None:
+        if self.closed:
+            raise CurvineError("connection closed")
+        bufs = msg.encode()
         async with self._wlock:
-            write_frame(self.writer, msg)
-            await self.writer.drain()
+            for b in bufs:
+                await self.loop.sock_sendall(self.sock, b)
 
-    async def route_or_none(self, msg: Message) -> bool:
-        """True if msg was an inbound stream chunk (routed, not dispatched)."""
-        if not (msg.is_chunk or msg.is_eof) or msg.is_response:
-            return False
-        # Copy chunk data: the frame buffer is reused after this returns.
-        msg.data = bytes(msg.data)
-        await self.open_stream(msg.req_id).put(msg)
-        return True
+    async def _recv_into(self, view: memoryview) -> None:
+        off = 0
+        n = len(view)
+        while off < n:
+            got = await self.loop.sock_recv_into(self.sock, view[off:])
+            if got == 0:
+                raise ConnectionResetError
+            off += got
+
+    def _payload_view(self, n: int) -> memoryview:
+        if len(self._buf) < n:
+            self._buf = bytearray(max(n, 2 * len(self._buf)))
+        return memoryview(self._buf)[:n]
 
 
 class RpcServer:
@@ -65,8 +117,10 @@ class RpcServer:
         self.port = port
         self.name = name
         self._handlers: dict[int, Handler] = {}
-        self._server: asyncio.base_events.Server | None = None
+        self._lsock: socket.socket | None = None
+        self._accept_task: asyncio.Task | None = None
         self._conns: set[ServerConn] = set()
+        self._conn_tasks: set[asyncio.Task] = set()
         # optional fault-injection hook (curvine_tpu.fault): called per
         # request, may sleep, raise, or ask for the request to be dropped
         self.fault_hook = None
@@ -81,53 +135,133 @@ class RpcServer:
         return deco
 
     async def start(self) -> None:
-        self._server = await asyncio.start_server(
-            self._on_conn, self.host, self.port, reuse_address=True,
-            limit=8 * 1024 * 1024)
+        loop = asyncio.get_running_loop()
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((self.host, self.port))
+        sock.listen(128)
+        sock.setblocking(False)
+        self._lsock = sock
         if self.port == 0:
-            self.port = self._server.sockets[0].getsockname()[1]
-        log.info("%s server listening on %s:%d", self.name, self.host, self.port)
+            self.port = sock.getsockname()[1]
+        self._accept_task = asyncio.ensure_future(self._accept_loop(loop))
+        log.info("%s server listening on %s:%d", self.name, self.host,
+                 self.port)
 
     async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            # force-close live connections: wait_closed() (3.12+) blocks on
-            # in-flight handlers, and idle clients never hang up on their own
-            for conn in list(self._conns):
-                conn.writer.close()
-            await self._server.wait_closed()
-            self._server = None
+        if self._accept_task:
+            self._accept_task.cancel()
+            self._accept_task = None
+        if self._lsock is not None:
+            self._lsock.close()
+            self._lsock = None
+        for conn in list(self._conns):
+            conn.closed = True
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+        for t in list(self._conn_tasks):
+            t.cancel()
+        self._conns.clear()
 
     @property
     def addr(self) -> str:
         return f"{self.host}:{self.port}"
 
-    async def _on_conn(self, reader: asyncio.StreamReader,
-                       writer: asyncio.StreamWriter) -> None:
-        conn = ServerConn(reader, writer)
-        self._conns.add(conn)
+    @property
+    def _server(self):
+        """Liveness probe used by tests (legacy streams-era attribute)."""
+        return self._lsock
+
+    async def _accept_loop(self, loop) -> None:
+        assert self._lsock is not None
+        while True:
+            try:
+                sock, _ = await loop.sock_accept(self._lsock)
+            except (asyncio.CancelledError, OSError):
+                return
+            sock.setblocking(False)
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            conn = ServerConn(sock, loop)
+            self._conns.add(conn)
+            t = asyncio.ensure_future(self._conn_loop(conn))
+            self._conn_tasks.add(t)
+            t.add_done_callback(self._conn_tasks.discard)
+
+    async def _conn_loop(self, conn: ServerConn) -> None:
+        prefix = bytearray(4)
+        fixed = bytearray(FIXED_LEN)
         pending: set[asyncio.Task] = set()
         try:
             while True:
                 try:
-                    msg = await read_frame(reader)
-                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    await conn._recv_into(memoryview(prefix))
+                except (ConnectionResetError, OSError):
                     break
-                if await conn.route_or_none(msg):
+                (total,) = LEN_PREFIX.unpack(prefix)
+                if total > MAX_FRAME or total < FIXED_LEN:
+                    log.warning("%s: bad frame length %d from %s",
+                                self.name, total, conn.peer)
+                    break
+                await conn._recv_into(memoryview(fixed))
+                version, code, req_id, status, flags, hdr_len = \
+                    frame_mod._FIXED.unpack(fixed)
+                header: dict = {}
+                if hdr_len:
+                    hview = conn._payload_view(hdr_len)
+                    await conn._recv_into(hview)
+                    import msgpack
+                    header = msgpack.unpackb(bytes(hview), raw=False,
+                                             strict_map_key=False)
+                data_len = total - FIXED_LEN - hdr_len
+                is_chunk = bool(flags & (Flags.CHUNK | Flags.EOF)) and \
+                    not (flags & Flags.RESPONSE)
+
+                if is_chunk and req_id in conn._sinks:
+                    # zero-copy upload: consume inline from the buffer
+                    # (replay any chunks that were queued pre-registration)
+                    q = conn._streams.get(req_id)
+                    if q is not None and not q.empty():
+                        await conn._drain_queue_into_sink(req_id)
+                    view = conn._payload_view(data_len)
+                    if data_len:
+                        await conn._recv_into(view)
+                    sink = conn._sinks.get(req_id)
+                    if sink is None:       # sink errored during drain
+                        continue
+                    try:
+                        await sink(header, view, bool(flags & Flags.EOF))
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:
+                        log.exception("%s stream sink", self.name)
+                        conn.close_stream(req_id)
                     continue
-                # Dispatch concurrently so a streaming write handler can
-                # consume chunk frames read by this same loop.
+
+                view = conn._payload_view(data_len)
+                if data_len:
+                    await conn._recv_into(view)
+                msg = Message(code=code, req_id=req_id, status=status,
+                              flags=flags, header=header,
+                              data=bytes(view) if data_len else b"")
+                if is_chunk:
+                    await conn.open_stream(req_id).put(msg)
+                    continue
                 t = asyncio.ensure_future(self._dispatch(msg, conn))
                 pending.add(t)
                 t.add_done_callback(pending.discard)
         finally:
+            conn.closed = True
             self._conns.discard(conn)
             for t in pending:
                 t.cancel()
-            writer.close()
             try:
-                await writer.wait_closed()
-            except Exception:
+                conn.sock.close()
+            except OSError:
                 pass
 
     async def _dispatch(self, msg: Message, conn: ServerConn) -> None:

@@ -92,7 +92,7 @@ class FuseConf:
     fs_path: str = "/"
     attr_ttl_ms: int = 1_000
     entry_ttl_ms: int = 1_000
-    max_write: int = 128 * 1024
+    max_write: int = 1024 * 1024
     workers: int = 2
 
 

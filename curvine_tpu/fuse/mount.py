@@ -24,7 +24,7 @@ def fusermount_mount(mountpoint: str, fsname: str = "curvine",
     recv_sock, send_sock = socket.socketpair(socket.AF_UNIX,
                                              socket.SOCK_STREAM)
     opts = f"rootmode=40000,user_id={os.getuid()},group_id={os.getgid()}," \
-           f"fsname={fsname},subtype=curvine"
+           f"fsname={fsname},subtype=curvine,max_read={1024 * 1024}"
     if options:
         opts += "," + options
     env = dict(os.environ, _FUSE_COMMFD=str(send_sock.fileno()))

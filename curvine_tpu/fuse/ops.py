@@ -60,7 +60,7 @@ class _Handle:
 
 class CurvineFuseFs:
     def __init__(self, client, fs_root: str = "/", attr_ttl_ms: int = 1000,
-                 entry_ttl_ms: int = 1000, max_write: int = 128 * 1024,
+                 entry_ttl_ms: int = 1000, max_write: int = 1024 * 1024,
                  uid: int = 0, gid: int = 0):
         self.client = client
         self.fs_root = fs_root.rstrip("/") or ""

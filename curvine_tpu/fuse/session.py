@@ -21,7 +21,7 @@ log = logging.getLogger(__name__)
 
 class FuseSession:
     def __init__(self, fs: CurvineFuseFs, fd: int,
-                 max_write: int = 128 * 1024):
+                 max_write: int = 1024 * 1024):
         self.fs = fs
         self.fd = fd
         self.bufsize = max_write + 64 * 1024

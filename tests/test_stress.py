@@ -1,0 +1,83 @@
+"""Concurrency stress: parallel metadata ops, mixed read/write clients,
+many small files. Parity: curvine-tests/src/rpc_stress/ and the
+lock-order deadlock stress (single-writer actor design means no locks to
+order, but the interleavings still get exercised)."""
+
+import asyncio
+import os
+
+from curvine_tpu.testing import MiniCluster
+
+MB = 1024 * 1024
+
+
+async def test_concurrent_metadata_ops():
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+
+        async def worker(i: int):
+            base = f"/stress/c{i}"
+            await c.meta.mkdir(f"{base}/d")
+            for j in range(10):
+                await c.write_all(f"{base}/d/f{j}", bytes([i]) * 100)
+            sts = await c.meta.list_status(f"{base}/d")
+            assert len(sts) == 10
+            await c.meta.rename(f"{base}/d", f"{base}/e")
+            for j in range(0, 10, 2):
+                await c.meta.delete(f"{base}/e/f{j}")
+            return len(await c.meta.list_status(f"{base}/e"))
+
+        results = await asyncio.gather(*(worker(i) for i in range(8)))
+        assert results == [5] * 8
+        info = await c.meta.master_info()
+        assert info.inode_num > 8 * 5
+
+
+async def test_concurrent_mixed_io():
+    async with MiniCluster(workers=2) as mc:
+        c = mc.client()
+        payloads = {i: os.urandom(256 * 1024 + i) for i in range(6)}
+
+        async def writer(i: int):
+            await c.write_all(f"/mix/f{i}", payloads[i])
+
+        await asyncio.gather(*(writer(i) for i in range(6)))
+
+        async def reader(i: int):
+            r = await c.open(f"/mix/f{i}")
+            got = await r.read_all()
+            assert got == payloads[i]
+            # interleaved ranged reads
+            assert await r.pread(1000, 500) == payloads[i][1000:1500]
+            await r.close()
+
+        await asyncio.gather(*(reader(i) for i in range(6)),
+                             *(reader(i) for i in range(6)))
+
+
+async def test_many_small_files_batched():
+    async with MiniCluster(workers=2) as mc:
+        c = mc.client()
+        n = 200
+        files = {f"/small/{i:04d}.bin": bytes([i % 256]) * (50 + i % 97)
+                 for i in range(n)}
+        # batch in groups of 50 concurrently
+        paths = list(files)
+        await asyncio.gather(*(
+            c.write_files_batch({p: files[p] for p in paths[k:k + 50]})
+            for k in range(0, n, 50)))
+        sts = await c.meta.list_status("/small")
+        assert len(sts) == n
+        # spot-check contents
+        for p in paths[::37]:
+            assert await (await c.open(p)).read_all() == files[p]
+
+
+async def test_rpc_pipelining_stress():
+    """Hundreds of in-flight unary calls multiplexed on few connections."""
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.meta.mkdir("/ping")
+        reps = await asyncio.gather(
+            *(c.meta.exists("/ping") for _ in range(500)))
+        assert all(reps)

@@ -23,7 +23,8 @@ log = logging.getLogger(__name__)
 class FsReader:
     def __init__(self, fs_client, path: str, file_blocks: FileBlocks,
                  pool: ConnectionPool, chunk_size: int = 512 * 1024,
-                 short_circuit: bool = True):
+                 short_circuit: bool = True, read_ahead: int = 2):
+        self.read_ahead = read_ahead
         self.fs = fs_client
         self.path = path
         self.blocks = file_blocks
@@ -249,15 +250,46 @@ class FsReader:
                 out += m.data
         return bytes(out)
 
-    async def chunks(self, chunk_size: int | None = None):
-        """Sequential whole-file chunk stream with one-block read-ahead."""
+    async def chunks(self, chunk_size: int | None = None,
+                     read_ahead: int | None = None):
+        """Sequential whole-file chunk stream with pipelined read-ahead:
+        the next `read_ahead` chunks are fetched while the consumer works
+        on the current one (conf: client.read_ahead_chunks)."""
         chunk_size = chunk_size or self.chunk_size
+        read_ahead = read_ahead if read_ahead is not None else self.read_ahead
         self.seek(0)
-        while self.pos < self.len:
-            data = await self.read(chunk_size)
-            if not data:
+        pending: list[asyncio.Task] = []
+        offset = 0
+
+        def schedule() -> None:
+            nonlocal offset
+            while len(pending) < max(1, read_ahead) and offset < self.len:
+                n = min(chunk_size, self.len - offset)
+                pending.append(asyncio.ensure_future(
+                    self._pread_bytes(offset, n)))
+                offset += n
+
+        try:
+            schedule()
+            while pending:
+                data = await pending.pop(0)
+                schedule()
+                if not data:
+                    break
+                self.pos += len(data)
+                yield data
+        finally:
+            for t in pending:
+                t.cancel()
+
+    async def _pread_bytes(self, offset: int, n: int) -> bytes:
+        out = bytearray()
+        while len(out) < n:
+            got = await self._read_some(offset + len(out), n - len(out))
+            if not got:
                 break
-            yield data
+            out += got
+        return bytes(out)
 
     async def close(self) -> None:
         for fd in self._local_fds.values():

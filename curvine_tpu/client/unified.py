@@ -70,7 +70,8 @@ class CurvineClient:
         cc = self.conf.client
         return FsReader(self.meta, path, fb, self.pool,
                         chunk_size=cc.read_chunk_size,
-                        short_circuit=cc.short_circuit)
+                        short_circuit=cc.short_circuit,
+                        read_ahead=cc.read_ahead_chunks)
 
     async def write_all(self, path: str, data: bytes, **kw) -> None:
         async with await self.create(path, overwrite=True, **kw) as w:

@@ -77,9 +77,14 @@ class FsWriter:
         return total
 
     async def _send_chunk(self, chunk) -> None:
+        import asyncio
         self._block_crc = zlib.crc32(chunk, self._block_crc)
-        for up in self._uploads:
-            await up.send_chunk(chunk)
+        if len(self._uploads) == 1:
+            await self._uploads[0].send_chunk(chunk)
+        else:
+            # replica fan-out in parallel, not serially
+            await asyncio.gather(*(up.send_chunk(chunk)
+                                   for up in self._uploads))
         self._block_written += len(chunk)
 
     async def _next_block(self) -> None:

@@ -40,6 +40,7 @@ class ModelConfig:
     dtype: str = "bfloat16"
     use_ring_attention: bool = False
     remat: bool = False        # jax.checkpoint each layer (HBM for FLOPs)
+    moe_experts: int = 0       # >0: MoE FFN, experts sharded over 'ep'
 
     @property
     def head_dim(self) -> int:
@@ -63,17 +64,25 @@ def init_params(rng, cfg: ModelConfig) -> dict:
 
     layers = []
     for i in range(cfg.n_layers):
-        k = jax.random.split(keys[2 + i], 6)
-        layers.append({
+        k = jax.random.split(keys[2 + i], 7)
+        layer = {
             "ln1": jnp.ones(cfg.d_model, dt),
             "wq": dense(k[0], cfg.d_model, (cfg.d_model, cfg.d_model)),
             "wk": dense(k[1], cfg.d_model, (cfg.d_model, cfg.d_model)),
             "wv": dense(k[2], cfg.d_model, (cfg.d_model, cfg.d_model)),
             "wo": dense(k[3], cfg.d_model, (cfg.d_model, cfg.d_model)),
             "ln2": jnp.ones(cfg.d_model, dt),
-            "w1": dense(k[4], cfg.d_model, (cfg.d_model, cfg.d_ff)),
-            "w2": dense(k[5], cfg.d_ff, (cfg.d_ff, cfg.d_model)),
-        })
+        }
+        if cfg.moe_experts > 0:
+            E = cfg.moe_experts
+            layer["router"] = dense(k[6], cfg.d_model, (cfg.d_model, E))
+            layer["ew1"] = dense(k[4], cfg.d_model,
+                                 (E, cfg.d_model, cfg.d_ff))
+            layer["ew2"] = dense(k[5], cfg.d_ff, (E, cfg.d_ff, cfg.d_model))
+        else:
+            layer["w1"] = dense(k[4], cfg.d_model, (cfg.d_model, cfg.d_ff))
+            layer["w2"] = dense(k[5], cfg.d_ff, (cfg.d_ff, cfg.d_model))
+        layers.append(layer)
     return {
         "embed": dense(keys[0], cfg.d_model, (cfg.vocab, cfg.d_model)),
         "pos": dense(keys[1], cfg.d_model, (cfg.max_seq, cfg.d_model)),
@@ -101,10 +110,31 @@ def _attention(x, layer, cfg: ModelConfig, mesh: Mesh | None):
     return o @ layer["wo"]
 
 
+def _moe_ffn(x, layer, cfg: ModelConfig):
+    """Expert-parallel FFN: experts sharded over the 'ep' mesh axis
+    (weights P('ep', …)); XLA partitions the expert einsums across chips
+    and inserts the combine all-reduce over 'ep'. Soft top-2 routing —
+    dense compute, the sharding/collective pattern of EP without the
+    dynamic-dispatch complexity (honest demo-scale MoE)."""
+    gates = jax.nn.softmax(
+        (x @ layer["router"]).astype(jnp.float32), axis=-1)
+    # keep top-2 gates, renormalize (still differentiable & static-shape)
+    top2 = jax.lax.top_k(gates, 2)[0][..., -1:]
+    gates = jnp.where(gates >= top2, gates, 0.0)
+    gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
+    h = jnp.einsum("bld,edf->belf", x, layer["ew1"])
+    h = jax.nn.gelu(h)
+    y = jnp.einsum("belf,efd->beld", h, layer["ew2"])
+    return jnp.einsum("beld,ble->bld", y, gates.astype(x.dtype))
+
+
 def _block(x, layer, cfg: ModelConfig, mesh: Mesh | None):
     x = x + _attention(_rmsnorm(x, layer["ln1"]), layer, cfg, mesh)
     h = _rmsnorm(x, layer["ln2"])
-    h = jax.nn.gelu(h @ layer["w1"]) @ layer["w2"]
+    if cfg.moe_experts > 0:
+        h = _moe_ffn(h, layer, cfg)
+    else:
+        h = jax.nn.gelu(h @ layer["w1"]) @ layer["w2"]
     return x + h
 
 
@@ -159,6 +189,9 @@ _PARAM_SPECS = {
     "wq": P(None, "model"), "wk": P(None, "model"), "wv": P(None, "model"),
     "wo": P("model", None),
     "w1": P(None, "model"), "w2": P("model", None),
+    # MoE: experts sharded over 'ep'
+    "router": P(None, None),
+    "ew1": P("ep", None, None), "ew2": P("ep", None, None),
 }
 
 
@@ -175,10 +208,16 @@ def param_spec_tree(params: dict) -> dict:
     }
 
 
+def _sanitize(spec: P, mesh: Mesh) -> P:
+    """Drop axes the mesh doesn't have (e.g. 'ep' on a dp×tp mesh)."""
+    return P(*(a if a in mesh.axis_names else None for a in spec))
+
+
 def shard_params(params: dict, mesh: Mesh) -> dict:
     specs = param_spec_tree(params)
     return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs,
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, _sanitize(s, mesh))),
+        params, specs,
         is_leaf=lambda x: isinstance(x, jax.Array))
 
 

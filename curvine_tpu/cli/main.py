@@ -317,6 +317,34 @@ async def cmd_load(args):
         await c.close()
 
 
+async def cmd_quota(args):
+    c = await _client(args)
+    try:
+        from curvine_tpu.common.types import SetAttrOpts
+        if args.action == "set":
+            add = {}
+            if args.bytes is not None:
+                add["quota.bytes"] = str(args.bytes).encode()
+            if args.files is not None:
+                add["quota.files"] = str(args.files).encode()
+            await c.meta.set_attr(args.path, SetAttrOpts(add_x_attr=add))
+            print(f"quota set on {args.path}: {add}")
+        elif args.action == "clear":
+            await c.meta.set_attr(args.path, SetAttrOpts(
+                remove_x_attr=["quota.bytes", "quota.files"]))
+            print(f"quota cleared on {args.path}")
+        else:
+            st = await c.meta.file_status(args.path)
+            size, files, dirs = await _du(c, args.path)
+            qb = st.x_attr.get("quota.bytes")
+            qf = st.x_attr.get("quota.files")
+            fmt = lambda v: v.decode() if isinstance(v, bytes) else (v or "-")
+            print(f"{args.path}: bytes={fmt(qb)} (used {size})  "
+                  f"files={fmt(qf)} (used {files})")
+    finally:
+        await c.close()
+
+
 async def cmd_export(args):
     c = await _client(args)
     try:
@@ -404,7 +432,10 @@ async def cmd_worker(args):
     log_setup(log_file=os.path.join(conf.data_dir, "logs", "worker.log"))
     w = WorkerServer(conf)
     await w.start()
-    print(f"worker {w.worker_id} at {w.addr}")
+    from curvine_tpu.web.server import WebServer
+    web = WebServer(conf.worker.web_port, worker=w)
+    await web.start()
+    print(f"worker {w.worker_id} at {w.addr}, web at :{web.port}")
     await asyncio.Event().wait()
 
 
@@ -458,6 +489,8 @@ def build_parser() -> argparse.ArgumentParser:
     add("load", cmd_load, A("path"), A("--replicas", type=int, default=1),
         A("--wait", action="store_true"))
     add("export", cmd_export, A("path"), A("--wait", action="store_true"))
+    add("quota", cmd_quota, A("action", choices=["get", "set", "clear"]),
+        A("path"), A("--bytes", type=int), A("--files", type=int))
     add("load-status", cmd_load_status, A("job_id"))
     add("load-cancel", cmd_load_cancel, A("job_id"))
     add("bench", cmd_bench, A("--size-mb", type=int, default=256))

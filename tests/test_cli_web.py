@@ -110,3 +110,13 @@ async def test_web_api():
                     assert "curvine-tpu" in await r.text()
         finally:
             await web.stop()
+
+
+def test_cli_quota(cluster_loop, capsys):
+    mc = cluster_loop
+    assert _cv(mc, "mkdir", "/qcli") == 0
+    assert _cv(mc, "quota", "set", "/qcli", "--files", "5") == 0
+    assert _cv(mc, "quota", "get", "/qcli") == 0
+    out = capsys.readouterr().out
+    assert "files=5" in out
+    assert _cv(mc, "quota", "clear", "/qcli") == 0

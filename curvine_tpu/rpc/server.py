@@ -96,6 +96,22 @@ class ServerConn:
             for b in bufs:
                 await self.loop.sock_sendall(self.sock, b)
 
+    async def send_chunk_from_file(self, code: int, req_id: int, f,
+                                   offset: int, count: int,
+                                   flags: int = Flags.RESPONSE | Flags.CHUNK,
+                                   ) -> int:
+        """Zero-copy chunk frame: header via sendall, payload via
+        kernel-side sendfile straight from the block file (orpc sendfile
+        parity — data never enters userspace)."""
+        prefix = LEN_PREFIX.pack(FIXED_LEN + count) + frame_mod._FIXED.pack(
+            frame_mod.VERSION, code, req_id, 0, flags, 0)
+        async with self._wlock:
+            await self.loop.sock_sendall(self.sock, prefix)
+            f.seek(offset)
+            sent = await self.loop.sock_sendfile(self.sock, f, offset, count,
+                                                 fallback=True)
+        return sent
+
     async def _recv_into(self, view: memoryview) -> None:
         off = 0
         n = len(view)

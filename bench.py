@@ -146,6 +146,8 @@ async def run_bench(total_mb: int = 256, block_mb: int = 64,
         results["vector_scan_mrows_s"] = 20_000 / scan_s / 1e6
 
         await c.close()
+    import shutil
+    shutil.rmtree(base, ignore_errors=True)
     return results
 
 

@@ -147,3 +147,60 @@ def test_metrics():
     assert "curvine_test_lat_count 1" in text
     snap = m.snapshot()
     assert snap["counters"]["reqs"] == 3
+
+
+def test_retry_cache_dedup():
+    """Retried non-idempotent mutations replay the cached response.
+    Parity: fs_retry_cache.rs."""
+    from curvine_tpu.master.retry_cache import RetryCache
+    rc = RetryCache(capacity=3, ttl_ms=10_000)
+    rc.put(("c1", 1), b"resp1")
+    assert rc.get(("c1", 1)) == b"resp1"
+    assert rc.get(("c1", 2)) is None
+    # capacity eviction (LRU)
+    rc.put(("c1", 2), b"r2")
+    rc.put(("c1", 3), b"r3")
+    rc.get(("c1", 1))               # touch 1 → LRU is 2
+    rc.put(("c1", 4), b"r4")
+    assert rc.get(("c1", 2)) is None
+    assert rc.get(("c1", 1)) == b"resp1"
+    # ttl expiry
+    rc2 = RetryCache(ttl_ms=0)
+    rc2.put(("x", 1), b"v")
+    import time
+    time.sleep(0.01)
+    assert rc2.get(("x", 1)) is None
+
+
+async def test_retry_cache_end_to_end():
+    """The same (client_id, call_id) mutation applied twice returns the
+    first response and doesn't double-apply."""
+    from curvine_tpu.testing import MiniCluster
+    from curvine_tpu.rpc import RpcCode
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        req = {"path": "/dedup", "create_parent": True,
+               "client_id": c.meta.client_id, "call_id": 424242}
+        rep1 = await c.meta.call(RpcCode.MKDIR, dict(req))
+        inodes = mc.master.fs.tree.count()
+        rep2 = await c.meta.call(RpcCode.MKDIR, dict(req))  # "retry"
+        assert rep1 == rep2
+        assert mc.master.fs.tree.count() == inodes
+
+
+def test_journal_snapshot_interval(tmp_path):
+    """Auto-checkpoint after N entries; old segments garbage-collected."""
+    import os
+    from curvine_tpu.master.filesystem import MasterFilesystem
+    from curvine_tpu.common.journal import Journal
+    fs = MasterFilesystem(journal=Journal(str(tmp_path)),
+                          snapshot_interval=10)
+    for i in range(25):
+        fs.mkdir(f"/snapdir/d{i}")
+    names = os.listdir(tmp_path)
+    assert any(n.startswith("snapshot-") for n in names)
+    # recovery from snapshot + tail entries
+    fs2 = MasterFilesystem(journal=Journal(str(tmp_path)))
+    fs2.recover()
+    for i in range(25):
+        assert fs2.tree.resolve(f"/snapdir/d{i}") is not None

@@ -459,6 +459,14 @@ class MasterFilesystem:
         node = self._inode_or_raise(inode_id)
         block_id = self.tree.alloc_block_id()
         node.blocks.append(block_id)
+        node.mtime = now_ms()      # writer liveness for lease recovery
+        # placeholder meta: a worker report of this in-flight block must
+        # not look like an orphan (it is referenced by the inode)
+        from curvine_tpu.master.block_map import BlockMeta
+        if block_id not in self.blocks.blocks:
+            self.blocks.blocks[block_id] = BlockMeta(
+                block_id=block_id, inode_id=inode_id,
+                replicas=node.replicas)
         return block_id
 
     def complete_file(self, path: str, length: int,
@@ -548,6 +556,34 @@ class MasterFilesystem:
         orphans = self.blocks.apply_report(worker_id, held, storage_types,
                                            incremental=incremental)
         return {"delete_blocks": orphans}
+
+    def recover_stale_leases(self, lease_timeout_ms: int = 300_000) -> int:
+        """Finalize files abandoned mid-write (dead client, no complete).
+        Parity: master/fs/fs_dir_watchdog.rs. A stale incomplete file is
+        completed at its committed block length (data salvaged) or deleted
+        when nothing was ever committed."""
+        deadline = now_ms() - lease_timeout_ms
+        recovered = 0
+        for node in list(self.tree.iter_files()):
+            if node.is_complete or node.mtime >= deadline:
+                continue
+            path = self.tree.path_of(node)
+            committed = sum((self.blocks.get(b).len
+                             for b in node.blocks if self.blocks.get(b)),
+                            start=0)
+            try:
+                if committed > 0:
+                    self._log("complete", dict(path=path, length=committed))
+                    log.warning("lease recovery: completed %s at %d bytes",
+                                path, committed)
+                else:
+                    self._log("delete", dict(path=path, recursive=False))
+                    log.warning("lease recovery: removed empty stale %s",
+                                path)
+                recovered += 1
+            except err.CurvineError as e:
+                log.warning("lease recovery of %s failed: %s", path, e)
+        return recovered
 
     def check_lost_workers(self) -> list[WorkerInfo]:
         newly_lost = self.workers.check_lost()

@@ -88,10 +88,15 @@ class MasterServer:
 
     async def _heartbeat_checker(self) -> None:
         interval = self.conf.master.heartbeat_check_ms / 1000
+        lease_every = max(1, int(30 / max(interval, 0.001)))
+        ticks = 0
         while True:
             await asyncio.sleep(interval)
             try:
                 self.fs.check_lost_workers()
+                ticks += 1
+                if ticks % lease_every == 0:
+                    self.fs.recover_stale_leases()
             except Exception:
                 log.exception("heartbeat checker")
 

@@ -179,3 +179,31 @@ async def test_fs_mode_write_through():
         assert await ufs.read_all("mem://wtb/obj.bin") == b"persisted"
         # cache has it
         assert await (await c.open("/wt/obj.bin")).read_all() == b"persisted"
+
+
+async def test_stale_lease_recovery():
+    """Abandoned writers: committed data salvaged, empty stubs removed.
+    Parity: fs_dir_watchdog.rs."""
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        # writer dies after sealing one block
+        w = await c.create("/lease/partial", block_size=MB)
+        await w.write(os.urandom(MB))     # fills+seals block 1
+        await w.write(b"tail")            # opens block 2, never completed
+        await w._seal_block()
+        # writer dies after create, nothing written
+        await c.meta.create_file("/lease/empty")
+        # worker block report tells the master the in-flight block lens
+        await mc.workers[0].block_report_once()
+
+        await asyncio.sleep(0.01)   # mtimes strictly older than "now"
+        fs = mc.master.fs
+        assert not fs.tree.resolve("/lease/partial").is_complete
+        recovered = fs.recover_stale_leases(lease_timeout_ms=0)
+        assert recovered == 2
+        st = await c.meta.file_status("/lease/partial")
+        assert st.is_complete and st.len == MB + 4
+        assert not await c.meta.exists("/lease/empty")
+        # salvaged data is readable
+        data = await (await c.open("/lease/partial")).read_all()
+        assert len(data) == MB + 4

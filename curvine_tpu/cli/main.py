@@ -439,6 +439,21 @@ async def cmd_worker(args):
     await asyncio.Event().wait()
 
 
+async def cmd_gateway(args):
+    """Serve the S3 and WebHDFS protocol gateways over the namespace."""
+    from curvine_tpu.client import CurvineClient
+    from curvine_tpu.gateway.s3 import S3Gateway
+    from curvine_tpu.gateway.webhdfs import WebHdfsGateway
+    conf = _conf(args)
+    client = CurvineClient(conf)
+    s3 = S3Gateway(client, port=args.s3_port, host="0.0.0.0")
+    hdfs = WebHdfsGateway(client, port=args.webhdfs_port, host="0.0.0.0")
+    await s3.start()
+    await hdfs.start()
+    print(f"s3 gateway :{s3.port}, webhdfs gateway :{hdfs.port}")
+    await asyncio.Event().wait()
+
+
 async def cmd_fuse(args):
     from curvine_tpu.fuse.mount import mount_and_serve
     conf = _conf(args)
@@ -497,6 +512,8 @@ def build_parser() -> argparse.ArgumentParser:
     add("master", cmd_master)
     add("worker", cmd_worker)
     add("fuse", cmd_fuse, A("--mountpoint"))
+    add("gateway", cmd_gateway, A("--s3-port", type=int, default=9900),
+        A("--webhdfs-port", type=int, default=9870))
     return p
 
 

@@ -1,0 +1,186 @@
+"""S3 protocol gateway: serve the cache namespace over the S3 REST API.
+
+Parity: the reference's "S3 protocol compatibility" surface — any S3
+client (boto3, s5cmd, our own ufs.s3 adapter) can read/write cached data
+without code changes. Path-style addressing: ``/<bucket>/<key>`` maps to
+``/<bucket>/<key>`` in the namespace.
+
+Implemented: GET/PUT/HEAD/DELETE object, ListObjectsV2 (delimiter +
+prefix), CreateBucket (mkdir), ranged GETs. Authentication is accepted
+but not enforced (cluster-internal gateway, like the reference's default).
+"""
+
+from __future__ import annotations
+
+import logging
+import urllib.parse
+import xml.sax.saxutils as sax
+
+from aiohttp import web
+
+from curvine_tpu.common import errors as cerr
+
+log = logging.getLogger(__name__)
+
+_NS = 'xmlns="http://s3.amazonaws.com/doc/2006-03-01/"'
+
+
+class S3Gateway:
+    def __init__(self, client, port: int = 0, host: str = "127.0.0.1"):
+        self.client = client
+        self.host = host
+        self.port = port
+        self.app = web.Application(client_max_size=1024 ** 3)
+        self.app.router.add_route("*", "/{bucket}", self._bucket)
+        self.app.router.add_route("*", "/{bucket}/{key:.*}", self._object)
+        self._runner: web.AppRunner | None = None
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for s in self._runner.sites:
+            self.port = s._server.sockets[0].getsockname()[1]
+        log.info("s3 gateway on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    # ---------------- bucket ops ----------------
+
+    async def _bucket(self, req: web.Request) -> web.StreamResponse:
+        bucket = req.match_info["bucket"]
+        if req.method == "PUT":                     # CreateBucket
+            await self.client.meta.mkdir(f"/{bucket}")
+            return web.Response(status=200)
+        if req.method in ("GET", "HEAD"):
+            if "list-type" in req.query or req.method == "GET":
+                return await self._list_objects(req, bucket)
+            exists = await self.client.meta.exists(f"/{bucket}")
+            return web.Response(status=200 if exists else 404)
+        if req.method == "DELETE":
+            try:
+                await self.client.meta.delete(f"/{bucket}", recursive=False)
+            except cerr.FileNotFound:
+                return self._error(404, "NoSuchBucket", bucket)
+            except cerr.DirNotEmpty:
+                return self._error(409, "BucketNotEmpty", bucket)
+            return web.Response(status=204)
+        return web.Response(status=405)
+
+    async def _list_objects(self, req: web.Request,
+                            bucket: str) -> web.Response:
+        prefix = req.query.get("prefix", "")
+        delimiter = req.query.get("delimiter", "")
+        max_keys = int(req.query.get("max-keys", "1000"))
+        base = f"/{bucket}"
+        if not await self.client.meta.exists(base):
+            return self._error(404, "NoSuchBucket", bucket)
+
+        contents: list[tuple[str, int, int]] = []
+        prefixes: set[str] = set()
+
+        async def walk(path: str) -> None:
+            for st in await self.client.meta.list_status(path):
+                key = st.path[len(base) + 1:]
+                if not key.startswith(prefix) and not prefix.startswith(key):
+                    continue
+                if st.is_dir:
+                    if delimiter == "/" and key.startswith(prefix):
+                        rest = key[len(prefix):]
+                        if "/" not in rest:
+                            prefixes.add(key + "/")
+                            continue
+                    await walk(st.path)
+                elif key.startswith(prefix):
+                    contents.append((key, st.len, st.mtime))
+
+        await walk(base)
+        contents.sort()
+        items = "".join(
+            f"<Contents><Key>{sax.escape(k)}</Key><Size>{n}</Size>"
+            f"<LastModified>1970-01-01T00:00:00.000Z</LastModified>"
+            f"<ETag>&quot;{m:x}&quot;</ETag>"
+            f"<StorageClass>STANDARD</StorageClass></Contents>"
+            for k, n, m in contents[:max_keys])
+        commons = "".join(
+            f"<CommonPrefixes><Prefix>{sax.escape(p)}</Prefix>"
+            f"</CommonPrefixes>" for p in sorted(prefixes))
+        body = (f'<?xml version="1.0"?><ListBucketResult {_NS}>'
+                f"<Name>{bucket}</Name><Prefix>{sax.escape(prefix)}</Prefix>"
+                f"<KeyCount>{len(contents[:max_keys])}</KeyCount>"
+                f"<MaxKeys>{max_keys}</MaxKeys><IsTruncated>"
+                f"{'true' if len(contents) > max_keys else 'false'}"
+                f"</IsTruncated>{items}{commons}</ListBucketResult>")
+        return web.Response(text=body, content_type="application/xml")
+
+    # ---------------- object ops ----------------
+
+    async def _object(self, req: web.Request) -> web.StreamResponse:
+        bucket = req.match_info["bucket"]
+        key = urllib.parse.unquote(req.match_info["key"])
+        path = f"/{bucket}/{key}"
+        try:
+            if req.method == "PUT":
+                data = await req.read()
+                await self.client.write_all(path, data)
+                return web.Response(status=200, headers={"ETag": '"ok"'})
+            if req.method == "HEAD":
+                st = await self.client.meta.file_status(path)
+                return web.Response(status=200, headers={
+                    "Content-Length": str(st.len),
+                    "ETag": '"ok"', "Accept-Ranges": "bytes"})
+            if req.method == "GET":
+                return await self._get_object(req, path)
+            if req.method == "DELETE":
+                try:
+                    await self.client.meta.delete(path, recursive=False)
+                except cerr.FileNotFound:
+                    pass
+                return web.Response(status=204)
+        except cerr.FileNotFound:
+            return self._error(404, "NoSuchKey", key)
+        except cerr.CurvineError as e:
+            return self._error(500, "InternalError", str(e))
+        return web.Response(status=405)
+
+    async def _get_object(self, req: web.Request,
+                          path: str) -> web.StreamResponse:
+        reader = await self.client.unified_open(path)
+        length = reader.len
+        status = 200
+        offset, n = 0, length
+        rng = req.headers.get("Range")
+        if rng and rng.startswith("bytes="):
+            lo, _, hi = rng[6:].partition("-")
+            offset = int(lo or 0)
+            end = int(hi) if hi else length - 1
+            n = min(end, length - 1) - offset + 1
+            status = 206
+        resp = web.StreamResponse(status=status, headers={
+            "Content-Length": str(max(0, n)),
+            "Accept-Ranges": "bytes",
+            "Content-Type": "application/octet-stream"})
+        if status == 206:
+            resp.headers["Content-Range"] = \
+                f"bytes {offset}-{offset + n - 1}/{length}"
+        await resp.prepare(req)
+        sent = 0
+        while sent < n:
+            chunk = await reader.pread(offset + sent,
+                                       min(4 * 1024 * 1024, n - sent))
+            if not chunk:
+                break
+            await resp.write(chunk)
+            sent += len(chunk)
+        await resp.write_eof()
+        await reader.close()
+        return resp
+
+    def _error(self, status: int, code: str, resource: str) -> web.Response:
+        body = (f'<?xml version="1.0"?><Error><Code>{code}</Code>'
+                f"<Resource>{sax.escape(resource)}</Resource></Error>")
+        return web.Response(status=status, text=body,
+                            content_type="application/xml")

@@ -1,0 +1,138 @@
+"""WebHDFS gateway: HDFS REST compatibility over the cache namespace.
+
+Parity: the reference's "HDFS protocol compatibility" surface. Speaks the
+WebHDFS v1 API (``/webhdfs/v1/<path>?op=...``) so HDFS tooling
+(`hdfs dfs -fs webhdfs://...`, Spark, distcp) can use the cache without
+code changes. Single-node flavor: data is served directly (no DN
+redirect hop)."""
+
+from __future__ import annotations
+
+import json
+import logging
+
+from aiohttp import web
+
+from curvine_tpu.common import errors as cerr
+
+log = logging.getLogger(__name__)
+
+
+def _fs_json(st) -> dict:
+    return {
+        "accessTime": st.atime, "modificationTime": st.mtime,
+        "blockSize": st.block_size, "length": st.len,
+        "owner": st.owner, "group": st.group,
+        "permission": f"{st.mode & 0o777:o}",
+        "replication": st.replicas,
+        "type": "DIRECTORY" if st.is_dir else "FILE",
+        "pathSuffix": st.name,
+        "childrenNum": st.children_num,
+    }
+
+
+class WebHdfsGateway:
+    def __init__(self, client, port: int = 0, host: str = "127.0.0.1"):
+        self.client = client
+        self.host = host
+        self.port = port
+        self.app = web.Application(client_max_size=1024 ** 3)
+        self.app.router.add_route("*", "/webhdfs/v1{path:.*}", self._handle)
+        self._runner: web.AppRunner | None = None
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for s in self._runner.sites:
+            self.port = s._server.sockets[0].getsockname()[1]
+        log.info("webhdfs gateway on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    async def _handle(self, req: web.Request) -> web.StreamResponse:
+        path = req.match_info["path"] or "/"
+        op = req.query.get("op", "").upper()
+        try:
+            return await self._dispatch(req, path, op)
+        except cerr.FileNotFound:
+            return self._remote_exc(404, "FileNotFoundException",
+                                    f"{path} not found")
+        except cerr.FileAlreadyExists:
+            return self._remote_exc(403, "FileAlreadyExistsException", path)
+        except cerr.CurvineError as e:
+            return self._remote_exc(500, "IOException", str(e))
+
+    async def _dispatch(self, req, path, op) -> web.StreamResponse:
+        c = self.client
+        if op == "GETFILESTATUS":
+            st = await c.meta.file_status(path)
+            return web.json_response({"FileStatus": _fs_json(st)})
+        if op == "LISTSTATUS":
+            sts = await c.meta.list_status(path)
+            return web.json_response(
+                {"FileStatuses": {"FileStatus": [_fs_json(s) for s in sts]}})
+        if op == "GETCONTENTSUMMARY":
+            st = await c.meta.file_status(path)
+            return web.json_response({"ContentSummary": {
+                "length": st.len, "fileCount": 0 if st.is_dir else 1,
+                "directoryCount": 1 if st.is_dir else 0,
+                "quota": -1, "spaceConsumed": st.len, "spaceQuota": -1}})
+        if op == "OPEN":
+            reader = await c.unified_open(path)
+            offset = int(req.query.get("offset", "0"))
+            length = int(req.query.get("length", str(reader.len - offset)))
+            resp = web.StreamResponse(headers={
+                "Content-Type": "application/octet-stream",
+                "Content-Length": str(max(0, length))})
+            await resp.prepare(req)
+            sent = 0
+            while sent < length:
+                chunk = await reader.pread(offset + sent,
+                                           min(4 * 1024 * 1024,
+                                               length - sent))
+                if not chunk:
+                    break
+                await resp.write(chunk)
+                sent += len(chunk)
+            await resp.write_eof()
+            await reader.close()
+            return resp
+        if op == "MKDIRS":
+            await c.meta.mkdir(path, create_parent=True)
+            return web.json_response({"boolean": True})
+        if op == "CREATE":
+            data = await req.read()
+            await c.write_all(path, data,
+                              **({"replicas": int(req.query["replication"])}
+                                 if "replication" in req.query else {}))
+            return web.Response(status=201)
+        if op == "APPEND":
+            data = await req.read()
+            w = await c.append(path)
+            await w.write(data)
+            await w.close()
+            return web.Response(status=200)
+        if op == "RENAME":
+            dst = req.query.get("destination", "")
+            ok = await c.meta.rename(path, dst)
+            return web.json_response({"boolean": ok})
+        if op == "DELETE":
+            recursive = req.query.get("recursive", "false") == "true"
+            await c.meta.delete(path, recursive=recursive)
+            return web.json_response({"boolean": True})
+        if op == "SETPERMISSION":
+            from curvine_tpu.common.types import SetAttrOpts
+            await c.meta.set_attr(path, SetAttrOpts(
+                mode=int(req.query.get("permission", "755"), 8)))
+            return web.Response(status=200)
+        return self._remote_exc(400, "UnsupportedOperationException",
+                                f"op {op!r}")
+
+    def _remote_exc(self, status: int, cls: str, msg: str) -> web.Response:
+        return web.json_response(
+            {"RemoteException": {"exception": cls, "message": msg}},
+            status=status)

@@ -76,6 +76,8 @@ class CurvineFuseFs:
         self.destroyed = False
         # path → FsWriter for in-flight writes (getattr sees live size)
         self._open_writers: dict[int, object] = {}
+        from curvine_tpu.common.metrics import MetricsRegistry
+        self.metrics = MetricsRegistry("fuse")
 
     # ---------------- node table (dcache) ----------------
 
@@ -137,17 +139,31 @@ class CurvineFuseFs:
     # ---------------- dispatch ----------------
 
     async def handle(self, hdr: abi.InHeader, payload: memoryview) -> bytes | None:
+        """Parity note: per-op counters/latency mirror the reference's
+        curvine-fuse-metrics-design.md."""
         fn = _DISPATCH.get(hdr.opcode)
         if fn is None:
             raise FuseError(Errno.ENOSYS)
+        name = fn.__name__[3:]
+        self.metrics.inc(f"ops.{name}")
         try:
-            return await fn(self, hdr, payload)
+            with self.metrics.timer(f"lat.{name}"):
+                result = await fn(self, hdr, payload)
+            if hdr.opcode == abi.Op.READ and result is not None:
+                self.metrics.inc("bytes.read", len(result))
+            elif hdr.opcode == abi.Op.WRITE:
+                self.metrics.inc("bytes.written",
+                                 max(0, hdr.length - 40 - abi.WRITE_IN.size))
+            return result
         except FuseError:
+            self.metrics.inc(f"errors.{name}")
             raise
         except cerr.CurvineError as e:
+            self.metrics.inc(f"errors.{name}")
             raise FuseError(_fuse_errno(e)) from e
         except Exception:
             log.exception("fuse op %d failed", hdr.opcode)
+            self.metrics.inc(f"errors.{name}")
             raise FuseError(Errno.EIO)
 
     # ---------------- ops ----------------

@@ -12,7 +12,7 @@ import logging
 
 from curvine_tpu.common import errors as err
 from curvine_tpu.common.conf import ClusterConf
-from curvine_tpu.common.types import StorageType, WriteType
+from curvine_tpu.common.types import StorageType
 from curvine_tpu.client.fs_client import FsClient
 from curvine_tpu.client.reader import FsReader
 from curvine_tpu.client.writer import FsWriter

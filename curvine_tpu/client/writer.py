@@ -14,7 +14,7 @@ import zlib
 from curvine_tpu.common import errors as err
 from curvine_tpu.common.types import CommitBlock, LocatedBlock, StorageType
 from curvine_tpu.rpc import RpcCode
-from curvine_tpu.rpc.client import Connection, ConnectionPool
+from curvine_tpu.rpc.client import ConnectionPool
 
 log = logging.getLogger(__name__)
 

@@ -8,7 +8,6 @@ redirect hop)."""
 
 from __future__ import annotations
 
-import json
 import logging
 
 from aiohttp import web

@@ -11,7 +11,7 @@ from dataclasses import dataclass, field
 
 from curvine_tpu.common import errors as err
 from curvine_tpu.common.types import (
-    FileStatus, FileType, StoragePolicy, StorageState, now_ms,
+    FileStatus, FileType, StoragePolicy, now_ms,
 )
 
 ROOT_ID = 1

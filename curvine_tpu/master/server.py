@@ -12,7 +12,7 @@ import logging
 
 from curvine_tpu.common.conf import ClusterConf
 from curvine_tpu.common.journal import Journal
-from curvine_tpu.common.types import CommitBlock, SetAttrOpts, now_ms
+from curvine_tpu.common.types import CommitBlock, SetAttrOpts
 from curvine_tpu.common.metrics import MetricsRegistry
 from curvine_tpu.master.filesystem import MasterFilesystem
 from curvine_tpu.master.jobs import JobManager

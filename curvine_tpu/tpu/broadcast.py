@@ -13,7 +13,6 @@ or a single .npz — both cache-native (written/read through CurvineClient).
 
 from __future__ import annotations
 
-import io
 import json
 import logging
 

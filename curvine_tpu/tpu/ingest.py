@@ -8,9 +8,8 @@ batch's host fetch and device transfer overlap the current step's compute.
 
 from __future__ import annotations
 
-import asyncio
 import collections
-from typing import AsyncIterator, Callable, Iterator
+from typing import AsyncIterator, Iterator
 
 import jax
 import numpy as np

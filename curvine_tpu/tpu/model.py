@@ -16,9 +16,7 @@ Sharding recipe (Megatron-style TP over the ``model`` axis):
 
 from __future__ import annotations
 
-import dataclasses
 from dataclasses import dataclass
-from functools import partial
 
 import jax
 import jax.numpy as jnp

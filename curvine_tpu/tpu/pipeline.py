@@ -12,11 +12,8 @@ ICI only."""
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from curvine_tpu.tpu.model import ModelConfig, _block, _rmsnorm
@@ -34,7 +31,6 @@ def stack_layers(params: dict) -> dict:
 
 def stacked_specs(params_stacked: dict) -> dict:
     """PartitionSpecs: stacked layer weights sharded over 'pp' dim 0."""
-    from curvine_tpu.tpu.model import param_spec_tree
     base = {"embed": P(None, None), "pos": P(None, None), "ln_f": P(None)}
     layer_specs = {k: P("pp", *([None] * (v.ndim - 1)))
                    for k, v in params_stacked["layers"].items()}

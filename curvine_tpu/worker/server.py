@@ -9,7 +9,6 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
-import socket
 import zlib
 
 from curvine_tpu.common import errors as err

@@ -155,3 +155,54 @@ def test_real_mount_posix_flow(tmp_path):
         asyncio.run_coroutine_threadsafe(mc.stop(), loop).result(30)
         loop.call_soon_threadsafe(loop.stop)
         t.join(5)
+
+
+@pytest.mark.skipif(not FUSE_AVAILABLE, reason="no /dev/fuse")
+def test_fuse_over_ufs_mount(tmp_path):
+    """POSIX view of a mounted object store: uncached UFS objects are
+    visible and readable through the kernel."""
+    import asyncio as aio
+    from curvine_tpu.fuse.mount import fusermount_mount, fusermount_umount
+    from curvine_tpu.fuse.ops import CurvineFuseFs
+    from curvine_tpu.fuse.session import FuseSession
+    from curvine_tpu.ufs import create_ufs
+    from curvine_tpu.ufs import memory as memufs
+
+    memufs.reset()
+    mnt = str(tmp_path / "mnt")
+    loop = aio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    mc = MiniCluster(workers=1)
+    aio.run_coroutine_threadsafe(mc.start(), loop).result(30)
+    session = None
+    try:
+        async def seed():
+            ufs = create_ufs("mem://fusebkt")
+            await ufs.write_all("mem://fusebkt/obj/data.bin", b"ufs bytes")
+            c = mc.client()
+            await c.meta.mount("/s3", "mem://fusebkt")
+            return c
+        client = aio.run_coroutine_threadsafe(seed(), loop).result(15)
+        fd = fusermount_mount(mnt)
+        fs = CurvineFuseFs(client, uid=os.getuid(), gid=os.getgid())
+        session = FuseSession(fs, fd)
+        aio.run_coroutine_threadsafe(session.run(), loop)
+
+        # UFS object appears in the POSIX view without ever being cached
+        assert os.listdir(f"{mnt}/s3") == ["obj"]
+        assert os.listdir(f"{mnt}/s3/obj") == ["data.bin"]
+        st = os.stat(f"{mnt}/s3/obj/data.bin")
+        assert st.st_size == 9
+        with open(f"{mnt}/s3/obj/data.bin", "rb") as f:
+            assert f.read() == b"ufs bytes"
+        # metrics recorded ops
+        assert fs.metrics.counters.get("ops.lookup", 0) > 0
+        assert fs.metrics.counters.get("ops.read", 0) > 0
+    finally:
+        fusermount_umount(mnt)
+        if session is not None:
+            session.stop()
+        aio.run_coroutine_threadsafe(mc.stop(), loop).result(30)
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(5)

@@ -194,7 +194,8 @@ class MasterFilesystem:
                       storage_policy: dict, file_type: int) -> FileStatus:
         existing = self.tree.resolve(path)
         if existing is not None:
-            self._delete_inode(existing, recursive=False)
+            p, n = self.tree.resolve_parent(path)
+            self._delete_inode(existing, recursive=False, parent=p, name=n)
         parent, name = self.tree.resolve_parent(path)
         if parent is None:
             parent, _ = self.tree.mkdirs("/".join(path.split("/")[:-1]) or "/")
@@ -265,7 +266,8 @@ class MasterFilesystem:
             raise err.FileNotFound(src)
         d = self.tree.resolve(dst)
         if d is not None:
-            self._delete_inode(d, recursive=False)
+            p, n = self.tree.resolve_parent(dst)
+            self._delete_inode(d, recursive=False, parent=p, name=n)
         new_parent, new_name = self.tree.resolve_parent(dst)
         if new_parent is None or not new_parent.is_dir:
             raise err.FileNotFound(f"parent of {dst} not found")
@@ -294,17 +296,24 @@ class MasterFilesystem:
         node = self.tree.resolve(path)
         if node is None:
             raise err.FileNotFound(path)
-        self._delete_inode(node, recursive)
+        parent, name = self.tree.resolve_parent(path)
+        self._delete_inode(node, recursive, parent=parent, name=name)
 
-    def _delete_inode(self, node: Inode, recursive: bool) -> None:
+    def _delete_inode(self, node: Inode, recursive: bool,
+                      parent: Inode | None = None,
+                      name: str | None = None) -> None:
+        """`name` is the directory-entry name being removed — it can
+        differ from node.name when the inode has hard links."""
         if node.is_dir and node.children:
             if not recursive:
                 raise err.DirNotEmpty(self.tree.path_of(node))
-            for cid in list(node.children.values()):
-                self._delete_inode(self.tree.inodes[cid], recursive=True)
-        parent = self.tree.inodes.get(node.parent_id)
+            for child_name, cid in list(node.children.items()):
+                self._delete_inode(self.tree.inodes[cid], recursive=True,
+                                   parent=node, name=child_name)
+        if parent is None:
+            parent = self.tree.inodes.get(node.parent_id)
         if parent is not None:
-            removed = self.tree.remove_child(parent, node.name)
+            removed = self.tree.remove_child(parent, name or node.name)
             if removed is not None and removed.nlink <= 0:
                 self._free_blocks(removed)
 

@@ -1,0 +1,110 @@
+"""Namespace semantics units on MasterFilesystem directly (no RPC).
+
+Mirrors reference: curvine-server/tests/inode_test.rs, master_fs_test.rs."""
+
+import pytest
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.common.types import CommitBlock, StorageType
+from curvine_tpu.master.filesystem import MasterFilesystem
+
+
+@pytest.fixture
+def fs():
+    return MasterFilesystem(journal=None)
+
+
+def test_mkdir_idempotent_and_nested(fs):
+    st1 = fs.mkdir("/a/b/c")
+    st2 = fs.mkdir("/a/b/c")
+    assert st1.id == st2.id
+    assert fs.file_status("/a").children_num == 1
+    with pytest.raises(err.FileNotFound):
+        fs.mkdir("/x/y", create_parent=False)
+
+
+def test_create_over_dir_rejected(fs):
+    fs.mkdir("/d")
+    with pytest.raises(err.IsADirectory):
+        fs.create_file("/d")
+    fs.create_file("/d/f")
+    with pytest.raises(err.FileAlreadyExists):   # POSIX: mkdir→EEXIST
+        fs.mkdir("/d/f")
+
+
+def test_rename_semantics(fs):
+    fs.mkdir("/src/sub")
+    fs.create_file("/src/sub/f")
+    # rename into own subtree rejected
+    with pytest.raises(err.InvalidArgument):
+        fs.rename("/src", "/src/sub/deeper")
+    # rename over a non-empty dir rejected
+    fs.mkdir("/dst/full")
+    fs.create_file("/dst/full/x")
+    with pytest.raises(err.DirNotEmpty):
+        fs.rename("/src", "/dst/full")
+    # dir over file rejected
+    fs.create_file("/plain")
+    with pytest.raises(err.NotADirectory):
+        fs.rename("/src", "/plain")
+    # happy path moves the whole subtree
+    fs.rename("/src", "/dst/moved")
+    assert fs.exists("/dst/moved/sub/f")
+    assert not fs.exists("/src")
+
+
+def test_hard_link_block_lifetime(fs):
+    """Blocks survive while any link remains; freed with the last one."""
+    st = fs.create_file("/orig")
+    lb = _alloc_and_commit(fs, "/orig", b_len=100)
+    fs.complete_file("/orig", 100)
+    fs.link("/orig", "/alias")
+    fs.delete("/orig")
+    assert fs.blocks.get(lb.block.id) is not None     # alias keeps it
+    assert fs.file_status("/alias").len == 100
+    fs.delete("/alias")
+    assert fs.blocks.get(lb.block.id) is None         # last link gone
+
+
+def test_delete_recursive_frees_blocks(fs):
+    fs.create_file("/t/a")
+    lb = _alloc_and_commit(fs, "/t/a", b_len=10)
+    fs.complete_file("/t/a", 10)
+    fs.delete("/t", recursive=True)
+    assert fs.blocks.count() == 0
+    # deletions scheduled for the holding worker
+    assert lb.locs[0].worker_id in fs.pending_deletes
+
+
+def test_resize_drops_tail_blocks(fs):
+    fs.create_file("/r", block_size=10)
+    b1 = _alloc_and_commit(fs, "/r", b_len=10)
+    b2 = _alloc_and_commit(fs, "/r", b_len=10)
+    fs.complete_file("/r", 20)
+    fs.resize_file("/r", 5)
+    assert fs.file_status("/r").len == 5
+    assert fs.blocks.get(b1.block.id) is not None
+    assert fs.blocks.get(b2.block.id) is None
+
+
+def test_symlink_status(fs):
+    fs.create_file("/target")
+    st = fs.symlink("/target", "/ln")
+    assert st.target == "/target"
+    assert fs.file_status("/ln").target == "/target"
+
+
+def _alloc_and_commit(fs, path, b_len):
+    from curvine_tpu.common.types import (
+        StorageInfo, WorkerAddress, WorkerInfo,
+    )
+    # one registered worker so placement succeeds
+    if not fs.workers.workers:
+        fs.workers.heartbeat(
+            WorkerAddress(worker_id=7, hostname="h", rpc_port=1),
+            [StorageInfo(capacity=1 << 30, available=1 << 30)])
+    lb = fs.add_block(path)
+    fs._commit(fs.tree.resolve(path), [CommitBlock(
+        block_id=lb.block.id, block_len=b_len, worker_ids=[7],
+        storage_type=StorageType.MEM)])
+    return fs.get_block_locations(path).block_locs[-1]

@@ -22,6 +22,7 @@ class MasterConf:
     meta_dir: str = "data/meta"
     # journal
     journal_dir: str = "data/journal"
+    journal_fsync: bool = False   # fsync every WAL append (crash durability)
     snapshot_interval_entries: int = 100_000
     # heartbeats
     worker_heartbeat_ms: int = 3_000

@@ -144,6 +144,7 @@ class SetattrValid:
 
 class InitFlags:
     ASYNC_READ = 1 << 0
+    ATOMIC_O_TRUNC = 1 << 3
     BIG_WRITES = 1 << 5
     DO_READDIRPLUS = 1 << 13
     READDIRPLUS_AUTO = 1 << 14

@@ -171,7 +171,10 @@ class CurvineFuseFs:
     async def op_init(self, hdr, payload) -> bytes:
         major, minor, max_readahead, flags = abi.INIT_IN.unpack_from(payload, 0)
         log.info("fuse init: kernel %d.%d flags=%#x", major, minor, flags)
-        want = (abi.InitFlags.ASYNC_READ | abi.InitFlags.BIG_WRITES |
+        # ATOMIC_O_TRUNC: kernel passes O_TRUNC through to OPEN instead of
+        # a SETATTR(size=0)+OPEN pair, so truncating opens are one op
+        want = (abi.InitFlags.ASYNC_READ | abi.InitFlags.ATOMIC_O_TRUNC |
+                abi.InitFlags.BIG_WRITES |
                 abi.InitFlags.DO_READDIRPLUS | abi.InitFlags.READDIRPLUS_AUTO |
                 abi.InitFlags.PARALLEL_DIROPS | abi.InitFlags.MAX_PAGES)
         out_flags = flags & want
@@ -307,8 +310,18 @@ class CurvineFuseFs:
         else:
             if flags & os.O_APPEND:
                 writer = await self.client.append(path)
-            else:
+            elif flags & os.O_TRUNC:
                 writer = await self.client.create(path, overwrite=True)
+            else:
+                # kernels without ATOMIC_O_TRUNC truncate via SETATTR then
+                # open without O_TRUNC — a zero-length target is fine; an
+                # in-place rewrite of real data is not (sequential-write
+                # cache semantics)
+                st = await self.client.meta.file_status(path)
+                if st.len == 0:
+                    writer = await self.client.create(path, overwrite=True)
+                else:
+                    raise FuseError(Errno.EOPNOTSUPP)
             fh = self._new_fh(_Handle(writer=writer, path=path))
             self._open_writers[path] = writer
         return abi.OPEN_OUT.pack(fh, 0, 0)
@@ -317,8 +330,13 @@ class CurvineFuseFs:
         flags, mode, _umask, _of = abi.CREATE_IN.unpack_from(payload, 0)
         name = bytes(payload[abi.CREATE_IN.size:]).rstrip(b"\x00")
         path = self._child(hdr.nodeid, name)
-        writer = await self.client.create(
-            path, overwrite=bool(flags & os.O_TRUNC) or True)
+        exists = await self.client.meta.exists(path)
+        if exists:
+            if flags & os.O_EXCL:
+                raise FuseError(Errno.EEXIST)
+            if not flags & os.O_TRUNC:
+                raise FuseError(Errno.EOPNOTSUPP)
+        writer = await self.client.create(path, overwrite=exists)
         await self.client.meta.set_attr(path, SetAttrOpts(mode=mode & 0o7777))
         st = await self.client.meta.file_status(path)
         fh = self._new_fh(_Handle(writer=writer, path=path))

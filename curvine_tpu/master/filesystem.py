@@ -64,12 +64,18 @@ class MasterFilesystem:
     audit_log = False   # set from MasterConf.audit_log
 
     def _log(self, op: str, args: dict):
+        # WAL discipline: journal BEFORE apply, so an append failure (disk
+        # full) never leaves in-memory state ahead of the durable log. An
+        # apply failure after append is deterministic — replay and followers
+        # fail the same way and skip the entry identically.
+        seq = None
+        if self.journal is not None:
+            seq = self.journal.append(op, args)
         result = self._apply(op, args)
         if self.audit_log:
             from curvine_tpu.common.logging import audit
             audit.log(op, str(args.get("path", args.get("src", ""))))
-        if self.journal is not None:
-            seq = self.journal.append(op, args)
+        if seq is not None:
             if self.on_mutation is not None:
                 self.on_mutation(seq, op, args)
             self._entries_since_snapshot += 1
@@ -95,6 +101,10 @@ class MasterFilesystem:
                 "rep": node.replicas, "blocks": node.blocks,
                 "done": node.is_complete, "target": node.target,
                 "dir": node.children is not None,
+                # explicit directory entries: a hard-linked inode has a
+                # second (parent, name) pair that (pid, name) alone cannot
+                # represent — children must be serialized, not derived.
+                "ch": dict(node.children) if node.children is not None else None,
             })
         blocks = [(m.block_id, m.len, m.inode_id, m.replicas)
                   for m in self.blocks.blocks.values()]
@@ -119,12 +129,20 @@ class MasterFilesystem:
                 is_complete=d["done"], target=d.get("target"),
                 children={} if d["dir"] else None)
             self.tree.inodes[node.id] = node
-        # rebuild children indexes
-        for node in self.tree.inodes.values():
-            if node.parent_id and node.parent_id in self.tree.inodes:
-                parent = self.tree.inodes[node.parent_id]
-                if parent.children is not None:
-                    parent.children[node.name] = node.id
+        have_entries = any(d.get("ch") is not None for d in snap["inodes"])
+        if have_entries:
+            # authoritative per-directory name→id entries (hard-link safe)
+            for d in snap["inodes"]:
+                if d.get("ch") is not None:
+                    self.tree.inodes[d["id"]].children = {
+                        str(k): v for k, v in d["ch"].items()}
+        else:
+            # legacy snapshot: derive children from (parent_id, name)
+            for node in self.tree.inodes.values():
+                if node.parent_id and node.parent_id in self.tree.inodes:
+                    parent = self.tree.inodes[node.parent_id]
+                    if parent.children is not None:
+                        parent.children[node.name] = node.id
         self.tree.next_id = snap["next_id"]
         self.tree.next_block_id = snap["next_block_id"]
         for bid, blen, iid, rep in snap["blocks"]:

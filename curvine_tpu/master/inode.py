@@ -48,8 +48,11 @@ class Inode:
         return self.file_type == FileType.DIR
 
     def to_status(self, path: str) -> FileStatus:
+        # name comes from the directory entry (the path tail), not the
+        # inode: a hard-linked inode is listed under each alias name
+        entry_name = path.rstrip("/").rsplit("/", 1)[-1] if path else self.name
         return FileStatus(
-            id=self.id, path=path, name=self.name, is_dir=self.is_dir,
+            id=self.id, path=path, name=entry_name, is_dir=self.is_dir,
             mtime=self.mtime, atime=self.atime,
             children_num=len(self.children) if self.children is not None else 0,
             is_complete=self.is_complete, len=self.len, replicas=self.replicas,

@@ -14,6 +14,7 @@ from curvine_tpu.common.conf import ClusterConf
 from curvine_tpu.common.journal import Journal
 from curvine_tpu.common.types import CommitBlock, SetAttrOpts
 from curvine_tpu.common.metrics import MetricsRegistry
+from curvine_tpu.common.path import norm_path
 from curvine_tpu.master.filesystem import MasterFilesystem
 from curvine_tpu.master.jobs import JobManager
 from curvine_tpu.master.mount import MountManager
@@ -31,7 +32,7 @@ class MasterServer:
                  journal: bool = True):
         self.conf = conf or ClusterConf()
         mc = self.conf.master
-        j = Journal(mc.journal_dir) if journal else None
+        j = Journal(mc.journal_dir, fsync=mc.journal_fsync) if journal else None
         self.fs = MasterFilesystem(
             journal=j, placement=mc.block_placement_policy,
             lost_timeout_ms=mc.worker_lost_timeout_ms,
@@ -150,6 +151,22 @@ class MasterServer:
         r(C.CANCEL_JOB, self._h(self._cancel_job, mutate=True))
         r(C.REPORT_TASK, self._h(self._report_task))
 
+    # Path-valued request fields, normalized ('.'/'..' resolved, root
+    # escapes rejected) before ANY handler sees them — an S3-gateway key
+    # like '..%2Fx' must never become a literal inode name.
+    _PATH_KEYS = ("path", "src", "dst", "link", "cv_path")
+
+    @classmethod
+    def _norm_req(cls, req: dict) -> dict:
+        for k in cls._PATH_KEYS:
+            v = req.get(k)
+            if isinstance(v, str):
+                req[k] = norm_path(v)
+        for sub in req.get("requests") or []:
+            if isinstance(sub, dict):
+                cls._norm_req(sub)
+        return req
+
     def _h(self, fn, mutate: bool = False):
         metrics = self.metrics
         import inspect
@@ -161,7 +178,7 @@ class MasterServer:
             return rep
 
         async def handler(msg: Message, conn: ServerConn):
-            req = unpack(msg.data) or {}
+            req = self._norm_req(unpack(msg.data) or {})
             with metrics.timer(f"rpc.{fn.__name__.lstrip('_')}"):
                 if mutate and self.raft is not None:
                     self.raft.check_leader()

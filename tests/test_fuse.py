@@ -206,3 +206,60 @@ def test_fuse_over_ufs_mount(tmp_path):
         aio.run_coroutine_threadsafe(mc.stop(), loop).result(30)
         loop.call_soon_threadsafe(loop.stop)
         t.join(5)
+
+
+async def test_create_excl_and_trunc_semantics():
+    """O_CREAT|O_EXCL on an existing file must fail EEXIST (not truncate);
+    non-truncating write opens are rejected, O_TRUNC ones succeed."""
+    from curvine_tpu.fuse.ops import CurvineFuseFs, FuseError
+
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.write_all("/keep.txt", b"precious")
+        fs = CurvineFuseFs(c)
+
+        def hdr(opcode, nodeid=1, unique=9):
+            return abi.InHeader(0, opcode, unique, nodeid, 0, 0, 0)
+
+        excl = os.O_WRONLY | os.O_CREAT | os.O_EXCL
+        with pytest.raises(FuseError) as ei:
+            await fs.op_create(
+                hdr(abi.Op.CREATE),
+                memoryview(abi.CREATE_IN.pack(excl, 0o644, 0o022, 0)
+                           + b"keep.txt\x00"))
+        assert ei.value.errno == abi.Errno.EEXIST
+        assert await (await c.open("/keep.txt")).read_all() == b"precious"
+
+        # non-truncating write open of an existing file: EOPNOTSUPP
+        wr = os.O_WRONLY | os.O_CREAT
+        with pytest.raises(FuseError) as ei:
+            await fs.op_create(
+                hdr(abi.Op.CREATE),
+                memoryview(abi.CREATE_IN.pack(wr, 0o644, 0o022, 0)
+                           + b"keep.txt\x00"))
+        assert ei.value.errno == abi.Errno.EOPNOTSUPP
+        assert await (await c.open("/keep.txt")).read_all() == b"precious"
+
+        # O_TRUNC on existing file truncates (the one legal overwrite)
+        out = await fs.op_create(
+            hdr(abi.Op.CREATE),
+            memoryview(abi.CREATE_IN.pack(wr | os.O_TRUNC, 0o644, 0o022, 0)
+                       + b"keep.txt\x00"))
+        fh, _, _ = abi.OPEN_OUT.unpack_from(out, abi.ENTRY_OUT.size
+                                            + abi.ATTR.size)
+        await fs.op_flush(hdr(abi.Op.FLUSH),
+                          memoryview(abi.FLUSH_IN.pack(fh, 0, 0, 0)))
+        assert await c.meta.exists("/keep.txt")
+        st = await c.meta.file_status("/keep.txt")
+        assert st.len == 0
+
+        # O_EXCL create of a NEW file works
+        out = await fs.op_create(
+            hdr(abi.Op.CREATE),
+            memoryview(abi.CREATE_IN.pack(excl, 0o600, 0o022, 0)
+                       + b"new.txt\x00"))
+        fh, _, _ = abi.OPEN_OUT.unpack_from(out, abi.ENTRY_OUT.size
+                                            + abi.ATTR.size)
+        await fs.op_flush(hdr(abi.Op.FLUSH),
+                          memoryview(abi.FLUSH_IN.pack(fh, 0, 0, 0)))
+        assert await c.meta.exists("/new.txt")

@@ -135,6 +135,18 @@ class FsWriter:
         """Push buffered data to workers (block stays open)."""
         await self._flush_chunk(None)
 
+    async def hflush(self) -> None:
+        """Durable flush: push buffered chunks and journal any sealed-block
+        commits at the master, WITHOUT completing the file — the write
+        stream stays open for more writes.
+        Parity: curvine-fuse/src/fs/fuse_writer.rs WriteTask::Flush (a
+        flush is a durability point, not a stream end)."""
+        await self._flush_chunk(None)
+        if self._commits:
+            await self.fs.complete_file(self.path, self.pos,
+                                        commit_blocks=self._take_commits(),
+                                        only_flush=True)
+
     async def close(self) -> None:
         if self._closed:
             return

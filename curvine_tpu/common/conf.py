@@ -19,7 +19,13 @@ class MasterConf:
     hostname: str = "127.0.0.1"
     rpc_port: int = 8995
     web_port: int = 9000
-    meta_dir: str = "data/meta"
+    # metadata store dir; empty → "<journal_dir>-meta" so every master
+    # node gets its own store without extra conf
+    meta_dir: str = ""
+    # metadata store: "kv" (log-structured KV; namespace can exceed RAM,
+    # O(journal-tail) restarts) or "mem" (dicts + snapshot replay)
+    meta_store: str = "kv"
+    meta_cache_inodes: int = 65_536
     # journal
     journal_dir: str = "data/journal"
     journal_fsync: bool = False   # fsync every WAL append (crash durability)

@@ -1,0 +1,107 @@
+"""Scheduled executor: named periodic / one-shot background tasks.
+
+Parity: curvine-common/src/executor/ (ScheduledExecutor, GroupExecutor) —
+the reference schedules heartbeat checkers, TTL scanners and job sweeps on
+a shared executor with per-task cancellation. This is the asyncio-native
+equivalent: tasks are registered by name, errors are isolated and logged
+(a failing tick never kills the schedule), and stop() cancels everything.
+
+Usage:
+    ex = ScheduledExecutor("master")
+    ex.submit_periodic("heartbeat-check", fs.check_lost_workers, 1.0)
+    ex.submit_delayed("recover", do_recover, delay_s=5.0)
+    await ex.stop()
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import logging
+import time
+
+log = logging.getLogger(__name__)
+
+
+class ScheduledExecutor:
+    def __init__(self, name: str = "executor"):
+        self.name = name
+        self._tasks: dict[str, asyncio.Task] = {}
+        self.ticks: dict[str, int] = {}        # per-task completed runs
+        self.errors: dict[str, int] = {}
+
+    def submit_periodic(self, name: str, fn, interval_s: float,
+                        initial_delay_s: float | None = None,
+                        fixed_rate: bool = False) -> None:
+        """Run ``fn`` (sync or async) every ``interval_s``. fixed_rate
+        schedules by wall clock (ticks don't drift with run time);
+        otherwise it is fixed-delay (sleep AFTER each run)."""
+        self.cancel(name)
+        self._tasks[name] = asyncio.ensure_future(
+            self._periodic(name, fn, interval_s,
+                           initial_delay_s if initial_delay_s is not None
+                           else interval_s, fixed_rate))
+
+    def submit_delayed(self, name: str, fn, delay_s: float) -> None:
+        """Run ``fn`` once after ``delay_s``."""
+        self.cancel(name)
+
+        async def once():
+            await asyncio.sleep(delay_s)
+            await self._run(name, fn)
+            self._tasks.pop(name, None)
+
+        self._tasks[name] = asyncio.ensure_future(once())
+
+    def submit(self, name: str, coro) -> asyncio.Task:
+        """Track an ad-hoc coroutine under the executor's lifecycle."""
+        self.cancel(name)
+        t = asyncio.ensure_future(coro)
+        self._tasks[name] = t
+        return t
+
+    async def _periodic(self, name: str, fn, interval_s: float,
+                        initial_delay_s: float, fixed_rate: bool) -> None:
+        next_at = time.monotonic() + initial_delay_s
+        await asyncio.sleep(initial_delay_s)
+        while True:
+            await self._run(name, fn)
+            if fixed_rate:
+                next_at += interval_s
+                delay = next_at - time.monotonic()
+                if delay < 0:          # overran: skip missed ticks
+                    next_at = time.monotonic() + interval_s
+                    delay = interval_s
+                await asyncio.sleep(delay)
+            else:
+                await asyncio.sleep(interval_s)
+
+    async def _run(self, name: str, fn) -> None:
+        try:
+            r = fn()
+            if inspect.isawaitable(r):
+                await r
+            self.ticks[name] = self.ticks.get(name, 0) + 1
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            self.errors[name] = self.errors.get(name, 0) + 1
+            log.exception("%s: scheduled task %r failed", self.name, name)
+
+    def cancel(self, name: str) -> None:
+        t = self._tasks.pop(name, None)
+        if t is not None:
+            t.cancel()
+
+    async def stop(self) -> None:
+        for t in self._tasks.values():
+            t.cancel()
+        for t in self._tasks.values():
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks.clear()
+
+    def names(self) -> list[str]:
+        return sorted(self._tasks)

@@ -29,6 +29,7 @@ class Journal:
         self.fsync = fsync
         os.makedirs(self.dir, exist_ok=True)
         self.seq = 0                       # last written seq
+        self.last_snapshot_seq = 0         # set by recover()
         self._fh = None
         self._fh_size = 0
 
@@ -84,6 +85,19 @@ class Journal:
             if end <= before_seq and start_seq <= before_seq and nexts:
                 os.unlink(p)
 
+    def gc_covered(self, applied_seq: int) -> None:
+        """Drop closed segments whose entries are all <= applied_seq
+        (KV-backed mode: the store is the checkpoint, no snapshot file).
+        The open segment is rolled first so it can be collected next
+        time once its successor exists."""
+        self._roll()
+        segs = self._list("edits-")
+        for i, (start_seq, path) in enumerate(segs):
+            has_next = i + 1 < len(segs)
+            end = segs[i + 1][0] - 1 if has_next else self.seq
+            if has_next and end <= applied_seq:
+                os.unlink(path)
+
     def _list(self, prefix: str) -> list[tuple[int, str]]:
         out = []
         for name in os.listdir(self.dir):
@@ -107,6 +121,7 @@ class Journal:
             with open(path, "rb") as f:
                 snap_state = msgpack.unpackb(f.read(), raw=False,
                                              strict_map_key=False)
+        self.last_snapshot_seq = snap_seq
         entries = []
         last_seq = snap_seq
         for _, path in self._list("edits-"):

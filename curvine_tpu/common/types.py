@@ -141,6 +141,25 @@ class StoragePolicy(Wire):
     ufs_mtime: int = 0
     state: StorageState = StorageState.CV
 
+    # hand-rolled codec: this sits on the per-inode encode path of the
+    # KV meta store, where the generic dataclass walker is measurably hot
+    def to_wire(self) -> dict:
+        return {"storage_type": int(self.storage_type),
+                "ttl_ms": self.ttl_ms,
+                "ttl_action": int(self.ttl_action),
+                "ufs_mtime": self.ufs_mtime,
+                "state": int(self.state)}
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "StoragePolicy":
+        return cls(storage_type=StorageType(d.get("storage_type",
+                                                  int(StorageType.DISK))),
+                   ttl_ms=d.get("ttl_ms", 0),
+                   ttl_action=TtlAction(d.get("ttl_action",
+                                              int(TtlAction.NONE))),
+                   ufs_mtime=d.get("ufs_mtime", 0),
+                   state=StorageState(d.get("state", int(StorageState.CV))))
+
 
 @dataclass
 class FileStatus(Wire):

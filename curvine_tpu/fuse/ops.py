@@ -298,10 +298,24 @@ class CurvineFuseFs:
         st = await self.client.meta.link(src, dst)
         return self._entry(dst, st)
 
+    async def _await_local_release(self, path: str) -> None:
+        """close(2) returns at FLUSH but the file completes at the async
+        RELEASE — an immediate re-open for write would race it and see
+        LEASE_CONFLICT. Wait (bounded) for our own writer to finish."""
+        import asyncio
+        for _ in range(500):
+            if path not in self._open_writers:
+                return
+            await asyncio.sleep(0.01)
+
     async def op_open(self, hdr, payload) -> bytes:
         flags, _ = abi.OPEN_IN.unpack_from(payload, 0)
         path = self.node_path(hdr.nodeid)
         acc = flags & os.O_ACCMODE
+        # ALL opens wait: a read-open racing the async RELEASE of our own
+        # just-closed writer would see the incomplete file (close-to-open
+        # consistency)
+        await self._await_local_release(path)
         if acc == os.O_RDONLY:
             # unified: cached files use block readers, uncached mounted
             # files stream from the UFS
@@ -330,12 +344,18 @@ class CurvineFuseFs:
         flags, mode, _umask, _of = abi.CREATE_IN.unpack_from(payload, 0)
         name = bytes(payload[abi.CREATE_IN.size:]).rstrip(b"\x00")
         path = self._child(hdr.nodeid, name)
+        await self._await_local_release(path)
         exists = await self.client.meta.exists(path)
         if exists:
             if flags & os.O_EXCL:
                 raise FuseError(Errno.EEXIST)
             if not flags & os.O_TRUNC:
-                raise FuseError(Errno.EOPNOTSUPP)
+                # mirror op_open's allowance: a stale negative dentry can
+                # turn open(O_CREAT) of an EMPTY existing file into CREATE
+                # — overwriting zero bytes is not an in-place rewrite
+                st = await self.client.meta.file_status(path)
+                if st.len != 0:
+                    raise FuseError(Errno.EOPNOTSUPP)
         writer = await self.client.create(path, overwrite=exists)
         await self.client.meta.set_attr(path, SetAttrOpts(mode=mode & 0o7777))
         st = await self.client.meta.file_status(path)
@@ -375,21 +395,23 @@ class CurvineFuseFs:
         return abi.WRITE_OUT.pack(size, 0)
 
     async def op_flush(self, hdr, payload) -> bytes:
-        """close(2) semantics: FLUSH is synchronous with close, RELEASE is
-        not — so the file is completed (visible size, committed blocks)
-        here, and RELEASE only cleans up."""
+        """FLUSH fires on EVERY close(2) of any fd referring to the handle
+        — including the dup2()+close() inside shell redirection, which
+        arrives BEFORE the first write. So FLUSH must not end the write
+        stream: it is a durability point (buffered chunks pushed, sealed
+        blocks journaled), and the file is completed at RELEASE.
+        Parity: curvine-fuse/src/fs/fuse_writer.rs WriteTask::Flush vs
+        ::Complete ('write_after_flush_keeps_the_durable_cleanup_boundary')."""
         fh, *_ = abi.FLUSH_IN.unpack_from(payload, 0)
         h = self.handles.get(fh)
         if h and h.writer is not None:
             async with h.lock:
                 if h.pending:
-                    await h.writer.abort()
-                    h.writer = None
-                    self._open_writers.pop(h.path, None)
+                    # out-of-order gap at a close boundary: surface it on
+                    # this close() but keep the stream — writes from a
+                    # still-open dup may yet fill the gap before RELEASE
                     raise FuseError(Errno.EIO)
-                await h.writer.close()
-                h.writer = None
-                self._open_writers.pop(h.path, None)
+                await h.writer.hflush()
         return b""
 
     async def op_fsync(self, hdr, payload) -> bytes:
@@ -403,7 +425,7 @@ class CurvineFuseFs:
         fh, *_ = abi.RELEASE_IN.unpack_from(payload, 0)
         h = self.handles.pop(fh, None)
         if h is not None:
-            if h.writer is not None:        # no FLUSH came (rare)
+            if h.writer is not None:        # last close: complete the file
                 async with h.lock:
                     if h.pending:
                         await h.writer.abort()

@@ -1,8 +1,10 @@
 """Block → location map.
 
-Parity: curvine-server/src/master/fs/state/block_map.rs. Tracks committed
-block replicas per worker; reconciled by worker block reports; feeds the
-replication manager's under-replicated scan."""
+Parity: curvine-server/src/master/fs/state/block_map.rs. Durable block
+meta (len, owning inode, desired replicas) lives in the MetaStore (KV or
+RAM); replica LOCATIONS are runtime state kept in RAM only — they are
+rebuilt from worker block reports after a restart, so their footprint is
+bounded by the data workers actually hold, not by namespace size."""
 
 from __future__ import annotations
 
@@ -21,54 +23,74 @@ class BlockMeta:
 
 
 class BlockMap:
-    def __init__(self) -> None:
-        self.blocks: dict[int, BlockMeta] = {}
+    def __init__(self, store=None) -> None:
+        from curvine_tpu.master.store import MemMetaStore
+        self.store = store if store is not None else MemMetaStore()
+        # runtime replica locations: block_id -> {worker_id: BlockLocation}
+        self.locs: dict[int, dict[int, BlockLocation]] = {}
         # worker_id -> set of block ids (for loss handling)
         self.worker_blocks: dict[int, set[int]] = {}
 
     def get(self, block_id: int) -> BlockMeta | None:
-        return self.blocks.get(block_id)
+        durable = self.store.block_get(block_id)
+        if durable is None:
+            return None
+        length, inode_id, replicas = durable
+        return BlockMeta(block_id=block_id, len=length, inode_id=inode_id,
+                         replicas=replicas,
+                         locs=self.locs.get(block_id, {}))
+
+    def put(self, block_id: int, length: int, inode_id: int,
+            replicas: int) -> None:
+        self.store.block_put(block_id, length, inode_id, replicas)
 
     def commit(self, block_id: int, length: int, worker_id: int,
                storage_type: StorageType, inode_id: int = 0,
-               replicas: int = 1) -> BlockMeta:
-        meta = self.blocks.get(block_id)
-        if meta is None:
-            meta = BlockMeta(block_id=block_id, len=length, inode_id=inode_id,
-                             replicas=replicas)
-            self.blocks[block_id] = meta
-        meta.len = max(meta.len, length)
-        if inode_id:
-            meta.inode_id = inode_id
-        meta.locs[worker_id] = BlockLocation(worker_id=worker_id,
-                                             storage_type=storage_type)
+               replicas: int = 1) -> None:
+        durable = self.store.block_get(block_id)
+        if durable is None:
+            self.store.block_put(block_id, length, inode_id, replicas)
+        else:
+            old_len, old_iid, old_rep = durable
+            self.store.block_put(block_id, max(old_len, length),
+                                 inode_id or old_iid, old_rep)
+        self.add_replica(block_id, worker_id, storage_type)
+
+    def add_replica(self, block_id: int, worker_id: int,
+                    storage_type: StorageType) -> None:
+        self.locs.setdefault(block_id, {})[worker_id] = BlockLocation(
+            worker_id=worker_id, storage_type=storage_type)
         self.worker_blocks.setdefault(worker_id, set()).add(block_id)
-        return meta
 
     def remove_block(self, block_id: int) -> BlockMeta | None:
-        meta = self.blocks.pop(block_id, None)
-        if meta:
-            for wid in meta.locs:
-                self.worker_blocks.get(wid, set()).discard(block_id)
+        meta = self.get(block_id)
+        if meta is None:
+            return None
+        self.store.block_remove(block_id)
+        for wid in self.locs.pop(block_id, {}):
+            self.worker_blocks.get(wid, set()).discard(block_id)
         return meta
 
     def remove_replica(self, block_id: int, worker_id: int) -> None:
-        meta = self.blocks.get(block_id)
-        if meta:
-            meta.locs.pop(worker_id, None)
+        self.locs.get(block_id, {}).pop(worker_id, None)
         self.worker_blocks.get(worker_id, set()).discard(block_id)
 
     def worker_lost(self, worker_id: int) -> list[int]:
         """Drop all replicas on a lost worker; returns affected block ids."""
         affected = list(self.worker_blocks.pop(worker_id, set()))
         for bid in affected:
-            meta = self.blocks.get(bid)
-            if meta:
-                meta.locs.pop(worker_id, None)
+            self.locs.get(bid, {}).pop(worker_id, None)
         return affected
 
     def under_replicated(self) -> list[BlockMeta]:
-        return [m for m in self.blocks.values() if 0 < len(m.locs) < m.replicas]
+        out = []
+        for bid, locs in self.locs.items():
+            if not locs:
+                continue
+            meta = self.get(bid)
+            if meta is not None and len(locs) < meta.replicas:
+                out.append(meta)
+        return out
 
     def apply_report(self, worker_id: int, held: dict[int, int],
                      storage_types: dict[int, int],
@@ -79,15 +101,15 @@ class BlockMap:
         known = self.worker_blocks.setdefault(worker_id, set())
         orphans = []
         for bid, length in held.items():
-            meta = self.blocks.get(bid)
-            if meta is None:
+            durable = self.store.block_get(bid)
+            if durable is None:
                 orphans.append(bid)
                 continue
+            old_len, iid, rep = durable
+            if length > old_len:
+                self.store.block_put(bid, length, iid, rep)
             st = StorageType(storage_types.get(bid, int(StorageType.MEM)))
-            meta.locs[worker_id] = BlockLocation(worker_id=worker_id,
-                                                 storage_type=st)
-            meta.len = max(meta.len, length)
-            known.add(bid)
+            self.add_replica(bid, worker_id, st)
         if not incremental:
             # replicas the master thinks this worker has but it doesn't
             for bid in list(known - set(held)):
@@ -95,4 +117,4 @@ class BlockMap:
         return orphans
 
     def count(self) -> int:
-        return len(self.blocks)
+        return self.store.block_count()

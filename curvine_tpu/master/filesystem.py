@@ -2,7 +2,18 @@
 
 Parity: curvine-server/src/master/fs/master_filesystem.rs (+ fs/context.rs,
 master/meta/fs_dir.rs). All mutations flow through journaled apply-ops so a
-restart (or a raft follower) reaches the same state by replay."""
+restart (or a raft follower) reaches the same state by replay.
+
+Two durability modes, selected by the metadata store:
+
+* ``MemMetaStore`` — namespace in RAM; restart = snapshot + journal replay
+  (the reference's journal-only mode).
+* ``KvMetaStore`` — namespace in a log-structured KV
+  (curvine-server/src/master/meta/store/rocks_inode_store.rs parity):
+  every journal entry's effects commit as one atomic KV batch tagged with
+  the entry seq, so cold start opens the KV and replays only the journal
+  tail past ``applied_seq`` — restart cost is O(tail), not O(namespace),
+  and the namespace can exceed RAM."""
 
 from __future__ import annotations
 
@@ -16,8 +27,9 @@ from curvine_tpu.common.types import (
     TtlAction, WorkerInfo, now_ms,
 )
 from curvine_tpu.master.block_map import BlockMap
-from curvine_tpu.master.inode import Inode, InodeTree
+from curvine_tpu.master.inode import Inode, InodeTree, ROOT_ID
 from curvine_tpu.master.placement import PlacementPolicy, create_policy
+from curvine_tpu.master.store import KvMetaStore, MemMetaStore
 from curvine_tpu.master.worker_map import WorkerMap
 
 log = logging.getLogger(__name__)
@@ -27,9 +39,11 @@ class MasterFilesystem:
     def __init__(self, journal: Journal | None = None,
                  placement: str | PlacementPolicy = "local",
                  lost_timeout_ms: int = 30_000,
-                 snapshot_interval: int = 100_000):
-        self.tree = InodeTree()
-        self.blocks = BlockMap()
+                 snapshot_interval: int = 100_000,
+                 store: MemMetaStore | KvMetaStore | None = None):
+        self.store = store if store is not None else MemMetaStore()
+        self.tree = InodeTree(self.store)
+        self.blocks = BlockMap(self.store)
         self.workers = WorkerMap(lost_timeout_ms=lost_timeout_ms)
         self.journal = journal
         self.snapshot_interval = snapshot_interval
@@ -42,7 +56,12 @@ class MasterFilesystem:
         self.mounts = None          # set by MountManager
         self.on_worker_lost = None  # hook: ReplicationManager
         self.on_mutation = None     # hook: RaftLite journal replication
+        self.acl = None             # set by AclEnforcer (permission checks)
         self.start_ms = now_ms()
+
+    @property
+    def _kv(self) -> bool:
+        return self.store.kind == "kv"
 
     # ==================== journal plumbing ====================
 
@@ -50,6 +69,33 @@ class MasterFilesystem:
         if self.journal is None:
             return
         snap, entries = self.journal.recover()
+        if self._kv:
+            applied = self.store.get_counter("applied_seq", 0)
+            snap_seq = getattr(self.journal, "last_snapshot_seq", 0)
+            if snap is not None and applied < snap_seq:
+                # KV is behind the newest snapshot (migration from mem mode
+                # or an HA snapshot install mid-crash): load it wholesale.
+                self._load_snapshot(snap)
+                applied = snap_seq
+                self.store.commit_applied(applied)
+            replayed = 0
+            for seq, op, args in entries:
+                if seq <= applied:
+                    continue
+                try:
+                    self._apply(op, args)
+                    self.store.commit_applied(seq)
+                except err.CurvineError as e:
+                    self.store.rollback()
+                    self.store.commit_applied(seq)
+                    log.warning("journal replay: %s(%s) -> %s", op, args, e)
+                replayed += 1
+            self.journal.seq = max(self.journal.seq, applied)
+            log.info("kv recovery: %d inodes, %d blocks, applied_seq=%d, "
+                     "replayed %d tail entries",
+                     self.tree.count(), self.blocks.count(),
+                     self.store.get_counter("applied_seq"), replayed)
+            return
         if snap is not None:
             self._load_snapshot(snap)
         for _seq, op, args in entries:
@@ -65,13 +111,27 @@ class MasterFilesystem:
 
     def _log(self, op: str, args: dict):
         # WAL discipline: journal BEFORE apply, so an append failure (disk
-        # full) never leaves in-memory state ahead of the durable log. An
-        # apply failure after append is deterministic — replay and followers
-        # fail the same way and skip the entry identically.
+        # full) never leaves in-memory state ahead of the durable log.
+        # Mutations are validated before journaling; if an apply still
+        # fails, on_mutation fires anyway so follower seqs stay contiguous
+        # (followers fail the same deterministic way and skip the entry).
         seq = None
         if self.journal is not None:
             seq = self.journal.append(op, args)
-        result = self._apply(op, args)
+        try:
+            result = self._apply(op, args)
+        except BaseException:
+            if self._kv:
+                self.store.rollback()
+                if seq is not None:
+                    self.store.commit_applied(seq)
+            if seq is not None and self.on_mutation is not None:
+                self.on_mutation(seq, op, args)
+            raise
+        if self._kv:
+            self.store.commit_applied(
+                seq if seq is not None
+                else self.store.get_counter("applied_seq", 0))
         if self.audit_log:
             from curvine_tpu.common.logging import audit
             audit.log(op, str(args.get("path", args.get("src", ""))))
@@ -86,12 +146,23 @@ class MasterFilesystem:
     def checkpoint(self) -> None:
         if self.journal is None:
             return
-        self.journal.write_snapshot(self._snapshot_state())
+        if self._kv:
+            # KV mode: the store IS the checkpoint. Flush the memtable and
+            # drop journal segments fully covered by applied_seq — no full
+            # snapshot write, so checkpoint cost is O(memtable) not O(ns).
+            self.store.flush()
+            self.journal.gc_covered(self.store.get_counter("applied_seq", 0))
+        else:
+            self.journal.write_snapshot(self._snapshot_state())
         self._entries_since_snapshot = 0
 
     def _snapshot_state(self) -> dict:
+        """Full-state dump (HA snapshot transfer / mem-mode checkpoints)."""
+        ch_map: dict[int, dict[str, int]] = {}
+        for pid, name, cid in self.store.iter_children_all():
+            ch_map.setdefault(pid, {})[name] = cid
         inodes = []
-        for node in self.tree.inodes.values():
+        for node in self.store.iter_inodes():
             inodes.append({
                 "id": node.id, "name": node.name, "ft": int(node.file_type),
                 "pid": node.parent_id, "mtime": node.mtime, "atime": node.atime,
@@ -100,24 +171,27 @@ class MasterFilesystem:
                 "nlink": node.nlink, "len": node.len, "bs": node.block_size,
                 "rep": node.replicas, "blocks": node.blocks,
                 "done": node.is_complete, "target": node.target,
-                "dir": node.children is not None,
+                "dir": node.is_dir,
                 # explicit directory entries: a hard-linked inode has a
                 # second (parent, name) pair that (pid, name) alone cannot
                 # represent — children must be serialized, not derived.
-                "ch": dict(node.children) if node.children is not None else None,
+                "ch": ch_map.get(node.id, {}) if node.is_dir else None,
             })
-        blocks = [(m.block_id, m.len, m.inode_id, m.replicas)
-                  for m in self.blocks.blocks.values()]
-        state = {"next_id": self.tree.next_id,
-                 "next_block_id": self.tree.next_block_id,
+        blocks = [(bid, length, iid, rep)
+                  for bid, (length, iid, rep) in self.store.iter_blocks()]
+        state = {"next_id": self.store.get_counter("next_id", ROOT_ID + 1),
+                 "next_block_id": self.store.get_counter("next_block_id", 1),
                  "inodes": inodes, "blocks": blocks}
         if self.mounts is not None:
             state["mounts"] = self.mounts.snapshot_state()
         return state
 
     def _load_snapshot(self, snap: dict) -> None:
-        self.tree.inodes.clear()
+        self.store.clear()
+        have_entries = any(d.get("ch") is not None for d in snap["inodes"])
         for d in snap["inodes"]:
+            is_dir = d["dir"]
+            ch = d.get("ch") if have_entries else None
             node = Inode(
                 id=d["id"], name=d["name"], file_type=FileType(d["ft"]),
                 parent_id=d["pid"], mtime=d["mtime"], atime=d["atime"],
@@ -127,30 +201,27 @@ class MasterFilesystem:
                 nlink=d["nlink"], len=d["len"], block_size=d["bs"],
                 replicas=d["rep"], blocks=list(d["blocks"]),
                 is_complete=d["done"], target=d.get("target"),
-                children={} if d["dir"] else None)
-            self.tree.inodes[node.id] = node
-        have_entries = any(d.get("ch") is not None for d in snap["inodes"])
-        if have_entries:
-            # authoritative per-directory name→id entries (hard-link safe)
-            for d in snap["inodes"]:
-                if d.get("ch") is not None:
-                    self.tree.inodes[d["id"]].children = {
-                        str(k): v for k, v in d["ch"].items()}
-        else:
+                children_num=len(ch) if ch is not None else 0)
+            self.store.put(node, new=True)
+            if ch is not None:
+                for name, cid in ch.items():
+                    self.store.child_put(node.id, str(name), cid)
+        if not have_entries:
             # legacy snapshot: derive children from (parent_id, name)
-            for node in self.tree.inodes.values():
-                if node.parent_id and node.parent_id in self.tree.inodes:
-                    parent = self.tree.inodes[node.parent_id]
-                    if parent.children is not None:
-                        parent.children[node.name] = node.id
-        self.tree.next_id = snap["next_id"]
-        self.tree.next_block_id = snap["next_block_id"]
+            counts: dict[int, int] = {}
+            for d in snap["inodes"]:
+                if d["pid"]:
+                    self.store.child_put(d["pid"], d["name"], d["id"])
+                    counts[d["pid"]] = counts.get(d["pid"], 0) + 1
+            for pid, n in counts.items():
+                parent = self.store.get(pid)
+                if parent is not None:
+                    parent.children_num = n
+                    self.store.put(parent)
+        self.store.set_counter("next_id", snap["next_id"])
+        self.store.set_counter("next_block_id", snap["next_block_id"])
         for bid, blen, iid, rep in snap["blocks"]:
-            meta = self.blocks.blocks.get(bid)
-            if meta is None:
-                from curvine_tpu.master.block_map import BlockMeta
-                self.blocks.blocks[bid] = BlockMeta(
-                    block_id=bid, len=blen, inode_id=iid, replicas=rep)
+            self.store.block_put(bid, blen, iid, rep)
         if self.mounts is not None and "mounts" in snap:
             self.mounts.load_snapshot_state(snap["mounts"])
 
@@ -170,6 +241,7 @@ class MasterFilesystem:
             if node.is_dir:
                 return node.to_status(path)
             raise err.FileAlreadyExists(f"{path} exists and is a file")
+        self.tree.check_parent_dirs(path)
         parent, _ = self.tree.resolve_parent(path)
         if parent is None and not create_parent:
             raise err.FileNotFound(f"parent of {path} not found")
@@ -196,6 +268,7 @@ class MasterFilesystem:
                 raise err.IsADirectory(path)
             if not overwrite:
                 raise err.FileAlreadyExists(path)
+        self.tree.check_parent_dirs(path)
         parent, _name = self.tree.resolve_parent(path)
         if parent is None and not create_parent:
             raise err.FileNotFound(f"parent of {path} not found")
@@ -235,12 +308,13 @@ class MasterFilesystem:
             raise err.LeaseConflict(f"{path} is being written")
         self._log("set_incomplete", dict(inode_id=node.id,
                                          client_name=client_name))
-        return self._file_blocks(node, path)
+        return self._file_blocks(self.tree.get(node.id), path)
 
     def _apply_set_incomplete(self, inode_id: int, client_name: str) -> None:
         node = self._inode_or_raise(inode_id)
         node.is_complete = False
         node.client_name = client_name
+        self.tree.save(node)
 
     def exists(self, path: str) -> bool:
         return self.tree.resolve(path) is not None
@@ -257,12 +331,9 @@ class MasterFilesystem:
             raise err.FileNotFound(path)
         if not node.is_dir:
             return [node.to_status(path)]
-        out = []
         base = path.rstrip("/")
-        for name in sorted(node.children or {}):
-            child = self.tree.inodes[node.children[name]]
-            out.append(child.to_status(f"{base}/{name}"))
-        return out
+        return [child.to_status(f"{base}/{name}")
+                for name, child in self.tree.children(node)]
 
     def rename(self, src: str, dst: str) -> bool:
         s = self.tree.resolve(src)
@@ -272,10 +343,11 @@ class MasterFilesystem:
             raise err.InvalidArgument(f"cannot rename {src} into itself")
         d = self.tree.resolve(dst)
         if d is not None:
-            if d.is_dir and d.children:
+            if d.is_dir and d.children_num:
                 raise err.DirNotEmpty(dst)
             if d.is_dir != s.is_dir:
                 raise (err.IsADirectory if d.is_dir else err.NotADirectory)(dst)
+        self.tree.check_parent_dirs(dst)
         return self._log("rename", dict(src=src, dst=dst))
 
     def _apply_rename(self, src: str, dst: str) -> bool:
@@ -289,24 +361,31 @@ class MasterFilesystem:
         new_parent, new_name = self.tree.resolve_parent(dst)
         if new_parent is None or not new_parent.is_dir:
             raise err.FileNotFound(f"parent of {dst} not found")
-        old_parent = self.tree.inodes[s.parent_id]
-        assert old_parent.children is not None
-        old_parent.children.pop(s.name, None)
+        # move the directory ENTRY (src path tail, which for a hard link
+        # can differ from s.name): remove old entry, add new, no nlink churn
+        old_parent, old_name = self.tree.resolve_parent(src)
+        self.store.child_remove(old_parent.id, old_name)
+        old_parent.children_num = max(0, old_parent.children_num - 1)
         old_parent.mtime = now_ms()
+        self.tree.save(old_parent)
         s.name = new_name
+        # refresh: old_parent save may be the same object as new_parent
+        new_parent = self.tree.get(new_parent.id)
         s.parent_id = new_parent.id
-        assert new_parent.children is not None
-        new_parent.children[new_name] = s.id
+        self.tree.save(s)
+        self.store.child_put(new_parent.id, new_name, s.id)
+        new_parent.children_num += 1
         new_parent.mtime = now_ms()
+        self.tree.save(new_parent)
         return True
 
     def delete(self, path: str, recursive: bool = False) -> None:
         node = self.tree.resolve(path)
         if node is None:
             raise err.FileNotFound(path)
-        if node.is_dir and node.children and not recursive:
+        if node.is_dir and node.children_num and not recursive:
             raise err.DirNotEmpty(path)
-        if node.id == 1:
+        if node.id == ROOT_ID:
             raise err.InvalidArgument("cannot delete root")
         self._log("delete", dict(path=path, recursive=recursive))
 
@@ -322,20 +401,23 @@ class MasterFilesystem:
                       name: str | None = None) -> None:
         """`name` is the directory-entry name being removed — it can
         differ from node.name when the inode has hard links."""
-        if node.is_dir and node.children:
+        if node.is_dir and node.children_num:
             if not recursive:
                 raise err.DirNotEmpty(self.tree.path_of(node))
-            for child_name, cid in list(node.children.items()):
-                self._delete_inode(self.tree.inodes[cid], recursive=True,
+            for child_name, child in self.tree.children(node):
+                self._delete_inode(child, recursive=True,
                                    parent=node, name=child_name)
         if parent is None:
-            parent = self.tree.inodes.get(node.parent_id)
+            parent = self.tree.get(node.parent_id)
         if parent is not None:
             removed = self.tree.remove_child(parent, name or node.name)
             if removed is not None and removed.nlink <= 0:
                 self._free_blocks(removed)
 
     def _free_blocks(self, node: Inode) -> None:
+        """Drops the node's blocks. Does NOT save the inode: callers on
+        the delete path have already removed it from the store (saving
+        would resurrect it as an orphan); the free path saves explicitly."""
         for bid in node.blocks:
             meta = self.blocks.remove_block(bid)
             if meta:
@@ -361,12 +443,13 @@ class MasterFilesystem:
         if node.is_dir:
             if not recursive:
                 return 0
-            for cid in list((node.children or {}).values()):
-                n += self._free_inode(self.tree.inodes[cid], recursive)
+            for _name, child in self.tree.children(node):
+                n += self._free_inode(child, recursive)
             return n
         if node.blocks:
             self._free_blocks(node)
             node.storage_policy.state = StorageState.UFS
+            self.tree.save(node)
             n += 1
         return n
 
@@ -399,10 +482,14 @@ class MasterFilesystem:
         node.x_attr.update(o.add_x_attr)
         for k in o.remove_x_attr:
             node.x_attr.pop(k, None)
+        self.tree.save(node)
 
     def symlink(self, target: str, link: str) -> FileStatus:
         if self.tree.resolve(link) is not None:
             raise err.FileAlreadyExists(link)
+        parent, _ = self.tree.resolve_parent(link)
+        if parent is None or not parent.is_dir:
+            raise err.FileNotFound(f"parent of {link} not found")
         return self._log("symlink", dict(target=target, link=link))
 
     def _apply_symlink(self, target: str, link: str) -> FileStatus:
@@ -416,9 +503,12 @@ class MasterFilesystem:
         return node.to_status(link)
 
     def link(self, src: str, dst: str) -> FileStatus:
-        node = self._file_or_raise(src)
+        self._file_or_raise(src)
         if self.tree.resolve(dst) is not None:
             raise err.FileAlreadyExists(dst)
+        parent, _ = self.tree.resolve_parent(dst)
+        if parent is None or not parent.is_dir:
+            raise err.FileNotFound(f"parent of {dst} not found")
         return self._log("link", dict(src=src, dst=dst))
 
     def _apply_link(self, src: str, dst: str) -> FileStatus:
@@ -426,10 +516,7 @@ class MasterFilesystem:
         parent, name = self.tree.resolve_parent(dst)
         if parent is None or not parent.is_dir:
             raise err.FileNotFound(f"parent of {dst} not found")
-        assert parent.children is not None
-        parent.children[name] = node.id
-        node.nlink += 1
-        parent.mtime = now_ms()
+        self.tree.add_entry(parent, name, node)
         return node.to_status(dst)
 
     def resize_file(self, path: str, new_len: int) -> None:
@@ -456,6 +543,7 @@ class MasterFilesystem:
                         self.pending_deletes.setdefault(wid, set()).add(bid)
             off += blen
         node.blocks = keep
+        self.tree.save(node)
 
     # ==================== block ops ====================
 
@@ -476,8 +564,9 @@ class MasterFilesystem:
         block_id = self._log("alloc_block", dict(inode_id=node.id))
         block = ExtendedBlock(id=block_id, len=0, storage_type=storage_type,
                               file_type=node.file_type)
-        off = sum((self.blocks.get(b).len if self.blocks.get(b) else 0)
-                  for b in node.blocks[:-1])
+        node = self.tree.get(node.id)
+        off = sum(meta.len for b in node.blocks[:-1]
+                  if (meta := self.blocks.get(b)) is not None)
         return LocatedBlock(block=block, offset=off,
                             locs=[w.address for w in chosen],
                             storage_types=[storage_type] * len(chosen))
@@ -487,13 +576,11 @@ class MasterFilesystem:
         block_id = self.tree.alloc_block_id()
         node.blocks.append(block_id)
         node.mtime = now_ms()      # writer liveness for lease recovery
+        self.tree.save(node)
         # placeholder meta: a worker report of this in-flight block must
         # not look like an orphan (it is referenced by the inode)
-        from curvine_tpu.master.block_map import BlockMeta
-        if block_id not in self.blocks.blocks:
-            self.blocks.blocks[block_id] = BlockMeta(
-                block_id=block_id, inode_id=inode_id,
-                replicas=node.replicas)
+        if self.store.block_get(block_id) is None:
+            self.store.block_put(block_id, 0, inode_id, node.replicas)
         return block_id
 
     def complete_file(self, path: str, length: int,
@@ -511,6 +598,7 @@ class MasterFilesystem:
         node.is_complete = True
         node.mtime = now_ms()
         node.client_name = ""
+        self.tree.save(node)
 
     def _commit(self, node: Inode, commit_blocks: list[CommitBlock] | None
                 ) -> None:
@@ -523,20 +611,19 @@ class MasterFilesystem:
             commits=[[cb.block_id, cb.block_len] for cb in commit_blocks]))
         for cb in commit_blocks:
             for wid in cb.worker_ids:
-                self.blocks.commit(cb.block_id, cb.block_len, wid,
-                                   cb.storage_type, inode_id=node.id,
-                                   replicas=node.replicas)
+                self.blocks.add_replica(cb.block_id, wid, cb.storage_type)
 
     def _apply_commit_blocks(self, inode_id: int, commits: list) -> None:
-        from curvine_tpu.master.block_map import BlockMeta
         node = self.tree.get(inode_id)
         replicas = node.replicas if node is not None else 1
         for bid, blen in commits:
-            meta = self.blocks.blocks.get(bid)
-            if meta is None:
-                meta = self.blocks.blocks[bid] = BlockMeta(
-                    block_id=bid, inode_id=inode_id, replicas=replicas)
-            meta.len = max(meta.len, blen)
+            durable = self.store.block_get(bid)
+            if durable is None:
+                self.store.block_put(bid, blen, inode_id, replicas)
+            else:
+                old_len, iid, rep = durable
+                self.store.block_put(bid, max(old_len, blen),
+                                     iid or inode_id, rep)
 
     def get_block_locations(self, path: str) -> FileBlocks:
         node = self._file_or_raise(path)
@@ -582,6 +669,9 @@ class MasterFilesystem:
         storage_types = {int(k): int(v) for k, v in storage_types.items()}
         orphans = self.blocks.apply_report(worker_id, held, storage_types,
                                            incremental=incremental)
+        # report-driven len bumps are durable but not journaled: persist
+        # them now so they don't ride some later entry's atomic batch
+        self.store.commit_runtime()
         return {"delete_blocks": orphans}
 
     def recover_stale_leases(self, lease_timeout_ms: int = 300_000) -> int:

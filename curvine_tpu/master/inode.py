@@ -1,9 +1,11 @@
-"""Namespace inode tree.
+"""Namespace inode tree over a pluggable metadata store.
 
 Parity: curvine-server/src/master/meta/inode/ (InodeDir/InodeFile/InodeView,
-fs_dir.rs path resolution, inode_id.rs allocation). The tree is in-memory
-(dict-based children index); durability comes from the journal (replayed
-mutations + snapshots), mirroring the reference's journal-backed design."""
+fs_dir.rs path resolution, inode_id.rs allocation) and
+master/meta/store/rocks_inode_store.rs (inodes + directory entries as
+individual store records). Durability comes from the journal (replayed
+mutations) plus, with the KV store, per-entry committed KV batches — so
+the namespace is NOT required to fit in RAM."""
 
 from __future__ import annotations
 
@@ -31,8 +33,7 @@ class Inode:
     x_attr: dict = field(default_factory=dict)
     storage_policy: StoragePolicy = field(default_factory=StoragePolicy)
     nlink: int = 1
-    # dir fields
-    children: dict | None = None          # name -> inode id
+    children_num: int = 0                 # dirs: live entry count
     # file fields
     len: int = 0
     block_size: int = 64 * 1024 * 1024
@@ -54,7 +55,7 @@ class Inode:
         return FileStatus(
             id=self.id, path=path, name=entry_name, is_dir=self.is_dir,
             mtime=self.mtime, atime=self.atime,
-            children_num=len(self.children) if self.children is not None else 0,
+            children_num=self.children_num,
             is_complete=self.is_complete, len=self.len, replicas=self.replicas,
             block_size=self.block_size, file_type=self.file_type,
             x_attr=dict(self.x_attr), storage_policy=self.storage_policy,
@@ -64,44 +65,54 @@ class Inode:
 
 
 class InodeTree:
-    """id → inode map plus path resolution. Single-writer (master actor)."""
+    """Path resolution + mutations over a MetaStore. Single-writer
+    (master actor); every mutation writes through to the store."""
 
-    def __init__(self) -> None:
-        self.inodes: dict[int, Inode] = {}
-        self.next_id = ROOT_ID
-        self.next_block_id = 1
-        root = Inode(id=self._alloc_id(), name="", file_type=FileType.DIR,
-                     parent_id=0, children={}, mtime=now_ms(), atime=now_ms())
-        self.inodes[root.id] = root
+    def __init__(self, store=None) -> None:
+        from curvine_tpu.master.store import MemMetaStore
+        self.store = store if store is not None else MemMetaStore()
+        if self.store.get(ROOT_ID) is None:
+            root = Inode(id=ROOT_ID, name="", file_type=FileType.DIR,
+                         parent_id=0, mtime=now_ms(), atime=now_ms())
+            self.store.put(root, new=True)
+            self.store.set_counter("next_id", ROOT_ID + 1)
+            if self.store.kind == "kv":
+                self.store.commit_applied(self.store.get_counter(
+                    "applied_seq", 0))
 
     # -- id allocation (journaled via op replay determinism) --
     def _alloc_id(self) -> int:
-        i = self.next_id
-        self.next_id += 1
+        i = self.store.get_counter("next_id", ROOT_ID + 1)
+        self.store.set_counter("next_id", i + 1)
         return i
 
     def alloc_block_id(self) -> int:
-        b = self.next_block_id
-        self.next_block_id += 1
+        b = self.store.get_counter("next_block_id", 1)
+        self.store.set_counter("next_block_id", b + 1)
         return b
 
     @property
     def root(self) -> Inode:
-        return self.inodes[ROOT_ID]
+        return self.store.get(ROOT_ID)
 
     def get(self, inode_id: int) -> Inode | None:
-        return self.inodes.get(inode_id)
+        return self.store.get(inode_id)
+
+    def save(self, inode: Inode) -> None:
+        self.store.put(inode)
 
     # -- path resolution --
     def resolve(self, path: str) -> Inode | None:
         node = self.root
         for comp in _components(path):
-            if node.children is None:
+            if not node.is_dir:
                 return None
-            cid = node.children.get(comp)
+            cid = self.store.child_get(node.id, comp)
             if cid is None:
                 return None
-            node = self.inodes[cid]
+            node = self.store.get(cid)
+            if node is None:
+                return None
         return node
 
     def resolve_parent(self, path: str) -> tuple[Inode | None, str]:
@@ -110,39 +121,87 @@ class InodeTree:
             return None, ""
         node = self.root
         for comp in comps[:-1]:
-            if node.children is None:
+            if not node.is_dir:
                 return None, comps[-1]
-            cid = node.children.get(comp)
+            cid = self.store.child_get(node.id, comp)
             if cid is None:
                 return None, comps[-1]
-            node = self.inodes[cid]
+            node = self.store.get(cid)
         return node, comps[-1]
+
+    def check_parent_dirs(self, path: str) -> None:
+        """Raise NotADirectory if any existing intermediate component is a
+        file — validated BEFORE journaling so followers never see the
+        failing entry (WAL-first discipline)."""
+        comps = _components(path)
+        node = self.root
+        for i, comp in enumerate(comps[:-1]):
+            cid = self.store.child_get(node.id, comp)
+            if cid is None:
+                return
+            node = self.store.get(cid)
+            if node is None:
+                return
+            if not node.is_dir:
+                raise err.NotADirectory(f"/{'/'.join(comps[:i + 1])} is a file")
 
     def path_of(self, inode: Inode) -> str:
         parts: list[str] = []
         node = inode
         while node.id != ROOT_ID:
             parts.append(node.name)
-            node = self.inodes[node.parent_id]
+            node = self.store.get(node.parent_id)
+            if node is None:
+                break
         return "/" + "/".join(reversed(parts))
+
+    def child(self, parent: Inode, name: str) -> Inode | None:
+        cid = self.store.child_get(parent.id, name)
+        return self.store.get(cid) if cid is not None else None
+
+    def children(self, parent: Inode) -> list[tuple[str, Inode]]:
+        out = []
+        for name, cid in self.store.children_of(parent.id):
+            node = self.store.get(cid)
+            if node is not None:
+                out.append((name, node))
+        return out
 
     # -- mutations (called only via journaled ops) --
     def add_child(self, parent: Inode, inode: Inode) -> None:
-        assert parent.children is not None
-        parent.children[inode.name] = inode.id
+        assert parent.is_dir
+        self.store.put(inode, new=True)
+        self.store.child_put(parent.id, inode.name, inode.id)
+        parent.children_num += 1
         parent.mtime = inode.mtime
-        self.inodes[inode.id] = inode
+        self.store.put(parent)
+
+    def add_entry(self, parent: Inode, name: str, inode: Inode) -> None:
+        """Extra directory entry for an existing inode (hard link)."""
+        assert parent.is_dir
+        self.store.child_put(parent.id, name, inode.id)
+        inode.nlink += 1
+        self.store.put(inode)
+        parent.children_num += 1
+        parent.mtime = now_ms()
+        self.store.put(parent)
 
     def remove_child(self, parent: Inode, name: str) -> Inode | None:
-        assert parent.children is not None
-        cid = parent.children.pop(name, None)
+        cid = self.store.child_get(parent.id, name)
         if cid is None:
             return None
-        node = self.inodes[cid]
+        self.store.child_remove(parent.id, name)
+        parent.children_num = max(0, parent.children_num - 1)
+        parent.mtime = now_ms()
+        self.store.put(parent)
+        node = self.store.get(cid)
+        if node is None:
+            return None
         node.nlink -= 1
         if node.nlink <= 0:
-            del self.inodes[cid]
-        parent.mtime = now_ms()
+            self.store.remove(cid)
+        else:
+            self.store.put(node)
         return node
 
     def mkdirs(self, path: str, mode: int = 0o755, owner: str = "root",
@@ -156,18 +215,17 @@ class InodeTree:
             return node, False
         created = False
         for i, comp in enumerate(comps):
-            assert node.children is not None
-            cid = node.children.get(comp)
-            if cid is not None:
-                node = self.inodes[cid]
-                if not node.is_dir:
+            existing = self.child(node, comp)
+            if existing is not None:
+                if not existing.is_dir:
                     raise err.NotADirectory(f"{'/'.join(comps[:i + 1])} is a file")
+                node = existing
                 continue
             if i < len(comps) - 1 and not create_parent:
                 raise err.FileNotFound(f"parent /{'/'.join(comps[:i + 1])} not found")
             child = Inode(id=self._alloc_id(), name=comp,
                           file_type=FileType.DIR, parent_id=node.id,
-                          children={}, mtime=now_ms(), atime=now_ms(),
+                          mtime=now_ms(), atime=now_ms(),
                           owner=owner, group=group, mode=mode,
                           x_attr=dict(x_attr or {}) if i == len(comps) - 1 else {},
                           storage_policy=policy or StoragePolicy())
@@ -177,10 +235,10 @@ class InodeTree:
         return node, created
 
     def count(self) -> int:
-        return len(self.inodes)
+        return self.store.inode_count()
 
     def iter_files(self):
-        for node in self.inodes.values():
+        for node in self.store.iter_inodes():
             if node.file_type != FileType.DIR:
                 yield node
 

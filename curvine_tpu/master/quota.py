@@ -66,8 +66,8 @@ class QuotaManager:
         if not node.is_dir:
             return node.len, 1
         b = f = 0
-        for cid in (node.children or {}).values():
-            cb, cf = self._usage(self.fs.tree.inodes[cid])
+        for _name, child in self.fs.tree.children(node):
+            cb, cf = self._usage(child)
             b += cb
             f += cf
         return b, f
@@ -90,7 +90,7 @@ class QuotaManager:
                     raise err.QuotaExceeded(
                         f"{self.fs.tree.path_of(node)}: file quota {qf} "
                         f"(used {uf})")
-            node = self.fs.tree.inodes.get(node.parent_id) \
+            node = self.fs.tree.get(node.parent_id) \
                 if node.parent_id else None
 
     # ---------------- cache pressure eviction ----------------

@@ -33,10 +33,16 @@ class MasterServer:
         self.conf = conf or ClusterConf()
         mc = self.conf.master
         j = Journal(mc.journal_dir, fsync=mc.journal_fsync) if journal else None
+        store = None
+        if mc.meta_store == "kv":
+            from curvine_tpu.master.store import KvMetaStore
+            meta_dir = mc.meta_dir or mc.journal_dir.rstrip("/") + "-meta"
+            store = KvMetaStore(meta_dir, fsync=mc.journal_fsync,
+                                cache_inodes=mc.meta_cache_inodes)
         self.fs = MasterFilesystem(
             journal=j, placement=mc.block_placement_policy,
             lost_timeout_ms=mc.worker_lost_timeout_ms,
-            snapshot_interval=mc.snapshot_interval_entries)
+            snapshot_interval=mc.snapshot_interval_entries, store=store)
         self.fs.audit_log = mc.audit_log
         self.mounts = MountManager(self.fs)
         self.fs.mounts = self.mounts
@@ -60,6 +66,8 @@ class MasterServer:
             self.fs.on_mutation = self.raft.on_mutation
         self._register_handlers()
         self._bg: list[asyncio.Task] = []
+        from curvine_tpu.common.executor import ScheduledExecutor
+        self.executor = ScheduledExecutor("master")
 
     @property
     def addr(self) -> str:
@@ -70,36 +78,30 @@ class MasterServer:
         await self.rpc.start()
         if self.raft is not None:
             await self.raft.start()
-        self._bg.append(asyncio.ensure_future(self._heartbeat_checker()))
-        self._bg.append(asyncio.ensure_future(self.ttl.run()))
-        self._bg.append(asyncio.ensure_future(self.replication.run()))
-        self._bg.append(asyncio.ensure_future(self.jobs.run()))
-        self._bg.append(asyncio.ensure_future(self.quota.run()))
+        # periodic duties ride the scheduled executor
+        # (parity: curvine-common/src/executor/ ScheduledExecutor)
+        interval = self.conf.master.heartbeat_check_ms / 1000
+        self.executor.submit_periodic("heartbeat-check",
+                                      self.fs.check_lost_workers, interval)
+        self.executor.submit_periodic("lease-recovery",
+                                      self.fs.recover_stale_leases, 30.0)
+        self.executor.submit("ttl", self.ttl.run())
+        self.executor.submit("replication", self.replication.run())
+        self.executor.submit("jobs", self.jobs.run())
+        self.executor.submit("quota", self.quota.run())
         log.info("master started at %s", self.addr)
 
     async def stop(self) -> None:
         if self.raft is not None:
             await self.raft.stop()
+        await self.executor.stop()
         for t in self._bg:
             t.cancel()
         self._bg.clear()
         await self.rpc.stop()
         if self.fs.journal:
             self.fs.journal.close()
-
-    async def _heartbeat_checker(self) -> None:
-        interval = self.conf.master.heartbeat_check_ms / 1000
-        lease_every = max(1, int(30 / max(interval, 0.001)))
-        ticks = 0
-        while True:
-            await asyncio.sleep(interval)
-            try:
-                self.fs.check_lost_workers()
-                ticks += 1
-                if ticks % lease_every == 0:
-                    self.fs.recover_stale_leases()
-            except Exception:
-                log.exception("heartbeat checker")
+        self.fs.store.close()
 
     # ---------------- handlers ----------------
 

@@ -1,0 +1,356 @@
+"""Metadata store: inodes, directory entries, durable block meta.
+
+Parity: curvine-server/src/master/meta/store/ (rocks_inode_store.rs,
+rocks_block_store.rs). Two implementations behind one surface:
+
+  MemMetaStore  — plain dicts; durability via journal snapshot+replay
+                  (the round-1 design, still the default for journal-only
+                  deployments and unit tests).
+  KvMetaStore   — log-structured KV (common/kvstore.py). Inodes, directory
+                  entries and block meta are individual KV records, so the
+                  namespace can exceed RAM: hot inodes sit in a bounded
+                  LRU cache, directory children are per-entry keys (no
+                  giant per-dir blobs), and cold-start reads only the KV
+                  applied-seq plus the journal tail instead of replaying a
+                  full snapshot.
+
+KV key layout (big-endian ids keep numeric order == byte order):
+  b"i" + id(8)                 → msgpack inode record
+  b"c" + parent_id(8) + name   → child id (8 bytes)
+  b"b" + block_id(8)           → msgpack [len, inode_id, replicas]
+  b"M" + name                  → counters (next_id, next_block_id,
+                                 applied_seq, inode_count, block_count)
+
+Mutations go through a pending overlay and are committed per journal
+entry with ``commit_applied(seq)`` — one atomic KV write batch containing
+the entry's effects plus the new applied_seq, so replay after a crash
+resumes exactly at the right entry.
+"""
+
+from __future__ import annotations
+
+import struct
+from collections import OrderedDict
+
+import msgpack
+
+from curvine_tpu.common.kvstore import KvStore
+from curvine_tpu.common.types import FileType, StoragePolicy
+
+_U64 = struct.Struct(">Q")
+
+
+class MemMetaStore:
+    """Everything in RAM; snapshots via the journal carry durability."""
+
+    kind = "mem"
+
+    def __init__(self) -> None:
+        self.inodes: dict[int, object] = {}
+        self.children: dict[int, dict[str, int]] = {}
+        self.blocks: dict[int, tuple[int, int, int]] = {}
+        self.counters: dict[str, int] = {}
+
+    # inodes
+    def get(self, inode_id: int):
+        return self.inodes.get(inode_id)
+
+    def put(self, inode, new: bool = False) -> None:
+        self.inodes[inode.id] = inode
+
+    def remove(self, inode_id: int) -> None:
+        self.inodes.pop(inode_id, None)
+        self.children.pop(inode_id, None)
+
+    def iter_inodes(self):
+        return iter(list(self.inodes.values()))
+
+    def inode_count(self) -> int:
+        return len(self.inodes)
+
+    # directory entries
+    def child_get(self, parent_id: int, name: str) -> int | None:
+        return self.children.get(parent_id, {}).get(name)
+
+    def child_put(self, parent_id: int, name: str, child_id: int) -> None:
+        self.children.setdefault(parent_id, {})[name] = child_id
+
+    def child_remove(self, parent_id: int, name: str) -> None:
+        self.children.get(parent_id, {}).pop(name, None)
+
+    def children_of(self, parent_id: int) -> list[tuple[str, int]]:
+        return sorted(self.children.get(parent_id, {}).items())
+
+    def iter_children_all(self):
+        for pid, entries in list(self.children.items()):
+            for name, cid in entries.items():
+                yield pid, name, cid
+
+    # durable block meta (len, inode_id, replicas)
+    def block_get(self, block_id: int) -> tuple[int, int, int] | None:
+        return self.blocks.get(block_id)
+
+    def block_put(self, block_id: int, length: int, inode_id: int,
+                  replicas: int) -> None:
+        self.blocks[block_id] = (length, inode_id, replicas)
+
+    def block_remove(self, block_id: int) -> None:
+        self.blocks.pop(block_id, None)
+
+    def iter_blocks(self):
+        return iter(list(self.blocks.items()))
+
+    def block_count(self) -> int:
+        return len(self.blocks)
+
+    # counters
+    def get_counter(self, name: str, default: int = 0) -> int:
+        return self.counters.get(name, default)
+
+    def set_counter(self, name: str, value: int) -> None:
+        self.counters[name] = value
+
+    # transaction surface (no-ops in RAM)
+    def commit_applied(self, seq: int) -> None:
+        self.counters["applied_seq"] = seq
+
+    def commit_runtime(self) -> None:
+        pass
+
+    def rollback(self) -> None:
+        pass
+
+    def flush(self) -> None:
+        pass
+
+    def clear(self) -> None:
+        self.inodes.clear()
+        self.children.clear()
+        self.blocks.clear()
+        self.counters.clear()
+
+    def close(self) -> None:
+        pass
+
+
+def _enc_inode(node) -> bytes:
+    return msgpack.packb({
+        "id": node.id, "n": node.name, "ft": int(node.file_type),
+        "p": node.parent_id, "mt": node.mtime, "at": node.atime,
+        "o": node.owner, "g": node.group, "md": node.mode,
+        "x": node.x_attr, "sp": node.storage_policy.to_wire(),
+        "nl": node.nlink, "ln": node.len, "bs": node.block_size,
+        "rp": node.replicas, "bl": node.blocks, "dn": node.is_complete,
+        "tg": node.target, "cn": node.children_num, "cl": node.client_name,
+    }, use_bin_type=True)
+
+
+def _dec_inode(raw: bytes):
+    from curvine_tpu.master.inode import Inode
+    d = msgpack.unpackb(raw, raw=False, strict_map_key=False)
+    return Inode(
+        id=d["id"], name=d["n"], file_type=FileType(d["ft"]),
+        parent_id=d["p"], mtime=d["mt"], atime=d["at"], owner=d["o"],
+        group=d["g"], mode=d["md"], x_attr=d["x"] or {},
+        storage_policy=StoragePolicy.from_wire(d["sp"]), nlink=d["nl"],
+        len=d["ln"], block_size=d["bs"], replicas=d["rp"],
+        blocks=list(d["bl"]), is_complete=d["dn"], target=d.get("tg"),
+        children_num=d.get("cn", 0), client_name=d.get("cl", ""))
+
+
+class KvMetaStore:
+    """KV-backed store with a bounded LRU inode cache and a pending
+    overlay committed atomically per journal entry."""
+
+    kind = "kv"
+
+    def __init__(self, kv_dir: str, cache_inodes: int = 65_536,
+                 fsync: bool = False, memtable_max_bytes: int = 8 << 20):
+        self.kv = KvStore(kv_dir, fsync=fsync,
+                          memtable_max_bytes=memtable_max_bytes)
+        self.cache_max = cache_inodes
+        self._cache: OrderedDict[int, object] = OrderedDict()
+        # (parent_id, name) -> child id | None (negative entries cached:
+        # create/exists prechecks probe missing names repeatedly)
+        self._child_cache: OrderedDict[tuple[int, str], int | None] = \
+            OrderedDict()
+        self._child_cache_max = 4 * cache_inodes
+        self._pending: dict[bytes, bytes | None] = {}
+        self._counters: dict[str, int] = {}        # write-back cache
+
+    # ---- key builders ----
+    @staticmethod
+    def _ik(inode_id: int) -> bytes:
+        return b"i" + _U64.pack(inode_id)
+
+    @staticmethod
+    def _ck(parent_id: int, name: str = "") -> bytes:
+        return b"c" + _U64.pack(parent_id) + name.encode()
+
+    @staticmethod
+    def _bk(block_id: int) -> bytes:
+        return b"b" + _U64.pack(block_id)
+
+    def _read(self, key: bytes) -> bytes | None:
+        if key in self._pending:
+            return self._pending[key]
+        return self.kv.get(key)
+
+    # ---- inodes ----
+    def get(self, inode_id: int):
+        node = self._cache.get(inode_id)
+        if node is not None:
+            self._cache.move_to_end(inode_id)
+            return node
+        raw = self._read(self._ik(inode_id))
+        if raw is None:
+            return None
+        node = _dec_inode(raw)
+        self._cache_put(node)
+        return node
+
+    def _cache_put(self, node) -> None:
+        self._cache[node.id] = node
+        self._cache.move_to_end(node.id)
+        while len(self._cache) > self.cache_max:
+            self._cache.popitem(last=False)
+
+    def put(self, inode, new: bool = False) -> None:
+        self._pending[self._ik(inode.id)] = _enc_inode(inode)
+        self._cache_put(inode)
+        if new:
+            self._bump("inode_count", 1)
+
+    def remove(self, inode_id: int) -> None:
+        self._pending[self._ik(inode_id)] = None
+        self._cache.pop(inode_id, None)
+        self._bump("inode_count", -1)
+
+    def iter_inodes(self):
+        # pending is committed per-op; callers iterate between ops
+        for _k, raw in self.kv.scan(prefix=b"i"):
+            yield _dec_inode(raw)
+
+    def inode_count(self) -> int:
+        return self.get_counter("inode_count")
+
+    # ---- directory entries ----
+    def child_get(self, parent_id: int, name: str) -> int | None:
+        key = (parent_id, name)
+        if key in self._child_cache:
+            self._child_cache.move_to_end(key)
+            return self._child_cache[key]
+        raw = self._read(self._ck(parent_id, name))
+        cid = _U64.unpack(raw)[0] if raw else None
+        self._child_cache[key] = cid
+        while len(self._child_cache) > self._child_cache_max:
+            self._child_cache.popitem(last=False)
+        return cid
+
+    def child_put(self, parent_id: int, name: str, child_id: int) -> None:
+        self._pending[self._ck(parent_id, name)] = _U64.pack(child_id)
+        self._child_cache[(parent_id, name)] = child_id
+
+    def child_remove(self, parent_id: int, name: str) -> None:
+        self._pending[self._ck(parent_id, name)] = None
+        self._child_cache[(parent_id, name)] = None
+
+    def children_of(self, parent_id: int) -> list[tuple[str, int]]:
+        prefix = self._ck(parent_id)
+        out = {}
+        for k, raw in self.kv.scan(prefix=prefix):
+            out[k[len(prefix):].decode()] = _U64.unpack(raw)[0]
+        for k, raw in self._pending.items():
+            if k.startswith(prefix):
+                name = k[len(prefix):].decode()
+                if raw is None:
+                    out.pop(name, None)
+                else:
+                    out[name] = _U64.unpack(raw)[0]
+        return sorted(out.items())
+
+    def iter_children_all(self):
+        for k, raw in self.kv.scan(prefix=b"c"):
+            yield (_U64.unpack(k[1:9])[0], k[9:].decode(),
+                   _U64.unpack(raw)[0])
+
+    # ---- durable block meta ----
+    def block_get(self, block_id: int) -> tuple[int, int, int] | None:
+        raw = self._read(self._bk(block_id))
+        if raw is None:
+            return None
+        length, inode_id, replicas = msgpack.unpackb(raw, raw=False)
+        return length, inode_id, replicas
+
+    def block_put(self, block_id: int, length: int, inode_id: int,
+                  replicas: int) -> None:
+        if self._read(self._bk(block_id)) is None:
+            self._bump("block_count", 1)
+        self._pending[self._bk(block_id)] = msgpack.packb(
+            [length, inode_id, replicas])
+
+    def block_remove(self, block_id: int) -> None:
+        if self._read(self._bk(block_id)) is not None:
+            self._bump("block_count", -1)
+        self._pending[self._bk(block_id)] = None
+
+    def iter_blocks(self):
+        for k, raw in self.kv.scan(prefix=b"b"):
+            length, inode_id, replicas = msgpack.unpackb(raw, raw=False)
+            yield _U64.unpack(k[1:])[0], (length, inode_id, replicas)
+
+    def block_count(self) -> int:
+        return self.get_counter("block_count")
+
+    # ---- counters ----
+    def get_counter(self, name: str, default: int = 0) -> int:
+        if name in self._counters:
+            return self._counters[name]
+        raw = self._read(b"M" + name.encode())
+        val = msgpack.unpackb(raw) if raw is not None else default
+        self._counters[name] = val
+        return val
+
+    def set_counter(self, name: str, value: int) -> None:
+        self._counters[name] = value
+        self._pending[b"M" + name.encode()] = msgpack.packb(value)
+
+    def _bump(self, name: str, delta: int) -> None:
+        self.set_counter(name, self.get_counter(name) + delta)
+
+    # ---- transactions ----
+    def commit_applied(self, seq: int) -> None:
+        """Commit this entry's pending writes + applied_seq as ONE atomic
+        WAL record: replay after a crash resumes at exactly seq+1."""
+        self.set_counter("applied_seq", seq)
+        self.kv.write_batch(list(self._pending.items()))
+        self._pending.clear()
+
+    def commit_runtime(self) -> None:
+        """Persist pending writes WITHOUT moving applied_seq (block-report
+        len bumps — durable state that isn't journaled)."""
+        if self._pending:
+            self.kv.write_batch(list(self._pending.items()))
+            self._pending.clear()
+
+    def rollback(self) -> None:
+        """Discard pending writes of a failed apply. The whole inode cache
+        is dropped: a failed apply may have mutated cached objects in place
+        before it raised, and those mutations were never put()."""
+        self._pending.clear()
+        self._cache.clear()
+        self._child_cache.clear()
+        self._counters.clear()
+
+    def flush(self) -> None:
+        self.kv.flush()
+
+    def clear(self) -> None:
+        self.kv.clear()
+        self._cache.clear()
+        self._child_cache.clear()
+        self._pending.clear()
+        self._counters.clear()
+
+    def close(self) -> None:
+        self.kv.close()

@@ -31,6 +31,7 @@ class MiniCluster:
         self.conf.master.hostname = "127.0.0.1"
         self.conf.master.rpc_port = 0
         self.conf.master.journal_dir = os.path.join(self.base_dir, "journal")
+        self.conf.master.meta_dir = os.path.join(self.base_dir, "meta")
         self.conf.master.worker_lost_timeout_ms = lost_timeout_ms
         self.conf.master.heartbeat_check_ms = 200
         self.conf.client.block_size = block_size

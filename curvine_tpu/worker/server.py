@@ -62,6 +62,8 @@ class WorkerServer:
             except Exception as e:  # noqa: BLE001 — no device available
                 log.warning("hbm tier disabled: %s", e)
         self._bg: list[asyncio.Task] = []
+        from curvine_tpu.common.executor import ScheduledExecutor
+        self.executor = ScheduledExecutor("worker")
         self._task_sem = asyncio.Semaphore(wc.task_parallelism)
         self._register_handlers()
 
@@ -81,13 +83,20 @@ class WorkerServer:
         if not self.worker_id:
             self.worker_id = worker_id_for(self.conf.worker.hostname,
                                            self.rpc.port)
-        self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
-        self._bg.append(asyncio.ensure_future(self._report_loop()))
-        self._bg.append(asyncio.ensure_future(self._eviction_loop()))
-        self._bg.append(asyncio.ensure_future(self._scrub_loop()))
+        # periodic duties ride the scheduled executor
+        # (parity: curvine-common/src/executor/ ScheduledExecutor)
+        wc = self.conf.worker
+        self.executor.submit_periodic("heartbeat", self.heartbeat_once,
+                                      wc.heartbeat_ms / 1000,
+                                      initial_delay_s=0.0)
+        self.executor.submit_periodic("block-report", self.block_report_once,
+                                      wc.block_report_interval_ms / 1000)
+        self.executor.submit_periodic("eviction", self._evict_once, 1.0)
+        self.executor.submit_periodic("scrub", self._scrub_once, 60.0)
         log.info("worker %d started at %s", self.worker_id, self.addr)
 
     async def stop(self) -> None:
+        await self.executor.stop()
         for t in self._bg:
             t.cancel()
         self._bg.clear()
@@ -130,48 +139,20 @@ class WorkerServer:
         for bid in (unpack(rep.data) or {}).get("delete_blocks", []):
             self.store.delete(bid)
 
-    async def _heartbeat_loop(self) -> None:
-        interval = self.conf.worker.heartbeat_ms / 1000
-        while True:
-            try:
-                await self.heartbeat_once()
-            except Exception as e:
-                log.warning("heartbeat failed: %s", e)
-            await asyncio.sleep(interval)
+    async def _evict_once(self) -> None:
+        evicted = await asyncio.to_thread(self.store.maybe_evict)
+        if evicted:
+            self.metrics.inc("blocks.evicted", len(evicted))
 
-    async def _report_loop(self) -> None:
-        interval = self.conf.worker.block_report_interval_ms / 1000
-        while True:
-            await asyncio.sleep(interval)
-            try:
-                await self.block_report_once()
-            except Exception as e:
-                log.warning("block report failed: %s", e)
-
-    async def _eviction_loop(self) -> None:
-        while True:
-            await asyncio.sleep(1.0)
-            try:
-                evicted = await asyncio.to_thread(self.store.maybe_evict)
-                if evicted:
-                    self.metrics.inc("blocks.evicted", len(evicted))
-            except Exception:
-                log.exception("eviction loop")
-
-    async def _scrub_loop(self, interval_s: float = 60.0) -> None:
-        """Periodic checksum scrub; corrupt blocks get dropped and the
-        master is told so re-replication can heal them."""
-        while True:
-            await asyncio.sleep(interval_s)
-            try:
-                corrupt = await asyncio.to_thread(self.store.scrub)
-                if corrupt:
-                    self.metrics.inc("blocks.corrupt", len(corrupt))
-                    mc = await self._master_conn()
-                    await mc.call(RpcCode.REPORT_UNDER_REPLICATED_BLOCKS,
-                                  data=pack({"block_ids": corrupt}))
-            except Exception:
-                log.exception("scrub loop")
+    async def _scrub_once(self) -> None:
+        """Checksum scrub; corrupt blocks get dropped and the master is
+        told so re-replication can heal them."""
+        corrupt = await asyncio.to_thread(self.store.scrub)
+        if corrupt:
+            self.metrics.inc("blocks.corrupt", len(corrupt))
+            mc = await self._master_conn()
+            await mc.call(RpcCode.REPORT_UNDER_REPLICATED_BLOCKS,
+                          data=pack({"block_ids": corrupt}))
 
     # ---------------- handlers ----------------
 

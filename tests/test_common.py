@@ -204,3 +204,35 @@ def test_journal_snapshot_interval(tmp_path):
     fs2.recover()
     for i in range(25):
         assert fs2.tree.resolve(f"/snapdir/d{i}") is not None
+
+
+# ---------------- scheduled executor ----------------
+
+def test_scheduled_executor_periodic_and_cancel():
+    import asyncio
+    from curvine_tpu.common.executor import ScheduledExecutor
+
+    async def main():
+        ex = ScheduledExecutor("t")
+        hits = []
+        ex.submit_periodic("tick", lambda: hits.append(1), 0.02,
+                           initial_delay_s=0.0)
+        fails = []
+        def boom():
+            fails.append(1)
+            raise RuntimeError("tick error must not kill the schedule")
+        ex.submit_periodic("boom", boom, 0.02, initial_delay_s=0.0)
+        ex.submit_delayed("later", lambda: hits.append("late"), 0.05)
+        await asyncio.sleep(0.2)
+        assert len(hits) >= 3
+        assert "late" in hits
+        assert len(fails) >= 3              # kept running through errors
+        assert ex.errors["boom"] >= 3
+        ex.cancel("tick")
+        n = len(hits)
+        await asyncio.sleep(0.06)
+        assert [h for h in hits[n:] if h == 1] == []
+        await ex.stop()
+        assert ex.names() == []
+
+    asyncio.run(main())

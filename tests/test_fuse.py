@@ -263,3 +263,53 @@ async def test_create_excl_and_trunc_semantics():
         await fs.op_flush(hdr(abi.Op.FLUSH),
                           memoryview(abi.FLUSH_IN.pack(fh, 0, 0, 0)))
         assert await c.meta.exists("/new.txt")
+
+
+@pytest.mark.skipif(not FUSE_AVAILABLE, reason="no /dev/fuse")
+def test_real_mount_shell_write_patterns(tmp_path):
+    """Shell redirection (`echo > f`) sends FLUSH before the first WRITE
+    (dup2+close), and `>>` re-opens a just-closed file racing its async
+    RELEASE. Both must work: FLUSH is a durability point, not stream end
+    (parity: curvine-fuse fuse_writer.rs WriteTask::Flush vs ::Complete)."""
+    import subprocess
+    from curvine_tpu.fuse.mount import fusermount_mount, fusermount_umount
+    from curvine_tpu.fuse.ops import CurvineFuseFs
+    from curvine_tpu.fuse.session import FuseSession
+
+    mnt = str(tmp_path / "mnt")
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    mc = MiniCluster(workers=1)
+    asyncio.run_coroutine_threadsafe(mc.start(), loop).result(30)
+    session = None
+    try:
+        client = asyncio.run_coroutine_threadsafe(
+            asyncio.sleep(0, result=mc.client()), loop).result(10)
+        fd = fusermount_mount(mnt)
+        fs = CurvineFuseFs(client, uid=os.getuid(), gid=os.getgid())
+        session = FuseSession(fs, fd)
+        asyncio.run_coroutine_threadsafe(session.run(), loop)
+
+        def sh(cmd):
+            r = subprocess.run(["/bin/bash", "-c", cmd],
+                               capture_output=True, text=True)
+            assert r.returncode == 0, f"{cmd!r}: {r.stderr}"
+            return r.stdout
+
+        sh(f"echo hello > {mnt}/s.txt")
+        assert sh(f"cat {mnt}/s.txt") == "hello\n"
+        sh(f"printf a > {mnt}/ab.txt && printf b >> {mnt}/ab.txt")
+        assert sh(f"cat {mnt}/ab.txt") == "ab"
+        sh(f"for i in 1 2 3; do echo line$i >> {mnt}/multi.txt; done")
+        assert sh(f"cat {mnt}/multi.txt") == "line1\nline2\nline3\n"
+        # overwrite an existing non-empty file via truncating redirect
+        sh(f"echo replaced > {mnt}/s.txt")
+        assert sh(f"cat {mnt}/s.txt") == "replaced\n"
+    finally:
+        fusermount_umount(mnt)
+        if session is not None:
+            session.stop()
+        asyncio.run_coroutine_threadsafe(mc.stop(), loop).result(30)
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(5)

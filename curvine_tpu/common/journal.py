@@ -4,9 +4,11 @@ Parity: curvine-server/src/master/journal/ (journal_writer, journal_loader,
 journal_system) and curvine-common/src/raft/storage/file/log_segment.rs.
 
 Entry frame on disk: ``[u32 len][u32 crc32][payload]`` where payload is
-msgpack ``[seq, op, args]``. Snapshots are msgpack blobs named
-``snapshot-<last_applied_seq>``; on recovery the newest valid snapshot is
-loaded and later segments are replayed. Torn tails are truncated."""
+msgpack ``[seq, op, args, term]`` (term = raft term the entry was written
+in; 0 in single-node mode — 3-element legacy entries read as term 0).
+Snapshots are msgpack blobs named ``snapshot-<last_applied_seq>``; on
+recovery the newest valid snapshot is loaded and later segments are
+replayed. Torn tails are truncated."""
 
 from __future__ import annotations
 
@@ -29,14 +31,35 @@ class Journal:
         self.fsync = fsync
         os.makedirs(self.dir, exist_ok=True)
         self.seq = 0                       # last written seq
+        self.term = 0                      # current raft term (stamped in)
+        self.last_term = 0                 # term of the last entry on disk
         self.last_snapshot_seq = 0         # set by recover()
         self._fh = None
         self._fh_size = 0
+        # seq -> term for recent entries (log-matching checks); bounded
+        self._terms: dict[int, int] = {}
+
+    def note_term(self, seq: int, term: int) -> None:
+        self._terms[seq] = term
+        if len(self._terms) > 16_384:
+            cutoff = seq - 8_192
+            self._terms = {s: t for s, t in self._terms.items()
+                           if s >= cutoff}
+
+    def term_of(self, seq: int) -> int | None:
+        """Term of entry ``seq`` if known (None past the retained window —
+        callers fall back to snapshot catch-up)."""
+        if seq == 0:
+            return 0
+        return self._terms.get(seq)
 
     # ---------- write ----------
-    def append(self, op: str, args: dict) -> int:
+    def append(self, op: str, args: dict, term: int | None = None) -> int:
         self.seq += 1
-        payload = msgpack.packb([self.seq, op, args], use_bin_type=True)
+        t = self.term if term is None else term
+        self.last_term = t
+        self.note_term(self.seq, t)
+        payload = msgpack.packb([self.seq, op, args, t], use_bin_type=True)
         frame = _ENTRY.pack(len(payload), zlib.crc32(payload)) + payload
         fh = self._writer()
         fh.write(frame)
@@ -65,8 +88,13 @@ class Journal:
     def write_snapshot(self, state: dict) -> str:
         path = os.path.join(self.dir, f"snapshot-{self.seq:020d}")
         tmp = path + ".tmp"
+        # envelope carries last_term: a node restarted right after a
+        # snapshot install must not revert its head term to 0 (it would
+        # grant votes to candidates with stale logs)
         with open(tmp, "wb") as f:
-            f.write(msgpack.packb(state, use_bin_type=True))
+            f.write(msgpack.packb({"__snap__": state,
+                                   "__last_term__": self.last_term},
+                                  use_bin_type=True))
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
@@ -121,6 +149,10 @@ class Journal:
             with open(path, "rb") as f:
                 snap_state = msgpack.unpackb(f.read(), raw=False,
                                              strict_map_key=False)
+            if isinstance(snap_state, dict) and "__snap__" in snap_state:
+                self.last_term = snap_state.get("__last_term__", 0)
+                self.note_term(snap_seq, self.last_term)
+                snap_state = snap_state["__snap__"]
         self.last_snapshot_seq = snap_seq
         entries = []
         last_seq = snap_seq
@@ -149,11 +181,15 @@ class Journal:
                 with open(path, "ab") as f:
                     f.truncate(off)
                 break
-            seq, op, args = msgpack.unpackb(payload, raw=False,
-                                            strict_map_key=False)
+            rec = msgpack.unpackb(payload, raw=False, strict_map_key=False)
+            seq, op, args = rec[0], rec[1], rec[2]
+            term = rec[3] if len(rec) > 3 else 0
             if seq > snap_seq:
-                out.append((seq, op, args))
-            last_seq = max(last_seq, seq)
+                out.append((seq, op, args, term))
+            self.note_term(seq, term)
+            if seq >= last_seq:
+                last_seq = seq
+                self.last_term = term
             off = end
         return last_seq
 

@@ -30,6 +30,9 @@ class BlockMap:
         self.locs: dict[int, dict[int, BlockLocation]] = {}
         # worker_id -> set of block ids (for loss handling)
         self.worker_blocks: dict[int, set[int]] = {}
+        # desired replica count cache: lets the periodic under-replication
+        # scan run on RAM instead of one KV point-get per located block
+        self.desired: dict[int, int] = {}
 
     def get(self, block_id: int) -> BlockMeta | None:
         durable = self.store.block_get(block_id)
@@ -50,10 +53,12 @@ class BlockMap:
         durable = self.store.block_get(block_id)
         if durable is None:
             self.store.block_put(block_id, length, inode_id, replicas)
+            self.desired[block_id] = replicas
         else:
             old_len, old_iid, old_rep = durable
             self.store.block_put(block_id, max(old_len, length),
                                  inode_id or old_iid, old_rep)
+            self.desired[block_id] = old_rep
         self.add_replica(block_id, worker_id, storage_type)
 
     def add_replica(self, block_id: int, worker_id: int,
@@ -67,6 +72,7 @@ class BlockMap:
         if meta is None:
             return None
         self.store.block_remove(block_id)
+        self.desired.pop(block_id, None)
         for wid in self.locs.pop(block_id, {}):
             self.worker_blocks.get(wid, set()).discard(block_id)
         return meta
@@ -87,9 +93,14 @@ class BlockMap:
         for bid, locs in self.locs.items():
             if not locs:
                 continue
-            meta = self.get(bid)
-            if meta is not None and len(locs) < meta.replicas:
-                out.append(meta)
+            d = self.desired.get(bid)
+            if d is None:
+                durable = self.store.block_get(bid)
+                d = self.desired[bid] = durable[2] if durable else 1
+            if len(locs) < d:
+                meta = self.get(bid)
+                if meta is not None:
+                    out.append(meta)
         return out
 
     def apply_report(self, worker_id: int, held: dict[int, int],
@@ -106,6 +117,7 @@ class BlockMap:
                 orphans.append(bid)
                 continue
             old_len, iid, rep = durable
+            self.desired[bid] = rep
             if length > old_len:
                 self.store.block_put(bid, length, iid, rep)
             st = StorageType(storage_types.get(bid, int(StorageType.MEM)))

@@ -79,7 +79,7 @@ class MasterFilesystem:
                 applied = snap_seq
                 self.store.commit_applied(applied)
             replayed = 0
-            for seq, op, args in entries:
+            for seq, op, args, _term in entries:
                 if seq <= applied:
                     continue
                 try:
@@ -98,7 +98,7 @@ class MasterFilesystem:
             return
         if snap is not None:
             self._load_snapshot(snap)
-        for _seq, op, args in entries:
+        for _seq, op, args, _term in entries:
             try:
                 self._apply(op, args)
             except err.CurvineError as e:
@@ -126,7 +126,7 @@ class MasterFilesystem:
                 if seq is not None:
                     self.store.commit_applied(seq)
             if seq is not None and self.on_mutation is not None:
-                self.on_mutation(seq, op, args)
+                self.on_mutation(seq, op, args, self.journal.last_term)
             raise
         if self._kv:
             self.store.commit_applied(
@@ -137,11 +137,42 @@ class MasterFilesystem:
             audit.log(op, str(args.get("path", args.get("src", ""))))
         if seq is not None:
             if self.on_mutation is not None:
-                self.on_mutation(seq, op, args)
+                self.on_mutation(seq, op, args, self.journal.last_term)
             self._entries_since_snapshot += 1
             if self._entries_since_snapshot >= self.snapshot_interval:
                 self.checkpoint()
         return result
+
+    def apply_replicated(self, seq: int, op: str, args: dict,
+                         term: int) -> None:
+        """Follower-side apply of a leader-streamed entry: journal first
+        (WAL), then apply, then commit the KV batch under the entry seq —
+        the same discipline as the leader's _log. ANY failure rolls back
+        the pending overlay (a partial apply must never ride the next
+        entry's atomic batch); applies are deterministic, so the leader
+        failed the same way."""
+        assert self.journal is not None
+        self.journal.append(op, args, term=term)
+        try:
+            self._apply(op, args)
+        except BaseException as e:
+            if self._kv:
+                self.store.rollback()
+            lvl = log.warning if isinstance(e, err.CurvineError) else log.error
+            lvl("follower apply %s failed: %s", op, e)
+        if self._kv:
+            self.store.commit_applied(seq)
+
+    def install_snapshot(self, state: dict, seq: int, last_term: int) -> None:
+        """Replace the whole state machine (HA catch-up / divergence heal)."""
+        self._load_snapshot(state)
+        if self._kv:
+            self.store.commit_applied(seq)
+        if self.journal is not None:
+            self.journal.seq = seq
+            self.journal.last_term = last_term
+            self.journal.note_term(seq, last_term)
+            self.journal.write_snapshot(state)
 
     def checkpoint(self) -> None:
         if self.journal is None:
@@ -230,6 +261,9 @@ class MasterFilesystem:
         if fn is None:
             raise err.InvalidArgument(f"unknown journal op {op!r}")
         return fn(**args)
+
+    def _apply_noop(self) -> None:
+        """Term-opening no-op (raft leader turnover)."""
 
     # ==================== namespace ops ====================
 

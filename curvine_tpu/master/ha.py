@@ -3,20 +3,29 @@
 Parity: curvine-common/src/raft/ (raft_node, raft_journal, snapshot/) —
 the reference replicates master metadata through the raft crate. This is
 a compact re-implementation over our RPC fabric with the same observable
-behavior: leader election (highest journal seq wins, majority votes,
-term-monotonic), journal-entry streaming to followers, snapshot catch-up
-for lagging peers, NOT_LEADER redirects that the client already follows.
+guarantees:
 
-Simplification vs full Raft (documented): the leader applies+journals
-locally before majority acknowledgment, so an acked write can be lost if
-the leader dies before any follower received it. The reference's raft
-commit rule closes that window; tightening this is tracked for a later
-round."""
+* leader election with persisted hard state (term + voted_for survive
+  restarts, so a node cannot double-vote in the same term);
+* log matching: every entry carries its term; AppendEntries carries the
+  predecessor's (seq, term) and followers reject mismatches, falling back
+  to a full snapshot install (which REPLACES follower state — the correct
+  recovery for a follower whose state machine already applied divergent
+  entries, since applies here are not undoable);
+* commit-after-majority: client-visible acks wait until the entry's seq
+  is replicated on a quorum (`wait_committed`), closing the acked-write-
+  loss window the round-1/2 design documented.
+
+The leader still applies locally before replicating (reference applies on
+commit; here applies are deterministic and a deposed leader's extra
+applied entries are healed by snapshot install from the new leader).
+"""
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import random
 
 import msgpack
@@ -34,7 +43,9 @@ FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
 class RaftLite:
     def __init__(self, node_id: int, peers: dict[int, str], fs,
                  rpc: RpcServer, election_timeout_ms: tuple[int, int] =
-                 (600, 1200), heartbeat_ms: int = 150):
+                 (600, 1200), heartbeat_ms: int = 150,
+                 state_dir: str | None = None,
+                 commit_timeout_s: float = 10.0):
         self.node_id = node_id
         self.peers = dict(peers)            # id -> addr (excluding self)
         self.fs = fs
@@ -45,13 +56,49 @@ class RaftLite:
         self.leader_id: int | None = None
         self.election_timeout = election_timeout_ms
         self.heartbeat_ms = heartbeat_ms
+        self.commit_timeout_s = commit_timeout_s
         self.pool = ConnectionPool(size=1, timeout_ms=2_000)
         self._last_heard = 0.0
         self._bg: list[asyncio.Task] = []
         self._repl_queues: dict[int, asyncio.Queue] = {}
+        # commit tracking (leader): follower id -> highest acked seq
+        self.match: dict[int, int] = {}
+        self.commit_seq = 0
+        self._commit_waiters: list[tuple[int, asyncio.Future]] = []
+        # persisted hard state (term, voted_for): raft_node.rs parity
+        self._state_path = os.path.join(
+            state_dir or (fs.journal.dir if fs.journal else "."),
+            "raft_hard_state")
+        self._load_hard_state()
         rpc.register(RpcCode.RAFT_VOTE, self._h_vote)
         rpc.register(RpcCode.RAFT_APPEND, self._h_append)
         rpc.register(RpcCode.RAFT_SNAPSHOT, self._h_snapshot)
+
+    # ---------------- hard state ----------------
+
+    def _load_hard_state(self) -> None:
+        try:
+            with open(self._state_path, "rb") as f:
+                d = msgpack.unpackb(f.read(), raw=False)
+            self.term = d.get("term", 0)
+            self.voted_for = d.get("voted_for")
+        except (FileNotFoundError, ValueError, msgpack.UnpackException):
+            pass
+        if self.fs.journal is not None:
+            self.fs.journal.term = self.term
+
+    def _save_hard_state(self) -> None:
+        """fsync'd before any vote/step-up takes effect: a restarted node
+        must never vote twice in one term or regress its term."""
+        tmp = self._state_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(msgpack.packb({"term": self.term,
+                                   "voted_for": self.voted_for}))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._state_path)
+        if self.fs.journal is not None:
+            self.fs.journal.term = self.term
 
     # ---------------- lifecycle ----------------
 
@@ -66,6 +113,9 @@ class RaftLite:
     def last_seq(self) -> int:
         return self.fs.journal.seq if self.fs.journal else 0
 
+    def last_term(self) -> int:
+        return self.fs.journal.last_term if self.fs.journal else 0
+
     async def start(self) -> None:
         self._touch()
         self._bg.append(asyncio.ensure_future(self._election_loop()))
@@ -74,6 +124,7 @@ class RaftLite:
         for t in self._bg:
             t.cancel()
         self._bg.clear()
+        self._fail_waiters(err.NotLeader("shutting down"))
         await self.pool.close()
 
     def _touch(self) -> None:
@@ -96,17 +147,19 @@ class RaftLite:
         self.role = CANDIDATE
         self.term += 1
         self.voted_for = self.node_id
+        self._save_hard_state()
         self.leader_id = None
         votes = 1
-        log.info("node %d: starting election term %d (last_seq=%d)",
-                 self.node_id, self.term, self.last_seq())
+        log.info("node %d: starting election term %d (last=%d/t%d)",
+                 self.node_id, self.term, self.last_seq(), self.last_term())
 
         async def ask(pid: int, addr: str) -> bool:
             try:
                 conn = await self.pool.get(addr)
                 rep = await conn.call(RpcCode.RAFT_VOTE, data=pack({
                     "term": self.term, "candidate": self.node_id,
-                    "last_seq": self.last_seq()}), timeout=1.0)
+                    "last_seq": self.last_seq(),
+                    "last_term": self.last_term()}), timeout=1.0)
                 body = unpack(rep.data) or {}
                 if body.get("term", 0) > self.term:
                     self._step_down(body["term"])
@@ -141,11 +194,13 @@ class RaftLite:
         if term > self.term:
             self.term = term
             self.voted_for = None
+            self._save_hard_state()
         if self.role == LEADER:
             log.info("node %d: stepping down in term %d", self.node_id, term)
             for t in self._bg[1:]:
                 t.cancel()
             del self._bg[1:]
+            self._fail_waiters(err.NotLeader("deposed"))
         self.role = FOLLOWER
         self._touch()
 
@@ -154,22 +209,83 @@ class RaftLite:
         self.role = LEADER
         self.leader_id = self.node_id
         self._repl_queues = {pid: asyncio.Queue() for pid in self.peers}
+        self.match = {pid: 0 for pid in self.peers}
+        self.commit_seq = self.last_seq() if not self.peers else 0
         for pid, addr in self.peers.items():
             self._bg.append(asyncio.ensure_future(
                 self._replicate_loop(pid, addr)))
+        if self.peers and self.fs.journal is not None:
+            # term-opening no-op (raft §5.4.2): gives the new term an entry
+            # that CAN be committed by counting, which transitively commits
+            # every prior-term entry beneath it
+            try:
+                self.fs._log("noop", {})
+            except err.CurvineError:
+                pass
+
+    # ---------------- commit tracking (leader) ----------------
+
+    def _advance_commit(self) -> None:
+        acked = sorted([self.last_seq()] + list(self.match.values()),
+                       reverse=True)
+        new_commit = acked[self.quorum - 1]
+        # Raft commit restriction: only entries of the CURRENT term may be
+        # committed by replica counting (figure-8 unsafety otherwise). The
+        # no-op appended at _become_leader makes this reachable right away;
+        # committing a current-term entry commits everything before it.
+        if new_commit > self.commit_seq:
+            t = (self.fs.journal.term_of(new_commit)
+                 if self.fs.journal else self.term)
+            if t != self.term:
+                return
+            self.commit_seq = new_commit
+            still = []
+            for seq, fut in self._commit_waiters:
+                if seq <= self.commit_seq:
+                    if not fut.done():
+                        fut.set_result(True)
+                else:
+                    still.append((seq, fut))
+            self._commit_waiters = still
+
+    def _fail_waiters(self, exc: Exception) -> None:
+        for _seq, fut in self._commit_waiters:
+            if not fut.done():
+                fut.set_exception(exc)
+        self._commit_waiters = []
+
+    async def wait_committed(self, seq: int | None = None) -> None:
+        """Block until ``seq`` (default: the journal head) is replicated
+        on a quorum. This is what makes a client ack mean 'durable on a
+        majority' (raft commit rule)."""
+        if not self.peers:
+            return
+        if self.role != LEADER:
+            raise err.NotLeader(f"node {self.node_id} is {self.role}")
+        seq = self.last_seq() if seq is None else seq
+        if seq <= self.commit_seq:
+            return
+        fut = asyncio.get_event_loop().create_future()
+        self._commit_waiters.append((seq, fut))
+        try:
+            await asyncio.wait_for(fut, self.commit_timeout_s)
+        except asyncio.TimeoutError:
+            raise err.RpcTimeout(
+                f"seq {seq} not committed on a quorum within "
+                f"{self.commit_timeout_s}s") from None
 
     # ---------------- replication (leader) ----------------
 
-    def on_mutation(self, seq: int, op: str, args: dict) -> None:
+    def on_mutation(self, seq: int, op: str, args: dict,
+                    term: int = 0) -> None:
         """Called by MasterFilesystem._log after a local apply+journal."""
         if self.role != LEADER:
             return
         for q in self._repl_queues.values():
-            q.put_nowait((seq, op, args))
+            q.put_nowait((seq, op, args, term))
 
     async def _replicate_loop(self, pid: int, addr: str) -> None:
         """Per-follower: heartbeats + journal entry stream + catch-up."""
-        follower_seq = -1     # unknown until first ack
         while self.role == LEADER:
             batch: list = []
             q = self._repl_queues[pid]
@@ -183,17 +299,35 @@ class RaftLite:
                 pass          # heartbeat
             try:
                 conn = await self.pool.get(addr)
+                prev_seq = batch[0][0] - 1 if batch else self.last_seq()
+                prev_term = (self.fs.journal.term_of(prev_seq)
+                             if self.fs.journal else 0)
+                if prev_term is None:
+                    # predecessor term fell out of the retained window:
+                    # can't prove log matching — snapshot catch-up instead
+                    await self._send_snapshot(pid, addr)
+                    for entry in batch:
+                        q.put_nowait(entry)
+                    continue
                 rep = await conn.call(RpcCode.RAFT_APPEND, data=pack({
                     "term": self.term, "leader": self.node_id,
-                    "entries": [[s, o, a] for s, o, a in batch],
-                    "leader_seq": self.last_seq()}), timeout=2.0)
+                    "entries": [[s, o, a, t] for s, o, a, t in batch],
+                    "prev_seq": prev_seq, "prev_term": prev_term,
+                    "leader_seq": self.last_seq(),
+                    "leader_last_term": self.last_term(),
+                    "commit_seq": self.commit_seq}), timeout=2.0)
                 body = unpack(rep.data) or {}
                 if body.get("term", 0) > self.term:
                     self._step_down(body["term"])
                     return
-                follower_seq = body.get("applied_seq", follower_seq)
                 if body.get("need_snapshot"):
-                    await self._send_snapshot(addr)
+                    # divergent/lagging log: its applied_seq must NOT
+                    # count toward commit (same seq, different history)
+                    await self._send_snapshot(pid, addr)
+                else:
+                    self.match[pid] = max(self.match.get(pid, 0),
+                                          body.get("applied_seq", 0))
+                    self._advance_commit()
             except Exception as e:
                 log.debug("replicate to %d failed: %s", pid, e)
                 # don't lose the batch: requeue it for the next round
@@ -202,27 +336,34 @@ class RaftLite:
                     q.put_nowait(entry)
                 await asyncio.sleep(0.2)
 
-    async def _send_snapshot(self, addr: str) -> None:
+    async def _send_snapshot(self, pid: int, addr: str) -> None:
         state = self.fs._snapshot_state()
         conn = await self.pool.get(addr)
-        await conn.call(RpcCode.RAFT_SNAPSHOT, data=msgpack.packb({
+        rep = await conn.call(RpcCode.RAFT_SNAPSHOT, data=msgpack.packb({
             "term": self.term, "leader": self.node_id,
-            "seq": self.last_seq(), "state": state}, use_bin_type=True),
+            "seq": self.last_seq(), "last_term": self.last_term(),
+            "state": state}, use_bin_type=True),
             timeout=30.0)
+        body = unpack(rep.data) or {}
+        self.match[pid] = max(self.match.get(pid, 0),
+                              body.get("applied_seq", 0))
+        self._advance_commit()
         log.info("snapshot (seq=%d) sent to %s", self.last_seq(), addr)
 
     # ---------------- handlers (follower) ----------------
 
     async def _h_vote(self, msg: Message, conn: ServerConn):
         q = unpack(msg.data) or {}
-        term, candidate, last_seq = q["term"], q["candidate"], q["last_seq"]
+        term, candidate = q["term"], q["candidate"]
+        cand_log = (q.get("last_term", 0), q["last_seq"])
         if term > self.term:
             self._step_down(term)
         granted = (term >= self.term
                    and self.voted_for in (None, candidate)
-                   and last_seq >= self.last_seq())
+                   and cand_log >= (self.last_term(), self.last_seq()))
         if granted:
             self.voted_for = candidate
+            self._save_hard_state()       # fsync BEFORE the vote leaves
             self._touch()
         return {}, pack({"granted": granted, "term": self.term})
 
@@ -236,20 +377,38 @@ class RaftLite:
         self.leader_id = q["leader"]
         self._touch()
         need_snapshot = False
-        for seq, op, args in q.get("entries", []):
+        entries = q.get("entries", [])
+        if entries:
+            # log-matching: our entry at prev_seq must carry prev_term —
+            # a deposed leader with divergent history at the same seqs
+            # fails this and heals via snapshot install
+            prev_seq = q.get("prev_seq", entries[0][0] - 1)
+            if prev_seq <= self.last_seq():
+                ours = (self.fs.journal.term_of(prev_seq)
+                        if self.fs.journal else 0)
+                if ours is None or ours != q.get("prev_term", 0):
+                    need_snapshot = True
+        for rec in ([] if need_snapshot else entries):
+            seq, op, args = rec[0], rec[1], rec[2]
+            eterm = rec[3] if len(rec) > 3 else 0
             if seq <= self.last_seq():
                 continue                      # already have it
             if seq != self.last_seq() + 1:
                 need_snapshot = True          # gap: ask for catch-up
                 break
-            try:
-                self.fs._apply(op, args)
-            except err.CurvineError as e:
-                log.warning("follower apply %s failed: %s", op, e)
-            if self.fs.journal:
-                self.fs.journal.append(op, args)
-        if not need_snapshot and q.get("leader_seq", 0) > self.last_seq():
-            need_snapshot = True
+            self.fs.apply_replicated(seq, op, args, eterm)
+        # log-matching check: same head seq must mean same head term; a
+        # follower that diverged (e.g. deposed leader with extra applied
+        # entries, or a different term at the same seq) takes a snapshot
+        # install, which REPLACES its state machine wholesale.
+        if not need_snapshot:
+            if q.get("leader_seq", 0) > self.last_seq():
+                need_snapshot = True
+            elif self.last_seq() > q.get("leader_seq", 0):
+                need_snapshot = True          # we have entries leader lacks
+            elif (q.get("leader_seq", 0) == self.last_seq()
+                  and q.get("leader_last_term", 0) != self.last_term()):
+                need_snapshot = True
         return {}, pack({"term": self.term, "applied_seq": self.last_seq(),
                          "need_snapshot": need_snapshot})
 
@@ -258,10 +417,8 @@ class RaftLite:
         if q["term"] < self.term:
             return {}, pack({"term": self.term})
         self._touch()
-        self.fs._load_snapshot(q["state"])
-        if self.fs.journal:
-            self.fs.journal.seq = q["seq"]
-            self.fs.journal.write_snapshot(q["state"])
+        self.fs.install_snapshot(q["state"], q["seq"],
+                                 q.get("last_term", 0))
         log.info("node %d: installed snapshot at seq %d", self.node_id,
                  q["seq"])
         return {}, pack({"term": self.term, "applied_seq": self.last_seq()})

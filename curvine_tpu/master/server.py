@@ -191,12 +191,22 @@ class MasterServer:
                         if cached is not None:
                             return {}, cached
                         rep = await call(req)
+                        await self._commit_barrier()
                         data = pack(rep)
                         self.retry_cache.put(key, data)
                         return {}, data
                 rep = await call(req)
+                if mutate:
+                    await self._commit_barrier()
             return {}, pack(rep)
         return handler
+
+    async def _commit_barrier(self) -> None:
+        """Raft commit rule: a mutation is acked to the client only after
+        its journal entry is replicated on a quorum (closes the acked-
+        write-loss window of the round-1 design)."""
+        if self.raft is not None:
+            await self.raft.wait_committed(self.fs.journal.seq)
 
     # --- fs ---
     def _mkdir(self, q):

@@ -104,7 +104,7 @@ def test_journal_replay(tmp_path):
     j2 = Journal(str(tmp_path / "j"))
     snap, entries = j2.recover()
     assert snap is None
-    assert [a["i"] for _, _, a in entries] == list(range(10))
+    assert [a["i"] for _, _, a, _ in entries] == list(range(10))
     assert j2.seq == 10
     # continue appending, snapshot, more entries
     j2.append("op", {"i": 10})
@@ -115,7 +115,7 @@ def test_journal_replay(tmp_path):
     j3 = Journal(str(tmp_path / "j"))
     snap, entries = j3.recover()
     assert snap == {"state": "s11"}
-    assert [a["i"] for _, _, a in entries] == [11]
+    assert [a["i"] for _, _, a, _ in entries] == [11]
 
 
 def test_journal_torn_tail(tmp_path):
@@ -131,7 +131,7 @@ def test_journal_torn_tail(tmp_path):
         f.truncate(size - 3)
     j2 = Journal(str(tmp_path / "j"))
     _, entries = j2.recover()
-    assert [a["i"] for _, _, a in entries] == [0]
+    assert [a["i"] for _, _, a, _ in entries] == [0]
 
 
 def test_metrics():

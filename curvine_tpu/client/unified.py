@@ -53,7 +53,8 @@ class CurvineClient:
         return FsWriter(self.meta, path, self.pool,
                         block_size=block_size or cc.block_size,
                         chunk_size=cc.write_chunk_size, storage_type=st,
-                        ici_coords=list(self.conf.worker.ici_coords) or None)
+                        ici_coords=list(self.conf.worker.ici_coords) or None,
+                        short_circuit=cc.short_circuit)
 
     async def append(self, path: str) -> FsWriter:
         fb = await self.meta.append_file(path)
@@ -61,7 +62,8 @@ class CurvineClient:
         w = FsWriter(self.meta, path, self.pool,
                      block_size=fb.status.block_size,
                      chunk_size=cc.write_chunk_size,
-                     storage_type=_TIERS.get(cc.storage_type, StorageType.MEM))
+                     storage_type=_TIERS.get(cc.storage_type, StorageType.MEM),
+                     short_circuit=cc.short_circuit)
         w.pos = fb.status.len
         return w
 

@@ -9,21 +9,27 @@ pipeline; with cache-tier replication ≤3 fan-out is equivalent)."""
 from __future__ import annotations
 
 import logging
+import os
 import zlib
 
 from curvine_tpu.common import errors as err
 from curvine_tpu.common.types import CommitBlock, LocatedBlock, StorageType
 from curvine_tpu.rpc import RpcCode
 from curvine_tpu.rpc.client import ConnectionPool
+from curvine_tpu.rpc.frame import pack
 
 log = logging.getLogger(__name__)
+
+# thread-offloaded hashing only pays when there is a core to overlap with
+_OFFLOAD = (os.cpu_count() or 1) > 1
 
 
 class FsWriter:
     def __init__(self, fs_client, path: str, pool: ConnectionPool,
                  block_size: int, chunk_size: int = 512 * 1024,
                  storage_type: StorageType = StorageType.MEM,
-                 ici_coords: list[int] | None = None):
+                 ici_coords: list[int] | None = None,
+                 short_circuit: bool = True):
         self.fs = fs_client
         self.path = path
         self.pool = pool
@@ -31,6 +37,7 @@ class FsWriter:
         self.chunk_size = chunk_size
         self.storage_type = storage_type
         self.ici_coords = ici_coords
+        self.short_circuit = short_circuit
         self.pos = 0
         self._buf = bytearray()
         self._block: LocatedBlock | None = None
@@ -39,6 +46,10 @@ class FsWriter:
         self._block_crc = 0
         self._commits: list[CommitBlock] = []
         self._closed = False
+        # short-circuit local write state (co-located single-replica)
+        self._sc_file = None
+        self._sc_conn = None
+        self._sc_worker_id = 0
 
     async def write(self, data: bytes | memoryview) -> int:
         if self._closed:
@@ -78,13 +89,30 @@ class FsWriter:
 
     async def _send_chunk(self, chunk) -> None:
         import asyncio
-        self._block_crc = zlib.crc32(chunk, self._block_crc)
+        if self._sc_file is not None:
+            # short-circuit: hash + write straight into the worker's temp
+            # block file — one hash pass, no socket copies
+            self._block_crc = zlib.crc32(chunk, self._block_crc)
+            self._sc_file.write(chunk)
+            self._block_written += len(chunk)
+            return
+        # multi-core: CRC in a worker thread (zlib releases the GIL),
+        # overlapped with the socket send; the chain stays ordered because
+        # we await the crc before returning. Single core: inline.
+        crc_task = None
+        if _OFFLOAD and len(chunk) >= 256 * 1024:
+            crc_task = asyncio.get_running_loop().run_in_executor(
+                None, zlib.crc32, chunk, self._block_crc)
+        else:
+            self._block_crc = zlib.crc32(chunk, self._block_crc)
         if len(self._uploads) == 1:
             await self._uploads[0].send_chunk(chunk)
         else:
             # replica fan-out in parallel, not serially
             await asyncio.gather(*(up.send_chunk(chunk)
                                    for up in self._uploads))
+        if crc_task is not None:
+            self._block_crc = await crc_task
         self._block_written += len(chunk)
 
     async def _next_block(self) -> None:
@@ -93,7 +121,13 @@ class FsWriter:
             ici_coords=self.ici_coords)
         if not self._block.locs:
             raise err.NoAvailableWorker(f"no locations for {self.path}")
+        self._block_written = 0
+        self._block_crc = 0
         self._uploads = []
+        self._sc_file = None
+        if self.short_circuit and len(self._block.locs) == 1:
+            if await self._try_short_circuit(self._block.locs[0]):
+                return
         for loc in self._block.locs:
             conn = await self.pool.get(
                 f"{loc.ip_addr or loc.hostname}:{loc.rpc_port}")
@@ -102,8 +136,33 @@ class FsWriter:
                 "storage_type": int(self.storage_type),
                 "len_hint": self.block_size})
             self._uploads.append(up)
-        self._block_written = 0
-        self._block_crc = 0
+
+    async def _try_short_circuit(self, loc) -> bool:
+        """Co-located single-replica block: get a temp-file grant from the
+        worker and write it directly — no socket copies, one hash pass.
+        Parity: the write-direction twin of the reader's fd short circuit."""
+        from curvine_tpu.rpc.frame import unpack
+        if not (self.fs.client_host in (loc.hostname, loc.ip_addr)
+                or loc.ip_addr in ("127.0.0.1", "localhost")):
+            return False
+        try:
+            conn = await self.pool.get(
+                f"{loc.ip_addr or loc.hostname}:{loc.rpc_port}")
+            rep = await conn.call(RpcCode.SC_WRITE_OPEN, data=pack({
+                "block_id": self._block.block.id,
+                "storage_type": int(self.storage_type),
+                "len_hint": self.block_size}))
+            body = unpack(rep.data) or {}
+            path = body.get("path")
+            if not path:
+                return False
+            self._sc_file = open(path, "wb")
+            self._sc_conn = conn
+            self._sc_worker_id = body.get("worker_id", loc.worker_id)
+            return True
+        except (err.CurvineError, OSError) as e:
+            log.debug("short-circuit write probe failed: %s", e)
+            return False
 
     async def _flush_chunk(self, n: int | None = None) -> None:
         n = len(self._buf) if n is None else min(n, len(self._buf))
@@ -117,10 +176,19 @@ class FsWriter:
         if self._block is None:
             return
         await self._flush_chunk(None)
-        worker_ids = []
-        for up, loc in zip(self._uploads, self._block.locs):
-            ack = await up.finish(header={"crc32": self._block_crc})
-            worker_ids.append(ack.header.get("worker_id", loc.worker_id))
+        if self._sc_file is not None:
+            self._sc_file.close()
+            self._sc_file = None
+            await self._sc_conn.call(RpcCode.SC_WRITE_COMMIT, data=pack({
+                "block_id": self._block.block.id,
+                "len": self._block_written,
+                "crc32": self._block_crc, "algo": "crc32"}))
+            worker_ids = [self._sc_worker_id]
+        else:
+            worker_ids = []
+            for up, loc in zip(self._uploads, self._block.locs):
+                ack = await up.finish(header={"crc32": self._block_crc})
+                worker_ids.append(ack.header.get("worker_id", loc.worker_id))
         self._commits.append(CommitBlock(
             block_id=self._block.block.id, block_len=self._block_written,
             worker_ids=worker_ids, storage_type=self.storage_type))
@@ -156,6 +224,16 @@ class FsWriter:
         self._closed = True
 
     async def abort(self) -> None:
+        if self._sc_file is not None:
+            self._sc_file.close()
+            self._sc_file = None
+            if self._block is not None and self._sc_conn is not None:
+                try:
+                    await self._sc_conn.call(
+                        RpcCode.SC_WRITE_ABORT,
+                        data=pack({"block_id": self._block.block.id}))
+                except err.CurvineError:
+                    pass
         for up in self._uploads:
             await up.abort()
         self._closed = True

@@ -113,6 +113,16 @@ class Journal:
             if end <= before_seq and start_seq <= before_seq and nexts:
                 os.unlink(p)
 
+    def reset_log(self) -> None:
+        """Drop ALL log segments. Snapshot-install path: the on-disk
+        entries may belong to a divergent history that the installed
+        snapshot supersedes — leaving them would replay stale entries
+        after the next restart."""
+        self._roll()
+        for _seq, p in self._list("edits-"):
+            os.unlink(p)
+        self._terms.clear()
+
     def gc_covered(self, applied_seq: int) -> None:
         """Drop closed segments whose entries are all <= applied_seq
         (KV-backed mode: the store is the checkpoint, no snapshot file).

@@ -155,11 +155,18 @@ class MasterFilesystem:
         self.journal.append(op, args, term=term)
         try:
             self._apply(op, args)
-        except BaseException as e:
+        except Exception as e:
+            # deterministic failures only: the leader failed identically.
+            # CancelledError propagates — a cancelled handler must NOT
+            # mark the entry applied (the journal has it; restart replays)
             if self._kv:
                 self.store.rollback()
             lvl = log.warning if isinstance(e, err.CurvineError) else log.error
             lvl("follower apply %s failed: %s", op, e)
+        except BaseException:
+            if self._kv:
+                self.store.rollback()
+            raise
         if self._kv:
             self.store.commit_applied(seq)
 
@@ -169,6 +176,9 @@ class MasterFilesystem:
         if self._kv:
             self.store.commit_applied(seq)
         if self.journal is not None:
+            # stale on-disk entries (possibly from a divergent history)
+            # must not survive to be replayed after a restart
+            self.journal.reset_log()
             self.journal.seq = seq
             self.journal.last_term = last_term
             self.journal.note_term(seq, last_term)

@@ -314,8 +314,7 @@ class RaftLite:
                     "entries": [[s, o, a, t] for s, o, a, t in batch],
                     "prev_seq": prev_seq, "prev_term": prev_term,
                     "leader_seq": self.last_seq(),
-                    "leader_last_term": self.last_term(),
-                    "commit_seq": self.commit_seq}), timeout=2.0)
+                    "leader_last_term": self.last_term()}), timeout=2.0)
                 body = unpack(rep.data) or {}
                 if body.get("term", 0) > self.term:
                     self._step_down(body["term"])
@@ -330,9 +329,15 @@ class RaftLite:
                     self._advance_commit()
             except Exception as e:
                 log.debug("replicate to %d failed: %s", pid, e)
-                # don't lose the batch: requeue it for the next round
-                # (followers dedupe by seq)
-                for entry in batch:
+                # requeue the batch IN SEQ ORDER ahead of anything enqueued
+                # meanwhile — tail-requeueing would make the next batch
+                # start past the follower's head and escalate a transient
+                # blip into a full snapshot install
+                pending = list(batch)
+                while not q.empty():
+                    pending.append(q.get_nowait())
+                pending.sort(key=lambda entry: entry[0])
+                for entry in pending:
                     q.put_nowait(entry)
                 await asyncio.sleep(0.2)
 

@@ -69,6 +69,11 @@ class RpcCode(enum.IntEnum):
     WRITE_COMMITS_BATCH = 83
     DELETE_BLOCK = 84
     GET_BLOCK_INFO = 85
+    # short-circuit local writes: co-located client writes the block file
+    # directly (one hash pass, no socket), then registers it
+    SC_WRITE_OPEN = 86
+    SC_WRITE_COMMIT = 87
+    SC_WRITE_ABORT = 88
 
     # raft-lite (master HA journal replication)
     RAFT_VOTE = 90

@@ -43,24 +43,46 @@ async def save_checkpoint(client: CurvineClient, path: str,
     await client.write_all(f"{path}/treedef.pkl", pickle.dumps(treedef))
 
 
-async def load_checkpoint(client: CurvineClient, path: str) -> dict:
-    """Read tensors back host-side (short-circuit mmap when co-located)."""
+async def load_checkpoint(client: CurvineClient, path: str,
+                          placer=None) -> dict:
+    """Read tensors back (short-circuit mmap when co-located). Tensor
+    fetches run CONCURRENTLY, and when ``placer`` is given (an arr→jax
+    transfer fn), each tensor's host→device transfer is dispatched as
+    soon as its bytes land — cache reads overlap device transfers instead
+    of the round-2 read-everything-then-transfer-everything sequence."""
+    import asyncio
     import pickle
-    manifest = json.loads(await (await client.open(f"{path}/manifest.json")
-                                 ).read_all())
-    treedef = pickle.loads(await (await client.open(f"{path}/treedef.pkl")
-                                  ).read_all())
-    flat = []
-    for t in manifest:
+    manifest_t = asyncio.ensure_future(
+        _read_all(client, f"{path}/manifest.json"))
+    treedef_t = asyncio.ensure_future(_read_all(client, f"{path}/treedef.pkl"))
+    manifest = json.loads(await manifest_t)
+    treedef = pickle.loads(await treedef_t)
+
+    async def load_one(t):
         reader = await client.open(f"{path}/{t['name']}")
-        nbytes = reader.len
-        view = await reader.mmap_view(0, nbytes)
+        view = await reader.mmap_view(0, reader.len)
         if view is None:
             view = np.frombuffer(await reader.read_all(), dtype=np.uint8)
         arr = view.view(np.dtype(t["dtype"])).reshape(t["shape"])
-        flat.append(np.array(arr))    # own the memory past reader close
+        if placer is not None:
+            out = placer(arr)         # async dispatch; device copies now
+        else:
+            out = np.array(arr)       # own the memory past reader close
         await reader.close()
+        return out
+
+    flat = await asyncio.gather(*(load_one(t) for t in manifest))
+    if placer is not None:
+        flat = [jax.block_until_ready(a) for a in flat]
     return jax.tree.unflatten(treedef, flat)
+
+
+async def _read_all(client: CurvineClient, path: str) -> bytes:
+    reader = await client.open(path)
+    try:
+        return await reader.read_all()
+    finally:
+        await reader.close()
 
 
 def broadcast_params(params, mesh: Mesh, spec_tree=None):
@@ -77,6 +99,23 @@ def broadcast_params(params, mesh: Mesh, spec_tree=None):
 
 async def distribute_checkpoint(client: CurvineClient, path: str,
                                 mesh: Mesh, spec_tree=None):
-    """cache → host → pod in one call; returns device-resident params."""
+    """cache → pod in one overlapped pass: each tensor is dispatched to
+    its mesh placement the moment its cache read completes (replicated
+    when spec_tree is None, else directly in its TP layout). spec_tree
+    placement for named leaves is resolved after unflatten, so the fast
+    overlapped path is used for the replicated (model-distribution)
+    case."""
+    if spec_tree is None:
+        sharding = NamedSharding(mesh, P())
+        return await load_checkpoint(
+            client, path, placer=lambda a: jax.device_put(a, sharding))
     host = await load_checkpoint(client, path)
     return broadcast_params(host, mesh, spec_tree)
+
+
+async def distribute_checkpoint_to_device(client: CurvineClient, path: str,
+                                          device):
+    """Single-chip variant: overlapped cache→HBM transfer of a whole
+    checkpoint onto one device."""
+    return await load_checkpoint(
+        client, path, placer=lambda a: jax.device_put(a, device))

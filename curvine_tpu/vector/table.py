@@ -22,6 +22,29 @@ from curvine_tpu.common import errors as err
 
 _DTYPES = {"f32": np.float32, "i32": np.int32, "i64": np.int64}
 
+_SCAN_FNS: dict = {}
+
+
+def _scan_fn(metric: str, k: int):
+    """Jitted [Q,D]×[D,N] scan+top_k, cached per (metric, k) — a jit
+    defined per call would recompile every time."""
+    fn = _SCAN_FNS.get((metric, k))
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+
+        def scan_knn(q, v):
+            if metric == "cosine":
+                qn = q / jnp.linalg.norm(q, axis=1, keepdims=True).clip(1e-12)
+                scores = qn @ v.T
+            else:
+                scores = -(jnp.sum(q * q, 1)[:, None]
+                           - 2 * q @ v.T + jnp.sum(v * v, 1)[None, :])
+            return jax.lax.top_k(scores, min(k, scores.shape[1]))
+
+        fn = _SCAN_FNS[(metric, k)] = jax.jit(scan_knn)
+    return fn
+
 
 class VectorTable:
     def __init__(self, client: CurvineClient, path: str, dim: int,
@@ -31,6 +54,10 @@ class VectorTable:
         self.dim = dim
         self.columns = columns
         self.row_groups = row_groups
+        # device-resident scan cache: the table's vectors pinned in HBM
+        # (normalized per metric), so repeated scans run at MXU speed
+        # instead of re-streaming host->device every call
+        self._dev_cache: dict = {}
 
     # ---------------- lifecycle ----------------
 
@@ -81,6 +108,7 @@ class VectorTable:
         await self.client.write_all(f"{self.path}/rg-{rg:05d}.vec",
                                     b"".join(parts))
         self.row_groups += 1
+        self._dev_cache.clear()
         await self._write_schema()
         return rg
 
@@ -115,50 +143,57 @@ class VectorTable:
 
     # ---------------- TPU knn ----------------
 
-    async def knn(self, query: np.ndarray, k: int = 10,
-                  metric: str = "cosine", device=None):
-        """Top-k nearest rows to `query` [D] or [Q, D]. The scan is a
-        single [Q, D] × [D, N] matmul per row group on the device (MXU),
-        with partial top-k merged across groups."""
+    async def _device_vectors(self, metric: str, device):
+        """All row groups as ONE device-resident [N, D] array (normalized
+        for cosine), pinned across calls — the table lives in HBM like an
+        HBM-tier block, and the scan is a single MXU matmul. Row groups
+        are fetched concurrently (prefetch) on a cache miss."""
+        import asyncio
         import jax
         import jax.numpy as jnp
 
+        key = (metric, getattr(device, "id", device), self.row_groups)
+        hit = self._dev_cache.get(key)
+        if hit is not None:
+            return hit
+        if self.row_groups == 0:
+            raise err.FileNotFound(f"table {self.path} is empty")
+        groups = await asyncio.gather(
+            *(self.read_group(rg) for rg in range(self.row_groups)))
+        host = (np.concatenate([v for v, _ in groups], axis=0)
+                if len(groups) > 1 else groups[0][0])
+        v = jax.device_put(host, device)
+        if metric == "cosine":
+            v = v / jnp.linalg.norm(v, axis=1, keepdims=True).clip(1e-12)
+        v = jax.block_until_ready(v)
+        self._dev_cache = {key: v}          # one resident copy per table
+        return v
+
+    async def knn(self, query: np.ndarray, k: int = 10,
+                  metric: str = "cosine", device=None,
+                  materialize: bool = True):
+        """Top-k nearest rows to `query` [D] or [Q, D]: ONE [Q, D]×[D, N]
+        matmul + top_k on the device over the pinned table — no per-group
+        host loop, no re-streaming (the round-2 per-group await+device_put
+        pattern benched at Python speed, not MXU speed).
+
+        materialize=False returns device arrays without forcing a
+        device→host sync — callers issuing a stream of scans can pipeline
+        dispatches and block once (remote-dispatch RTT amortizes)."""
+        import jax
+
+        if metric not in ("cosine", "l2"):
+            raise err.InvalidArgument(f"metric {metric!r}")
         query = np.atleast_2d(np.asarray(query, dtype=np.float32))
         if query.shape[1] != self.dim:
             raise err.InvalidArgument(f"query dim {query.shape[1]} != {self.dim}")
         dev = device if device is not None else jax.devices()[0]
+        v = await self._device_vectors(metric, dev)
         q = jax.device_put(query, dev)
-        if metric == "cosine":
-            q = q / jnp.linalg.norm(q, axis=1, keepdims=True).clip(1e-12)
-
-        best_scores = None
-        best_ids = None
-        row_base = 0
-        async for vectors, _cols in self.scan():
-            v = jax.device_put(vectors, dev)
-            if metric == "cosine":
-                v = v / jnp.linalg.norm(v, axis=1, keepdims=True).clip(1e-12)
-                scores = q @ v.T
-            elif metric == "l2":
-                scores = -(jnp.sum(q * q, 1)[:, None]
-                           - 2 * q @ v.T + jnp.sum(v * v, 1)[None, :])
-            else:
-                raise err.InvalidArgument(f"metric {metric!r}")
-            kk = min(k, scores.shape[1])
-            s, i = jax.lax.top_k(scores, kk)
-            i = i + row_base
-            row_base += vectors.shape[0]
-            if best_scores is None:
-                best_scores, best_ids = s, i
-            else:
-                cat_s = jnp.concatenate([best_scores, s], axis=1)
-                cat_i = jnp.concatenate([best_ids, i], axis=1)
-                kk = min(k, cat_s.shape[1])
-                best_scores, sel = jax.lax.top_k(cat_s, kk)
-                best_ids = jnp.take_along_axis(cat_i, sel, axis=1)
-        if best_scores is None:
-            raise err.FileNotFound(f"table {self.path} is empty")
-        return np.asarray(best_ids), np.asarray(best_scores)
+        s, i = _scan_fn(metric, k)(q, v)
+        if not materialize:
+            return i, s
+        return np.asarray(i), np.asarray(s)
 
     async def take(self, row_ids: np.ndarray) -> tuple[np.ndarray, dict]:
         """Materialize rows by global row id."""

@@ -162,6 +162,9 @@ class WorkerServer:
         r(RpcCode.READ_BLOCK, self._read_block)
         r(RpcCode.DELETE_BLOCK, self._delete_block)
         r(RpcCode.GET_BLOCK_INFO, self._get_block_info)
+        r(RpcCode.SC_WRITE_OPEN, self._sc_write_open)
+        r(RpcCode.SC_WRITE_COMMIT, self._sc_write_commit)
+        r(RpcCode.SC_WRITE_ABORT, self._sc_write_abort)
         r(RpcCode.WRITE_BLOCKS_BATCH, self._write_blocks_batch)
         r(RpcCode.HBM_PIN, self._hbm_pin)
         r(RpcCode.HBM_UNPIN, self._hbm_unpin)
@@ -177,24 +180,47 @@ class WorkerServer:
         block_id = q["block_id"]
         hint = StorageType(q.get("storage_type", int(StorageType.MEM)))
         info = self.store.create_temp(block_id, hint, q.get("len_hint", 0))
-        # MEM-tier files live on tmpfs: a 4 MiB write is a memcpy, cheaper
-        # inline than a to_thread round trip
         inline_io = info.tier.storage_type <= StorageType.MEM
         f = open(info.path, "wb") if inline_io else \
             await asyncio.to_thread(open, info.path, "wb")
         state = {"crc": 0, "total": 0}
+        # hash+write: on multi-core hosts each chunk is copied out of the
+        # reusable receive buffer and processed in a worker thread chained
+        # behind the previous one (CRC chain + file order need sequencing)
+        # while the receive loop takes the next frame — zlib releases the
+        # GIL, so hashing overlaps the socket. On a single core the thread
+        # hops are pure overhead, so the original inline path is kept.
+        offload = (os.cpu_count() or 1) > 1
+        tail: dict = {"t": None}
+
+        def _hash_write(data) -> None:
+            state["crc"] = zlib.crc32(data, state["crc"])
+            f.write(data)
+
+        async def _chained(prev, data: bytes) -> None:
+            if prev is not None:
+                await prev
+            if len(data) >= 256 * 1024:
+                await asyncio.to_thread(_hash_write, data)
+            else:
+                _hash_write(data)
 
         async def sink(header: dict, view: memoryview, is_eof: bool) -> None:
             try:
                 if len(view):
-                    state["crc"] = zlib.crc32(view, state["crc"])
                     state["total"] += len(view)
-                    if inline_io:
-                        f.write(view)
+                    if offload:
+                        tail["t"] = asyncio.ensure_future(
+                            _chained(tail["t"], bytes(view)))
+                    elif inline_io:
+                        _hash_write(view)
                     else:
+                        state["crc"] = zlib.crc32(view, state["crc"])
                         await asyncio.to_thread(f.write, bytes(view))
                 if not is_eof:
                     return
+                if tail["t"] is not None:
+                    await tail["t"]
                 conn.close_stream(msg.req_id)
                 f.close()
                 want = header.get("crc32")
@@ -222,6 +248,32 @@ class WorkerServer:
 
         conn.set_stream_sink(msg.req_id, sink)
         return None                # reply is sent from the sink at EOF
+
+    async def _sc_write_open(self, msg: Message, conn: ServerConn):
+        """Short-circuit write grant: a co-located client writes the temp
+        block file directly (no socket copy, one hash pass) and commits
+        via SC_WRITE_COMMIT. The TPU-host counterpart of the reference's
+        short-circuit read (orpc zero-copy parity, write direction)."""
+        q = unpack(msg.data) or {}
+        info = self.store.create_temp(
+            q["block_id"], StorageType(q.get("storage_type",
+                                             int(StorageType.MEM))),
+            q.get("len_hint", 0))
+        return {}, pack({"path": info.path, "worker_id": self.worker_id})
+
+    async def _sc_write_commit(self, msg: Message, conn: ServerConn):
+        q = unpack(msg.data) or {}
+        info = self.store.commit(q["block_id"], q["len"],
+                                 checksum=q.get("crc32"),
+                                 checksum_algo=q.get("algo", "crc32"))
+        self.metrics.inc("bytes.written", info.len)
+        return {}, pack({"block_id": info.block_id, "len": info.len,
+                         "worker_id": self.worker_id})
+
+    async def _sc_write_abort(self, msg: Message, conn: ServerConn):
+        q = unpack(msg.data) or {}
+        self.store.delete(q["block_id"])
+        return {}, pack({})
 
     async def _read_block(self, msg: Message, conn: ServerConn):
         """Streaming download. Request {block_id, offset, len, chunk_size}.

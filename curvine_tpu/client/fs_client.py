@@ -23,6 +23,33 @@ from curvine_tpu.rpc.frame import pack, unpack
 log = logging.getLogger(__name__)
 
 
+def _os_user() -> str:
+    try:
+        import getpass
+        return getpass.getuser()
+    except Exception:
+        return "root"
+
+
+def _os_groups(user: str) -> list[str]:
+    """Primary AND supplementary groups (getgrouplist) — primary-only
+    would deny group-permission access the OS actually grants."""
+    try:
+        import grp
+        import os
+        import pwd
+        gid = pwd.getpwnam(user).pw_gid
+        names = []
+        for g in os.getgrouplist(user, gid):
+            try:
+                names.append(grp.getgrgid(g).gr_name)
+            except KeyError:
+                continue
+        return names
+    except Exception:
+        return []
+
+
 class FsClient:
     def __init__(self, conf: ClusterConf | None = None):
         self.conf = conf or ClusterConf()
@@ -36,6 +63,9 @@ class FsClient:
         self.client_id = uuid.uuid4().hex
         self._call_ids = itertools.count(1)
         self.client_host = socket.gethostname()
+        # identity for master-side ACL checks (acl_feature.rs parity)
+        self.user = cc.user or _os_user()
+        self.groups = list(cc.groups) or _os_groups(self.user)
 
     async def close(self) -> None:
         await self.pool.close()
@@ -44,8 +74,10 @@ class FsClient:
         return await self.pool.get(self.masters[self._active])
 
     async def call(self, code: RpcCode, req: dict, mutate: bool = False) -> dict:
+        req = dict(req)
+        req.setdefault("user", self.user)
+        req.setdefault("groups", self.groups)
         if mutate:
-            req = dict(req)
             req["client_id"] = self.client_id
             req["call_id"] = next(self._call_ids)
 

@@ -125,6 +125,8 @@ class FsWriter:
         self._block_crc = 0
         self._uploads = []
         self._sc_file = None
+        self._sc_conn = None      # else abort() could SC-abort a later
+                                  # socket-path block of the same writer
         if self.short_circuit and len(self._block.locs) == 1:
             if await self._try_short_circuit(self._block.locs[0]):
                 return
@@ -227,13 +229,15 @@ class FsWriter:
         if self._sc_file is not None:
             self._sc_file.close()
             self._sc_file = None
-            if self._block is not None and self._sc_conn is not None:
-                try:
-                    await self._sc_conn.call(
-                        RpcCode.SC_WRITE_ABORT,
-                        data=pack({"block_id": self._block.block.id}))
-                except err.CurvineError:
-                    pass
+        # _sc_conn outlives _sc_file: a failed SC_WRITE_COMMIT (worker
+        # restart/timeout) must still free the worker's temp block
+        if self._sc_conn is not None and self._block is not None:
+            try:
+                await self._sc_conn.call(
+                    RpcCode.SC_WRITE_ABORT,
+                    data=pack({"block_id": self._block.block.id}))
+            except err.CurvineError:
+                pass
         for up in self._uploads:
             await up.abort()
         self._closed = True

@@ -43,6 +43,10 @@ class MasterConf:
     # ttl scanner
     ttl_check_ms: int = 1_000
     ttl_bucket_ms: int = 1_000
+    # permissions (parity: acl_feature.rs)
+    acl_enabled: bool = True
+    superuser: str = "root"
+    supergroup: str = "supergroup"
     # audit/metrics
     audit_log: bool = False
     # raft (HA); empty peers → single-node journal mode
@@ -79,6 +83,9 @@ class WorkerConf:
 @dataclass
 class ClientConf:
     master_addrs: list[str] = field(default_factory=lambda: ["127.0.0.1:8995"])
+    # identity sent with every request (empty → the OS user / its group)
+    user: str = ""
+    groups: list[str] = field(default_factory=list)
     block_size: int = 64 * MB
     replicas: int = 1
     write_chunk_size: int = 4 * MB

@@ -20,6 +20,33 @@ log = logging.getLogger(__name__)
 ROOT_ID = 1
 
 
+_UID_CACHE: dict = {}
+
+
+def _uid_names(uid: int, gid: int) -> tuple[str, list[str]]:
+    """Map kernel uid/gid to (user, group names) via the host user db;
+    unknown ids fall back to their decimal string."""
+    key = (uid, gid)
+    hit = _UID_CACHE.get(key)
+    if hit is not None:
+        return hit
+    try:
+        import pwd
+        user = pwd.getpwuid(uid).pw_name
+    except (KeyError, OSError):
+        user = str(uid)
+    groups = []
+    try:
+        import grp
+        groups.append(grp.getgrgid(gid).gr_name)
+        groups.extend(g.gr_name for g in grp.getgrall()
+                      if user in g.gr_mem)
+    except (KeyError, OSError):
+        groups.append(str(gid))
+    _UID_CACHE[key] = (user, groups)
+    return user, groups
+
+
 class FuseError(Exception):
     def __init__(self, errno: int):
         self.errno = errno
@@ -76,6 +103,8 @@ class CurvineFuseFs:
         self.destroyed = False
         # path → FsWriter for in-flight writes (getattr sees live size)
         self._open_writers: dict[int, object] = {}
+        # access(2) result cache: (nodeid, uid, gid, mask) -> (ok, expiry)
+        self._access_cache: dict = {}
         from curvine_tpu.common.metrics import MetricsRegistry
         self.metrics = MetricsRegistry("fuse")
 
@@ -494,6 +523,33 @@ class CurvineFuseFs:
                                    0, 0, 0, 0, 0, 0)
 
     async def op_access(self, hdr, payload) -> bytes:
+        """Honest access(2): POSIX mode check of the caller's uid/gid
+        (mapped to names via the host user db) against the file's
+        owner/group/mode. Parity: acl_feature.rs via the FUSE surface;
+        root (uid 0) bypasses, like the master's superuser."""
+        (mask, _pad) = abi.ACCESS_IN.unpack_from(payload, 0)
+        if hdr.uid == 0 or mask == 0:        # F_OK / superuser
+            return b""
+        # short-TTL result cache: access(2) fires on hot paths (shell
+        # completion, ls -l) and each miss is a master round trip
+        import time
+        key = (hdr.nodeid, hdr.uid, hdr.gid, mask)
+        hit = self._access_cache.get(key)
+        now = time.monotonic()
+        if hit is not None and hit[1] > now:
+            if not hit[0]:
+                raise FuseError(Errno.EACCES)
+            return b""
+        from curvine_tpu.master.acl import posix_bits
+        st = await self.client.meta.file_status(self.node_path(hdr.nodeid))
+        user, groups = _uid_names(hdr.uid, hdr.gid)
+        bits = posix_bits(st.owner, st.group, st.mode, user, groups)
+        ok = (bits & mask) == mask
+        self._access_cache[key] = (ok, now + self.attr_ttl / 1000)
+        if len(self._access_cache) > 4096:
+            self._access_cache.clear()
+        if not ok:
+            raise FuseError(Errno.EACCES)
         return b""
 
     async def op_getxattr(self, hdr, payload) -> bytes:

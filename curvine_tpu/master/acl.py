@@ -1,0 +1,151 @@
+"""POSIX permission enforcement on master metadata ops.
+
+Parity: curvine-server/src/master/meta/feature/acl_feature.rs — the
+reference checks owner/group/mode on every namespace op with a superuser
+bypass. Same model here: requests carry (user, groups); every path op
+checks traverse (x) on ancestors plus the op's permission on the target
+or its parent. Owner-only rules apply to chmod/chown (chown itself is
+superuser-only, chgrp needs membership of the target group), matching
+POSIX semantics.
+
+Enforcement lives at the RPC handler layer (leader side): journal replay
+and raft followers re-apply already-authorized mutations and must not
+re-check.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from curvine_tpu.common import errors as err
+
+R, W, X = 4, 2, 1
+
+
+def posix_bits(owner: str, group: str, mode: int, user: str,
+               groups: list[str]) -> int:
+    """The permission triplet that applies to (user, groups) — shared by
+    the master enforcer and the FUSE access(2) path."""
+    if user == owner:
+        return (mode >> 6) & 7
+    if group in groups:
+        return (mode >> 3) & 7
+    return mode & 7
+
+
+@dataclass
+class UserCtx:
+    user: str = "root"
+    groups: list[str] = field(default_factory=list)
+
+    @staticmethod
+    def from_req(q: dict) -> "UserCtx":
+        return UserCtx(user=q.get("user") or "root",
+                       groups=list(q.get("groups") or []))
+
+
+class AclEnforcer:
+    def __init__(self, fs, enabled: bool = True, superuser: str = "root",
+                 supergroup: str = "supergroup"):
+        self.fs = fs
+        self.enabled = enabled
+        self.superuser = superuser
+        self.supergroup = supergroup
+
+    # ---------------- core ----------------
+
+    def _is_super(self, ctx: UserCtx) -> bool:
+        return ctx.user == self.superuser or self.supergroup in ctx.groups
+
+    @staticmethod
+    def _bits(node, ctx: UserCtx) -> int:
+        return posix_bits(node.owner, node.group, node.mode,
+                          ctx.user, ctx.groups)
+
+    def _deny(self, ctx: UserCtx, path: str, what: str):
+        raise err.PermissionDenied(
+            f"user={ctx.user} lacks {what} on {path}")
+
+    def _walk(self, path: str):
+        """Yield (inode, sub-path) for every EXISTING component of path,
+        root first (missing tail components are the op's business)."""
+        node = self.fs.tree.root
+        yield node, "/"
+        cur = ""
+        for comp in path.strip("/").split("/"):
+            if not comp:
+                continue
+            if not node.is_dir:
+                return
+            child = self.fs.tree.child(node, comp)
+            if child is None:
+                return
+            cur += "/" + comp
+            yield child, cur
+            node = child
+
+    def _check_traverse(self, ctx: UserCtx, path: str):
+        """x on every existing ancestor directory of `path`."""
+        chain = list(self._walk(path))
+        for node, sub in chain[:-1] if len(chain) > 1 else chain[:0]:
+            if node.is_dir and not self._bits(node, ctx) & X:
+                self._deny(ctx, sub, "traverse (x)")
+        return chain
+
+    # ---------------- op checks ----------------
+
+    def check(self, ctx: UserCtx, path: str, perm: int,
+              on_parent: bool = False) -> None:
+        """Require `perm` (R|W|X bitmask) on `path` — or on its deepest
+        existing ancestor when on_parent (create/delete-style ops)."""
+        if not self.enabled or self._is_super(ctx):
+            return
+        chain = self._check_traverse(ctx, path)
+        if not chain:
+            return
+        node, sub = chain[-1]
+        target_is_path = sub.rstrip("/") == ("/" + path.strip("/")).rstrip("/")
+        if on_parent:
+            # permission applies to the parent dir of the path tail
+            if target_is_path and len(chain) > 1:
+                node, sub = chain[-2]
+            if not node.is_dir:
+                return          # parent-is-a-file errors surface later
+            if (self._bits(node, ctx) & perm) != perm:
+                self._deny(ctx, sub, _perm_str(perm))
+            return
+        if not target_is_path:
+            return              # target doesn't exist: op raises NotFound
+        if (self._bits(node, ctx) & perm) != perm:
+            self._deny(ctx, sub, _perm_str(perm))
+
+    def check_set_attr(self, ctx: UserCtx, path: str, opts) -> None:
+        """chmod: owner or superuser. chown: superuser only. chgrp: owner
+        AND member of the target group (or superuser). Everything else
+        (times, ttl, xattrs, replicas): write permission."""
+        if not self.enabled or self._is_super(ctx):
+            return
+        chain = self._check_traverse(ctx, path)
+        if not chain:
+            return
+        node, sub = chain[-1]
+        if sub.rstrip("/") != ("/" + path.strip("/")).rstrip("/"):
+            return
+        if opts.owner is not None and opts.owner != node.owner:
+            self._deny(ctx, sub, "chown (superuser only)")
+        is_owner = ctx.user == node.owner
+        if opts.mode is not None and not is_owner:
+            self._deny(ctx, sub, "chmod (owner only)")
+        if opts.group is not None and opts.group != node.group:
+            if not (is_owner and opts.group in ctx.groups):
+                self._deny(ctx, sub, "chgrp (owner + member)")
+        plain = (opts.replicas is not None or opts.ttl_ms is not None
+                 or opts.ttl_action is not None or opts.atime is not None
+                 or opts.mtime is not None or opts.add_x_attr
+                 or opts.remove_x_attr)
+        if plain and not self._bits(node, ctx) & W and not is_owner:
+            self._deny(ctx, sub, "w")
+
+def _perm_str(perm: int) -> str:
+    return "".join(c for bit, c in ((R, "r"), (W, "w"), (X, "x"))
+                   if perm & bit)

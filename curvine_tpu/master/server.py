@@ -15,6 +15,7 @@ from curvine_tpu.common.journal import Journal
 from curvine_tpu.common.types import CommitBlock, SetAttrOpts
 from curvine_tpu.common.metrics import MetricsRegistry
 from curvine_tpu.common.path import norm_path
+from curvine_tpu.master.acl import AclEnforcer, R, UserCtx, W, X
 from curvine_tpu.master.filesystem import MasterFilesystem
 from curvine_tpu.master.jobs import JobManager
 from curvine_tpu.master.mount import MountManager
@@ -55,6 +56,9 @@ class MasterServer:
         self.quota = QuotaManager(self.fs)
         from curvine_tpu.master.locks import LockManager
         self.locks = LockManager()
+        self.acl = AclEnforcer(self.fs, enabled=mc.acl_enabled,
+                               superuser=mc.superuser,
+                               supergroup=mc.supergroup)
         self.retry_cache = RetryCache(mc.retry_cache_size, mc.retry_cache_ttl_ms)
         self.rpc = RpcServer(mc.hostname, mc.rpc_port, "master")
         self.raft = None
@@ -210,38 +214,57 @@ class MasterServer:
 
     # --- fs ---
     def _mkdir(self, q):
+        ctx = UserCtx.from_req(q)
+        if self.fs.exists(q["path"]):
+            self.acl.check(ctx, q["path"], 0)     # idempotent: traverse only
+        else:
+            self.acl.check(ctx, q["path"], W | X, on_parent=True)
         st = self.fs.mkdir(q["path"], create_parent=q.get("create_parent", True),
-                           mode=q.get("mode", 0o755), owner=q.get("owner", "root"),
-                           group=q.get("group", "root"), x_attr=q.get("x_attr"))
+                           mode=q.get("mode", 0o755),
+                           owner=q.get("owner") or ctx.user,
+                           group=q.get("group") or (ctx.groups[0] if ctx.groups
+                                                    else ctx.user),
+                           x_attr=q.get("x_attr"))
         return {"status": st.to_wire()}
 
     def _delete(self, q):
+        self.acl.check(UserCtx.from_req(q), q["path"], W | X, on_parent=True)
         self.fs.delete(q["path"], recursive=q.get("recursive", False))
         return {}
 
     def _create_file(self, q):
+        ctx = UserCtx.from_req(q)
+        if self.fs.exists(q["path"]):
+            self.acl.check(ctx, q["path"], W)     # overwrite needs w on file
+        else:
+            self.acl.check(ctx, q["path"], W | X, on_parent=True)
         self.quota.check_create(q["path"])
         st = self.fs.create_file(
             q["path"], overwrite=q.get("overwrite", False),
             create_parent=q.get("create_parent", True),
             replicas=q.get("replicas", 1),
             block_size=q.get("block_size", self.conf.client.block_size),
-            mode=q.get("mode", 0o644), owner=q.get("owner", "root"),
-            group=q.get("group", "root"), client_name=q.get("client_name", ""),
+            mode=q.get("mode", 0o644), owner=q.get("owner") or ctx.user,
+            group=q.get("group") or (ctx.groups[0] if ctx.groups
+                                     else ctx.user),
+            client_name=q.get("client_name", ""),
             x_attr=q.get("x_attr"), storage_policy=q.get("storage_policy"),
             file_type=q.get("file_type", 1))
         return {"status": st.to_wire()}
 
     def _open_file(self, q):
+        self.acl.check(UserCtx.from_req(q), q["path"], R)
         fb = self.fs.get_block_locations(q["path"])
         return {"file_blocks": fb.to_wire()}
 
     def _append_file(self, q):
+        self.acl.check(UserCtx.from_req(q), q["path"], W)
         fb = self.fs.append_file(q["path"], client_name=q.get("client_name", ""))
         return {"file_blocks": fb.to_wire()}
 
     async def _file_status(self, q):
         from curvine_tpu.common import errors as cerr
+        self.acl.check(UserCtx.from_req(q), q["path"], 0)   # traverse only
         try:
             return {"status": self.fs.file_status(q["path"]).to_wire()}
         except cerr.FileNotFound:
@@ -256,6 +279,9 @@ class MasterServer:
         Parity: reference sync_ufs_meta / unified listing."""
         from curvine_tpu.common import errors as cerr
         path = q["path"]
+        node = self.fs.tree.resolve(path)
+        self.acl.check(UserCtx.from_req(q), path,
+                       R if node is not None and node.is_dir else 0)
         try:
             cached = self.fs.list_status(path)
         except cerr.FileNotFound:
@@ -267,15 +293,20 @@ class MasterServer:
         return {"statuses": [merged[k].to_wire() for k in sorted(merged)]}
 
     async def _exists(self, q):
+        self.acl.check(UserCtx.from_req(q), q["path"], 0)   # traverse
         if self.fs.exists(q["path"]):
             return {"exists": True}
         st = await self.mounts.ufs_status(q["path"])
         return {"exists": st is not None}
 
     def _rename(self, q):
+        ctx = UserCtx.from_req(q)
+        self.acl.check(ctx, q["src"], W | X, on_parent=True)
+        self.acl.check(ctx, q["dst"], W | X, on_parent=True)
         return {"result": self.fs.rename(q["src"], q["dst"])}
 
     def _add_block(self, q):
+        self.acl.check(UserCtx.from_req(q), q["path"], W)
         node = self.fs.tree.resolve(q["path"])
         if node is not None:
             self.quota.check_create(q["path"], new_bytes=node.block_size,
@@ -289,6 +320,7 @@ class MasterServer:
         return {"block": lb.to_wire()}
 
     def _complete_file(self, q):
+        self.acl.check(UserCtx.from_req(q), q["path"], W)
         ok = self.fs.complete_file(
             q["path"], q.get("len", 0),
             commit_blocks=[CommitBlock.from_wire(c)
@@ -298,29 +330,38 @@ class MasterServer:
         return {"result": ok}
 
     def _get_block_locations(self, q):
+        self.acl.check(UserCtx.from_req(q), q["path"], R)
         return {"file_blocks": self.fs.get_block_locations(q["path"]).to_wire()}
 
     def _master_info(self, q):
         return {"info": self.fs.master_info(self.addr).to_wire()}
 
     def _set_attr(self, q):
-        self.fs.set_attr(q["path"], SetAttrOpts.from_wire(q.get("opts", {})))
+        opts = SetAttrOpts.from_wire(q.get("opts", {}))
+        self.acl.check_set_attr(UserCtx.from_req(q), q["path"], opts)
+        self.fs.set_attr(q["path"], opts)
         node = self.fs.tree.resolve(q["path"])
         if node is not None:
             self.ttl.index(node.id, node.mtime, node.storage_policy.ttl_ms)
         return {}
 
     def _symlink(self, q):
+        self.acl.check(UserCtx.from_req(q), q["link"], W | X, on_parent=True)
         return {"status": self.fs.symlink(q["target"], q["link"]).to_wire()}
 
     def _link(self, q):
+        ctx = UserCtx.from_req(q)
+        self.acl.check(ctx, q["src"], 0)
+        self.acl.check(ctx, q["dst"], W | X, on_parent=True)
         return {"status": self.fs.link(q["src"], q["dst"]).to_wire()}
 
     def _resize(self, q):
+        self.acl.check(UserCtx.from_req(q), q["path"], W)
         self.fs.resize_file(q["path"], q["len"])
         return {}
 
     def _free(self, q):
+        self.acl.check(UserCtx.from_req(q), q["path"], W)
         return {"freed": self.fs.free(q["path"], q.get("recursive", False))}
 
     def _list_options(self, q):

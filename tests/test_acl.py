@@ -1,0 +1,126 @@
+"""Permission/ACL enforcement on master metadata ops + FUSE access(2).
+
+Parity: curvine-server/src/master/meta/feature/acl_feature.rs (owner/
+group/mode checks with superuser bypass)."""
+
+import asyncio
+
+import pytest
+
+from curvine_tpu.client import CurvineClient
+from curvine_tpu.common import errors as err
+from curvine_tpu.common.conf import ClusterConf
+from curvine_tpu.testing import MiniCluster
+
+
+def _client_as(mc, user, groups=None) -> CurvineClient:
+    conf = ClusterConf()
+    conf.client.master_addrs = [mc.master.addr]
+    conf.client.block_size = mc.conf.client.block_size
+    conf.client.user = user
+    conf.client.groups = groups or []
+    c = CurvineClient(conf)
+    mc._clients.append(c)
+    return c
+
+
+async def test_acl_enforcement_end_to_end():
+    async with MiniCluster(workers=1) as mc:
+        root = mc.client()                     # superuser
+        alice = _client_as(mc, "alice", ["staff"])
+        bob = _client_as(mc, "bob", ["interns"])
+
+        from curvine_tpu.common.types import SetAttrOpts
+        # '/' is root-owned 0o755: alice cannot create at top level
+        with pytest.raises(err.PermissionDenied):
+            await alice.meta.mkdir("/home")
+        await root.meta.mkdir("/home", mode=0o777)
+        # alice builds a private tree
+        await alice.meta.mkdir("/home/alice", mode=0o750)
+        st = await alice.meta.file_status("/home/alice")
+        assert st.owner == "alice"             # ownership from the caller
+        await alice.write_all("/home/alice/secret.txt", b"s3cr3t")
+        await alice.meta.set_attr("/home/alice/secret.txt",
+                                  SetAttrOpts(mode=0o600))
+
+        # bob: no traverse into 0o750 dir owned by alice
+        with pytest.raises(err.PermissionDenied):
+            await bob.meta.file_status("/home/alice/secret.txt")
+        with pytest.raises(err.PermissionDenied):
+            await bob.open("/home/alice/secret.txt")
+        with pytest.raises(err.PermissionDenied):
+            await bob.meta.create_file("/home/alice/mine.txt")
+        with pytest.raises(err.PermissionDenied):
+            await bob.meta.delete("/home/alice/secret.txt")
+        with pytest.raises(err.PermissionDenied):
+            await bob.meta.rename("/home/alice/secret.txt", "/stolen")
+
+        # staff group member gets group bits (r-x on the dir)
+        carol = _client_as(mc, "carol", ["staff"])
+        sts = await carol.meta.list_status("/home/alice")
+        assert [s.name for s in sts] == ["secret.txt"]
+        # ...but 0o600 file stays closed to group
+        with pytest.raises(err.PermissionDenied):
+            await carol.open("/home/alice/secret.txt")
+
+        # chmod by non-owner denied; by owner allowed
+        with pytest.raises(err.PermissionDenied):
+            await bob.meta.set_attr("/home/alice/secret.txt",
+                                    SetAttrOpts(mode=0o777))
+        await alice.meta.set_attr("/home/alice/secret.txt",
+                                  SetAttrOpts(mode=0o644))
+        # chown is superuser-only
+        with pytest.raises(err.PermissionDenied):
+            await alice.meta.set_attr("/home/alice/secret.txt",
+                                      SetAttrOpts(owner="bob"))
+        await root.meta.set_attr("/home/alice/secret.txt",
+                                 SetAttrOpts(owner="bob"))
+        assert (await root.meta.file_status(
+            "/home/alice/secret.txt")).owner == "bob"
+
+        # superuser bypasses everything
+        data = await (await root.open("/home/alice/secret.txt")).read_all()
+        assert data == b"s3cr3t"
+
+        # world-writable works for anyone
+        await root.meta.mkdir("/tmp", mode=0o777)
+        await bob.write_all("/tmp/bob.txt", b"hi")
+        assert await bob.meta.exists("/tmp/bob.txt")
+
+
+async def test_acl_disabled_allows_everything():
+    conf = ClusterConf()
+    conf.master.acl_enabled = False
+    async with MiniCluster(workers=1, conf=conf) as mc:
+        nobody = _client_as(mc, "nobody")
+        await mc.client().meta.mkdir("/locked", mode=0o700)
+        await nobody.meta.create_file("/locked/f")   # no enforcement
+        assert await nobody.meta.exists("/locked/f")
+
+
+async def test_fuse_access_check():
+    """op_access computes POSIX bits instead of always-yes."""
+    import os
+    from curvine_tpu.fuse import abi
+    from curvine_tpu.fuse.ops import CurvineFuseFs, FuseError
+
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.write_all("/f600", b"x")
+        from curvine_tpu.common.types import SetAttrOpts
+        await c.meta.set_attr("/f600", SetAttrOpts(mode=0o600, owner="zed",
+                                                   group="zeds"))
+        fs = CurvineFuseFs(c, uid=os.getuid(), gid=os.getgid())
+        nid = fs.intern("/f600")
+
+        class Hdr:
+            nodeid = nid
+            uid = 12345      # not zed, not root
+            gid = 12345
+
+        payload = memoryview(abi.ACCESS_IN.pack(4, 0))   # R_OK
+        with pytest.raises(FuseError) as ei:
+            await fs.op_access(Hdr, payload)
+        assert ei.value.errno == 13                       # EACCES
+        Hdr.uid = 0                                       # root bypass
+        assert await fs.op_access(Hdr, payload) == b""

@@ -40,7 +40,9 @@ def _os_groups(user: str) -> list[str]:
         import pwd
         gid = pwd.getpwnam(user).pw_gid
         names = []
-        for g in os.getgrouplist(user, gid):
+        # primary group FIRST — getgrouplist order is unspecified and the
+        # master assigns groups[0] to newly created files
+        for g in [gid] + [x for x in os.getgrouplist(user, gid) if x != gid]:
             try:
                 names.append(grp.getgrgid(g).gr_name)
             except KeyError:

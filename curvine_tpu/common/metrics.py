@@ -64,6 +64,12 @@ class MetricsRegistry:
         finally:
             self.observe(name, time.perf_counter() - t0)
 
+    def as_dict(self) -> dict[str, float]:
+        """Flat counters+gauges snapshot (dashboard JSON feed)."""
+        out = dict(self.counters)
+        out.update(self.gauges)
+        return out
+
     def prometheus_text(self) -> str:
         lines = []
         pre = f"curvine_{self.component}_"

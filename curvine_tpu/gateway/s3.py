@@ -122,6 +122,12 @@ class S3Gateway:
         bucket = req.match_info["bucket"]
         key = urllib.parse.unquote(req.match_info["key"])
         path = f"/{bucket}/{key}"
+        # a key like '..%2Fother/file' must not cross the bucket boundary:
+        # reject any key whose normalized path escapes /<bucket>/
+        import posixpath
+        normed = posixpath.normpath(path)
+        if not normed.startswith(f"/{bucket}/"):
+            return self._error(400, "InvalidObjectName", path)
         try:
             if req.method == "PUT":
                 data = await req.read()

@@ -85,9 +85,16 @@ class AclEnforcer:
             node = child
 
     def _check_traverse(self, ctx: UserCtx, path: str):
-        """x on every existing ancestor directory of `path`."""
+        """x on every existing directory on the way to `path` — including
+        the deepest existing dir when the tail is missing, so a missing
+        name and an existing name fail identically (EACCES, no existence
+        oracle inside unreadable directories)."""
         chain = list(self._walk(path))
-        for node, sub in chain[:-1] if len(chain) > 1 else chain[:0]:
+        full = ("/" + path.strip("/")).rstrip("/") or "/"
+        for node, sub in chain:
+            is_target = sub.rstrip("/") == full or sub == full
+            if is_target:
+                continue          # the target's own x is the op's business
             if node.is_dir and not self._bits(node, ctx) & X:
                 self._deny(ctx, sub, "traverse (x)")
         return chain

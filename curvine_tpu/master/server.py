@@ -69,6 +69,7 @@ class MasterServer:
             self.raft = RaftLite(mc.raft_node_id, peers, self.fs, self.rpc)
             self.fs.on_mutation = self.raft.on_mutation
         self._register_handlers()
+        self._worker_counters: dict[int, dict] = {}
         self._bg: list[asyncio.Task] = []
         from curvine_tpu.common.executor import ScheduledExecutor
         self.executor = ScheduledExecutor("master")
@@ -306,8 +307,12 @@ class MasterServer:
         return {"result": self.fs.rename(q["src"], q["dst"])}
 
     def _add_block(self, q):
-        self.acl.check(UserCtx.from_req(q), q["path"], W)
         node = self.fs.tree.resolve(q["path"])
+        # an open (incomplete) file is written under the creating client's
+        # lease: create/append authorized the write already, and POSIX
+        # lets the creating fd write regardless of the new file's mode
+        if node is None or node.is_complete:
+            self.acl.check(UserCtx.from_req(q), q["path"], W)
         if node is not None:
             self.quota.check_create(q["path"], new_bytes=node.block_size,
                                     new_files=0)
@@ -320,7 +325,9 @@ class MasterServer:
         return {"block": lb.to_wire()}
 
     def _complete_file(self, q):
-        self.acl.check(UserCtx.from_req(q), q["path"], W)
+        node = self.fs.tree.resolve(q["path"])
+        if node is None or node.is_complete:
+            self.acl.check(UserCtx.from_req(q), q["path"], W)
         ok = self.fs.complete_file(
             q["path"], q.get("len", 0),
             commit_blocks=[CommitBlock.from_wire(c)
@@ -431,6 +438,21 @@ class MasterServer:
     def _worker_heartbeat(self, q):
         cmds = self.fs.worker_heartbeat(q["info"])
         self.metrics.gauge("workers.live", len(self.fs.workers.live_workers()))
+        wm = q.get("metrics")
+        if wm:
+            # aggregate worker-plane byte counters (dashboard throughput);
+            # lost/decommissioned workers are pruned so their final
+            # snapshots don't inflate the gauges forever
+            wid = q["info"]["address"]["worker_id"]
+            self._worker_counters[wid] = wm
+            live = {w.address.worker_id
+                    for w in self.fs.workers.live_workers()}
+            self._worker_counters = {k: v for k, v
+                                     in self._worker_counters.items()
+                                     if k in live}
+            for name in ("bytes.read", "bytes.written"):
+                self.metrics.gauge(name, sum(
+                    c.get(name, 0) for c in self._worker_counters.values()))
         return cmds
 
     def _worker_block_report(self, q):

@@ -63,6 +63,12 @@ class WebServer:
         r.add_get("/api/mounts", self._mounts)
         r.add_get("/api/jobs", self._jobs)
         r.add_get("/api/jobs/{job_id}", self._job)
+        r.add_get("/api/workers", self._workers)
+        r.add_get("/api/metrics.json", self._metrics_json)
+        import os
+        static_dir = os.path.join(os.path.dirname(__file__), "static")
+        if os.path.isdir(static_dir):
+            r.add_static("/ui", static_dir)
 
     async def start(self) -> None:
         self._runner = web.AppRunner(self.app, access_log=None)
@@ -81,7 +87,29 @@ class WebServer:
     # ---------------- handlers ----------------
 
     async def _dashboard(self, req):
+        import os
+        index = os.path.join(os.path.dirname(__file__), "static",
+                             "index.html")
+        if os.path.exists(index):
+            with open(index) as f:
+                return web.Response(text=f.read(), content_type="text/html")
         return web.Response(text=_DASH, content_type="text/html")
+
+    async def _workers(self, req):
+        if self.master is None:
+            return self._json([])
+        fs = self.master.fs
+        return self._json([w.to_wire() for w in
+                           fs.workers.live_workers() + fs.workers.lost_workers()])
+
+    async def _metrics_json(self, req):
+        """Flat {name: value} of counters+gauges — feeds the dashboard's
+        throughput sparklines. Worker-plane byte counters are aggregated
+        from worker heartbeats' metrics reports when present."""
+        src = self.master or self.worker
+        if src is None:
+            return self._json({})
+        return self._json(src.metrics.as_dict())
 
     async def _metrics(self, req):
         src = self.master or self.worker

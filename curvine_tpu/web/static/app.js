@@ -1,0 +1,198 @@
+/* curvine-tpu dashboard — hash-routed SPA over the master REST API.
+   Views: overview (stat tiles + capacity meters + throughput sparkline),
+   workers (per-tier detail incl. HBM), namespace browser, mounts, jobs.
+   Parity: curvine-web/webui/src/views/. */
+
+const $ = (s, el) => (el || document).querySelector(s);
+const view = $("#view");
+const gib = n => (n / 2 ** 30).toFixed(2) + " GiB";
+const bytesFmt = n => n >= 2 ** 30 ? gib(n)
+  : n >= 2 ** 20 ? (n / 2 ** 20).toFixed(1) + " MiB"
+  : n >= 1024 ? (n / 1024).toFixed(1) + " KiB" : n + " B";
+const api = p => fetch(p).then(r => r.json());
+const TIERS = { "-1": "HBM", 0: "MEM", 1: "SSD", 2: "HDD", 3: "UFS", 4: "DISK" };
+
+/* ---------- throughput history (polled; survives view switches) ---------- */
+const hist = { t: [], read: [], write: [], last: null };
+async function pollMetrics() {
+  try {
+    const m = await api("/api/metrics.json");
+    const now = Date.now() / 1000;
+    const rd = m["bytes.read"] || 0, wr = m["bytes.written"] || 0;
+    if (hist.last) {
+      const dt = Math.max(now - hist.last.t, 1e-3);
+      hist.t.push(now);
+      hist.read.push(Math.max(0, (rd - hist.last.rd) / dt));
+      hist.write.push(Math.max(0, (wr - hist.last.wr) / dt));
+      if (hist.t.length > 120) { hist.t.shift(); hist.read.shift(); hist.write.shift(); }
+    }
+    hist.last = { t: now, rd, wr };
+  } catch (e) { /* master away: keep polling */ }
+}
+setInterval(pollMetrics, 2000);
+pollMetrics();
+
+/* ---------- sparkline (single series per chart: no legend needed) -------- */
+function sparkline(canvas, data, color, tipFmt) {
+  const ctx = canvas.getContext("2d");
+  const W = canvas.width = canvas.clientWidth * devicePixelRatio;
+  const H = canvas.height = canvas.clientHeight * devicePixelRatio;
+  ctx.clearRect(0, 0, W, H);
+  if (data.length < 2) {
+    ctx.fillStyle = getComputedStyle(canvas).color;
+    return;
+  }
+  const max = Math.max(...data, 1e-9);
+  const px = i => (i / (data.length - 1)) * (W - 8) + 4;
+  const py = v => H - 6 - (v / max) * (H - 16);
+  ctx.lineWidth = 2 * devicePixelRatio;
+  ctx.strokeStyle = color;
+  ctx.lineJoin = "round";
+  ctx.beginPath();
+  data.forEach((v, i) => i ? ctx.lineTo(px(i), py(v)) : ctx.moveTo(px(i), py(v)));
+  ctx.stroke();
+  // hover layer: crosshair + tooltip
+  const tip = $("#tip") || document.body.appendChild(
+    Object.assign(document.createElement("div"), { id: "tip", className: "tip" }));
+  canvas.onmousemove = ev => {
+    const r = canvas.getBoundingClientRect();
+    const i = Math.round(((ev.clientX - r.left) / r.width) * (data.length - 1));
+    if (i < 0 || i >= data.length) return;
+    tip.style.display = "block";
+    tip.style.left = (ev.clientX + 12) + "px";
+    tip.style.top = (ev.clientY - 10) + "px";
+    tip.textContent = tipFmt(data[i]);
+  };
+  canvas.onmouseleave = () => { tip.style.display = "none"; };
+}
+
+/* ---------- views ---------- */
+async function overview() {
+  const d = await api("/api/info");
+  const used = d.capacity - d.available;
+  const pct = d.capacity ? used / d.capacity : 0;
+  view.innerHTML = `
+    <div class="tiles">
+      <div class="tile"><div class="v">${d.inode_num}</div><div class="l">inodes</div></div>
+      <div class="tile"><div class="v">${d.block_num}</div><div class="l">blocks</div></div>
+      <div class="tile"><div class="v">${d.live_workers.length}</div><div class="l">live workers</div></div>
+      <div class="tile"><div class="v">${d.lost_workers.length}</div><div class="l">lost workers</div></div>
+      <div class="tile"><div class="v">${gib(d.capacity)}</div><div class="l">capacity</div></div>
+      <div class="tile"><div class="v">${(pct * 100).toFixed(1)}%</div><div class="l">used</div></div>
+    </div>
+    <h2>Cache usage</h2>
+    <div class="meter ${pct > 0.92 ? "crit" : pct > 0.8 ? "warn" : ""}" style="max-width:420px">
+      <div style="width:${(pct * 100).toFixed(1)}%"></div>
+    </div>
+    <div class="spark-wrap"><div class="cap">read throughput (worker plane, 4&thinsp;min window)</div>
+      <canvas id="spark-read"></canvas></div>
+    <div class="spark-wrap"><div class="cap">write throughput</div>
+      <canvas id="spark-write"></canvas></div>`;
+  const css = getComputedStyle(document.body);
+  sparkline($("#spark-read"), hist.read, css.getPropertyValue("--series-1").trim(),
+            v => bytesFmt(v) + "/s read");
+  sparkline($("#spark-write"), hist.write, css.getPropertyValue("--series-2").trim(),
+            v => bytesFmt(v) + "/s written");
+}
+
+async function workers() {
+  const d = await api("/api/workers");
+  if (!d.length) { view.innerHTML = `<div class="empty">no workers registered</div>`; return; }
+  const rows = d.map(w => {
+    const tiers = w.storages.map(s => {
+      const used = s.capacity - s.available;
+      const p = s.capacity ? used / s.capacity : 0;
+      return `<div style="display:flex;gap:8px;align-items:center;margin:2px 0">
+        <span style="width:38px">${TIERS[s.storage_type] ?? s.storage_type}</span>
+        <div class="meter ${p > 0.92 ? "crit" : p > 0.8 ? "warn" : ""}" style="flex:1">
+          <div style="width:${(p * 100).toFixed(1)}%"></div></div>
+        <span style="width:150px;text-align:right">${gib(used)} / ${gib(s.capacity)}</span>
+      </div>`;
+    }).join("");
+    return `<tr>
+      <td>${w.address.worker_id}</td>
+      <td>${w.address.hostname}:${w.address.rpc_port}</td>
+      <td><span class="status ${w.state === 0 ? "live" : "lost"}">
+        <span class="dot"></span>${w.state === 0 ? "LIVE" : "LOST"}</span></td>
+      <td style="min-width:380px">${tiers}</td>
+      <td>${JSON.stringify(w.ici_coords || [])}</td>
+    </tr>`;
+  }).join("");
+  view.innerHTML = `<h2>Workers</h2><table>
+    <tr><th>id</th><th>address</th><th>state</th>
+    <th>tiers (HBM / MEM / SSD / HDD)</th><th>ICI coords</th></tr>${rows}</table>`;
+}
+
+async function browse(path) {
+  path = path || "/";
+  const sts = await api("/api/browse?path=" + encodeURIComponent(path));
+  const parts = path.split("/").filter(Boolean);
+  let acc = "";
+  const crumbs = ['<a href="#/browse/">/</a>'].concat(parts.map(p => {
+    acc += "/" + p;
+    return `<a href="#/browse${acc}">${p}</a>`;
+  })).join(" / ");
+  if (sts.error) { view.innerHTML = `<div class="crumbs">${crumbs}</div><div class="empty">${sts.error}</div>`; return; }
+  const rows = sts.map(s => `<tr>
+      <td>${s.is_dir
+        ? `<a href="#/browse${s.path}">${s.name}/</a>` : s.name}</td>
+      <td>${s.is_dir ? "—" : bytesFmt(s.len)}</td>
+      <td>${fmtMode(s)}</td>
+      <td>${s.owner}:${s.group}</td>
+      <td>${s.replicas}</td>
+      <td>${new Date(s.mtime).toISOString().replace("T", " ").slice(0, 19)}</td>
+    </tr>`).join("");
+  view.innerHTML = `<h2>Namespace</h2><div class="crumbs">${crumbs}</div>
+    <table><tr><th>name</th><th>size</th><th>mode</th><th>owner</th>
+    <th>repl</th><th>mtime</th></tr>${rows ||
+    `<tr><td colspan="6" class="empty">empty directory</td></tr>`}</table>`;
+}
+
+function fmtMode(s) {
+  const m = s.mode, c = "rwxrwxrwx";
+  let out = s.is_dir ? "d" : "-";
+  for (let i = 0; i < 9; i++) out += (m >> (8 - i)) & 1 ? c[i] : "-";
+  return out;
+}
+
+async function mounts() {
+  const ms = await api("/api/mounts");
+  const rows = ms.map(m => `<tr><td>${m.cv_path}</td><td>${m.ufs_path}</td>
+    <td>${m.write_type}</td><td>${m.auto_cache ? "yes" : "no"}</td></tr>`).join("");
+  view.innerHTML = `<h2>Mount table</h2><table>
+    <tr><th>cv path</th><th>ufs path</th><th>write mode</th><th>auto-cache</th></tr>
+    ${rows || `<tr><td colspan="4" class="empty">no mounts</td></tr>`}</table>`;
+}
+
+async function jobs() {
+  const js = await api("/api/jobs");
+  const STATES = ["PENDING", "RUNNING", "COMPLETED", "FAILED", "CANCELLED"];
+  const rows = js.map(j => `<tr><td>${j.job_id}</td><td>${j.kind}</td>
+    <td>${j.path || ""}</td><td>${STATES[j.state] ?? j.state}</td>
+    <td>${j.progress != null ? (j.progress * 100).toFixed(0) + "%" : ""}</td></tr>`).join("");
+  view.innerHTML = `<h2>Jobs</h2><table>
+    <tr><th>id</th><th>kind</th><th>path</th><th>state</th><th>progress</th></tr>
+    ${rows || `<tr><td colspan="5" class="empty">no jobs</td></tr>`}</table>`;
+}
+
+/* ---------- router ---------- */
+const routes = { overview, workers, mounts, jobs };
+async function route() {
+  const hash = location.hash || "#/overview";
+  const m = hash.match(/^#\/([a-z]+)(\/.*)?$/);
+  const name = m ? m[1] : "overview";
+  document.querySelectorAll("#nav a").forEach(a =>
+    a.classList.toggle("active", a.getAttribute("href") === "#/" + name));
+  try {
+    if (name === "browse") await browse(m[2] || "/");
+    else await (routes[name] || overview)();
+  } catch (e) {
+    view.innerHTML = `<div class="empty">error: ${e}</div>`;
+  }
+}
+window.addEventListener("hashchange", route);
+route();
+setInterval(() => {   // live refresh for the non-browser views
+  const name = (location.hash || "#/overview").slice(2).split("/")[0];
+  if (name !== "browse") route();
+}, 5000);

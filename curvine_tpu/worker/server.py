@@ -125,7 +125,11 @@ class WorkerServer:
     async def heartbeat_once(self) -> None:
         conn = await self._master_conn()
         rep = await conn.call(RpcCode.WORKER_HEARTBEAT,
-                              data=pack({"info": self._info().to_wire()}))
+                              data=pack({"info": self._info().to_wire(),
+                                         "metrics": {
+            "bytes.read": self.metrics.counters.get("bytes.read", 0),
+            "bytes.written": self.metrics.counters.get("bytes.written", 0),
+        }}))
         cmds = unpack(rep.data) or {}
         for bid in cmds.get("delete_blocks", []):
             self.store.delete(bid)

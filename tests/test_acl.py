@@ -124,3 +124,19 @@ async def test_fuse_access_check():
         assert ei.value.errno == 13                       # EACCES
         Hdr.uid = 0                                       # root bypass
         assert await fs.op_access(Hdr, payload) == b""
+
+
+async def test_acl_no_existence_oracle():
+    """Probing names inside an unreadable dir must fail EACCES whether or
+    not the name exists (no error-code existence oracle)."""
+    async with MiniCluster(workers=1) as mc:
+        root = mc.client()
+        await root.meta.mkdir("/vault", mode=0o700)
+        await root.meta.create_file("/vault/real.txt")
+        bob = _client_as(mc, "bob")
+        with pytest.raises(err.PermissionDenied):
+            await bob.meta.file_status("/vault/real.txt")
+        with pytest.raises(err.PermissionDenied):
+            await bob.meta.file_status("/vault/missing.txt")   # same error
+        with pytest.raises(err.PermissionDenied):
+            await bob.meta.exists("/vault/missing.txt")

@@ -112,6 +112,48 @@ async def test_web_api():
             await web.stop()
 
 
+async def test_web_dashboard_spa():
+    """The static SPA (parity: curvine-web/webui Vue views) served by
+    aiohttp and fed by the JSON API, driven against a MiniCluster."""
+    import aiohttp
+    from curvine_tpu.web.server import WebServer
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.write_all("/dash/data.bin", b"z" * 4096)
+        # generate worker-plane traffic so byte counters are non-zero
+        await (await c.open("/dash/data.bin")).read_all()
+        await mc.workers[0].heartbeat_once()
+        web = WebServer(0, master=mc.master, host="127.0.0.1")
+        await web.start()
+        try:
+            base = f"http://127.0.0.1:{web.port}"
+            async with aiohttp.ClientSession() as s:
+                # the SPA shell + assets
+                async with s.get(base) as r:
+                    html = await r.text()
+                    assert '/ui/app.js' in html
+                async with s.get(f"{base}/ui/app.js") as r:
+                    assert r.status == 200
+                    js = await r.text()
+                    assert "overview" in js and "sparkline" in js
+                async with s.get(f"{base}/ui/app.css") as r:
+                    assert r.status == 200
+                # data feeds the SPA renders from
+                async with s.get(f"{base}/api/workers") as r:
+                    ws = await r.json()
+                    assert len(ws) == 1
+                    assert ws[0]["storages"][0]["capacity"] > 0
+                async with s.get(f"{base}/api/metrics.json") as r:
+                    m = await r.json()
+                    assert m.get("bytes.written", 0) >= 4096
+                async with s.get(f"{base}/api/browse?path=/dash") as r:
+                    ls = await r.json()
+                    assert ls[0]["name"] == "data.bin"
+                    assert "mode" in ls[0] and "owner" in ls[0]
+        finally:
+            await web.stop()
+
+
 def test_cli_quota(cluster_loop, capsys):
     mc = cluster_loop
     assert _cv(mc, "mkdir", "/qcli") == 0

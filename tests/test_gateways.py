@@ -94,3 +94,25 @@ async def test_webhdfs_gateway():
                     assert r.status == 404
         finally:
             await gw.stop()
+
+
+async def test_s3_gateway_rejects_bucket_escape():
+    """A key whose normalized path escapes /<bucket>/ (e.g. '..%2Fother')
+    must be rejected, not silently cross bucket boundaries."""
+    import aiohttp
+    from curvine_tpu.gateway.s3 import S3Gateway
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.write_all("/other/secret.bin", b"hidden")
+        gw = S3Gateway(c, port=0, host="127.0.0.1")
+        await gw.start()
+        try:
+            base = f"http://127.0.0.1:{gw.port}"
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"{base}/bkt/..%2Fother/secret.bin") as r:
+                    assert r.status == 400
+                async with s.put(f"{base}/bkt/..%2F..%2Fescape.bin",
+                                 data=b"x") as r:
+                    assert r.status == 400
+        finally:
+            await gw.stop()

@@ -87,7 +87,7 @@ class MasterServer:
         # (parity: curvine-common/src/executor/ ScheduledExecutor)
         interval = self.conf.master.heartbeat_check_ms / 1000
         self.executor.submit_periodic("heartbeat-check",
-                                      self.fs.check_lost_workers, interval)
+                                      self._heartbeat_tick, interval)
         self.executor.submit_periodic("lease-recovery",
                                       self.fs.recover_stale_leases, 30.0)
         self.executor.submit("ttl", self.ttl.run())
@@ -95,6 +95,21 @@ class MasterServer:
         self.executor.submit("jobs", self.jobs.run())
         self.executor.submit("quota", self.quota.run())
         log.info("master started at %s", self.addr)
+
+    def _heartbeat_tick(self) -> None:
+        self.fs.check_lost_workers()
+        # prune byte counters of dead workers even when no heartbeat
+        # arrives to do it (a lone worker's last snapshot must not pin
+        # the throughput gauges forever)
+        live = {w.address.worker_id for w in self.fs.workers.live_workers()}
+        if any(k not in live for k in self._worker_counters):
+            self._worker_counters = {k: v for k, v
+                                     in self._worker_counters.items()
+                                     if k in live}
+            for name in ("bytes.read", "bytes.written"):
+                self.metrics.gauge(name, sum(
+                    c.get(name, 0)
+                    for c in self._worker_counters.values()))
 
     async def stop(self) -> None:
         if self.raft is not None:
@@ -306,13 +321,26 @@ class MasterServer:
         self.acl.check(ctx, q["dst"], W | X, on_parent=True)
         return {"result": self.fs.rename(q["src"], q["dst"])}
 
-    def _add_block(self, q):
+    def _check_write_lease(self, q) -> None:
+        """Writes to an OPEN file are restricted to the lease holder (the
+        client that created/appended it, which was ACL-authorized then);
+        everyone else needs W — and traverse is always enforced so open
+        files can't be probed through unreadable dirs."""
+        ctx = UserCtx.from_req(q)
+        self.acl.check(ctx, q["path"], 0)             # traverse, always
         node = self.fs.tree.resolve(q["path"])
-        # an open (incomplete) file is written under the creating client's
-        # lease: create/append authorized the write already, and POSIX
-        # lets the creating fd write regardless of the new file's mode
-        if node is None or node.is_complete:
-            self.acl.check(UserCtx.from_req(q), q["path"], W)
+        if node is not None and not node.is_complete and node.client_name:
+            caller = q.get("client_name") or q.get("client_id")
+            if caller == node.client_name or self.acl._is_super(ctx):
+                return                                # lease holder
+            from curvine_tpu.common import errors as cerr
+            raise cerr.LeaseConflict(
+                f"{q['path']} is open by another client")
+        self.acl.check(ctx, q["path"], W)
+
+    def _add_block(self, q):
+        self._check_write_lease(q)
+        node = self.fs.tree.resolve(q["path"])
         if node is not None:
             self.quota.check_create(q["path"], new_bytes=node.block_size,
                                     new_files=0)
@@ -325,9 +353,7 @@ class MasterServer:
         return {"block": lb.to_wire()}
 
     def _complete_file(self, q):
-        node = self.fs.tree.resolve(q["path"])
-        if node is None or node.is_complete:
-            self.acl.check(UserCtx.from_req(q), q["path"], W)
+        self._check_write_lease(q)
         ok = self.fs.complete_file(
             q["path"], q.get("len", 0),
             commit_blocks=[CommitBlock.from_wire(c)

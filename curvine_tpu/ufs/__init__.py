@@ -4,6 +4,8 @@ from curvine_tpu.ufs.base import Ufs, UfsStatus, create_ufs, register_scheme
 import curvine_tpu.ufs.local   # noqa: F401  (file://)
 import curvine_tpu.ufs.memory  # noqa: F401  (mem://)
 import curvine_tpu.ufs.s3      # noqa: F401  (s3://, env-gated)
-import curvine_tpu.ufs.stubs   # noqa: F401  (oss/cos/gcs/azblob/hdfs)
+import curvine_tpu.ufs.hdfs    # noqa: F401  (hdfs:// via WebHDFS REST)
+import curvine_tpu.ufs.gcs     # noqa: F401  (gs://gcs:// via XML interop)
+import curvine_tpu.ufs.stubs   # noqa: F401  (oss/cos/azblob, env-gated)
 
 __all__ = ["Ufs", "UfsStatus", "create_ufs", "register_scheme"]

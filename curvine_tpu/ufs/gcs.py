@@ -1,0 +1,45 @@
+"""gs:///gcs:// UFS adapter — GCS via the XML interoperability API.
+
+Parity: curvine-ufs opendal services-gcs. Google Cloud Storage's XML API
+is S3-wire-compatible when used with HMAC interoperability keys, so this
+rides the same SigV4 client as s3:// with the GCS endpoint as the
+default. Properties/env:
+
+  gcs.endpoint_url       default https://storage.googleapis.com
+                         (point at any S3-compatible endpoint for tests)
+  gcs.credentials.access / gcs.credentials.secret
+                         HMAC interop key pair (falls back to
+                         GCS_ACCESS_KEY_ID/GCS_SECRET_ACCESS_KEY, then
+                         the s3.* properties / AWS_* env)
+"""
+
+from __future__ import annotations
+
+import os
+
+from curvine_tpu.ufs.base import register_scheme
+from curvine_tpu.ufs.s3 import S3Ufs
+
+
+class GcsUfs(S3Ufs):
+    scheme = "gcs"
+
+    def __init__(self, properties: dict | None = None):
+        p = dict(properties or {})
+        p.setdefault("s3.endpoint_url",
+                     p.get("gcs.endpoint_url")
+                     or os.environ.get("GCS_ENDPOINT_URL",
+                                       "https://storage.googleapis.com"))
+        access = (p.get("gcs.credentials.access")
+                  or os.environ.get("GCS_ACCESS_KEY_ID"))
+        secret = (p.get("gcs.credentials.secret")
+                  or os.environ.get("GCS_SECRET_ACCESS_KEY"))
+        if access:
+            p.setdefault("s3.credentials.access", access)
+        if secret:
+            p.setdefault("s3.credentials.secret", secret)
+        super().__init__(p)
+
+
+register_scheme("gcs", GcsUfs)
+register_scheme("gs", GcsUfs)

@@ -33,10 +33,7 @@ register_scheme("oss", _gated(
     "oss", "set s3.endpoint_url to the OSS S3-compatible endpoint"))
 register_scheme("cos", _gated(
     "cos", "set s3.endpoint_url to the COS S3-compatible endpoint"))
-register_scheme("gcs", _gated(
-    "gcs", "set s3.endpoint_url to the GCS interoperability endpoint"))
 register_scheme("azblob", _gated(
     "azblob", "Azure Blob needs an azblob backend (not bundled)"))
-register_scheme("hdfs", _gated(
-    "hdfs", "HDFS needs a JVM/webhdfs bridge (not bundled); "
-            "use webhdfs via s3.endpoint_url-style gateway if available"))
+# gcs:// and hdfs:// have real backends now (ufs/gcs.py via the XML
+# interop API, ufs/hdfs.py via WebHDFS REST) — no longer stubbed.

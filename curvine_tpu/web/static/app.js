@@ -4,6 +4,10 @@
    Parity: curvine-web/webui/src/views/. */
 
 const $ = (s, el) => (el || document).querySelector(s);
+/* every server-sourced string goes through esc() before innerHTML —
+   file names / owners / hostnames are user-controlled (stored XSS) */
+const esc = v => String(v).replace(/[&<>"']/g,
+  c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
 const view = $("#view");
 const gib = n => (n / 2 ** 30).toFixed(2) + " GiB";
 const bytesFmt = n => n >= 2 ** 30 ? gib(n)
@@ -38,10 +42,7 @@ function sparkline(canvas, data, color, tipFmt) {
   const W = canvas.width = canvas.clientWidth * devicePixelRatio;
   const H = canvas.height = canvas.clientHeight * devicePixelRatio;
   ctx.clearRect(0, 0, W, H);
-  if (data.length < 2) {
-    ctx.fillStyle = getComputedStyle(canvas).color;
-    return;
-  }
+  if (data.length < 2) return;   // nothing to draw yet
   const max = Math.max(...data, 1e-9);
   const px = i => (i / (data.length - 1)) * (W - 8) + 4;
   const py = v => H - 6 - (v / max) * (H - 16);
@@ -111,7 +112,7 @@ async function workers() {
     }).join("");
     return `<tr>
       <td>${w.address.worker_id}</td>
-      <td>${w.address.hostname}:${w.address.rpc_port}</td>
+      <td>${esc(w.address.hostname)}:${w.address.rpc_port}</td>
       <td><span class="status ${w.state === 0 ? "live" : "lost"}">
         <span class="dot"></span>${w.state === 0 ? "LIVE" : "LOST"}</span></td>
       <td style="min-width:380px">${tiers}</td>
@@ -130,15 +131,16 @@ async function browse(path) {
   let acc = "";
   const crumbs = ['<a href="#/browse/">/</a>'].concat(parts.map(p => {
     acc += "/" + p;
-    return `<a href="#/browse${acc}">${p}</a>`;
+    return `<a href="#/browse${encodeURI(acc)}">${esc(p)}</a>`;
   })).join(" / ");
-  if (sts.error) { view.innerHTML = `<div class="crumbs">${crumbs}</div><div class="empty">${sts.error}</div>`; return; }
+  if (sts.error) { view.innerHTML = `<div class="crumbs">${crumbs}</div><div class="empty">${esc(sts.error)}</div>`; return; }
   const rows = sts.map(s => `<tr>
       <td>${s.is_dir
-        ? `<a href="#/browse${s.path}">${s.name}/</a>` : s.name}</td>
+        ? `<a href="#/browse${encodeURI(s.path)}">${esc(s.name)}/</a>`
+        : esc(s.name)}</td>
       <td>${s.is_dir ? "—" : bytesFmt(s.len)}</td>
       <td>${fmtMode(s)}</td>
-      <td>${s.owner}:${s.group}</td>
+      <td>${esc(s.owner)}:${esc(s.group)}</td>
       <td>${s.replicas}</td>
       <td>${new Date(s.mtime).toISOString().replace("T", " ").slice(0, 19)}</td>
     </tr>`).join("");
@@ -157,8 +159,8 @@ function fmtMode(s) {
 
 async function mounts() {
   const ms = await api("/api/mounts");
-  const rows = ms.map(m => `<tr><td>${m.cv_path}</td><td>${m.ufs_path}</td>
-    <td>${m.write_type}</td><td>${m.auto_cache ? "yes" : "no"}</td></tr>`).join("");
+  const rows = ms.map(m => `<tr><td>${esc(m.cv_path)}</td><td>${esc(m.ufs_path)}</td>
+    <td>${esc(m.write_type)}</td><td>${m.auto_cache ? "yes" : "no"}</td></tr>`).join("");
   view.innerHTML = `<h2>Mount table</h2><table>
     <tr><th>cv path</th><th>ufs path</th><th>write mode</th><th>auto-cache</th></tr>
     ${rows || `<tr><td colspan="4" class="empty">no mounts</td></tr>`}</table>`;
@@ -167,8 +169,8 @@ async function mounts() {
 async function jobs() {
   const js = await api("/api/jobs");
   const STATES = ["PENDING", "RUNNING", "COMPLETED", "FAILED", "CANCELLED"];
-  const rows = js.map(j => `<tr><td>${j.job_id}</td><td>${j.kind}</td>
-    <td>${j.path || ""}</td><td>${STATES[j.state] ?? j.state}</td>
+  const rows = js.map(j => `<tr><td>${esc(j.job_id)}</td><td>${esc(j.kind)}</td>
+    <td>${esc(j.path || "")}</td><td>${esc(STATES[j.state] ?? j.state)}</td>
     <td>${j.progress != null ? (j.progress * 100).toFixed(0) + "%" : ""}</td></tr>`).join("");
   view.innerHTML = `<h2>Jobs</h2><table>
     <tr><th>id</th><th>kind</th><th>path</th><th>state</th><th>progress</th></tr>
@@ -187,7 +189,7 @@ async function route() {
     if (name === "browse") await browse(m[2] || "/");
     else await (routes[name] || overview)();
   } catch (e) {
-    view.innerHTML = `<div class="empty">error: ${e}</div>`;
+    view.innerHTML = `<div class="empty">error: ${esc(e)}</div>`;
   }
 }
 window.addEventListener("hashchange", route);

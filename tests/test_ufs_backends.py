@@ -1,0 +1,110 @@
+"""Real hdfs:// and gcs:// UFS backends, tested against our own gateways.
+
+The HDFS adapter is a WebHDFS REST client — exercised against the
+WebHDFS protocol `gateway/webhdfs.py` serves (client and server of the
+same protocol proving each other). The GCS adapter rides the S3-wire
+XML interop API — exercised against our own S3 gateway as the
+"interoperability endpoint". Parity: curvine-ufs/src/fs/ (opendal gcs +
+hdfs services)."""
+
+import pytest
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.testing import MiniCluster
+from curvine_tpu.ufs import create_ufs
+
+
+async def test_hdfs_ufs_against_own_webhdfs_gateway():
+    from curvine_tpu.gateway.webhdfs import WebHdfsGateway
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        gw = WebHdfsGateway(c, port=0, host="127.0.0.1")
+        await gw.start()
+        try:
+            base = f"hdfs://127.0.0.1:{gw.port}"
+            ufs = create_ufs(base + "/")
+            # write → stat → read → list → rename → delete, full loop
+            await ufs.mkdir(f"{base}/data")
+            n = await ufs.write_all(f"{base}/data/obj.bin", b"hdfs-bytes" * 100)
+            assert n == 1000
+            st = await ufs.stat(f"{base}/data/obj.bin")
+            assert st is not None and st.len == 1000 and not st.is_dir
+            data = await ufs.read_all(f"{base}/data/obj.bin")
+            assert data == b"hdfs-bytes" * 100
+            # ranged read
+            out = bytearray()
+            async for chunk in ufs.read(f"{base}/data/obj.bin",
+                                        offset=10, length=20):
+                out += chunk
+            assert bytes(out) == (b"hdfs-bytes" * 100)[10:30]
+            ls = await ufs.list(f"{base}/data")
+            assert [s.path.rsplit("/", 1)[-1] for s in ls] == ["obj.bin"]
+            await ufs.rename(f"{base}/data/obj.bin", f"{base}/data/obj2.bin")
+            assert await ufs.stat(f"{base}/data/obj.bin") is None
+            await ufs.delete(f"{base}/data/obj2.bin")
+            assert await ufs.stat(f"{base}/data/obj2.bin") is None
+            await ufs.close()
+        finally:
+            await gw.stop()
+
+
+async def test_mount_hdfs_cluster_as_understore():
+    """Cluster B mounts cluster A (served over WebHDFS) as its UFS: the
+    unified read-through path streams uncached data from another cluster
+    — the multi-cluster federation story."""
+    from curvine_tpu.gateway.webhdfs import WebHdfsGateway
+    async with MiniCluster(workers=1) as upstream:
+        up = upstream.client()
+        await up.write_all("/warm/shard-0.bin", b"U" * 4096)
+        gw = WebHdfsGateway(up, port=0, host="127.0.0.1")
+        await gw.start()
+        try:
+            async with MiniCluster(workers=1) as mc:
+                c = mc.client()
+                await c.meta.mount("/up", f"hdfs://127.0.0.1:{gw.port}/warm")
+                sts = await c.meta.list_status("/up")
+                assert [s.name for s in sts] == ["shard-0.bin"]
+                reader = await c.unified_open("/up/shard-0.bin")
+                assert await reader.read_all() == b"U" * 4096
+        finally:
+            await gw.stop()
+
+
+async def test_gcs_ufs_against_own_s3_gateway():
+    from curvine_tpu.gateway.s3 import S3Gateway
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.meta.mkdir("/bkt")
+        gw = S3Gateway(c, port=0, host="127.0.0.1")
+        await gw.start()
+        try:
+            props = {"gcs.endpoint_url": f"http://127.0.0.1:{gw.port}",
+                     "gcs.credentials.access": "interop-key",
+                     "gcs.credentials.secret": "interop-secret"}
+            ufs = create_ufs("gs://bkt/", properties=props)
+            assert type(ufs).__name__ == "GcsUfs"
+            await ufs.write_all("gs://bkt/obj/a.bin", b"gcs-data" * 64)
+            st = await ufs.stat("gs://bkt/obj/a.bin")
+            assert st is not None and st.len == 512
+            assert await ufs.read_all("gs://bkt/obj/a.bin") == b"gcs-data" * 64
+            names = [s.path for s in await ufs.list("gs://bkt/obj/")]
+            assert any(p.endswith("a.bin") for p in names)
+            await ufs.delete("gs://bkt/obj/a.bin")
+            assert await ufs.stat("gs://bkt/obj/a.bin") is None
+        finally:
+            await gw.stop()
+
+
+def test_gcs_default_endpoint_is_google():
+    ufs = create_ufs("gs://some-bucket/", properties={
+        "gcs.credentials.access": "k", "gcs.credentials.secret": "s"})
+    assert ufs.endpoint == "https://storage.googleapis.com"
+    assert ufs.object_url("gs://b/k.bin").startswith(
+        "https://storage.googleapis.com/b/")
+
+
+def test_hdfs_scheme_registered_for_mount_typecheck():
+    ufs = create_ufs("hdfs://nn:9870/")
+    assert ufs.scheme == "hdfs"
+    assert ufs._url("hdfs://nn:9870/a/b.bin", "OPEN", offset=5) == \
+        "http://nn:9870/webhdfs/v1/a/b.bin?op=OPEN&offset=5"

@@ -98,18 +98,18 @@ class MasterServer:
 
     def _heartbeat_tick(self) -> None:
         self.fs.check_lost_workers()
-        # prune byte counters of dead workers even when no heartbeat
-        # arrives to do it (a lone worker's last snapshot must not pin
-        # the throughput gauges forever)
+        # dead workers' last snapshots must not pin the gauges forever
+        self._prune_worker_counters()
+
+    def _prune_worker_counters(self) -> None:
         live = {w.address.worker_id for w in self.fs.workers.live_workers()}
         if any(k not in live for k in self._worker_counters):
             self._worker_counters = {k: v for k, v
                                      in self._worker_counters.items()
                                      if k in live}
-            for name in ("bytes.read", "bytes.written"):
-                self.metrics.gauge(name, sum(
-                    c.get(name, 0)
-                    for c in self._worker_counters.values()))
+        for name in ("bytes.read", "bytes.written"):
+            self.metrics.gauge(name, sum(
+                c.get(name, 0) for c in self._worker_counters.values()))
 
     async def stop(self) -> None:
         if self.raft is not None:
@@ -451,14 +451,26 @@ class MasterServer:
             self.metrics.inc(f"client.{name}", value)
         return {}
 
+    @staticmethod
+    def _with_identity(q: dict, r: dict) -> dict:
+        """Batch RPCs carry identity on the OUTER request; it must be
+        stamped onto every inner one (and win over anything smuggled
+        there) or ACL/lease checks would see the default superuser."""
+        ident = {k: q[k] for k in ("user", "groups", "client_name",
+                                   "client_id") if k in q}
+        return {**r, **ident}
+
     def _create_files_batch(self, q):
-        return {"responses": [self._create_file(r) for r in q["requests"]]}
+        return {"responses": [self._create_file(self._with_identity(q, r))
+                              for r in q["requests"]]}
 
     def _add_blocks_batch(self, q):
-        return {"responses": [self._add_block(r) for r in q["requests"]]}
+        return {"responses": [self._add_block(self._with_identity(q, r))
+                              for r in q["requests"]]}
 
     def _complete_files_batch(self, q):
-        return {"responses": [self._complete_file(r) for r in q["requests"]]}
+        return {"responses": [self._complete_file(self._with_identity(q, r))
+                              for r in q["requests"]]}
 
     # --- worker plane ---
     def _worker_heartbeat(self, q):
@@ -471,14 +483,7 @@ class MasterServer:
             # snapshots don't inflate the gauges forever
             wid = q["info"]["address"]["worker_id"]
             self._worker_counters[wid] = wm
-            live = {w.address.worker_id
-                    for w in self.fs.workers.live_workers()}
-            self._worker_counters = {k: v for k, v
-                                     in self._worker_counters.items()
-                                     if k in live}
-            for name in ("bytes.read", "bytes.written"):
-                self.metrics.gauge(name, sum(
-                    c.get(name, 0) for c in self._worker_counters.values()))
+            self._prune_worker_counters()
         return cmds
 
     def _worker_block_report(self, q):

@@ -75,3 +75,103 @@ class HbmTier:
         return {"capacity": self.capacity, "used": self.used,
                 "blocks": len(self._blocks), "hits": self.hits,
                 "misses": self.misses}
+
+
+class MultiHbmTier:
+    """HBM tier-0 across ALL local chips of a TPU host (a v5e host drives
+    4-8). One HbmTier per device with independent capacity accounting;
+    placement picks the least-used chip (or an explicit target), and hot
+    blocks can be spread as replicas across chips so every consumer
+    reads HBM-locally instead of crossing PCIe or ICI.
+
+    This is the multi-chip completion of the round-2 single-device tier
+    (which bound jax.devices()[0] only)."""
+
+    def __init__(self, capacity_bytes: int, devices=None):
+        """``capacity_bytes`` is the TOTAL HBM budget for the tier (the
+        operator's `worker.hbm_capacity`), split evenly across the local
+        chips — same semantics as the round-2 single-device tier, so the
+        advertised capacity doesn't silently multiply by chip count."""
+        devices = devices if devices is not None else jax.local_devices()
+        if not devices:
+            raise ValueError("no local devices for the HBM tier")
+        per_chip = max(1, capacity_bytes // len(devices))
+        self.tiers: dict = {d.id: HbmTier(per_chip, device=d)
+                            for d in devices}
+        self.devices = list(devices)
+
+    # ---- capacity (per chip, for heartbeat advertisement) ----
+    @property
+    def capacity(self) -> int:
+        return sum(t.capacity for t in self.tiers.values())
+
+    @property
+    def used(self) -> int:
+        return sum(t.used for t in self.tiers.values())
+
+    def per_device_stats(self) -> list[dict]:
+        return [{"device_id": did, **t.stats()}
+                for did, t in sorted(self.tiers.items())]
+
+    # ---- placement ----
+    def _pick(self) -> "HbmTier":
+        return min(self.tiers.values(), key=lambda t: t.used)
+
+    def _tier_of(self, device) -> "HbmTier":
+        did = getattr(device, "id", device)
+        t = self.tiers.get(did)
+        if t is None:
+            raise ValueError(f"device {did} is not part of the HBM tier")
+        return t
+
+    def put(self, block_id: int, data, device=None) -> jax.Array:
+        """Pin on one chip: the consumer's chip when given, else the
+        least-used chip (capacity-balanced placement)."""
+        for t in self.tiers.values():         # already resident somewhere?
+            if block_id in t:
+                if device is None or getattr(device, "id", device) == \
+                        t.device.id:
+                    return t.get(block_id)
+        t = self._tier_of(device) if device is not None else self._pick()
+        return t.put(block_id, data)
+
+    def put_replicated(self, block_id: int, data, k: int | None = None
+                       ) -> list[jax.Array]:
+        """Spread a hot block as replicas across k chips (all local chips
+        by default) — every consumer then reads its own HBM copy. Replica
+        chips are chosen least-used-first (ICI-local by construction:
+        local_devices share the host's ICI neighborhood)."""
+        targets = sorted(self.tiers.values(), key=lambda t: t.used)
+        targets = targets[:k if k is not None else len(targets)]
+        return [t.put(block_id, data) for t in targets]
+
+    def get(self, block_id: int, device=None) -> jax.Array | None:
+        """Prefer the copy on `device` (HBM-local read); fall back to any
+        chip holding it."""
+        if device is not None:
+            t = self.tiers.get(getattr(device, "id", device))
+            if t is not None and block_id in t:
+                return t.get(block_id)
+        for t in self.tiers.values():
+            if block_id in t:
+                return t.get(block_id)
+        return None
+
+    def holders(self, block_id: int) -> list[int]:
+        return [did for did, t in sorted(self.tiers.items())
+                if block_id in t]
+
+    def drop(self, block_id: int) -> None:
+        for t in self.tiers.values():
+            t.drop(block_id)
+
+    def __contains__(self, block_id: int) -> bool:
+        return any(block_id in t for t in self.tiers.values())
+
+    def stats(self) -> dict:
+        agg = {"capacity": self.capacity, "used": self.used,
+               "devices": len(self.tiers),
+               "blocks": len({b for t in self.tiers.values()
+                              for b in t._blocks})}
+        agg["per_device"] = self.per_device_stats()
+        return agg

@@ -112,8 +112,12 @@ class HdfsUfs(Ufs):
                 yield chunk
 
     async def write(self, uri: str, chunks) -> int:
-        """Streams the async chunk iterator straight into the PUT body
-        (chunked transfer) — no whole-object buffering."""
+        """WebHDFS two-step CREATE, streaming the chunk iterator into the
+        data PUT (no whole-object buffering). A real namenode answers the
+        bodyless step-1 PUT with a 307 redirect to a datanode; single-hop
+        servers (like our own gateway) answer 2xx directly and get the
+        body in a second direct PUT. Either way the one-shot generator is
+        consumed exactly once."""
         total = 0
 
         async def body():
@@ -123,10 +127,17 @@ class HdfsUfs(Ufs):
                 yield bytes(chunk)
 
         s = await self._http()
-        async with s.put(self._url(uri, "CREATE", overwrite="true"),
-                         data=body()) as r:
-            if r.status >= 400:
-                await self._raise_remote(r, uri)
+        url = self._url(uri, "CREATE", overwrite="true")
+        async with s.put(url, allow_redirects=False) as r1:
+            if r1.status in (301, 302, 307):
+                target = r1.headers.get("Location", url)
+            elif r1.status < 400:
+                target = url          # single-hop server: re-PUT with data
+            else:
+                await self._raise_remote(r1, uri)
+        async with s.put(target, data=body()) as r2:
+            if r2.status >= 400:
+                await self._raise_remote(r2, uri)
         return total
 
     async def delete(self, uri: str) -> None:
@@ -135,6 +146,13 @@ class HdfsUfs(Ufs):
                                       recursive="true")) as r:
             if r.status >= 400:
                 await self._raise_remote(r, uri)
+            # WebHDFS signals "nothing deleted" as 200 {"boolean": false}
+            try:
+                ok = (await r.json()).get("boolean", True)
+            except Exception:
+                ok = True
+            if not ok:
+                raise err.FileNotFound(uri)
 
     async def mkdir(self, uri: str) -> None:
         s = await self._http()

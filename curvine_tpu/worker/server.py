@@ -57,8 +57,10 @@ class WorkerServer:
         self.hbm = None
         if wc.hbm_capacity > 0:
             try:
-                from curvine_tpu.tpu.hbm import HbmTier
-                self.hbm = HbmTier(wc.hbm_capacity)
+                # one tier per local chip (a TPU host drives 4-8): per-chip
+                # capacity accounting, least-used placement, replica spread
+                from curvine_tpu.tpu.hbm import MultiHbmTier
+                self.hbm = MultiHbmTier(wc.hbm_capacity)
             except Exception as e:  # noqa: BLE001 — no device available
                 log.warning("hbm tier disabled: %s", e)
         self._bg: list[asyncio.Task] = []
@@ -113,11 +115,22 @@ class WorkerServer:
         storages = self.store.storages()
         if self.hbm is not None:
             from curvine_tpu.common.types import StorageInfo
-            storages.insert(0, StorageInfo(
-                storage_type=StorageType.HBM, dir_id="hbm:0",
-                capacity=self.hbm.capacity,
-                available=self.hbm.capacity - self.hbm.used,
-                block_num=len(self.hbm._blocks)))
+            if hasattr(self.hbm, "per_device_stats"):
+                # one HBM StorageInfo PER CHIP: the master sees per-device
+                # capacity, not a single opaque pool
+                for s in reversed(self.hbm.per_device_stats()):
+                    storages.insert(0, StorageInfo(
+                        storage_type=StorageType.HBM,
+                        dir_id=f"hbm:{s['device_id']}",
+                        capacity=s["capacity"],
+                        available=s["capacity"] - s["used"],
+                        block_num=s["blocks"]))
+            else:                              # single-device tier
+                storages.insert(0, StorageInfo(
+                    storage_type=StorageType.HBM, dir_id="hbm:0",
+                    capacity=self.hbm.capacity,
+                    available=self.hbm.capacity - self.hbm.used,
+                    block_num=len(self.hbm._blocks)))
         return WorkerInfo(address=self.address, storages=storages,
                           last_heartbeat_ms=now_ms(),
                           ici_coords=list(self.conf.worker.ici_coords))
@@ -444,9 +457,19 @@ class WorkerServer:
             os.preadv(fd, [memoryview(buf)], 0)
         finally:
             os.close(fd)
-        arr = await asyncio.to_thread(self.hbm.put, block_id, buf)
+        multi = hasattr(self.hbm, "tiers")     # MultiHbmTier vs single
+        if multi and q.get("replicas", 1) > 1:
+            arrs = await asyncio.to_thread(self.hbm.put_replicated,
+                                           block_id, buf, q["replicas"])
+            arr = arrs[0]
+        elif multi:
+            arr = await asyncio.to_thread(self.hbm.put, block_id, buf,
+                                          q.get("device_id"))
+        else:
+            arr = await asyncio.to_thread(self.hbm.put, block_id, buf)
         self.metrics.gauge("hbm.used", self.hbm.used)
         return {"block_id": block_id, "len": int(arr.nbytes),
+                "holders": self.hbm.holders(block_id) if multi else [0],
                 "hbm": self.hbm.stats()}
 
     async def _hbm_unpin(self, msg: Message, conn: ServerConn):

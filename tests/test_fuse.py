@@ -313,3 +313,67 @@ def test_real_mount_shell_write_patterns(tmp_path):
         asyncio.run_coroutine_threadsafe(mc.stop(), loop).result(30)
         loop.call_soon_threadsafe(loop.stop)
         t.join(5)
+
+
+@pytest.mark.skipif(not FUSE_AVAILABLE, reason="no /dev/fuse")
+def test_real_mount_fio_style_workloads(tmp_path):
+    """The reference's headline bench is fio over FUSE; this runs the
+    same access patterns (seq write, seq read, random 4k reads) as POSIX
+    IO against a real kernel mount and asserts they complete correctly.
+    In-place rewrite of committed data is the documented unsupported
+    pattern (docs/fuse-semantics.md) and must fail EOPNOTSUPP, not
+    corrupt."""
+    import errno
+    import random
+    from curvine_tpu.fuse.mount import fusermount_mount, fusermount_umount
+    from curvine_tpu.fuse.ops import CurvineFuseFs
+    from curvine_tpu.fuse.session import FuseSession
+
+    mnt = str(tmp_path / "mnt")
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    mc = MiniCluster(workers=1)
+    asyncio.run_coroutine_threadsafe(mc.start(), loop).result(30)
+    session = None
+    try:
+        client = asyncio.run_coroutine_threadsafe(
+            asyncio.sleep(0, result=mc.client()), loop).result(10)
+        fd = fusermount_mount(mnt)
+        fs = CurvineFuseFs(client, uid=os.getuid(), gid=os.getgid())
+        session = FuseSession(fs, fd)
+        asyncio.run_coroutine_threadsafe(session.run(), loop)
+
+        total, bs = 8 * 1024 * 1024, 1024 * 1024
+        payload = os.urandom(total)
+        # fio seq write
+        with open(f"{mnt}/fio.bin", "wb") as f:
+            for off in range(0, total, bs):
+                f.write(payload[off:off + bs])
+        # fio seq read
+        with open(f"{mnt}/fio.bin", "rb", buffering=0) as f:
+            got = bytearray()
+            while chunk := f.read(bs):
+                got += chunk
+        assert bytes(got) == payload
+        # fio randread 4k
+        rng = random.Random(0)
+        fd2 = os.open(f"{mnt}/fio.bin", os.O_RDONLY)
+        for _ in range(64):
+            off = rng.randrange(0, total - 4096)
+            assert os.pread(fd2, 4096, off) == payload[off:off + 4096]
+        os.close(fd2)
+        # documented unsupported pattern: in-place rewrite of committed
+        # data fails loudly (EOPNOTSUPP at open), never corrupts
+        with pytest.raises(OSError) as ei:
+            os.open(f"{mnt}/fio.bin", os.O_WRONLY)   # no O_TRUNC
+        assert ei.value.errno == errno.EOPNOTSUPP
+        with open(f"{mnt}/fio.bin", "rb", buffering=0) as f:
+            assert f.read(bs) == payload[:bs]        # intact
+    finally:
+        fusermount_umount(mnt)
+        if session is not None:
+            session.stop()
+        asyncio.run_coroutine_threadsafe(mc.stop(), loop).result(30)
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(5)

@@ -325,6 +325,7 @@ async def _fuse_bench(c) -> dict:
     mnt = tempfile.mkdtemp(prefix="curvine-fio-")
     out = {}
     session = None
+    sess_task = None
     try:
         fd = fusermount_mount(mnt)
         fs = CurvineFuseFs(c, uid=os.getuid(), gid=os.getgid())
@@ -361,8 +362,13 @@ async def _fuse_bench(c) -> dict:
         # the mount is served by THIS event loop: POSIX calls must run in
         # a thread or they deadlock against the FUSE session
         out = await asyncio.to_thread(blocking)
-        sess_task.cancel()
+    except Exception as e:  # noqa: BLE001 — FUSE denied (container policy
+        # etc.) must not discard every other measured result
+        print(f"fuse bench skipped: {e}", file=sys.stderr)
+        out = {}
     finally:
+        if sess_task is not None:
+            sess_task.cancel()
         try:
             fusermount_umount(mnt)
         except Exception:

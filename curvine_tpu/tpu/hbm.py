@@ -133,7 +133,16 @@ class MultiHbmTier:
                         t.device.id:
                     return t.get(block_id)
         t = self._tier_of(device) if device is not None else self._pick()
-        return t.put(block_id, data)
+        try:
+            return t.put(block_id, data)
+        except ValueError as e:
+            # hbm_capacity is the TOTAL budget split over len(tiers)
+            # chips; a block can only live on ONE chip, so the per-chip
+            # share is the real ceiling — make that actionable
+            raise ValueError(
+                f"{e} (per-chip share: {t.capacity}B = total hbm_capacity "
+                f"/ {len(self.tiers)} chips — raise worker.hbm_capacity "
+                f"or use a smaller block_size)") from e
 
     def put_replicated(self, block_id: int, data, k: int | None = None
                        ) -> list[jax.Array]:

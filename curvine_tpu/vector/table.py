@@ -33,14 +33,15 @@ def _scan_fn(metric: str, k: int):
         import jax
         import jax.numpy as jnp
 
-        def scan_knn(q, v):
+        def scan_knn(q, v, ids):
             if metric == "cosine":
                 qn = q / jnp.linalg.norm(q, axis=1, keepdims=True).clip(1e-12)
                 scores = qn @ v.T
             else:
                 scores = -(jnp.sum(q * q, 1)[:, None]
                            - 2 * q @ v.T + jnp.sum(v * v, 1)[None, :])
-            return jax.lax.top_k(scores, min(k, scores.shape[1]))
+            s, dense = jax.lax.top_k(scores, min(k, scores.shape[1]))
+            return s, jnp.take(ids, dense)   # dense idx → global row id
 
         fn = _SCAN_FNS[(metric, k)] = jax.jit(scan_knn)
     return fn
@@ -48,15 +49,21 @@ def _scan_fn(metric: str, k: int):
 
 class VectorTable:
     def __init__(self, client: CurvineClient, path: str, dim: int,
-                 columns: dict[str, str], row_groups: int):
+                 columns: dict[str, str], row_groups: int,
+                 version: int = 0, rows: int | None = None):
         self.client = client
         self.path = path.rstrip("/")
         self.dim = dim
         self.columns = columns
         self.row_groups = row_groups
-        # device-resident scan cache: the table's vectors pinned in HBM
-        # (normalized per metric), so repeated scans run at MXU speed
-        # instead of re-streaming host->device every call
+        self.version = version
+        self.rows = rows          # physical rows (None: legacy manifest)
+        # deleted global row ids (Lance-style delete vector; rows stay in
+        # their row groups until compaction rewrites them out)
+        self._deletes: set[int] | None = None
+        # device-resident scan cache: the table's LIVE vectors pinned in
+        # HBM (normalized per metric) + dense→global id map, so repeated
+        # scans run at MXU speed instead of re-streaming host->device
         self._dev_cache: dict = {}
 
     # ---------------- lifecycle ----------------
@@ -68,7 +75,7 @@ class VectorTable:
         for name, dt in columns.items():
             if dt not in _DTYPES:
                 raise err.InvalidArgument(f"column {name}: bad dtype {dt}")
-        t = VectorTable(client, path, dim, columns, 0)
+        t = VectorTable(client, path, dim, columns, 0, rows=0)
         await client.meta.mkdir(path)
         await t._write_schema()
         return t
@@ -79,13 +86,34 @@ class VectorTable:
                      ).read_all()
         s = json.loads(raw)
         return VectorTable(client, path, s["dim"], s["columns"],
-                           s["row_groups"])
+                           s["row_groups"], version=s.get("version", 0),
+                           rows=s.get("rows"))
 
     async def _write_schema(self) -> None:
         await self.client.write_all(
             f"{self.path}/schema.json",
             json.dumps({"dim": self.dim, "columns": self.columns,
-                        "row_groups": self.row_groups}).encode())
+                        "row_groups": self.row_groups,
+                        "version": self.version,
+                        "rows": self.rows}).encode())
+
+    # ---------------- delete vector ----------------
+
+    async def _load_deletes(self) -> set[int]:
+        if self._deletes is None:
+            try:
+                raw = await (await self.client.open(
+                    f"{self.path}/deletes.bin")).read_all()
+                self._deletes = set(
+                    np.frombuffer(raw, dtype=np.int64).tolist())
+            except err.CurvineError:
+                self._deletes = set()
+        return self._deletes
+
+    async def _save_deletes(self) -> None:
+        arr = np.array(sorted(self._deletes or ()), dtype=np.int64)
+        await self.client.write_all(f"{self.path}/deletes.bin",
+                                    arr.tobytes())
 
     # ---------------- append / scan ----------------
 
@@ -108,6 +136,8 @@ class VectorTable:
         await self.client.write_all(f"{self.path}/rg-{rg:05d}.vec",
                                     b"".join(parts))
         self.row_groups += 1
+        if self.rows is not None:          # legacy manifests stay lazy
+            self.rows += n
         self._dev_cache.clear()
         await self._write_schema()
         return rg
@@ -135,24 +165,108 @@ class VectorTable:
         for rg in range(self.row_groups):
             yield await self.read_group(rg)
 
-    async def count(self) -> int:
-        total = 0
+    async def _physical_rows(self) -> int:
+        if self.rows is not None:
+            return self.rows
+        total = 0                  # legacy manifest without a row count
         async for vectors, _ in self.scan():
             total += vectors.shape[0]
+        self.rows = total
         return total
+
+    async def count(self) -> int:
+        """Live rows (deletes excluded)."""
+        return await self._physical_rows() - len(await self._load_deletes())
+
+    # ---------------- delete / update / compaction ----------------
+
+    async def delete(self, row_ids) -> int:
+        """Mark global row ids deleted (Lance-style delete vector: the
+        bytes stay in their row groups until compact()). Returns how many
+        NEW rows were deleted."""
+        total = await self._physical_rows()
+        ids = [int(r) for r in np.asarray(row_ids).reshape(-1)]
+        bad = [r for r in ids if not 0 <= r < total]
+        if bad:
+            raise err.InvalidArgument(
+                f"row ids out of range [0, {total}): {bad[:5]}")
+        dels = await self._load_deletes()
+        before = len(dels)
+        dels.update(ids)
+        await self._save_deletes()
+        self._dev_cache.clear()
+        return len(dels) - before
+
+    async def update(self, row_ids, vectors: np.ndarray,
+                     columns: dict[str, np.ndarray] | None = None) -> int:
+        """delete + insert (the Lance update model): old versions are
+        tombstoned, new versions appended as a fresh row group. Returns
+        the row-group index holding the new versions."""
+        vectors = np.atleast_2d(np.asarray(vectors, dtype=np.float32))
+        row_ids = np.asarray(row_ids).reshape(-1)
+        if vectors.shape[0] != row_ids.size:
+            raise err.InvalidArgument("update rows/vectors length mismatch")
+        await self.delete(row_ids)
+        return await self.append(vectors, columns)
+
+    async def compact(self) -> int:
+        """Rewrite row groups dropping deleted rows; global row ids are
+        renumbered densely (as with Lance compaction, ids are not stable
+        across compactions). Returns live rows kept."""
+        dels = await self._load_deletes()
+        live_vecs: list[np.ndarray] = []
+        live_cols: dict[str, list[np.ndarray]] = {n: [] for n in self.columns}
+        base = 0
+        async for vectors, cols in self.scan():
+            n = vectors.shape[0]
+            keep = np.array([i for i in range(n) if base + i not in dels],
+                            dtype=np.int64)
+            if keep.size:
+                live_vecs.append(vectors[keep])
+                for name in self.columns:
+                    live_cols[name].append(np.asarray(cols[name])[keep])
+            base += n
+        old_groups = self.row_groups
+        self.row_groups = 0
+        self.rows = 0
+        self.version += 1
+        self._deletes = set()
+        # clear the delete vector on disk BEFORE rewriting rg-0: a crash
+        # mid-compaction then resurrects tombstoned rows (recoverable by
+        # re-deleting) instead of tombstoning arbitrary renumbered rows
+        await self._save_deletes()
+        kept = 0
+        if live_vecs:
+            all_vecs = np.concatenate(live_vecs, axis=0)
+            all_cols = {n: np.concatenate(v) for n, v in live_cols.items()}
+            kept = all_vecs.shape[0]
+            await self.append(all_vecs, all_cols)   # rg-00000 of the new ver
+        else:
+            await self._write_schema()
+        # drop the superseded row-group files (append() above wrote rg-0)
+        for rg in range(1 if live_vecs else 0, old_groups):
+            try:
+                await self.client.meta.delete(f"{self.path}/rg-{rg:05d}.vec")
+            except err.CurvineError:
+                pass
+        self._dev_cache.clear()
+        return kept
 
     # ---------------- TPU knn ----------------
 
     async def _device_vectors(self, metric: str, device):
-        """All row groups as ONE device-resident [N, D] array (normalized
-        for cosine), pinned across calls — the table lives in HBM like an
-        HBM-tier block, and the scan is a single MXU matmul. Row groups
-        are fetched concurrently (prefetch) on a cache miss."""
+        """LIVE rows of all row groups as ONE device-resident [N, D]
+        array (normalized for cosine) plus a dense→global row-id map,
+        pinned across calls — the table lives in HBM like an HBM-tier
+        block, and the scan is a single MXU matmul. Row groups are
+        fetched concurrently (prefetch) on a cache miss."""
         import asyncio
         import jax
         import jax.numpy as jnp
 
-        key = (metric, getattr(device, "id", device), self.row_groups)
+        dels = await self._load_deletes()
+        key = (metric, getattr(device, "id", device), self.row_groups,
+               len(dels))
         hit = self._dev_cache.get(key)
         if hit is not None:
             return hit
@@ -162,12 +276,21 @@ class VectorTable:
             *(self.read_group(rg) for rg in range(self.row_groups)))
         host = (np.concatenate([v for v, _ in groups], axis=0)
                 if len(groups) > 1 else groups[0][0])
+        if dels:
+            live = np.array([i for i in range(host.shape[0])
+                             if i not in dels], dtype=np.int32)
+            host = host[live]
+        else:
+            live = np.arange(host.shape[0], dtype=np.int32)
+        if host.shape[0] == 0:
+            raise err.FileNotFound(f"table {self.path} has no live rows")
         v = jax.device_put(host, device)
         if metric == "cosine":
             v = v / jnp.linalg.norm(v, axis=1, keepdims=True).clip(1e-12)
         v = jax.block_until_ready(v)
-        self._dev_cache = {key: v}          # one resident copy per table
-        return v
+        ids = jax.block_until_ready(jax.device_put(live, device))
+        self._dev_cache = {key: (v, ids)}   # one resident copy per table
+        return v, ids
 
     async def knn(self, query: np.ndarray, k: int = 10,
                   metric: str = "cosine", device=None,
@@ -188,16 +311,20 @@ class VectorTable:
         if query.shape[1] != self.dim:
             raise err.InvalidArgument(f"query dim {query.shape[1]} != {self.dim}")
         dev = device if device is not None else jax.devices()[0]
-        v = await self._device_vectors(metric, dev)
+        v, ids = await self._device_vectors(metric, dev)
         q = jax.device_put(query, dev)
-        s, i = _scan_fn(metric, k)(q, v)
+        s, i = _scan_fn(metric, k)(q, v, ids)
         if not materialize:
             return i, s
         return np.asarray(i), np.asarray(s)
 
     async def take(self, row_ids: np.ndarray) -> tuple[np.ndarray, dict]:
-        """Materialize rows by global row id."""
+        """Materialize rows by global row id (deleted rows are invalid)."""
         row_ids = np.asarray(row_ids).reshape(-1)
+        dels = await self._load_deletes()
+        bad = [int(r) for r in row_ids if int(r) in dels]
+        if bad:
+            raise err.InvalidArgument(f"row ids deleted: {bad[:5]}")
         out_vecs = np.zeros((row_ids.size, self.dim), dtype=np.float32)
         out_cols = {name: np.zeros(row_ids.size, dtype=_DTYPES[dt])
                     for name, dt in self.columns.items()}

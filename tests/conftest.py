@@ -4,11 +4,23 @@ sharding tests run without TPU hardware), asyncio helpers."""
 import os
 
 # Must be set before jax import anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+# A dev-env sitecustomize may have registered a remote-TPU plugin at
+# interpreter startup and overridden jax_platforms via jax.config (which
+# beats the env var). Re-assert CPU at the config level BEFORE any
+# backend initializes — otherwise a hung tunnel blocks even
+# jax.devices("cpu") and the whole suite stalls at collection.
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
 
 import asyncio
 import inspect

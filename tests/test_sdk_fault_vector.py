@@ -176,3 +176,60 @@ async def test_vector_table():
         vecs, cols = await t2.take(np.array([120, 3]))
         assert cols["doc_id"].tolist() == [120, 3]
         assert np.allclose(vecs[0], v2[20])
+
+
+async def test_vector_table_delete_update_compact():
+    """Lance-model mutations: delete vector (tombstones), update =
+    delete+insert, compaction rewrites row groups dropping dead rows.
+    Parity: curvine-lancedb table mutation surface."""
+    from curvine_tpu.common import errors as err
+    from curvine_tpu.vector import VectorTable
+    rng = np.random.default_rng(0)
+    dim = 32
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        t = await VectorTable.create(c, "/vec/mut", dim,
+                                     columns={"label": "i32"})
+        v = rng.normal(size=(100, dim)).astype(np.float32)
+        labels = np.arange(100, dtype=np.int32)
+        await t.append(v[:60], {"label": labels[:60]})
+        await t.append(v[60:], {"label": labels[60:]})
+        assert await t.count() == 100
+
+        # delete: knn never returns tombstoned rows
+        ids, _ = await t.knn(v[42], k=1, device=CPU)
+        assert int(ids[0, 0]) == 42
+        assert await t.delete([42, 7, 99]) == 3
+        assert await t.count() == 97
+        ids, _ = await t.knn(v[42], k=3, device=CPU)
+        assert 42 not in ids[0]
+        with pytest.raises(err.InvalidArgument):
+            await t.take([7])
+
+        # update: new version wins the scan
+        new_vec = rng.normal(size=(1, dim)).astype(np.float32)
+        await t.update([13], new_vec, {"label": np.array([1313],
+                                                         dtype=np.int32)})
+        ids, _ = await t.knn(new_vec[0], k=1, device=CPU)
+        new_id = int(ids[0, 0])
+        assert new_id >= 100                      # appended row
+        _, cols = await t.take([new_id])
+        assert int(cols["label"][0]) == 1313
+        assert await t.count() == 97              # -1 old, +1 new
+
+        # compact: dense renumber, deletes gone, one row group
+        kept = await t.compact()
+        assert kept == 97
+        assert t.row_groups == 1 and t.version == 1
+        assert await t.count() == 97
+        ids, _ = await t.knn(new_vec[0], k=1, device=CPU)
+        _, cols = await t.take([int(ids[0, 0])])
+        assert int(cols["label"][0]) == 1313
+        # persisted: reopen sees the compacted table
+        t2 = await VectorTable.open(c, "/vec/mut")
+        assert t2.row_groups == 1 and t2.version == 1
+        assert await t2.count() == 97
+        # superseded row-group files are gone
+        sts = await c.meta.list_status("/vec/mut")
+        assert sorted(s.name for s in sts if s.name.startswith("rg-")) == \
+            ["rg-00000.vec"]

@@ -379,8 +379,37 @@ async def _fuse_bench(c) -> dict:
     return out
 
 
+def _device_backend_alive(timeout_s: float = 120.0) -> bool:
+    """Probe device-backend init in a SUBPROCESS with a deadline: a stuck
+    remote-TPU tunnel hangs jax.devices() uninterruptibly, which would
+    hang the whole bench. If the probe can't come up, the bench re-execs
+    itself pinned to CPU so the driver still gets a JSON line (marked
+    backend=cpu) instead of a dead run."""
+    import subprocess
+    code = ("import jax; jax.devices(); "
+            "print(jax.default_backend())")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, timeout=timeout_s)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
     total_mb = int(os.environ.get("BENCH_TOTAL_MB", "256"))
+    if (os.environ.get("_CURVINE_BENCH_CHILD") != "1"
+            and not _device_backend_alive()):
+        print("bench: device backend unreachable; re-running on CPU",
+              file=sys.stderr)
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith(("TPU_", "PJRT_", "AXON_", "PALLAS_AXON",
+                                    "LIBTPU", "MEGASCALE"))}
+        env["_CURVINE_BENCH_CHILD"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+        import subprocess
+        return subprocess.call([sys.executable, __file__], env=env)
     results = asyncio.run(run_bench(total_mb=total_mb))
     value = round(results["read_gibs_into_hbm"], 3)
     out = {

@@ -1,0 +1,788 @@
+// libcurvine_sdk — native C-ABI client SDK speaking the curvine-tpu wire
+// protocol (frame layout + msgpack control plane) directly over TCP.
+//
+// Parity: curvine-libsdk (the reference ships a native JNI/PyO3 SDK built
+// on its Rust client; this is the C++ equivalent for the rebuild — a JNI
+// or any FFI shim binds this C ABI). No external dependencies: the
+// msgpack subset and crc32 are implemented here.
+//
+// Wire (rpc/frame.py parity):
+//   u32 total_len | u8 ver=1 | u16 code | u64 req_id | u8 status |
+//   u8 flags | u32 header_len | header msgpack | data
+// Control payloads are msgpack maps in `data`; block bytes stream as
+// CHUNK frames ending with an EOF frame.
+//
+// C ABI (all functions return 0 on success, -1 on error;
+// cv_sdk_last_error() returns a thread-local message):
+//   void* cv_sdk_connect(const char* host, int port, const char* user)
+//   void  cv_sdk_close(void* h)
+//   int   cv_sdk_mkdir(void* h, const char* path)
+//   int   cv_sdk_put(void* h, const char* path, const void* buf, int64 n)
+//   int64 cv_sdk_get(void* h, const char* path, void* buf, int64 cap)
+//   int64 cv_sdk_len(void* h, const char* path)      // -1: not found
+//   int   cv_sdk_delete(void* h, const char* path, int recursive)
+//   int   cv_sdk_rename(void* h, const char* src, const char* dst)
+//   int   cv_sdk_exists(void* h, const char* path)   // 1/0/-1
+//   char* cv_sdk_list(void* h, const char* path)     // JSON; cv_sdk_free
+//   void  cv_sdk_free(char* p)
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <random>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- msgpack
+struct Value {
+  enum Kind { NIL, BOOL, INT, UINT, DBL, STR, BIN, ARR, MAP } kind = NIL;
+  bool b = false;
+  int64_t i = 0;
+  uint64_t u = 0;
+  double d = 0;
+  std::string s;                      // STR and BIN
+  std::vector<Value> arr;
+  std::vector<std::pair<std::string, Value>> map;  // string keys only
+
+  int64_t as_int() const {
+    if (kind == INT) return i;
+    if (kind == UINT) return static_cast<int64_t>(u);
+    if (kind == DBL) return static_cast<int64_t>(d);
+    return 0;
+  }
+  bool as_bool() const { return kind == BOOL ? b : as_int() != 0; }
+  const Value* get(const std::string& key) const {
+    for (auto& kv : map)
+      if (kv.first == key) return &kv.second;
+    return nullptr;
+  }
+};
+
+void pack_value(std::string& out, const Value& v);
+
+void pack_uint(std::string& out, uint64_t u) {
+  if (u < 128) {
+    out.push_back(static_cast<char>(u));
+  } else if (u <= 0xFF) {
+    out.push_back('\xcc');
+    out.push_back(static_cast<char>(u));
+  } else if (u <= 0xFFFF) {
+    out.push_back('\xcd');
+    uint16_t x = htons(static_cast<uint16_t>(u));
+    out.append(reinterpret_cast<char*>(&x), 2);
+  } else if (u <= 0xFFFFFFFFULL) {
+    out.push_back('\xce');
+    uint32_t x = htonl(static_cast<uint32_t>(u));
+    out.append(reinterpret_cast<char*>(&x), 4);
+  } else {
+    out.push_back('\xcf');
+    for (int s = 56; s >= 0; s -= 8)
+      out.push_back(static_cast<char>((u >> s) & 0xFF));
+  }
+}
+
+void pack_int(std::string& out, int64_t i) {
+  if (i >= 0) {
+    pack_uint(out, static_cast<uint64_t>(i));
+    return;
+  }
+  if (i >= -32) {
+    out.push_back(static_cast<char>(i));
+  } else if (i >= INT8_MIN) {
+    out.push_back('\xd0');
+    out.push_back(static_cast<char>(i));
+  } else if (i >= INT16_MIN) {
+    out.push_back('\xd1');
+    uint16_t x = htons(static_cast<uint16_t>(i));
+    out.append(reinterpret_cast<char*>(&x), 2);
+  } else if (i >= INT32_MIN) {
+    out.push_back('\xd2');
+    uint32_t x = htonl(static_cast<uint32_t>(i));
+    out.append(reinterpret_cast<char*>(&x), 4);
+  } else {
+    out.push_back('\xd3');
+    for (int s = 56; s >= 0; s -= 8)
+      out.push_back(static_cast<char>((static_cast<uint64_t>(i) >> s) & 0xFF));
+  }
+}
+
+void pack_str(std::string& out, const std::string& s) {
+  size_t n = s.size();
+  if (n < 32) {
+    out.push_back(static_cast<char>(0xA0 | n));
+  } else if (n <= 0xFF) {
+    out.push_back('\xd9');
+    out.push_back(static_cast<char>(n));
+  } else {
+    out.push_back('\xda');
+    uint16_t x = htons(static_cast<uint16_t>(n));
+    out.append(reinterpret_cast<char*>(&x), 2);
+  }
+  out += s;
+}
+
+void pack_value(std::string& out, const Value& v) {
+  switch (v.kind) {
+    case Value::NIL: out.push_back('\xc0'); break;
+    case Value::BOOL: out.push_back(v.b ? '\xc3' : '\xc2'); break;
+    case Value::INT: pack_int(out, v.i); break;
+    case Value::UINT: pack_uint(out, v.u); break;
+    case Value::DBL: {
+      out.push_back('\xcb');
+      uint64_t bits;
+      memcpy(&bits, &v.d, 8);
+      for (int s = 56; s >= 0; s -= 8)
+        out.push_back(static_cast<char>((bits >> s) & 0xFF));
+      break;
+    }
+    case Value::STR: pack_str(out, v.s); break;
+    case Value::BIN: {
+      size_t n = v.s.size();
+      if (n <= 0xFF) {
+        out.push_back('\xc4');
+        out.push_back(static_cast<char>(n));
+      } else if (n <= 0xFFFF) {
+        out.push_back('\xc5');
+        uint16_t x = htons(static_cast<uint16_t>(n));
+        out.append(reinterpret_cast<char*>(&x), 2);
+      } else {
+        out.push_back('\xc6');
+        uint32_t x = htonl(static_cast<uint32_t>(n));
+        out.append(reinterpret_cast<char*>(&x), 4);
+      }
+      out += v.s;
+      break;
+    }
+    case Value::ARR: {
+      size_t n = v.arr.size();
+      if (n < 16) {
+        out.push_back(static_cast<char>(0x90 | n));
+      } else {
+        out.push_back('\xdc');
+        uint16_t x = htons(static_cast<uint16_t>(n));
+        out.append(reinterpret_cast<char*>(&x), 2);
+      }
+      for (auto& e : v.arr) pack_value(out, e);
+      break;
+    }
+    case Value::MAP: {
+      size_t n = v.map.size();
+      if (n < 16) {
+        out.push_back(static_cast<char>(0x80 | n));
+      } else {
+        out.push_back('\xde');
+        uint16_t x = htons(static_cast<uint16_t>(n));
+        out.append(reinterpret_cast<char*>(&x), 2);
+      }
+      for (auto& kv : v.map) {
+        pack_str(out, kv.first);
+        pack_value(out, kv.second);
+      }
+      break;
+    }
+  }
+}
+
+struct Cursor {
+  const uint8_t* p;
+  size_t n;
+  size_t off = 0;
+  uint8_t u8() {
+    if (off >= n) throw std::runtime_error("msgpack: truncated");
+    return p[off++];
+  }
+  uint64_t be(int bytes) {
+    uint64_t v = 0;
+    for (int i = 0; i < bytes; i++) v = (v << 8) | u8();
+    return v;
+  }
+  std::string bytes(size_t k) {
+    if (off + k > n) throw std::runtime_error("msgpack: truncated str");
+    std::string s(reinterpret_cast<const char*>(p + off), k);
+    off += k;
+    return s;
+  }
+};
+
+Value unpack_value(Cursor& c) {
+  Value v;
+  uint8_t t = c.u8();
+  if (t < 0x80) { v.kind = Value::UINT; v.u = t; return v; }
+  if (t >= 0xE0) { v.kind = Value::INT; v.i = static_cast<int8_t>(t); return v; }
+  if ((t & 0xF0) == 0x80 || t == 0xDE || t == 0xDF) {   // map
+    size_t n = (t & 0xF0) == 0x80 ? (t & 0x0F)
+               : (t == 0xDE ? c.be(2) : c.be(4));
+    v.kind = Value::MAP;
+    for (size_t i = 0; i < n; i++) {
+      Value key = unpack_value(c);
+      v.map.emplace_back(key.s, unpack_value(c));
+    }
+    return v;
+  }
+  if ((t & 0xF0) == 0x90 || t == 0xDC || t == 0xDD) {   // array
+    size_t n = (t & 0xF0) == 0x90 ? (t & 0x0F)
+               : (t == 0xDC ? c.be(2) : c.be(4));
+    v.kind = Value::ARR;
+    for (size_t i = 0; i < n; i++) v.arr.push_back(unpack_value(c));
+    return v;
+  }
+  if ((t & 0xE0) == 0xA0) { v.kind = Value::STR; v.s = c.bytes(t & 0x1F); return v; }
+  switch (t) {
+    case 0xC0: v.kind = Value::NIL; return v;
+    case 0xC2: v.kind = Value::BOOL; v.b = false; return v;
+    case 0xC3: v.kind = Value::BOOL; v.b = true; return v;
+    case 0xC4: v.kind = Value::BIN; v.s = c.bytes(c.be(1)); return v;
+    case 0xC5: v.kind = Value::BIN; v.s = c.bytes(c.be(2)); return v;
+    case 0xC6: v.kind = Value::BIN; v.s = c.bytes(c.be(4)); return v;
+    case 0xCA: {
+      uint32_t bits = static_cast<uint32_t>(c.be(4));
+      float f;
+      memcpy(&f, &bits, 4);
+      v.kind = Value::DBL;
+      v.d = f;
+      return v;
+    }
+    case 0xCB: {
+      uint64_t bits = c.be(8);
+      memcpy(&v.d, &bits, 8);
+      v.kind = Value::DBL;
+      return v;
+    }
+    case 0xCC: v.kind = Value::UINT; v.u = c.be(1); return v;
+    case 0xCD: v.kind = Value::UINT; v.u = c.be(2); return v;
+    case 0xCE: v.kind = Value::UINT; v.u = c.be(4); return v;
+    case 0xCF: v.kind = Value::UINT; v.u = c.be(8); return v;
+    case 0xD0: v.kind = Value::INT; v.i = static_cast<int8_t>(c.be(1)); return v;
+    case 0xD1: v.kind = Value::INT; v.i = static_cast<int16_t>(c.be(2)); return v;
+    case 0xD2: v.kind = Value::INT; v.i = static_cast<int32_t>(c.be(4)); return v;
+    case 0xD3: v.kind = Value::INT; v.i = static_cast<int64_t>(c.be(8)); return v;
+    case 0xD9: v.kind = Value::STR; v.s = c.bytes(c.be(1)); return v;
+    case 0xDA: v.kind = Value::STR; v.s = c.bytes(c.be(2)); return v;
+    case 0xDB: v.kind = Value::STR; v.s = c.bytes(c.be(4)); return v;
+  }
+  throw std::runtime_error("msgpack: unsupported type byte");
+}
+
+Value M() { Value v; v.kind = Value::MAP; return v; }
+Value S(const std::string& s) { Value v; v.kind = Value::STR; v.s = s; return v; }
+Value I(int64_t i) { Value v; v.kind = Value::INT; v.i = i; return v; }
+Value B(bool b) { Value v; v.kind = Value::BOOL; v.b = b; return v; }
+Value A() { Value v; v.kind = Value::ARR; return v; }
+
+// ---------------------------------------------------------------- crc32
+uint32_t crc_table[256];
+struct CrcInit {
+  CrcInit() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      crc_table[i] = c;
+    }
+  }
+} crc_init;
+
+uint32_t crc32(const uint8_t* p, size_t n, uint32_t crc = 0) {
+  crc ^= 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++)
+    crc = crc_table[(crc ^ p[i]) & 0xFF] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
+
+// ---------------------------------------------------------------- frames
+constexpr uint8_t kVersion = 1;
+constexpr uint8_t kFlagResponse = 1, kFlagChunk = 2, kFlagEof = 4;
+
+struct Frame {
+  uint16_t code = 0;
+  uint64_t req_id = 0;
+  uint8_t status = 0;
+  uint8_t flags = 0;
+  Value header;       // MAP or NIL
+  std::string data;
+};
+
+void be_append(std::string& out, uint64_t v, int bytes) {
+  for (int s = (bytes - 1) * 8; s >= 0; s -= 8)
+    out.push_back(static_cast<char>((v >> s) & 0xFF));
+}
+
+std::string encode_frame(const Frame& f) {
+  std::string hdr;
+  if (f.header.kind == Value::MAP && !f.header.map.empty())
+    pack_value(hdr, f.header);
+  std::string out;
+  uint32_t total = 17 + hdr.size() + f.data.size();
+  be_append(out, total, 4);
+  out.push_back(static_cast<char>(kVersion));
+  be_append(out, f.code, 2);
+  be_append(out, f.req_id, 8);
+  out.push_back(static_cast<char>(f.status));
+  out.push_back(static_cast<char>(f.flags));
+  be_append(out, hdr.size(), 4);
+  out += hdr;
+  out += f.data;
+  return out;
+}
+
+// ---------------------------------------------------------------- client
+thread_local std::string g_err;
+thread_local int g_err_code = 0;           // ErrorCode wire value; 0 = local
+
+void set_err(const std::string& e, int code = 0) {
+  g_err = e;
+  g_err_code = code;
+}
+
+struct Conn {
+  int fd = -1;
+
+  ~Conn() {
+    if (fd >= 0) close(fd);
+  }
+
+  bool dial(const std::string& host, int port) {
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    if (getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints,
+                    &res) != 0 || !res) {
+      set_err("resolve " + host + " failed");
+      return false;
+    }
+    fd = socket(res->ai_family, SOCK_STREAM, 0);
+    if (fd < 0 || connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+      set_err("connect " + host + ":" + std::to_string(port) + " failed: " +
+              strerror(errno));
+      freeaddrinfo(res);
+      if (fd >= 0) { close(fd); fd = -1; }
+      return false;
+    }
+    freeaddrinfo(res);
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, 1 /*TCP_NODELAY*/, &one, sizeof(one));
+    return true;
+  }
+
+  bool send_all(const char* p, size_t n) {
+    while (n) {
+      ssize_t w = ::send(fd, p, n, 0);
+      if (w <= 0) { set_err(std::string("send failed: ") + strerror(errno)); return false; }
+      p += w;
+      n -= static_cast<size_t>(w);
+    }
+    return true;
+  }
+
+  bool recv_all(char* p, size_t n) {
+    while (n) {
+      ssize_t r = ::recv(fd, p, n, 0);
+      if (r <= 0) { set_err("connection closed mid-frame"); return false; }
+      p += r;
+      n -= static_cast<size_t>(r);
+    }
+    return true;
+  }
+
+  bool send_frame(const Frame& f) {
+    std::string buf = encode_frame(f);
+    return send_all(buf.data(), buf.size());
+  }
+
+  bool recv_frame(Frame& out) {
+    char pre[4];
+    if (!recv_all(pre, 4)) return false;
+    uint32_t total = (uint8_t(pre[0]) << 24) | (uint8_t(pre[1]) << 16) |
+                     (uint8_t(pre[2]) << 8) | uint8_t(pre[3]);
+    if (total < 17 || total > (64u << 20) + 1024) {
+      set_err("bad frame length");
+      return false;
+    }
+    std::string body(total, '\0');
+    if (!recv_all(body.data(), total)) return false;
+    const uint8_t* p = reinterpret_cast<const uint8_t*>(body.data());
+    if (p[0] != kVersion) { set_err("bad frame version"); return false; }
+    out.code = (p[1] << 8) | p[2];
+    out.req_id = 0;
+    for (int i = 0; i < 8; i++) out.req_id = (out.req_id << 8) | p[3 + i];
+    out.status = p[11];
+    out.flags = p[12];
+    uint32_t hl = (p[13] << 24) | (p[14] << 16) | (p[15] << 8) | p[16];
+    out.header = Value();
+    try {
+      if (hl) {
+        Cursor c{p + 17, hl};
+        out.header = unpack_value(c);
+      }
+      out.data.assign(body, 17 + hl, total - 17 - hl);
+    } catch (const std::exception& e) {
+      set_err(e.what());
+      return false;
+    }
+    return true;
+  }
+};
+
+bool frame_error(const Frame& f) {
+  if (f.status == 0) return false;
+  const Value* msg = f.header.get("error");
+  const Value* code = f.header.get("error_code");
+  set_err(msg ? msg->s : "remote error",
+          code ? static_cast<int>(code->as_int()) : 0);
+  return true;
+}
+
+// RpcCodes (rpc/codes.py parity)
+enum : uint16_t {
+  MKDIR = 2, DELETE_ = 3, CREATE_FILE = 4, FILE_STATUS = 7,
+  LIST_STATUS = 8, EXISTS = 9, RENAME = 10, ADD_BLOCK = 11,
+  COMPLETE_FILE = 12, GET_BLOCK_LOCATIONS = 13,
+  WRITE_BLOCK = 80, READ_BLOCK = 81,
+};
+
+struct Client {
+  Conn master;
+  std::string host;
+  std::string user;
+  std::string client_id;
+  uint64_t next_req = 1;
+  int64_t next_call = 1;
+  // one pooled conn per worker addr
+  std::map<std::string, std::unique_ptr<Conn>> workers;
+
+  bool call(Conn& c, uint16_t code, const Value& req, Value& rep) {
+    std::string body;
+    pack_value(body, req);
+    Frame f;
+    f.code = code;
+    f.req_id = next_req++;
+    f.data = body;
+    if (!c.send_frame(f)) return false;
+    Frame r;
+    if (!c.recv_frame(r)) return false;
+    if (frame_error(r)) return false;
+    if (!r.data.empty()) {
+      try {
+        Cursor cur{reinterpret_cast<const uint8_t*>(r.data.data()),
+                   r.data.size()};
+        rep = unpack_value(cur);
+      } catch (const std::exception& e) {
+        set_err(e.what());
+        return false;
+      }
+    }
+    return true;
+  }
+
+  Value base_req(const std::string& path, bool mutate) {
+    Value r = M();
+    r.map.emplace_back("path", S(path));
+    r.map.emplace_back("user", S(user));
+    Value groups = A();
+    groups.arr.push_back(S(user));
+    r.map.emplace_back("groups", groups);
+    if (mutate) {
+      r.map.emplace_back("client_id", S(client_id));
+      r.map.emplace_back("call_id", I(next_call++));
+      r.map.emplace_back("client_name", S(client_id));
+    }
+    return r;
+  }
+
+  static std::string worker_key(const Value& loc) {
+    const Value* ip = loc.get("ip_addr");
+    const Value* hostname = loc.get("hostname");
+    const Value* port = loc.get("rpc_port");
+    std::string addr = ((ip && !ip->s.empty()) ? ip->s
+                        : hostname ? hostname->s : "127.0.0.1");
+    int p = port ? static_cast<int>(port->as_int()) : 0;
+    return addr + ":" + std::to_string(p);
+  }
+
+  Conn* worker_conn(const Value& loc) {
+    std::string key = worker_key(loc);
+    auto it = workers.find(key);
+    if (it != workers.end()) return it->second.get();
+    auto pos = key.rfind(':');
+    auto c = std::make_unique<Conn>();
+    if (!c->dial(key.substr(0, pos), atoi(key.c_str() + pos + 1)))
+      return nullptr;
+    return workers.emplace(key, std::move(c)).first->second.get();
+  }
+
+  void evict_worker(const Value& loc) {
+    // a connection abandoned mid-stream is desynchronized: drop it so the
+    // next op dials fresh instead of reading leftover chunk frames
+    workers.erase(worker_key(loc));
+  }
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------- C ABI
+extern "C" {
+
+const char* cv_sdk_last_error() { return g_err.c_str(); }
+
+// ErrorCode wire value of the last remote error (0 = local/transport)
+int cv_sdk_last_error_code() { return g_err_code; }
+
+void* cv_sdk_connect(const char* host, int port, const char* user) {
+  auto c = std::make_unique<Client>();
+  if (!c->master.dial(host, port)) return nullptr;
+  c->host = host;
+  c->user = user && *user ? user : "root";
+  std::mt19937_64 rng(std::random_device{}());
+  char buf[33];
+  snprintf(buf, sizeof buf, "%016llx",
+           static_cast<unsigned long long>(rng()));
+  c->client_id = std::string("csdk-") + buf;
+  return c.release();
+}
+
+void cv_sdk_close(void* h) { delete static_cast<Client*>(h); }
+
+int cv_sdk_mkdir(void* h, const char* path) {
+  auto* c = static_cast<Client*>(h);
+  Value rep;
+  return c->call(c->master, MKDIR, c->base_req(path, true), rep) ? 0 : -1;
+}
+
+int cv_sdk_delete(void* h, const char* path, int recursive) {
+  auto* c = static_cast<Client*>(h);
+  Value req = c->base_req(path, true);
+  req.map.emplace_back("recursive", B(recursive != 0));
+  Value rep;
+  return c->call(c->master, DELETE_, req, rep) ? 0 : -1;
+}
+
+int cv_sdk_rename(void* h, const char* src, const char* dst) {
+  auto* c = static_cast<Client*>(h);
+  Value req = c->base_req(src, true);
+  req.map.erase(req.map.begin());           // rename carries src/dst, not path
+  req.map.emplace_back("src", S(src));
+  req.map.emplace_back("dst", S(dst));
+  Value rep;
+  return c->call(c->master, RENAME, req, rep) ? 0 : -1;
+}
+
+int cv_sdk_exists(void* h, const char* path) {
+  auto* c = static_cast<Client*>(h);
+  Value rep;
+  if (!c->call(c->master, EXISTS, c->base_req(path, false), rep)) return -1;
+  const Value* e = rep.get("exists");
+  return e && e->as_bool() ? 1 : 0;
+}
+
+int64_t cv_sdk_len(void* h, const char* path) {
+  auto* c = static_cast<Client*>(h);
+  Value rep;
+  if (!c->call(c->master, FILE_STATUS, c->base_req(path, false), rep))
+    return -1;
+  const Value* st = rep.get("status");
+  const Value* len = st ? st->get("len") : nullptr;
+  return len ? len->as_int() : -1;
+}
+
+int cv_sdk_put(void* h, const char* path, const void* buf, int64_t n) {
+  auto* c = static_cast<Client*>(h);
+  // 1. create
+  Value req = c->base_req(path, true);
+  req.map.emplace_back("overwrite", B(true));
+  Value rep;
+  if (!c->call(c->master, CREATE_FILE, req, rep)) return -1;
+  const Value* st = rep.get("status");
+  const Value* bs = st ? st->get("block_size") : nullptr;
+  int64_t block_size = bs ? bs->as_int() : 64 << 20;
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  int64_t pos = 0;
+  Value commits = A();
+  while (pos < n || (n == 0 && pos == 0)) {
+    // 2. add_block (flushes prior commits)
+    Value ab = c->base_req(path, true);
+    ab.map.emplace_back("client_host", S("csdk"));
+    {
+      Value cb = commits;
+      ab.map.emplace_back("commit_blocks", cb);
+    }
+    commits = A();
+    Value abrep;
+    if (!c->call(c->master, ADD_BLOCK, ab, abrep)) return -1;
+    const Value* blk = abrep.get("block");
+    const Value* binfo = blk ? blk->get("block") : nullptr;
+    const Value* locs = blk ? blk->get("locs") : nullptr;
+    if (!binfo || !locs || locs->arr.empty()) {
+      set_err("add_block returned no locations");
+      return -1;
+    }
+    int64_t block_id = binfo->get("id")->as_int();
+    Conn* w = c->worker_conn(locs->arr[0]);
+    if (!w) return -1;
+    // 3. stream the block
+    int64_t take = std::min(block_size, n - pos);
+    Frame open;
+    open.code = WRITE_BLOCK;
+    open.req_id = c->next_req++;
+    open.header = M();
+    open.header.map.emplace_back("block_id", I(block_id));
+    open.header.map.emplace_back("storage_type", I(0));
+    open.header.map.emplace_back("len_hint", I(take));
+    if (!w->send_frame(open)) return -1;
+    uint32_t crc = 0;
+    int64_t sent = 0;
+    while (sent < take) {
+      int64_t k = std::min<int64_t>(4 << 20, take - sent);
+      crc = crc32(p + pos + sent, static_cast<size_t>(k), crc);
+      Frame ch;
+      ch.code = WRITE_BLOCK;
+      ch.req_id = open.req_id;
+      ch.flags = kFlagChunk;
+      ch.data.assign(reinterpret_cast<const char*>(p + pos + sent),
+                     static_cast<size_t>(k));
+      if (!w->send_frame(ch)) return -1;
+      sent += k;
+    }
+    Frame eof;
+    eof.code = WRITE_BLOCK;
+    eof.req_id = open.req_id;
+    eof.flags = kFlagEof;
+    eof.header = M();
+    eof.header.map.emplace_back("crc32", I(static_cast<int64_t>(crc)));
+    if (!w->send_frame(eof)) return -1;
+    Frame ack;
+    if (!w->recv_frame(ack)) return -1;
+    if (frame_error(ack)) return -1;
+    const Value* wid = ack.header.get("worker_id");
+    Value commit = M();
+    commit.map.emplace_back("block_id", I(block_id));
+    commit.map.emplace_back("block_len", I(take));
+    Value wids = A();
+    wids.arr.push_back(I(wid ? wid->as_int() : 0));
+    commit.map.emplace_back("worker_ids", wids);
+    commit.map.emplace_back("storage_type", I(0));
+    commits.arr.push_back(commit);
+    pos += take;
+    if (n == 0) break;
+  }
+  // 4. complete
+  Value done = c->base_req(path, true);
+  done.map.emplace_back("len", I(n));
+  done.map.emplace_back("commit_blocks", commits);
+  Value drep;
+  return c->call(c->master, COMPLETE_FILE, done, drep) ? 0 : -1;
+}
+
+int64_t cv_sdk_get(void* h, const char* path, void* buf, int64_t cap) {
+  auto* c = static_cast<Client*>(h);
+  Value rep;
+  if (!c->call(c->master, GET_BLOCK_LOCATIONS, c->base_req(path, false),
+               rep))
+    return -1;
+  const Value* fb = rep.get("file_blocks");
+  const Value* blocks = fb ? fb->get("block_locs") : nullptr;
+  if (!blocks) {
+    set_err("no block locations");
+    return -1;
+  }
+  uint8_t* out = static_cast<uint8_t*>(buf);
+  int64_t got = 0;
+  for (auto& lb : blocks->arr) {
+    const Value* binfo = lb.get("block");
+    const Value* locs = lb.get("locs");
+    if (!binfo || !locs || locs->arr.empty()) {
+      set_err("block has no live locations");
+      return -1;
+    }
+    int64_t block_id = binfo->get("id")->as_int();
+    int64_t blen = binfo->get("len")->as_int();
+    Conn* w = c->worker_conn(locs->arr[0]);
+    if (!w) return -1;
+    Value req = M();
+    req.map.emplace_back("block_id", I(block_id));
+    req.map.emplace_back("offset", I(0));
+    req.map.emplace_back("len", I(blen));
+    std::string body;
+    pack_value(body, req);
+    Frame f;
+    f.code = READ_BLOCK;
+    f.req_id = c->next_req++;
+    f.data = body;
+    if (!w->send_frame(f)) return -1;
+    for (;;) {
+      Frame ch;
+      if (!w->recv_frame(ch)) return -1;
+      if (frame_error(ch)) return -1;
+      if (!ch.data.empty()) {
+        int64_t k = static_cast<int64_t>(ch.data.size());
+        if (got + k > cap) {
+          set_err("buffer too small");
+          c->evict_worker(locs->arr[0]);   // mid-stream abandon: desync
+          return -1;
+        }
+        memcpy(out + got, ch.data.data(), static_cast<size_t>(k));
+        got += k;
+      }
+      if (ch.flags & kFlagEof) break;
+    }
+  }
+  return got;
+}
+
+static void json_escape(std::string& out, const std::string& s) {
+  out.push_back('"');
+  for (unsigned char ch : s) {
+    if (ch == '"') {
+      out += "\\\"";
+    } else if (ch == '\\') {
+      out += "\\\\";
+    } else if (ch < 0x20) {              // ALL control chars, not just \n
+      char buf[8];
+      snprintf(buf, sizeof buf, "\\u%04x", ch);
+      out += buf;
+    } else {
+      out.push_back(static_cast<char>(ch));
+    }
+  }
+  out.push_back('"');
+}
+
+char* cv_sdk_list(void* h, const char* path) {
+  auto* c = static_cast<Client*>(h);
+  Value rep;
+  if (!c->call(c->master, LIST_STATUS, c->base_req(path, false), rep))
+    return nullptr;
+  const Value* sts = rep.get("statuses");
+  std::string out = "[";
+  if (sts) {
+    bool first = true;
+    for (auto& st : sts->arr) {
+      if (!first) out.push_back(',');
+      first = false;
+      const Value* name = st.get("name");
+      const Value* len = st.get("len");
+      const Value* is_dir = st.get("is_dir");
+      out += "{\"name\":";
+      json_escape(out, name ? name->s : "");
+      out += ",\"len\":" + std::to_string(len ? len->as_int() : 0);
+      out += std::string(",\"is_dir\":") +
+             ((is_dir && is_dir->as_bool()) ? "true" : "false") + "}";
+    }
+  }
+  out.push_back(']');
+  char* ret = static_cast<char*>(malloc(out.size() + 1));
+  memcpy(ret, out.c_str(), out.size() + 1);
+  return ret;
+}
+
+void cv_sdk_free(char* p) { free(p); }
+
+}  // extern "C"

@@ -106,8 +106,11 @@ class VectorTable:
                     f"{self.path}/deletes.bin")).read_all()
                 self._deletes = set(
                     np.frombuffer(raw, dtype=np.int64).tolist())
-            except err.CurvineError:
+            except err.FileNotFound:
                 self._deletes = set()
+            # any OTHER failure (timeout, connect) propagates WITHOUT
+            # memoizing: caching an empty set would silently resurrect
+            # tombstoned rows for the life of this instance
         return self._deletes
 
     async def _save_deletes(self) -> None:
@@ -117,21 +120,33 @@ class VectorTable:
 
     # ---------------- append / scan ----------------
 
-    async def append(self, vectors: np.ndarray,
-                     columns: dict[str, np.ndarray] | None = None) -> int:
-        """Append one row group; returns its index."""
+    def _validate_batch(self, vectors: np.ndarray,
+                        columns: dict[str, np.ndarray] | None
+                        ) -> tuple[np.ndarray, dict[str, np.ndarray]]:
         columns = columns or {}
         vectors = np.ascontiguousarray(vectors, dtype=np.float32)
         if vectors.ndim != 2 or vectors.shape[1] != self.dim:
             raise err.InvalidArgument(
                 f"vectors must be [n, {self.dim}], got {vectors.shape}")
         n = vectors.shape[0]
-        parts = [np.int64(n).tobytes(), vectors.tobytes()]
+        out = {}
         for name, dt in self.columns.items():
+            if name not in columns:
+                raise err.InvalidArgument(f"missing column {name!r}")
             col = np.ascontiguousarray(columns[name], dtype=_DTYPES[dt])
             if col.shape[0] != n:
                 raise err.InvalidArgument(f"column {name} length mismatch")
-            parts.append(col.tobytes())
+            out[name] = col
+        return vectors, out
+
+    async def append(self, vectors: np.ndarray,
+                     columns: dict[str, np.ndarray] | None = None) -> int:
+        """Append one row group; returns its index."""
+        vectors, columns = self._validate_batch(vectors, columns)
+        n = vectors.shape[0]
+        parts = [np.int64(n).tobytes(), vectors.tobytes()]
+        for name in self.columns:
+            parts.append(columns[name].tobytes())
         rg = self.row_groups
         await self.client.write_all(f"{self.path}/rg-{rg:05d}.vec",
                                     b"".join(parts))
@@ -202,10 +217,13 @@ class VectorTable:
         """delete + insert (the Lance update model): old versions are
         tombstoned, new versions appended as a fresh row group. Returns
         the row-group index holding the new versions."""
-        vectors = np.atleast_2d(np.asarray(vectors, dtype=np.float32))
+        vectors, columns = self._validate_batch(
+            np.atleast_2d(np.asarray(vectors, dtype=np.float32)), columns)
         row_ids = np.asarray(row_ids).reshape(-1)
         if vectors.shape[0] != row_ids.size:
             raise err.InvalidArgument("update rows/vectors length mismatch")
+        # validation above runs BEFORE the tombstones persist: an invalid
+        # replacement must not delete the old versions
         await self.delete(row_ids)
         return await self.append(vectors, columns)
 
@@ -214,37 +232,38 @@ class VectorTable:
         renumbered densely (as with Lance compaction, ids are not stable
         across compactions). Returns live rows kept."""
         dels = await self._load_deletes()
-        live_vecs: list[np.ndarray] = []
-        live_cols: dict[str, list[np.ndarray]] = {n: [] for n in self.columns}
-        base = 0
-        async for vectors, cols in self.scan():
-            n = vectors.shape[0]
-            keep = np.array([i for i in range(n) if base + i not in dels],
-                            dtype=np.int64)
-            if keep.size:
-                live_vecs.append(vectors[keep])
-                for name in self.columns:
-                    live_cols[name].append(np.asarray(cols[name])[keep])
-            base += n
+        del_arr = np.fromiter(dels, dtype=np.int64) if dels else \
+            np.empty(0, dtype=np.int64)
         old_groups = self.row_groups
         self.row_groups = 0
         self.rows = 0
         self.version += 1
         self._deletes = set()
-        # clear the delete vector on disk BEFORE rewriting rg-0: a crash
-        # mid-compaction then resurrects tombstoned rows (recoverable by
-        # re-deleting) instead of tombstoning arbitrary renumbered rows
+        # clear the delete vector on disk BEFORE rewriting row groups: a
+        # crash mid-compaction then resurrects tombstoned rows
+        # (recoverable by re-deleting) instead of tombstoning arbitrary
+        # renumbered rows
         await self._save_deletes()
+        # stream group by group (no whole-table materialization): each old
+        # group's live rows become one new group, in order, so renumbering
+        # is dense and peak memory is one row group
         kept = 0
-        if live_vecs:
-            all_vecs = np.concatenate(live_vecs, axis=0)
-            all_cols = {n: np.concatenate(v) for n, v in live_cols.items()}
-            kept = all_vecs.shape[0]
-            await self.append(all_vecs, all_cols)   # rg-00000 of the new ver
-        else:
+        base = 0
+        for rg in range(old_groups):
+            vectors, cols = await self.read_group(rg)
+            n = vectors.shape[0]
+            keep = np.nonzero(~np.isin(np.arange(n) + base, del_arr))[0]
+            base += n
+            if not keep.size:
+                continue
+            await self.append(vectors[keep],
+                              {name: np.asarray(cols[name])[keep]
+                               for name in self.columns})
+            kept += int(keep.size)
+        if kept == 0:
             await self._write_schema()
-        # drop the superseded row-group files (append() above wrote rg-0)
-        for rg in range(1 if live_vecs else 0, old_groups):
+        # drop superseded row-group files past the rewritten prefix
+        for rg in range(self.row_groups, old_groups):
             try:
                 await self.client.meta.delete(f"{self.path}/rg-{rg:05d}.vec")
             except err.CurvineError:
@@ -277,8 +296,9 @@ class VectorTable:
         host = (np.concatenate([v for v, _ in groups], axis=0)
                 if len(groups) > 1 else groups[0][0])
         if dels:
-            live = np.array([i for i in range(host.shape[0])
-                             if i not in dels], dtype=np.int32)
+            mask = ~np.isin(np.arange(host.shape[0]),
+                            np.fromiter(dels, dtype=np.int64))
+            live = np.nonzero(mask)[0].astype(np.int32)
             host = host[live]
         else:
             live = np.arange(host.shape[0], dtype=np.int32)

@@ -217,19 +217,20 @@ async def test_vector_table_delete_update_compact():
         assert int(cols["label"][0]) == 1313
         assert await t.count() == 97              # -1 old, +1 new
 
-        # compact: dense renumber, deletes gone, one row group
+        # compact: dense renumber, deletes gone, groups rewritten
+        # streaming (one live group per non-empty source group)
         kept = await t.compact()
         assert kept == 97
-        assert t.row_groups == 1 and t.version == 1
+        assert t.row_groups == 3 and t.version == 1
         assert await t.count() == 97
         ids, _ = await t.knn(new_vec[0], k=1, device=CPU)
         _, cols = await t.take([int(ids[0, 0])])
         assert int(cols["label"][0]) == 1313
         # persisted: reopen sees the compacted table
         t2 = await VectorTable.open(c, "/vec/mut")
-        assert t2.row_groups == 1 and t2.version == 1
+        assert t2.row_groups == 3 and t2.version == 1
         assert await t2.count() == 97
-        # superseded row-group files are gone
+        # no superseded row-group files linger
         sts = await c.meta.list_status("/vec/mut")
         assert sorted(s.name for s in sts if s.name.startswith("rg-")) == \
-            ["rg-00000.vec"]
+            ["rg-00000.vec", "rg-00001.vec", "rg-00002.vec"]

@@ -167,6 +167,25 @@ async def run_bench(total_mb: int = 256, block_mb: int = 64,
             host_rates.append(n / (1024 ** 3) / (time.perf_counter() - t0))
         results["read_gibs_host"] = max(host_rates)
 
+        # ---- metadata QPS (reference headline: "100K+ QPS") ----
+        # pipelined stat storm: many in-flight FILE_STATUS calls multiplex
+        # by req-id over pooled connections
+        await c.meta.mkdir("/bench/meta")
+        for i in range(32):
+            await c.meta.create_file(f"/bench/meta/f{i:02d}", block_size=MB)
+            await c.meta.complete_file(f"/bench/meta/f{i:02d}", 0)
+        conc = 64
+        per_worker = 62
+        total_calls = conc * per_worker        # numerator = actual calls
+
+        async def stat_worker(k: int) -> None:
+            for j in range(per_worker):
+                await c.meta.file_status(f"/bench/meta/f{(k + j) % 32:02d}")
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(stat_worker(k) for k in range(conc)))
+        results["meta_qps"] = total_calls / (time.perf_counter() - t0)
+
         # ---- p99 block-fetch latency ----
         await c.write_all("/bench/small",
                           rng.integers(0, 255, latency_block_mb * MB,
@@ -420,6 +439,7 @@ def main():
         "backend": results["backend"],
         "link_gibs": round(results["link_gibs"], 3),
         "pipeline_vs_link": round(results.get("pipeline_vs_link", 0), 3),
+        "meta_qps": round(results.get("meta_qps", 0), 1),
         "p99_block_fetch_ms": round(results["p99_block_fetch_ms"], 3),
         "p50_block_fetch_ms": round(results["p50_block_fetch_ms"], 3),
         "read_gibs_host": round(results["read_gibs_host"], 3),

@@ -236,3 +236,18 @@ def test_scheduled_executor_periodic_and_cancel():
         assert ex.names() == []
 
     asyncio.run(main())
+
+
+def test_hand_rolled_codecs_cover_all_fields():
+    """FileStatus/StoragePolicy have hand-rolled wire codecs (hot path);
+    this guards against silently dropping fields added later."""
+    import dataclasses
+    from curvine_tpu.common.types import FileStatus, StoragePolicy
+    for cls in (FileStatus, StoragePolicy):
+        wire = set(cls().to_wire())
+        declared = {f.name for f in dataclasses.fields(cls)}
+        assert wire == declared, (cls.__name__, wire ^ declared)
+        # and from_wire round-trips every field
+        inst = cls()
+        back = cls.from_wire(inst.to_wire())
+        assert back == inst

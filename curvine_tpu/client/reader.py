@@ -35,6 +35,9 @@ class FsReader:
         self.len = file_blocks.status.len
         self._local_paths: dict[int, str | None] = {}
         self._local_fds: dict[int, int] = {}
+        # bdev tiers: the block is an extent at this base offset inside
+        # the tier's shared backing file
+        self._local_offs: dict[int, int] = {}
 
     # ---------------- positioning ----------------
 
@@ -80,6 +83,7 @@ class FsReader:
                     p = info.get("path")
                     if p and os.path.exists(p):
                         path = p
+                        self._local_offs[bid] = info.get("offset", 0)
                 except err.CurvineError as e:
                     log.debug("short-circuit probe failed for %d: %s", bid, e)
         self._local_paths[bid] = path
@@ -139,8 +143,9 @@ class FsReader:
             local = await self._local_path(lb)
             if local is not None:
                 fd = self._fd_for(lb.block.id, local)
+                base = self._local_offs.get(lb.block.id, 0)
                 got = os.preadv(fd, [memoryview(out[filled:filled + seg])],
-                                block_off)
+                                base + block_off)
                 if got < seg:
                     out = out[:filled + max(0, got)]
                     break
@@ -198,7 +203,8 @@ class FsReader:
             return None
         fd = self._fd_for(lb.block.id, local)
         buf = np.empty(n, dtype=np.uint8)
-        got = os.preadv(fd, [memoryview(buf)], block_off)
+        base = self._local_offs.get(lb.block.id, 0)
+        got = os.preadv(fd, [memoryview(buf)], base + block_off)
         if got != n:
             return None
         return buf
@@ -212,7 +218,8 @@ class FsReader:
         local = await self._local_path(lb)
         if local is not None:
             fd = self._fd_for(lb.block.id, local)
-            return os.pread(fd, n, block_off)
+            base = self._local_offs.get(lb.block.id, 0)
+            return os.pread(fd, n, base + block_off)
         # failover across replica locations (local-first ordering)
         preferred = self._pick_loc(lb)
         locs = [preferred] + [l for l in lb.locs if l is not preferred]

@@ -57,8 +57,11 @@ class MasterConf:
 @dataclass
 class TierConf:
     storage_type: str = "mem"   # hbm|mem|ssd|hdd
-    dir: str = "data/mem"
+    dir: str = "data/mem"       # dir (file layout) | backing file (bdev)
     capacity: int = 1 * GB
+    # "file": one file per block in hashed subdirs; "bdev": blocks as
+    # extents inside ONE preallocated backing file / raw device
+    layout: str = "file"
 
 
 @dataclass

@@ -59,10 +59,16 @@ class MiniCluster:
         wconf.worker.hostname = "127.0.0.1"
         wconf.worker.rpc_port = 0
         wconf.worker.heartbeat_ms = self.worker_heartbeat_ms
-        wconf.worker.tiers = [TierConf(
-            storage_type="mem",
-            dir=os.path.join(self.base_dir, f"worker{idx}", "mem"),
-            capacity=self.tier_capacity)]
+        default_tiers = self.conf.worker.tiers == [TierConf()]
+        if default_tiers:
+            wconf.worker.tiers = [TierConf(
+                storage_type="mem",
+                dir=os.path.join(self.base_dir, f"worker{idx}", "mem"),
+                capacity=self.tier_capacity)]
+        elif idx:
+            # caller-supplied tiers: give later workers distinct paths
+            for t in wconf.worker.tiers:
+                t.dir = f"{t.dir}.w{idx}"
         wconf.worker.ici_coords = [idx, 0]
         w = WorkerServer(wconf)
         await w.start()

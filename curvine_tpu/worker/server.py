@@ -20,7 +20,7 @@ from curvine_tpu.common.types import (
 from curvine_tpu.rpc import Message, RpcCode, RpcServer, ServerConn
 from curvine_tpu.rpc.client import Connection, ConnectionPool
 from curvine_tpu.rpc.frame import Flags, pack, response_for, unpack
-from curvine_tpu.worker.storage import BlockStore, TierDir
+from curvine_tpu.worker.storage import BdevTier, BlockStore, TierDir
 
 log = logging.getLogger(__name__)
 
@@ -30,6 +30,21 @@ _TIER_NAMES = {"hbm": StorageType.HBM, "mem": StorageType.MEM,
 
 def worker_id_for(hostname: str, port: int) -> int:
     return zlib.crc32(f"{hostname}:{port}".encode()) & 0x7FFFFFFF
+
+
+def _open_block_writer(info):
+    """File layout: fresh per-block file. Bdev layout: seek to the
+    block's extent inside the shared backing file (NEVER truncate it)."""
+    if getattr(info, "is_extent", False):
+        f = open(info.path, "r+b")
+        f.seek(info.offset)
+        return f
+    return open(info.path, "wb")
+
+
+def _write_block_bytes(info, data: bytes) -> None:
+    with _open_block_writer(info) as f:
+        f.write(data)
 
 
 def _write_file_bytes(path: str, data: bytes) -> None:
@@ -43,8 +58,11 @@ class WorkerServer:
         self.conf = conf or ClusterConf()
         wc = self.conf.worker
         self.rpc = RpcServer(wc.hostname, wc.rpc_port, "worker")
-        tiers = [TierDir(_TIER_NAMES.get(t.storage_type, StorageType.MEM),
-                         t.dir, t.capacity) for t in wc.tiers]
+        tiers = [
+            (BdevTier if getattr(t, "layout", "file") == "bdev" else TierDir)(
+                _TIER_NAMES.get(t.storage_type, StorageType.MEM),
+                t.dir, t.capacity)
+            for t in wc.tiers]
         self.store = BlockStore(tiers, wc.eviction_high_water,
                                 wc.eviction_low_water)
         self.metrics = MetricsRegistry("worker")
@@ -197,10 +215,12 @@ class WorkerServer:
         block_id = q["block_id"]
         hint = StorageType(q.get("storage_type", int(StorageType.MEM)))
         info = self.store.create_temp(block_id, hint, q.get("len_hint", 0))
-        inline_io = info.tier.storage_type <= StorageType.MEM
-        f = open(info.path, "wb") if inline_io else \
-            await asyncio.to_thread(open, info.path, "wb")
+        inline_io = (info.tier.storage_type <= StorageType.MEM
+                     and not info.is_extent)
+        f = _open_block_writer(info) if inline_io else \
+            await asyncio.to_thread(_open_block_writer, info)
         state = {"crc": 0, "total": 0}
+        max_len = info.alloc_len if info.is_extent else None
         # hash+write: on multi-core hosts each chunk is copied out of the
         # reusable receive buffer and processed in a worker thread chained
         # behind the previous one (CRC chain + file order need sequencing)
@@ -226,6 +246,10 @@ class WorkerServer:
             try:
                 if len(view):
                     state["total"] += len(view)
+                    if max_len is not None and state["total"] > max_len:
+                        raise err.CapacityExceeded(
+                            f"block {block_id} exceeds its "
+                            f"{max_len}B extent")
                     if offload:
                         tail["t"] = asyncio.ensure_future(
                             _chained(tail["t"], bytes(view)))
@@ -245,9 +269,9 @@ class WorkerServer:
                     raise err.AbnormalData(
                         f"block {block_id} crc mismatch: "
                         f"{state['crc']:#x} != {want:#x}")
-                self.store.commit(block_id, state["total"],
-                                  checksum=state["crc"],
-                                  checksum_algo="crc32")
+                await asyncio.to_thread(
+                    self.store.commit, block_id, state["total"],
+                    checksum=state["crc"], checksum_algo="crc32")
                 self.metrics.inc("bytes.written", state["total"])
                 await conn.send(response_for(msg, header={
                     "block_id": block_id, "len": state["total"],
@@ -276,13 +300,19 @@ class WorkerServer:
             q["block_id"], StorageType(q.get("storage_type",
                                              int(StorageType.MEM))),
             q.get("len_hint", 0))
+        if info.is_extent:
+            # the sc client opens the path with O_TRUNC — fatal on a
+            # shared bdev file; stream over the socket instead
+            self.store.delete(q["block_id"])
+            raise err.Unsupported("short-circuit write unsupported on "
+                                  "bdev tiers")
         return {}, pack({"path": info.path, "worker_id": self.worker_id})
 
     async def _sc_write_commit(self, msg: Message, conn: ServerConn):
         q = unpack(msg.data) or {}
-        info = self.store.commit(q["block_id"], q["len"],
-                                 checksum=q.get("crc32"),
-                                 checksum_algo=q.get("algo", "crc32"))
+        info = await asyncio.to_thread(
+            self.store.commit, q["block_id"], q["len"],
+            checksum=q.get("crc32"), checksum_algo=q.get("algo", "crc32"))
         self.metrics.inc("bytes.written", info.len)
         return {}, pack({"block_id": info.block_id, "len": info.len,
                          "worker_id": self.worker_id})
@@ -308,6 +338,7 @@ class WorkerServer:
         inline_io = info.tier.storage_type <= StorageType.MEM
         want_crc = bool(q.get("verify", False))
 
+        base = info.offset                  # bdev extents start mid-file
         if not want_crc:
             # zero-copy: chunk payloads leave via kernel sendfile, data
             # never enters userspace (TCP checksums the wire; at-rest
@@ -318,7 +349,7 @@ class WorkerServer:
                 while pos < end:
                     n = min(chunk_size, end - pos)
                     sent = await conn.send_chunk_from_file(
-                        msg.code, msg.req_id, f, pos, n)
+                        msg.code, msg.req_id, f, base + pos, n)
                     if sent <= 0:
                         break
                     pos += sent
@@ -342,9 +373,10 @@ class WorkerServer:
                 n = min(chunk_size, end - pos)
                 view = memoryview(buf[:n])
                 if inline_io:
-                    got = os.preadv(fd, [view], pos)
+                    got = os.preadv(fd, [view], base + pos)
                 else:
-                    got = await asyncio.to_thread(os.preadv, fd, [view], pos)
+                    got = await asyncio.to_thread(os.preadv, fd, [view],
+                                                  base + pos)
                 if got <= 0:
                     break
                 view = view[:got]
@@ -373,8 +405,9 @@ class WorkerServer:
                                                  int(StorageType.MEM))),
                 len(data))
             try:
-                await asyncio.to_thread(_write_file_bytes, info.path, data)
-                self.store.commit(b["block_id"], len(data))
+                await asyncio.to_thread(_write_block_bytes, info, data)
+                await asyncio.to_thread(self.store.commit,
+                                        b["block_id"], len(data))
                 results.append({"block_id": b["block_id"], "len": len(data),
                                 "worker_id": self.worker_id})
             except Exception:
@@ -395,7 +428,8 @@ class WorkerServer:
         info = self.store.get(q["block_id"])
         return {"block_id": info.block_id, "len": info.len,
                 "storage_type": int(info.tier.storage_type),
-                "path": os.path.abspath(info.path)}
+                "path": os.path.abspath(info.path),
+                "offset": info.offset}
 
     async def _replicate_block(self, msg: Message, conn: ServerConn):
         """Pull a block replica from a peer worker and report to master.
@@ -411,13 +445,20 @@ class WorkerServer:
                 info = self.store.create_temp(block_id,
                                               size_hint=q.get("block_len", 0))
                 total = 0
-                f = await asyncio.to_thread(open, info.path, "wb")
+                cap = info.alloc_len if info.is_extent else None
+                f = await asyncio.to_thread(_open_block_writer, info)
                 try:
                     async for m in peer.call_stream(
                             RpcCode.READ_BLOCK, header={"block_id": block_id}):
                         if len(m.data):
-                            await asyncio.to_thread(f.write, m.data)
                             total += len(m.data)
+                            if cap is not None and total > cap:
+                                # never write past the extent into a
+                                # neighboring committed block
+                                raise err.CapacityExceeded(
+                                    f"replica {block_id} exceeds its "
+                                    f"{cap}B extent")
+                            await asyncio.to_thread(f.write, m.data)
                 finally:
                     await asyncio.to_thread(f.close)
                 self.store.commit(block_id, total)
@@ -454,7 +495,7 @@ class WorkerServer:
         buf = np.empty(info.len, dtype=np.uint8)
         fd = os.open(info.path, os.O_RDONLY)
         try:
-            os.preadv(fd, [memoryview(buf)], 0)
+            os.preadv(fd, [memoryview(buf)], info.offset)
         finally:
             os.close(fd)
         multi = hasattr(self.hbm, "tiers")     # MultiHbmTier vs single

@@ -27,11 +27,18 @@ QUOTA_FILES = "quota.files"
 
 class QuotaManager:
     def __init__(self, fs, high_water: float = 0.92, low_water: float = 0.80,
-                 check_interval_s: float = 5.0):
+                 check_interval_s: float = 5.0, usage_ttl_s: float = 2.0):
         self.fs = fs
         self.high_water = high_water
         self.low_water = low_water
         self.check_interval_s = check_interval_s
+        self.usage_ttl_s = usage_ttl_s
+        # quota'd-dir usage cache: inode id -> [bytes, files, expiry].
+        # The subtree walk is O(subtree) — unaffordable per create on big
+        # namespaces — so enforcement reads a TTL'd snapshot and bumps it
+        # optimistically for admissions inside the window (bursts between
+        # walks still count against the quota).
+        self._usage_cache: dict[int, list] = {}
 
     # ---------------- quotas ----------------
 
@@ -72,6 +79,16 @@ class QuotaManager:
             f += cf
         return b, f
 
+    def _cached_usage(self, node) -> list:
+        """[bytes, files, expiry] for a quota'd dir, rewalked past TTL."""
+        import time
+        ent = self._usage_cache.get(node.id)
+        now = time.monotonic()
+        if ent is None or ent[2] <= now:
+            b, f = self._usage(node)
+            ent = self._usage_cache[node.id] = [b, f, now + self.usage_ttl_s]
+        return ent
+
     def check_create(self, path: str, new_bytes: int = 0,
                      new_files: int = 1) -> None:
         """Walk ancestors of `path`; any quota'd dir must have room."""
@@ -81,7 +98,8 @@ class QuotaManager:
             qb = _int_attr(node, QUOTA_BYTES)
             qf = _int_attr(node, QUOTA_FILES)
             if qb is not None or qf is not None:
-                ub, uf = self._usage(node)
+                ent = self._cached_usage(node)
+                ub, uf = ent[0], ent[1]
                 if qb is not None and ub + new_bytes > qb:
                     raise err.QuotaExceeded(
                         f"{self.fs.tree.path_of(node)}: bytes quota {qb} "
@@ -90,6 +108,9 @@ class QuotaManager:
                     raise err.QuotaExceeded(
                         f"{self.fs.tree.path_of(node)}: file quota {qf} "
                         f"(used {uf})")
+                # count this admission against the window's snapshot
+                ent[0] += new_bytes
+                ent[1] += new_files
             node = self.fs.tree.get(node.parent_id) \
                 if node.parent_id else None
 

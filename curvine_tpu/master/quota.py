@@ -98,7 +98,16 @@ class QuotaManager:
             qb = _int_attr(node, QUOTA_BYTES)
             qf = _int_attr(node, QUOTA_FILES)
             if qb is not None or qf is not None:
+                import time
                 ent = self._cached_usage(node)
+                over = ((qb is not None and ent[0] + new_bytes > qb)
+                        or (qf is not None and ent[1] + new_files > qf))
+                if over:
+                    # a denial must be EXACT: the snapshot may be stale
+                    # after deletes freed quota inside the TTL window —
+                    # rewalk before refusing
+                    b, f = self._usage(node)
+                    ent[:] = [b, f, time.monotonic() + self.usage_ttl_s]
                 ub, uf = ent[0], ent[1]
                 if qb is not None and ub + new_bytes > qb:
                     raise err.QuotaExceeded(

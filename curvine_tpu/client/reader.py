@@ -23,7 +23,8 @@ log = logging.getLogger(__name__)
 class FsReader:
     def __init__(self, fs_client, path: str, file_blocks: FileBlocks,
                  pool: ConnectionPool, chunk_size: int = 512 * 1024,
-                 short_circuit: bool = True, read_ahead: int = 2):
+                 short_circuit: bool = True, read_ahead: int = 2,
+                 counters: dict | None = None):
         self.read_ahead = read_ahead
         self.fs = fs_client
         self.path = path
@@ -38,6 +39,7 @@ class FsReader:
         # bdev tiers: the block is an extent at this base offset inside
         # the tier's shared backing file
         self._local_offs: dict[int, int] = {}
+        self.counters = counters if counters is not None else {}
 
     # ---------------- positioning ----------------
 
@@ -146,6 +148,8 @@ class FsReader:
                 base = self._local_offs.get(lb.block.id, 0)
                 got = os.preadv(fd, [memoryview(out[filled:filled + seg])],
                                 base + block_off)
+                self.counters["sc.bytes.read"] = \
+                    self.counters.get("sc.bytes.read", 0) + max(0, got)
                 if got < seg:
                     out = out[:filled + max(0, got)]
                     break
@@ -207,6 +211,8 @@ class FsReader:
         got = os.preadv(fd, [memoryview(buf)], base + block_off)
         if got != n:
             return None
+        self.counters["sc.bytes.read"] = \
+            self.counters.get("sc.bytes.read", 0) + n
         return buf
 
     async def _read_some(self, offset: int, n: int) -> bytes:
@@ -219,7 +225,10 @@ class FsReader:
         if local is not None:
             fd = self._fd_for(lb.block.id, local)
             base = self._local_offs.get(lb.block.id, 0)
-            return os.pread(fd, n, base + block_off)
+            data = os.pread(fd, n, base + block_off)
+            self.counters["sc.bytes.read"] = \
+                self.counters.get("sc.bytes.read", 0) + len(data)
+            return data
         # failover across replica locations (local-first ordering)
         preferred = self._pick_loc(lb)
         locs = [preferred] + [l for l in lb.locs if l is not preferred]

@@ -33,10 +33,54 @@ class CurvineClient:
         self.pool = ConnectionPool(size=self.conf.client.conn_pool_size,
                                    timeout_ms=self.conf.client.rpc_timeout_ms)
         self._mount_cache: dict[str, object] = {}
+        # client-side IO counters: short-circuit reads/writes bypass the
+        # worker entirely, so their bytes are invisible to worker metrics
+        # — pushed to the master (METRICS_REPORT) so dashboards see the
+        # co-located fast path too
+        self.counters: dict[str, float] = {}
+        self._reported: dict[str, float] = {}
+        self._metrics_task = None
 
     async def close(self) -> None:
+        if self._metrics_task is not None:
+            self._metrics_task.cancel()
+            self._metrics_task = None
+        try:
+            await self.flush_metrics()
+        except Exception:      # noqa: BLE001 — best-effort on teardown
+            pass
         await self.meta.close()
         await self.pool.close()
+
+    def _ensure_metrics_task(self) -> None:
+        """Periodic flush so dashboards see long-running jobs' sc bytes
+        as they happen, not as one spike at close(). Lazily started from
+        async entry points (construction can be outside a loop)."""
+        if self._metrics_task is not None:
+            return
+        import asyncio
+
+        async def loop():
+            while True:
+                await asyncio.sleep(5.0)
+                try:
+                    await self.flush_metrics()
+                except Exception:   # noqa: BLE001 — master away; retry
+                    pass
+
+        self._metrics_task = asyncio.ensure_future(loop())
+
+    async def flush_metrics(self) -> None:
+        """Push counter DELTAS since the last flush to the master."""
+        # deltas come from a SNAPSHOT: increments landing during the RPC
+        # await must stay unreported until the next flush
+        snap = dict(self.counters)
+        delta = {k: v - self._reported.get(k, 0)
+                 for k, v in snap.items()
+                 if v != self._reported.get(k, 0)}
+        if delta:
+            await self.meta.report_metrics(delta)
+            self._reported = snap
 
     # ---------------- plain cache paths ----------------
 
@@ -46,6 +90,7 @@ class CurvineClient:
                      storage_type: str | None = None) -> FsWriter:
         cc = self.conf.client
         st = _TIERS.get(storage_type or cc.storage_type, StorageType.MEM)
+        self._ensure_metrics_task()
         await self.meta.create_file(
             path, overwrite=overwrite,
             replicas=replicas if replicas is not None else cc.replicas,
@@ -54,7 +99,8 @@ class CurvineClient:
                         block_size=block_size or cc.block_size,
                         chunk_size=cc.write_chunk_size, storage_type=st,
                         ici_coords=list(self.conf.worker.ici_coords) or None,
-                        short_circuit=cc.short_circuit)
+                        short_circuit=cc.short_circuit,
+                        counters=self.counters)
 
     async def append(self, path: str) -> FsWriter:
         fb = await self.meta.append_file(path)
@@ -63,17 +109,20 @@ class CurvineClient:
                      block_size=fb.status.block_size,
                      chunk_size=cc.write_chunk_size,
                      storage_type=_TIERS.get(cc.storage_type, StorageType.MEM),
-                     short_circuit=cc.short_circuit)
+                     short_circuit=cc.short_circuit,
+                     counters=self.counters)
         w.pos = fb.status.len
         return w
 
     async def open(self, path: str) -> FsReader:
+        self._ensure_metrics_task()
         fb = await self.meta.get_block_locations(path)
         cc = self.conf.client
         return FsReader(self.meta, path, fb, self.pool,
                         chunk_size=cc.read_chunk_size,
                         short_circuit=cc.short_circuit,
-                        read_ahead=cc.read_ahead_chunks)
+                        read_ahead=cc.read_ahead_chunks,
+                        counters=self.counters)
 
     async def write_all(self, path: str, data: bytes, **kw) -> None:
         async with await self.create(path, overwrite=True, **kw) as w:

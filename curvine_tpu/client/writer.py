@@ -29,7 +29,8 @@ class FsWriter:
                  block_size: int, chunk_size: int = 512 * 1024,
                  storage_type: StorageType = StorageType.MEM,
                  ici_coords: list[int] | None = None,
-                 short_circuit: bool = True):
+                 short_circuit: bool = True,
+                 counters: dict | None = None):
         self.fs = fs_client
         self.path = path
         self.pool = pool
@@ -38,6 +39,7 @@ class FsWriter:
         self.storage_type = storage_type
         self.ici_coords = ici_coords
         self.short_circuit = short_circuit
+        self.counters = counters if counters is not None else {}
         self.pos = 0
         self._buf = bytearray()
         self._block: LocatedBlock | None = None
@@ -95,6 +97,8 @@ class FsWriter:
             self._block_crc = zlib.crc32(chunk, self._block_crc)
             self._sc_file.write(chunk)
             self._block_written += len(chunk)
+            self.counters["sc.bytes.written"] = \
+                self.counters.get("sc.bytes.written", 0) + len(chunk)
             return
         # multi-core: CRC in a worker thread (zlib releases the GIL),
         # overlapped with the socket send; the chain stays ordered because

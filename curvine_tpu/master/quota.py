@@ -80,14 +80,28 @@ class QuotaManager:
         return b, f
 
     def _cached_usage(self, node) -> list:
-        """[bytes, files, expiry] for a quota'd dir, rewalked past TTL."""
+        """[bytes, files, expiry, walked_clean] for a quota'd dir,
+        rewalked past TTL. walked_clean: the snapshot came straight from
+        a walk (no optimistic bumps since), so a denial may trust it."""
         import time
         ent = self._usage_cache.get(node.id)
         now = time.monotonic()
         if ent is None or ent[2] <= now:
             b, f = self._usage(node)
-            ent = self._usage_cache[node.id] = [b, f, now + self.usage_ttl_s]
+            ent = self._usage_cache[node.id] = [b, f,
+                                                now + self.usage_ttl_s, True]
         return ent
+
+    def invalidate(self, path: str) -> None:
+        """Drop cached usage for every ancestor of `path` — called after
+        deletes/frees/renames so freed quota is admissible immediately
+        (the deny path trusts clean snapshots inside their TTL)."""
+        parent, _ = self.fs.tree.resolve_parent(path)
+        node = parent
+        while node is not None:
+            self._usage_cache.pop(node.id, None)
+            node = self.fs.tree.get(node.parent_id) \
+                if node.parent_id else None
 
     def check_create(self, path: str, new_bytes: int = 0,
                      new_files: int = 1) -> None:
@@ -102,12 +116,16 @@ class QuotaManager:
                 ent = self._cached_usage(node)
                 over = ((qb is not None and ent[0] + new_bytes > qb)
                         or (qf is not None and ent[1] + new_files > qf))
-                if over:
-                    # a denial must be EXACT: the snapshot may be stale
-                    # after deletes freed quota inside the TTL window —
-                    # rewalk before refusing
+                if over and not ent[3]:
+                    # a denial must be EXACT: optimistic bumps may have
+                    # overshot and deletes may have freed quota inside the
+                    # TTL window — rewalk ONCE before refusing. A clean
+                    # walked snapshot inside its TTL is trusted, so a
+                    # client hammering a full dir can't force a walk per
+                    # attempt.
                     b, f = self._usage(node)
-                    ent[:] = [b, f, time.monotonic() + self.usage_ttl_s]
+                    ent[:] = [b, f, time.monotonic() + self.usage_ttl_s,
+                              True]
                 ub, uf = ent[0], ent[1]
                 if qb is not None and ub + new_bytes > qb:
                     raise err.QuotaExceeded(
@@ -120,6 +138,7 @@ class QuotaManager:
                 # count this admission against the window's snapshot
                 ent[0] += new_bytes
                 ent[1] += new_files
+                ent[3] = False          # bumped: a denial must rewalk
             node = self.fs.tree.get(node.parent_id) \
                 if node.parent_id else None
 

@@ -246,6 +246,7 @@ class MasterServer:
     def _delete(self, q):
         self.acl.check(UserCtx.from_req(q), q["path"], W | X, on_parent=True)
         self.fs.delete(q["path"], recursive=q.get("recursive", False))
+        self.quota.invalidate(q["path"])
         return {}
 
     def _create_file(self, q):
@@ -319,7 +320,10 @@ class MasterServer:
         ctx = UserCtx.from_req(q)
         self.acl.check(ctx, q["src"], W | X, on_parent=True)
         self.acl.check(ctx, q["dst"], W | X, on_parent=True)
-        return {"result": self.fs.rename(q["src"], q["dst"])}
+        out = {"result": self.fs.rename(q["src"], q["dst"])}
+        self.quota.invalidate(q["src"])
+        self.quota.invalidate(q["dst"])
+        return out
 
     def _check_write_lease(self, q) -> None:
         """Writes to an OPEN file are restricted to the lease holder (the
@@ -395,7 +399,9 @@ class MasterServer:
 
     def _free(self, q):
         self.acl.check(UserCtx.from_req(q), q["path"], W)
-        return {"freed": self.fs.free(q["path"], q.get("recursive", False))}
+        freed = self.fs.free(q["path"], q.get("recursive", False))
+        self.quota.invalidate(q["path"])
+        return {"freed": freed}
 
     def _list_options(self, q):
         """Filtered/paged listing. Parity: list_options in filesystem.rs —

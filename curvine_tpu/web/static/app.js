@@ -22,7 +22,10 @@ async function pollMetrics() {
   try {
     const m = await api("/api/metrics.json");
     const now = Date.now() / 1000;
-    const rd = m["bytes.read"] || 0, wr = m["bytes.written"] || 0;
+    // worker-plane bytes + client-pushed short-circuit bytes (the
+    // co-located fast path never touches a worker socket)
+    const rd = (m["bytes.read"] || 0) + (m["client.sc.bytes.read"] || 0);
+    const wr = (m["bytes.written"] || 0) + (m["client.sc.bytes.written"] || 0);
     if (hist.last) {
       const dt = Math.max(now - hist.last.t, 1e-3);
       hist.t.push(now);

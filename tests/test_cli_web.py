@@ -162,3 +162,24 @@ def test_cli_quota(cluster_loop, capsys):
     out = capsys.readouterr().out
     assert "files=5" in out
     assert _cv(mc, "quota", "clear", "/qcli") == 0
+
+
+async def test_client_sc_counters_reach_master():
+    """Short-circuit IO bypasses workers; the client pushes its byte
+    counters to the master (METRICS_REPORT) so throughput dashboards see
+    the co-located fast path."""
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.write_all("/scm/a.bin", b"q" * 65536)
+        data = await (await c.open("/scm/a.bin")).read_all()
+        assert data == b"q" * 65536
+        assert c.counters.get("sc.bytes.written", 0) >= 65536
+        assert c.counters.get("sc.bytes.read", 0) >= 65536
+        await c.flush_metrics()
+        m = mc.master.metrics.as_dict()
+        assert m.get("client.sc.bytes.written", 0) >= 65536
+        assert m.get("client.sc.bytes.read", 0) >= 65536
+        # flush pushes DELTAS: a second flush with no new IO adds nothing
+        await c.flush_metrics()
+        assert mc.master.metrics.as_dict()["client.sc.bytes.read"] == \
+            m["client.sc.bytes.read"]

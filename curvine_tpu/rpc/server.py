@@ -223,16 +223,31 @@ class RpcServer:
                     log.warning("%s: bad frame length %d from %s",
                                 self.name, total, conn.peer)
                     break
-                await conn._recv_into(memoryview(fixed))
-                version, code, req_id, status, flags, hdr_len = \
-                    frame_mod._FIXED.unpack(fixed)
-                header: dict = {}
-                if hdr_len:
-                    hview = conn._payload_view(hdr_len)
-                    await conn._recv_into(hview)
-                    import msgpack
-                    header = msgpack.unpackb(bytes(hview), raw=False,
-                                             strict_map_key=False)
+                try:
+                    await conn._recv_into(memoryview(fixed))
+                    version, code, req_id, status, flags, hdr_len = \
+                        frame_mod._FIXED.unpack(fixed)
+                    if FIXED_LEN + hdr_len > total:
+                        log.warning("%s: bad header length %d from %s",
+                                    self.name, hdr_len, conn.peer)
+                        break
+                    header: dict = {}
+                    if hdr_len:
+                        hview = conn._payload_view(hdr_len)
+                        await conn._recv_into(hview)
+                        import msgpack
+                        header = msgpack.unpackb(bytes(hview), raw=False,
+                                                 strict_map_key=False)
+                        if not isinstance(header, dict):
+                            raise ValueError(
+                                f"header is {type(header).__name__}, "
+                                "not a map")
+                except OSError:
+                    break          # peer hung up mid-frame: just close
+                except Exception as e:  # noqa: BLE001 — hostile bytes
+                    log.warning("%s: malformed frame from %s: %s",
+                                self.name, conn.peer, e)
+                    break
                 data_len = total - FIXED_LEN - hdr_len
                 is_chunk = bool(flags & (Flags.CHUNK | Flags.EOF)) and \
                     not (flags & Flags.RESPONSE)

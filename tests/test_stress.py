@@ -81,3 +81,49 @@ async def test_rpc_pipelining_stress():
         reps = await asyncio.gather(
             *(c.meta.exists("/ping") for _ in range(500)))
         assert all(reps)
+
+
+async def test_rpc_server_survives_malformed_frames():
+    """A byte-level client (native SDK, fuzzers, port scanners) must not
+    be able to wedge or crash the master: garbage frames drop the one
+    connection, well-formed traffic keeps flowing."""
+    import asyncio
+    import struct
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.meta.mkdir("/robust")
+        host, port = mc.master.addr.rsplit(":", 1)
+
+        async def send_raw(payload: bytes):
+            r, w = await asyncio.open_connection(host, int(port))
+            try:
+                w.write(payload)
+                await w.drain()
+                try:
+                    await asyncio.wait_for(r.read(64), 1.0)
+                except (asyncio.TimeoutError, ConnectionError):
+                    pass           # server RST on garbage is acceptable
+            finally:
+                w.close()
+
+        from curvine_tpu.rpc import frame as frame_mod
+        # oversized length prefix
+        await send_raw(struct.pack(">I", 1 << 31))
+        # header_len larger than the frame itself
+        fixed = frame_mod._FIXED.pack(1, 0, 0, 0, 0, 0xFFFF)
+        await send_raw(struct.pack(">I", len(fixed)) + fixed)
+        # header bytes that are not valid msgpack
+        fixed = frame_mod._FIXED.pack(1, 0, 0, 0, 0, 4)
+        await send_raw(struct.pack(">I", len(fixed) + 4) + fixed
+                       + b"\xc1\xc1\xc1\xc1")
+        # header that is valid msgpack but not a map (nil)
+        fixed = frame_mod._FIXED.pack(1, 0, 0, 0, 0, 1)
+        await send_raw(struct.pack(">I", len(fixed) + 1) + fixed + b"\xc0")
+        # truncated mid-frame then hangup
+        await send_raw(struct.pack(">I", 1000) + b"\x01\x02")
+        # pure garbage
+        await send_raw(b"\xde\xad\xbe\xef" * 16)
+        # the server still serves real clients
+        assert await c.meta.exists("/robust")
+        await c.meta.mkdir("/robust/after")
+        assert await c.meta.exists("/robust/after")

@@ -267,6 +267,10 @@ class MasterServer:
             client_name=q.get("client_name", ""),
             x_attr=q.get("x_attr"), storage_policy=q.get("storage_policy"),
             file_type=q.get("file_type", 1))
+        if st.storage_policy.ttl_ms > 0:
+            # index at create so the TTL engages without waiting for the
+            # periodic O(namespace) rescan
+            self.ttl.index(st.id, st.mtime, st.storage_policy.ttl_ms)
         return {"status": st.to_wire()}
 
     def _open_file(self, q):

@@ -75,9 +75,13 @@ class TtlManager:
             await asyncio.sleep(self.check_ms / 1000)
             try:
                 ticks += self.check_ms / 1000
-                if ticks - last_rescan >= rescan_every_s:
-                    # safety net for files whose ttl changed without an
-                    # index() hook call (e.g. journal replay paths)
+                # safety net for files whose ttl changed without an
+                # index() hook call. The rescan is O(namespace) (a full
+                # KV scan on big trees), so its interval scales with the
+                # namespace: hooks (set_attr + create) cover the normal
+                # paths, the rescan only heals replay/install edge cases.
+                interval = max(rescan_every_s, self.fs.tree.count() / 10_000)
+                if ticks - last_rescan >= interval:
                     self.rescan()
                     last_rescan = ticks
                 self.check(now_ms())

@@ -180,11 +180,12 @@ class QuotaManager:
             log.info("cache pressure: freed %d cold files", freed)
         return freed
 
-    async def run(self) -> None:
+    async def run(self, leader_gate=None) -> None:
         while True:
             await asyncio.sleep(self.check_interval_s)
             try:
-                self.evict_once()
+                if leader_gate is None or leader_gate():
+                    self.evict_once()
             except Exception:
                 log.exception("quota eviction loop")
 

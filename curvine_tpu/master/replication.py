@@ -21,6 +21,8 @@ log = logging.getLogger(__name__)
 
 
 class ReplicationManager:
+    _leader_gate = None
+
     def __init__(self, fs, scan_interval_s: float = 5.0):
         self.fs = fs
         self.scan_interval_s = scan_interval_s
@@ -48,7 +50,8 @@ class ReplicationManager:
             exclude=exclude | holders, needed=meta.len if meta else 0)
         return chosen[0]
 
-    async def run(self) -> None:
+    async def run(self, leader_gate=None) -> None:
+        self._leader_gate = leader_gate
         scan = asyncio.ensure_future(self._scan_loop())
         try:
             while True:
@@ -64,6 +67,8 @@ class ReplicationManager:
     async def _scan_loop(self) -> None:
         while True:
             await asyncio.sleep(self.scan_interval_s)
+            if self._leader_gate is not None and not self._leader_gate():
+                continue           # followers never dispatch repair work
             under = [m.block_id for m in self.fs.blocks.under_replicated()]
             if under:
                 log.info("scan: %d under-replicated blocks", len(under))

@@ -86,17 +86,32 @@ class MasterServer:
         # periodic duties ride the scheduled executor
         # (parity: curvine-common/src/executor/ ScheduledExecutor)
         interval = self.conf.master.heartbeat_check_ms / 1000
+        # HA followers must not ACT on replicated state (ttl deletes,
+        # evictions, lease recovery, repair dispatch): acting appends
+        # divergent local journal entries. Every mutating periodic duty
+        # is gated on leadership; single-node mode gates to True.
+        gate = self._is_leader
         self.executor.submit_periodic("heartbeat-check",
                                       self._heartbeat_tick, interval)
         self.executor.submit_periodic("lease-recovery",
-                                      self.fs.recover_stale_leases, 30.0)
-        self.executor.submit("ttl", self.ttl.run())
-        self.executor.submit("replication", self.replication.run())
+                                      self._lease_recovery_tick, 30.0)
+        self.executor.submit("ttl", self.ttl.run(leader_gate=gate))
+        self.executor.submit("replication",
+                             self.replication.run(leader_gate=gate))
         self.executor.submit("jobs", self.jobs.run())
-        self.executor.submit("quota", self.quota.run())
+        self.executor.submit("quota", self.quota.run(leader_gate=gate))
         log.info("master started at %s", self.addr)
 
+    def _is_leader(self) -> bool:
+        return self.raft is None or self.raft.is_leader
+
+    def _lease_recovery_tick(self) -> None:
+        if self._is_leader():
+            self.fs.recover_stale_leases()
+
     def _heartbeat_tick(self) -> None:
+        if not self._is_leader():
+            return              # lost-worker actions mutate; leader-only
         self.fs.check_lost_workers()
         # dead workers' last snapshots must not pin the gauges forever
         self._prune_worker_counters()

@@ -67,14 +67,30 @@ class TtlManager:
             if node.storage_policy.ttl_ms > 0:
                 self.index(node.id, node.mtime, node.storage_policy.ttl_ms)
 
-    async def run(self, rescan_every_s: float = 30.0) -> None:
-        self.rescan()
+    async def run(self, rescan_every_s: float = 30.0,
+                  leader_gate=None) -> None:
+        """leader_gate: callable; when False (HA follower) the manager
+        neither acts nor rescans — followers' hooks never fire (mutations
+        arrive via raft apply), so their index is rebuilt by the
+        PROMOTION rescan the moment the gate flips true."""
+        was_leader = leader_gate is None or leader_gate()
+        if was_leader:
+            self.rescan()
         last_rescan = 0.0
         ticks = 0
         while True:
             await asyncio.sleep(self.check_ms / 1000)
             try:
+                is_leader = leader_gate is None or leader_gate()
+                if not is_leader:
+                    was_leader = False
+                    continue
                 ticks += self.check_ms / 1000
+                if not was_leader:
+                    # just promoted: the follower index is stale/empty
+                    self.rescan()
+                    last_rescan = ticks
+                    was_leader = True
                 # safety net for files whose ttl changed without an
                 # index() hook call. The rescan is O(namespace) (a full
                 # KV scan on big trees), so its interval scales with the

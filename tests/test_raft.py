@@ -224,3 +224,36 @@ async def test_hard_state_survives_restart(tmp_path):
         for m in masters:
             if m.rpc._server is not None:
                 await m.stop()
+
+
+async def test_followers_do_not_act_on_ttl(tmp_path):
+    """Periodic duties (TTL, eviction, lease recovery, repair dispatch)
+    are leadership-gated: a follower acting on replicated state would
+    append divergent local journal entries. The leader applies the TTL
+    delete and replicates it; follower seqs never run ahead."""
+    from curvine_tpu.common.types import SetAttrOpts
+    masters, addrs = await _make_ha_cluster(tmp_path)
+    try:
+        leader = await _wait_leader(masters)
+        conf = ClusterConf()
+        conf.client.master_addrs = addrs
+        c = CurvineClient(conf)
+        await c.meta.create_file("/ttl-ha.bin")
+        await c.meta.complete_file("/ttl-ha.bin", 0)
+        await c.meta.set_attr("/ttl-ha.bin",
+                              SetAttrOpts(ttl_ms=300, ttl_action=1))
+
+        async def wait_gone():
+            while any(m.fs.tree.resolve("/ttl-ha.bin") for m in masters):
+                await asyncio.sleep(0.05)
+        await asyncio.wait_for(wait_gone(), 15)
+        # convergence: no follower ran ahead of the leader's journal
+        assert max(m.fs.journal.seq for m in masters) == leader.fs.journal.seq
+        followers = [m for m in masters if m is not leader]
+        for f in followers:
+            assert f.fs.journal.seq <= leader.fs.journal.seq
+        await c.close()
+    finally:
+        for m in masters:
+            if m.rpc._server is not None:
+                await m.stop()

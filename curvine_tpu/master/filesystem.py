@@ -54,6 +54,11 @@ class MasterFilesystem:
         # worker_id -> block ids scheduled for deletion (drained by heartbeat)
         self.pending_deletes: dict[int, set[int]] = {}
         self.mounts = None          # set by MountManager
+        # inode ids of files open for writing (is_complete=False):
+        # lease recovery iterates THIS, not the whole namespace. None
+        # until first use after a restart (built by one lazy scan, then
+        # maintained incrementally by the journaled applies).
+        self.open_files: set[int] | None = None
         self.on_worker_lost = None  # hook: ReplicationManager
         self.on_mutation = None     # hook: RaftLite journal replication
         self.acl = None             # set by AclEnforcer (permission checks)
@@ -229,6 +234,7 @@ class MasterFilesystem:
 
     def _load_snapshot(self, snap: dict) -> None:
         self.store.clear()
+        self.open_files = None       # rebuilt lazily from the new state
         have_entries = any(d.get("ch") is not None for d in snap["inodes"])
         for d in snap["inodes"]:
             is_dir = d["dir"]
@@ -344,6 +350,8 @@ class MasterFilesystem:
                      replicas=replicas, block_size=block_size,
                      is_complete=False, client_name=client_name)
         self.tree.add_child(parent, node)
+        if self.open_files is not None:
+            self.open_files.add(node.id)
         return node.to_status(path)
 
     def append_file(self, path: str, client_name: str = "") -> FileBlocks:
@@ -359,6 +367,8 @@ class MasterFilesystem:
         node.is_complete = False
         node.client_name = client_name
         self.tree.save(node)
+        if self.open_files is not None:
+            self.open_files.add(node.id)
 
     def exists(self, path: str) -> bool:
         return self.tree.resolve(path) is not None
@@ -457,6 +467,8 @@ class MasterFilesystem:
             removed = self.tree.remove_child(parent, name or node.name)
             if removed is not None and removed.nlink <= 0:
                 self._free_blocks(removed)
+                if self.open_files is not None:
+                    self.open_files.discard(removed.id)
 
     def _free_blocks(self, node: Inode) -> None:
         """Drops the node's blocks. Does NOT save the inode: callers on
@@ -643,6 +655,8 @@ class MasterFilesystem:
         node.mtime = now_ms()
         node.client_name = ""
         self.tree.save(node)
+        if self.open_files is not None:
+            self.open_files.discard(node.id)
 
     def _commit(self, node: Inode, commit_blocks: list[CommitBlock] | None
                 ) -> None:
@@ -725,8 +739,19 @@ class MasterFilesystem:
         when nothing was ever committed."""
         deadline = now_ms() - lease_timeout_ms
         recovered = 0
-        for node in list(self.tree.iter_files()):
-            if node.is_complete or node.mtime >= deadline:
+        if self.open_files is None:
+            # one lazy scan after restart; incremental from then on
+            self.open_files = {n.id for n in self.tree.iter_files()
+                               if not n.is_complete}
+        for inode_id in list(self.open_files):
+            node = self.tree.get(inode_id)
+            if node is None or node.file_type == FileType.DIR:
+                self.open_files.discard(inode_id)
+                continue
+            if node.is_complete:
+                self.open_files.discard(inode_id)
+                continue
+            if node.mtime >= deadline:
                 continue
             path = self.tree.path_of(node)
             committed = sum((self.blocks.get(b).len
@@ -746,11 +771,15 @@ class MasterFilesystem:
                 log.warning("lease recovery of %s failed: %s", path, e)
         return recovered
 
-    def check_lost_workers(self) -> list[WorkerInfo]:
+    def check_lost_workers(self, act: bool = True) -> list[WorkerInfo]:
+        """LOST-state bookkeeping always runs (reads filter locations on
+        worker state, so followers must notice dead workers too);
+        `act=False` skips the repair dispatch side effects (HA followers
+        must not initiate re-replication)."""
         newly_lost = self.workers.check_lost()
         for w in newly_lost:
             affected = self.blocks.worker_lost(w.address.worker_id)
-            if affected and self.on_worker_lost:
+            if act and affected and self.on_worker_lost:
                 self.on_worker_lost(w, affected)
         return newly_lost
 

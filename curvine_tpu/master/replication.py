@@ -21,9 +21,8 @@ log = logging.getLogger(__name__)
 
 
 class ReplicationManager:
-    _leader_gate = None
-
     def __init__(self, fs, scan_interval_s: float = 5.0):
+        self._leader_gate = None
         self.fs = fs
         self.scan_interval_s = scan_interval_s
         self.pool = ConnectionPool(size=1)
@@ -57,6 +56,10 @@ class ReplicationManager:
             while True:
                 bid = await self.queue.get()
                 self._queued.discard(bid)
+                if self._leader_gate is not None and \
+                        not self._leader_gate():
+                    continue    # RPC-fed work (scrub reports, requeues)
+                                # must not dispatch from a follower either
                 try:
                     await self._replicate(bid)
                 except Exception as e:

@@ -110,9 +110,10 @@ class MasterServer:
             self.fs.recover_stale_leases()
 
     def _heartbeat_tick(self) -> None:
-        if not self._is_leader():
-            return              # lost-worker actions mutate; leader-only
-        self.fs.check_lost_workers()
+        # LOST bookkeeping runs everywhere (follower-served reads must
+        # not return dead-worker locations); repair dispatch and counter
+        # pruning side effects stay leader-gated
+        self.fs.check_lost_workers(act=self._is_leader())
         # dead workers' last snapshots must not pin the gauges forever
         self._prune_worker_counters()
 

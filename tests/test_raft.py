@@ -249,9 +249,6 @@ async def test_followers_do_not_act_on_ttl(tmp_path):
         await asyncio.wait_for(wait_gone(), 15)
         # convergence: no follower ran ahead of the leader's journal
         assert max(m.fs.journal.seq for m in masters) == leader.fs.journal.seq
-        followers = [m for m in masters if m is not leader]
-        for f in followers:
-            assert f.fs.journal.seq <= leader.fs.journal.seq
         await c.close()
     finally:
         for m in masters:

@@ -150,6 +150,28 @@ class _Miss:
 _MISS = _Miss()
 
 
+def _merge_runs(segments, drop_tombs: bool, start: bytes = b""):
+    """Ordered (key, value) across `segments` (oldest→newest); the newest
+    occurrence of a key wins."""
+    def source(seg, rank):
+        # rank must be bound eagerly (a genexp in the comprehension
+        # would close over the loop variable and give every source
+        # the same final rank, breaking newest-wins)
+        return ((k, rank, v) for k, v in seg.iter_from(start))
+
+    # newer segments get lower rank so heapq pops them first
+    sources = [source(seg, rank)
+               for rank, seg in enumerate(reversed(segments))]
+    last = None
+    for k, _rank, v in heapq.merge(*sources):
+        if k == last:
+            continue
+        last = k
+        if v is None and drop_tombs:
+            continue
+        yield k, v
+
+
 class KvStore:
     def __init__(self, kv_dir: str, memtable_max_bytes: int = 8 << 20,
                  compact_threshold: int = 8, fsync: bool = False):
@@ -268,7 +290,7 @@ class KvStore:
                 pass
         self._wal_paths = []
         if len(self.segments) > self.compact_threshold:
-            self.compact()
+            self._compact_tiered()
 
     def _write_segment(self, path: str, items) -> None:
         """``items`` is any iterable of sorted (key, value|None) — large
@@ -304,9 +326,9 @@ class KvStore:
         os.replace(tmp, path)
 
     def compact(self) -> None:
-        """Merge all segments into one run, dropping tombstones and shadowed
-        versions. The memtable is untouched (call flush() first for a full
-        collapse)."""
+        """FULL compaction: merge every segment into one run, dropping
+        tombstones (explicit admin/maintenance op). Auto-compaction from
+        flush() uses the size-tiered policy instead."""
         if len(self.segments) <= 1:
             return
         self._gen += 1
@@ -318,25 +340,38 @@ class KvStore:
             seg.close()
             os.unlink(seg.path)
 
-    def _merged_segments(self, drop_tombs: bool, start: bytes = b""):
-        """Ordered (key, value) across segments; newest segment wins."""
-        def source(seg, rank):
-            # rank must be bound eagerly (a genexp in the comprehension
-            # would close over the loop variable and give every source
-            # the same final rank, breaking newest-wins)
-            return ((k, rank, v) for k, v in seg.iter_from(start))
+    def _compact_tiered(self) -> None:
+        """Size-tiered compaction: merge the NEWEST suffix of segments
+        whose sizes are comparable (each next-older segment joins while
+        it is ≤ 2× the accumulated suffix size). Fresh small flushes fold
+        together cheaply while a big old run is left alone — write
+        amplification stays logarithmic instead of O(total) per merge.
+        Tombstones drop only when the merge covers EVERY segment (a
+        partial merge's tombstone may still shadow keys in older runs)."""
+        if len(self.segments) <= 1:
+            return
+        sizes = [os.path.getsize(s.path) for s in self.segments]
+        start = len(self.segments) - 1
+        acc = sizes[start]
+        while start > 0 and sizes[start - 1] <= 2 * acc:
+            start -= 1
+            acc += sizes[start]
+        if start == len(self.segments) - 1:
+            start -= 1                     # always merge at least two
+        victims = self.segments[start:]
+        full = start == 0
+        self._gen += 1
+        path = os.path.join(self.dir, f"seg-{self._gen:012d}.sst")
+        self._write_segment(
+            path, _merge_runs(victims, drop_tombs=full))
+        self.segments = self.segments[:start] + [Segment(path)]
+        for seg in victims:
+            seg.close()
+            os.unlink(seg.path)
 
-        # newer segments get lower rank so heapq pops them first
-        sources = [source(seg, rank)
-                   for rank, seg in enumerate(reversed(self.segments))]
-        last = None
-        for k, _rank, v in heapq.merge(*sources):
-            if k == last:
-                continue
-            last = k
-            if v is None and drop_tombs:
-                continue
-            yield k, v
+    def _merged_segments(self, drop_tombs: bool, start: bytes = b""):
+        """Ordered (key, value) across ALL segments; newest wins."""
+        yield from _merge_runs(self.segments, drop_tombs, start)
 
     # ---------- reads ----------
 

@@ -111,8 +111,9 @@ class MasterServer:
 
     def _heartbeat_tick(self) -> None:
         # LOST bookkeeping runs everywhere (follower-served reads must
-        # not return dead-worker locations); repair dispatch and counter
-        # pruning side effects stay leader-gated
+        # not return dead-worker locations); repair-dispatch side effects
+        # stay leader-gated. Counter pruning is local metrics state and
+        # runs everywhere too.
         self.fs.check_lost_workers(act=self._is_leader())
         # dead workers' last snapshots must not pin the gauges forever
         self._prune_worker_counters()
@@ -523,6 +524,18 @@ class MasterServer:
         return {"worker": w.address.to_wire()}
 
     def _report_under_replicated(self, q):
+        if not self._is_leader():
+            # reject so the worker rotates to the leader instead of the
+            # report being silently dropped by the gated repair queue
+            from curvine_tpu.common import errors as cerr
+            raise cerr.NotLeader("repair reports go to the leader")
+        # the reporting worker DROPPED its corrupt replica: retire the
+        # stale location now so the periodic under-replication scan can
+        # re-detect the block even if this immediate dispatch fails
+        wid = q.get("worker_id")
+        for bid in q.get("block_ids", []):
+            if wid is not None:
+                self.fs.blocks.remove_replica(bid, wid)
         self.replication.enqueue(q.get("block_ids", []))
         return {"success": True}
 

@@ -85,6 +85,7 @@ class WorkerServer:
         from curvine_tpu.common.executor import ScheduledExecutor
         self.executor = ScheduledExecutor("worker")
         self._task_sem = asyncio.Semaphore(wc.task_parallelism)
+        self._leader_idx = 0
         self._register_handlers()
 
     @property
@@ -127,7 +128,32 @@ class WorkerServer:
     # ---------------- master plane ----------------
 
     async def _master_conn(self) -> Connection:
-        return await self.master_pool.get(self.conf.client.master_addrs[0])
+        """Connection to the current LEADER (rotates on failure —
+        `_leader_call` handles NOT_LEADER rotation for actual calls)."""
+        addrs = self.conf.client.master_addrs
+        return await self.master_pool.get(addrs[self._leader_idx
+                                                % len(addrs)])
+
+    async def _leader_call(self, code, data):
+        """Call the leader, rotating through master_addrs on NOT_LEADER
+        or connect failure (workers were previously pinned to addrs[0],
+        which breaks every worker→master report in an HA cluster whose
+        leader isn't the first address)."""
+        addrs = self.conf.client.master_addrs
+        last: Exception | None = None
+        for i in range(len(addrs)):
+            idx = (self._leader_idx + i) % len(addrs)
+            try:
+                conn = await self.master_pool.get(addrs[idx])
+                rep = await conn.call(code, data=data)
+                self._leader_idx = idx
+                return rep
+            except err.CurvineError as e:
+                if e.code not in (err.ErrorCode.NOT_LEADER,
+                                  err.ErrorCode.CONNECT):
+                    raise
+                last = e
+        raise last or err.NotLeader("no reachable master")
 
     def _info(self) -> WorkerInfo:
         storages = self.store.storages()
@@ -154,24 +180,45 @@ class WorkerServer:
                           ici_coords=list(self.conf.worker.ici_coords))
 
     async def heartbeat_once(self) -> None:
-        conn = await self._master_conn()
-        rep = await conn.call(RpcCode.WORKER_HEARTBEAT,
-                              data=pack({"info": self._info().to_wire(),
-                                         "metrics": {
+        """Heartbeat EVERY master: followers serve reads and need live
+        worker state + replica locations too (runtime locs never ride the
+        journal). Delete commands from any master are idempotent."""
+        payload = pack({"info": self._info().to_wire(),
+                        "metrics": {
             "bytes.read": self.metrics.counters.get("bytes.read", 0),
             "bytes.written": self.metrics.counters.get("bytes.written", 0),
-        }}))
-        cmds = unpack(rep.data) or {}
-        for bid in cmds.get("delete_blocks", []):
+        }})
+        deletes: set[int] = set()
+        ok = 0
+        for addr in self.conf.client.master_addrs:
+            try:
+                conn = await self.master_pool.get(addr)
+                rep = await conn.call(RpcCode.WORKER_HEARTBEAT, data=payload)
+                ok += 1
+                for bid in (unpack(rep.data) or {}).get("delete_blocks", []):
+                    deletes.add(bid)
+            except Exception as e:  # noqa: BLE001 — peer down is routine
+                log.debug("heartbeat to %s failed: %s", addr, e)
+        if not ok:
+            raise err.ConnectError("no master reachable for heartbeat")
+        for bid in deletes:
             self.store.delete(bid)
 
     async def block_report_once(self) -> None:
         held, types = self.store.report()
-        conn = await self._master_conn()
-        rep = await conn.call(RpcCode.WORKER_BLOCK_REPORT, data=pack({
-            "worker_id": self.worker_id, "blocks": held,
-            "storage_types": types}))
-        for bid in (unpack(rep.data) or {}).get("delete_blocks", []):
+        payload = pack({"worker_id": self.worker_id, "blocks": held,
+                        "storage_types": types})
+        deletes: set[int] = set()
+        for addr in self.conf.client.master_addrs:
+            try:
+                conn = await self.master_pool.get(addr)
+                rep = await conn.call(RpcCode.WORKER_BLOCK_REPORT,
+                                      data=payload)
+                for bid in (unpack(rep.data) or {}).get("delete_blocks", []):
+                    deletes.add(bid)
+            except Exception as e:  # noqa: BLE001
+                log.debug("block report to %s failed: %s", addr, e)
+        for bid in deletes:
             self.store.delete(bid)
 
     async def _evict_once(self) -> None:
@@ -464,8 +511,7 @@ class WorkerServer:
                 self.store.commit(block_id, total)
                 # tell master about the new replica via commit on next report;
                 # also push an immediate incremental report
-                mc = await self._master_conn()
-                await mc.call(RpcCode.WORKER_BLOCK_REPORT, data=pack({
+                await self._leader_call(RpcCode.WORKER_BLOCK_REPORT, pack({
                     "worker_id": self.worker_id,
                     "blocks": {block_id: total},
                     "storage_types": {block_id: int(info.tier.storage_type)},
@@ -474,10 +520,10 @@ class WorkerServer:
             ok, message = False, str(e)
             self.store.delete(block_id)
         try:
-            mc = await self._master_conn()
-            await mc.call(RpcCode.REPORT_BLOCK_REPLICATION_RESULT, data=pack({
-                "block_id": block_id, "worker_id": self.worker_id,
-                "success": ok, "message": message}))
+            await self._leader_call(
+                RpcCode.REPORT_BLOCK_REPLICATION_RESULT,
+                pack({"block_id": block_id, "worker_id": self.worker_id,
+                      "success": ok, "message": message}))
         except Exception as e:
             log.warning("replication result report failed: %s", e)
         return {"success": ok, "message": message}
@@ -546,9 +592,8 @@ class WorkerServer:
             finally:
                 task.worker_id = self.worker_id
                 try:
-                    mc = await self._master_conn()
-                    await mc.call(RpcCode.REPORT_TASK,
-                                  data=pack({"task": task.to_wire()}))
+                    await self._leader_call(RpcCode.REPORT_TASK,
+                                            pack({"task": task.to_wire()}))
                 except Exception as e:
                     log.warning("task report failed: %s", e)
                 await client.close()

@@ -254,3 +254,65 @@ async def test_followers_do_not_act_on_ttl(tmp_path):
         for m in masters:
             if m.rpc._server is not None:
                 await m.stop()
+
+
+async def test_workers_heartbeat_all_masters(tmp_path):
+    """Workers heartbeat EVERY master (followers serve reads and need
+    live worker state + replica locations, which never ride the journal)
+    and rotate reports to the leader — previously they were pinned to
+    master_addrs[0], breaking any HA cluster whose leader wasn't first."""
+    from curvine_tpu.worker import WorkerServer
+    masters, addrs = await _make_ha_cluster(tmp_path)
+    worker = None
+    try:
+        leader = await _wait_leader(masters)
+        wconf = ClusterConf()
+        wconf.worker.hostname = "127.0.0.1"
+        wconf.worker.rpc_port = 0
+        wconf.worker.heartbeat_ms = 100
+        # follower locations converge via block reports (commits register
+        # replicas on the leader only)
+        wconf.worker.block_report_interval_ms = 300
+        from curvine_tpu.common.conf import TierConf
+        wconf.worker.tiers = [TierConf(storage_type="mem",
+                                       dir=str(tmp_path / "wmem"),
+                                       capacity=64 * MB)]
+        wconf.client.master_addrs = addrs
+        worker = WorkerServer(wconf)
+        await worker.start()
+
+        async def all_see_worker():
+            while not all(len(m.fs.workers.live_workers()) == 1
+                          for m in masters):
+                await asyncio.sleep(0.05)
+        await asyncio.wait_for(all_see_worker(), 10)
+
+        # data flows end-to-end through whichever master leads
+        conf = ClusterConf()
+        conf.client.master_addrs = addrs
+        conf.client.block_size = MB
+        c = CurvineClient(conf)
+        await c.write_all("/ha-data.bin", b"H" * 2048)
+        assert await (await c.open("/ha-data.bin")).read_all() == b"H" * 2048
+        # every master (followers included) knows the replica location
+        async def all_have_locs():
+            while True:
+                ok = 0
+                for m in masters:
+                    try:
+                        fb = m.fs.get_block_locations("/ha-data.bin")
+                        if fb.block_locs and fb.block_locs[0].locs:
+                            ok += 1
+                    except Exception:
+                        pass
+                if ok == len(masters):
+                    return
+                await asyncio.sleep(0.05)
+        await asyncio.wait_for(all_have_locs(), 10)
+        await c.close()
+    finally:
+        if worker is not None:
+            await worker.stop()
+        for m in masters:
+            if m.rpc._server is not None:
+                await m.stop()

@@ -338,6 +338,7 @@ class TaskInfo(Wire):
     message: str = ""
     total_len: int = 0
     loaded_len: int = 0
+    attempts: int = 0
 
 
 @dataclass
@@ -350,6 +351,10 @@ class JobInfo(Wire):
     create_ms: int = 0
     finish_ms: int = 0
     tasks: list[TaskInfo] = field(default_factory=list)
+    # planning parameters, persisted so a restarted master can RE-PLAN
+    # an interrupted job (resume)
+    recursive: bool = True
+    replicas: int = 1
 
 
 @dataclass

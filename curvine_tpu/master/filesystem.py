@@ -227,7 +227,8 @@ class MasterFilesystem:
                   for bid, (length, iid, rep) in self.store.iter_blocks()]
         state = {"next_id": self.store.get_counter("next_id", ROOT_ID + 1),
                  "next_block_id": self.store.get_counter("next_block_id", 1),
-                 "inodes": inodes, "blocks": blocks}
+                 "inodes": inodes, "blocks": blocks,
+                 "jobs": list(self.store.iter_jobs())}
         if self.mounts is not None:
             state["mounts"] = self.mounts.snapshot_state()
         return state
@@ -269,6 +270,8 @@ class MasterFilesystem:
         self.store.set_counter("next_block_id", snap["next_block_id"])
         for bid, blen, iid, rep in snap["blocks"]:
             self.store.block_put(bid, blen, iid, rep)
+        for wire in snap.get("jobs", []):
+            self.store.job_put(wire["job_id"], wire)
         if self.mounts is not None and "mounts" in snap:
             self.mounts.load_snapshot_state(snap["mounts"])
 
@@ -280,6 +283,13 @@ class MasterFilesystem:
 
     def _apply_noop(self) -> None:
         """Term-opening no-op (raft leader turnover)."""
+
+    def _apply_job_put(self, job: dict) -> None:
+        """Durable job record (resume after restart/failover)."""
+        self.store.job_put(job["job_id"], job)
+
+    def _apply_job_del(self, job_id: str) -> None:
+        self.store.job_remove(job_id)
 
     # ==================== namespace ops ====================
 

@@ -37,13 +37,62 @@ class JobManager:
         if kind not in ("load", "export"):
             raise err.Unsupported(f"job kind {kind!r}")
         job = JobInfo(job_id=uuid.uuid4().hex[:16], kind=kind, path=path,
-                      state=JobState.PENDING, create_ms=now_ms())
+                      state=JobState.PENDING, create_ms=now_ms(),
+                      recursive=recursive, replicas=replicas)
         self.jobs[job.job_id] = job
-        if kind == "load":
-            asyncio.ensure_future(self._plan_load(job, recursive, replicas))
-        else:
-            asyncio.ensure_future(self._plan_export(job, recursive))
+        self._persist(job)
+        self._plan(job)
         return job
+
+    def _plan(self, job: JobInfo) -> None:
+        if job.kind == "load":
+            asyncio.ensure_future(
+                self._plan_load(job, job.recursive, job.replicas))
+        else:
+            asyncio.ensure_future(self._plan_export(job, job.recursive))
+
+    def _persist(self, job: JobInfo) -> None:
+        """Journal the job record (sans per-file tasks — a resumed
+        master RE-PLANS instead of replaying task lists). Replicates to
+        HA followers like any other namespace mutation."""
+        wire = job.to_wire()
+        wire["tasks"] = []
+        try:
+            self.fs._log("job_put", {"job": wire})
+        except err.CurvineError as e:
+            log.warning("persisting job %s failed: %s", job.job_id, e)
+
+    def recover(self) -> int:
+        """Resume interrupted jobs from the durable store (called when
+        this master starts leading): PENDING/RUNNING jobs re-plan;
+        finished ones stay queryable; finished jobs older than 7 days are
+        pruned. Returns the number of jobs resumed."""
+        resumed = 0
+        cutoff = now_ms() - 7 * 24 * 3600 * 1000
+        for wire in list(self.fs.store.iter_jobs()):
+            job = JobInfo.from_wire(wire)
+            if job.state in (JobState.PENDING, JobState.RUNNING):
+                # the DURABLE state is the truth: re-plan even when an
+                # in-RAM record exists (a demoted tenure drained its task
+                # queue, so those tasks are gone). Load/export tasks are
+                # idempotent, so a duplicate dispatch wastes work at most.
+                job.state = JobState.PENDING
+                job.tasks = []
+                self.jobs[job.job_id] = job
+                self._plan(job)
+                resumed += 1
+                log.info("resuming %s job %s on %s", job.kind,
+                         job.job_id, job.path)
+            else:
+                if job.finish_ms and job.finish_ms < cutoff:
+                    try:
+                        self.fs._log("job_del", {"job_id": job.job_id})
+                    except err.CurvineError:
+                        pass
+                    self.jobs.pop(job.job_id, None)
+                    continue
+                self.jobs.setdefault(job.job_id, job)
+        return resumed
 
     async def _plan_export(self, job: JobInfo, recursive: bool) -> None:
         """Enumerate cached files under job.path → one export task each.
@@ -74,10 +123,12 @@ class JobManager:
             job.state = JobState.RUNNING if files else JobState.COMPLETED
             if not files:
                 job.finish_ms = now_ms()
+                self._persist(job)
         except Exception as e:  # noqa: BLE001 — job fails with message
             log.warning("export job %s planning failed: %s", job.job_id, e)
             job.state = JobState.FAILED
             job.message = str(e)
+            self._persist(job)
 
     async def _plan_load(self, job: JobInfo, recursive: bool,
                          replicas: int) -> None:
@@ -107,14 +158,26 @@ class JobManager:
             if not files:
                 job.state = JobState.COMPLETED
                 job.finish_ms = now_ms()
+                self._persist(job)
         except Exception as e:  # noqa: BLE001 — job fails with message
             log.warning("load job %s planning failed: %s", job.job_id, e)
             job.state = JobState.FAILED
             job.message = str(e)
+            self._persist(job)
 
-    async def run(self) -> None:
+    async def run(self, leader_gate=None) -> None:
+        was_leader = False
         while True:
-            task = await self._pending.get()
+            is_leader = leader_gate is None or leader_gate()
+            if is_leader and not was_leader:
+                self.recover()        # startup or just promoted: resume
+            was_leader = is_leader
+            try:
+                task = await asyncio.wait_for(self._pending.get(), 1.0)
+            except asyncio.TimeoutError:
+                continue              # gate re-check tick
+            if not is_leader:
+                continue              # followers never dispatch
             job = self.jobs.get(task.job_id)
             if job is None or job.state in (JobState.CANCELLED, JobState.FAILED):
                 continue
@@ -128,6 +191,15 @@ class JobManager:
     async def _dispatch(self, task: TaskInfo) -> None:
         workers = self.fs.workers.live_workers()
         if not workers:
+            # transient right after a master (re)start: workers register
+            # on their next heartbeat — retry with backoff before failing
+            task.attempts += 1
+            if task.attempts <= 20:
+                async def requeue():
+                    await asyncio.sleep(min(0.5 * task.attempts, 3.0))
+                    await self._pending.put(task)
+                asyncio.ensure_future(requeue())
+                return
             raise err.NoAvailableWorker("no live workers for load task")
         w = workers[next(self._rr) % len(workers)]
         task.worker_id = w.address.worker_id
@@ -150,16 +222,22 @@ class JobManager:
     def _maybe_finish(self, job: JobInfo) -> None:
         if job.state not in (JobState.RUNNING, JobState.PENDING):
             return
+        if not job.tasks:
+            # reachable mid-resume (tasks reset, re-plan in flight): an
+            # empty set must not read as 'all tasks completed'
+            return
         states = {t.state for t in job.tasks}
         if states <= {JobState.COMPLETED}:
             job.state = JobState.COMPLETED
             job.finish_ms = now_ms()
+            self._persist(job)
         elif JobState.FAILED in states and not (
                 states & {JobState.PENDING, JobState.RUNNING}):
             job.state = JobState.FAILED
             job.finish_ms = now_ms()
             job.message = "; ".join(t.message for t in job.tasks
                                     if t.state == JobState.FAILED)[:500]
+            self._persist(job)
 
     def status(self, job_id: str) -> JobInfo:
         job = self.jobs.get(job_id)
@@ -172,3 +250,4 @@ class JobManager:
         if job.state in (JobState.PENDING, JobState.RUNNING):
             job.state = JobState.CANCELLED
             job.finish_ms = now_ms()
+            self._persist(job)

@@ -46,6 +46,7 @@ class MountManager:
                          auto_cache=auto_cache,
                          write_type=WriteType(write_type))
         self._mounts[cv_path] = info
+        self.fs.store.mount_put(cv_path, info.to_wire())
         return info
 
     def umount(self, cv_path: str) -> None:
@@ -56,6 +57,7 @@ class MountManager:
 
     def _apply_remove(self, cv_path: str) -> None:
         self._mounts.pop(cv_path, None)
+        self.fs.store.mount_remove(cv_path)
 
     def update(self, cv_path: str, properties: dict | None = None,
                auto_cache: bool | None = None) -> MountInfo:
@@ -72,7 +74,20 @@ class MountManager:
             info.properties.update(properties)
         if auto_cache is not None:
             info.auto_cache = auto_cache
+        self.fs.store.mount_put(cv_path, info.to_wire())
         return info
+
+    def load_from_store(self) -> None:
+        """Rebuild the in-RAM table from durable records — the KV cold
+        start skips already-applied journal entries, so mount_add never
+        re-runs there (mounts previously vanished on KV restarts)."""
+        top = 0
+        for wire in self.fs.store.iter_mounts():
+            info = MountInfo.from_wire(wire)
+            self._mounts[info.cv_path] = info
+            top = max(top, info.mount_id)
+        if top:
+            self._ids = itertools.count(top + 1)
 
     # ---------- resolution ----------
     def table(self) -> list[MountInfo]:
@@ -160,6 +175,10 @@ class MountManager:
 
     def load_snapshot_state(self, state: list[dict]) -> None:
         self._mounts = {m["cv_path"]: MountInfo.from_wire(m) for m in state}
+        # re-persist: a snapshot install cleared the store's durable
+        # mount records, and a later restart reloads from the store
+        for cv_path, info in self._mounts.items():
+            self.fs.store.mount_put(cv_path, info.to_wire())
         if self._mounts:
             top = max(m.mount_id for m in self._mounts.values())
             self._ids = itertools.count(top + 1)

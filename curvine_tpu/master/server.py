@@ -80,6 +80,7 @@ class MasterServer:
 
     async def start(self) -> None:
         self.fs.recover()
+        self.mounts.load_from_store()
         await self.rpc.start()
         if self.raft is not None:
             await self.raft.start()
@@ -98,7 +99,7 @@ class MasterServer:
         self.executor.submit("ttl", self.ttl.run(leader_gate=gate))
         self.executor.submit("replication",
                              self.replication.run(leader_gate=gate))
-        self.executor.submit("jobs", self.jobs.run())
+        self.executor.submit("jobs", self.jobs.run(leader_gate=gate))
         self.executor.submit("quota", self.quota.run(leader_gate=gate))
         log.info("master started at %s", self.addr)
 

@@ -50,6 +50,8 @@ class MemMetaStore:
         self.children: dict[int, dict[str, int]] = {}
         self.blocks: dict[int, tuple[int, int, int]] = {}
         self.counters: dict[str, int] = {}
+        self.mounts_tbl: dict[str, dict] = {}
+        self.jobs_tbl: dict[str, dict] = {}
 
     # inodes
     def get(self, inode_id: int):
@@ -103,6 +105,26 @@ class MemMetaStore:
     def block_count(self) -> int:
         return len(self.blocks)
 
+    # mount table records
+    def mount_put(self, cv_path: str, wire: dict) -> None:
+        self.mounts_tbl[cv_path] = wire
+
+    def mount_remove(self, cv_path: str) -> None:
+        self.mounts_tbl.pop(cv_path, None)
+
+    def iter_mounts(self):
+        return iter(list(self.mounts_tbl.values()))
+
+    # job records (persisted so restarts resume interrupted jobs)
+    def job_put(self, job_id: str, wire: dict) -> None:
+        self.jobs_tbl[job_id] = wire
+
+    def job_remove(self, job_id: str) -> None:
+        self.jobs_tbl.pop(job_id, None)
+
+    def iter_jobs(self):
+        return iter(list(self.jobs_tbl.values()))
+
     # counters
     def get_counter(self, name: str, default: int = 0) -> int:
         return self.counters.get(name, default)
@@ -128,6 +150,8 @@ class MemMetaStore:
         self.children.clear()
         self.blocks.clear()
         self.counters.clear()
+        self.mounts_tbl.clear()
+        self.jobs_tbl.clear()
 
     def close(self) -> None:
         pass
@@ -301,6 +325,30 @@ class KvMetaStore:
 
     def block_count(self) -> int:
         return self.get_counter("block_count")
+
+    # ---- mount table records ----
+    def mount_put(self, cv_path: str, wire: dict) -> None:
+        self._pending[b"m" + cv_path.encode()] = msgpack.packb(
+            wire, use_bin_type=True)
+
+    def mount_remove(self, cv_path: str) -> None:
+        self._pending[b"m" + cv_path.encode()] = None
+
+    def iter_mounts(self):
+        for _k, raw in self.kv.scan(prefix=b"m"):
+            yield msgpack.unpackb(raw, raw=False, strict_map_key=False)
+
+    # ---- job records ----
+    def job_put(self, job_id: str, wire: dict) -> None:
+        self._pending[b"J" + job_id.encode()] = msgpack.packb(
+            wire, use_bin_type=True)
+
+    def job_remove(self, job_id: str) -> None:
+        self._pending[b"J" + job_id.encode()] = None
+
+    def iter_jobs(self):
+        for _k, raw in self.kv.scan(prefix=b"J"):
+            yield msgpack.unpackb(raw, raw=False, strict_map_key=False)
 
     # ---- counters ----
     def get_counter(self, name: str, default: int = 0) -> int:

@@ -196,3 +196,35 @@ async def test_ufs_metadata_passthrough():
         r = await c.unified_open("/m/raw/x.bin")
         assert await r.read_all() == b"X" * 300
         assert await r.pread(10, 5) == b"X" * 5
+
+
+async def test_load_job_resumes_after_master_restart():
+    """Job records are journaled (sans task lists); a restarted master
+    re-plans interrupted PENDING/RUNNING jobs — the checkpoint/resume
+    story for distributed cache warming."""
+    from curvine_tpu.common.types import JobState
+    from curvine_tpu.ufs import create_ufs
+    from curvine_tpu.ufs import memory as memufs
+    memufs.reset()
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        ufs = create_ufs("mem://resbkt")
+        for i in range(6):
+            await ufs.write_all(f"mem://resbkt/d/obj{i}.bin", b"R" * 2048)
+        await c.meta.mount("/res", "mem://resbkt")
+        job = mc.master.jobs.submit("load", "/res/d")
+        # restart the master BEFORE the job can finish
+        await mc.restart_master()
+        # the restarted master resumed the job from its journaled record
+        async def wait_done():
+            while True:
+                j = mc.master.jobs.jobs.get(job.job_id)
+                if j is not None and j.state == JobState.COMPLETED:
+                    return j
+                await asyncio.sleep(0.05)
+        j = await asyncio.wait_for(wait_done(), 20)
+        assert j.state == JobState.COMPLETED
+        # the data actually got warmed into the cache
+        for i in range(6):
+            st = await c.meta.file_status(f"/res/d/obj{i}.bin")
+            assert st.len == 2048

@@ -13,7 +13,9 @@ but not enforced (cluster-internal gateway, like the reference's default).
 from __future__ import annotations
 
 import logging
+import re
 import urllib.parse
+import uuid
 import xml.sax.saxutils as sax
 
 from aiohttp import web
@@ -129,6 +131,88 @@ class S3Gateway:
         if not normed.startswith(f"/{bucket}/"):
             return self._error(400, "InvalidObjectName", path)
         try:
+            # ---- multipart upload (real S3 clients use it for anything
+            # big: boto3 defaults to multipart above 8 MiB) ----
+            if req.method == "POST" and "uploads" in req.query:
+                upload_id = uuid.uuid4().hex[:20]
+                await self.client.meta.mkdir(
+                    f"/.s3mpu/{upload_id}", create_parent=True)
+                await self._gc_stale_uploads()
+                return web.Response(content_type="application/xml", text=(
+                    f'<?xml version="1.0"?>'
+                    f"<InitiateMultipartUploadResult {_NS}>"
+                    f"<Bucket>{bucket}</Bucket>"
+                    f"<Key>{sax.escape(key)}</Key>"
+                    f"<UploadId>{upload_id}</UploadId>"
+                    f"</InitiateMultipartUploadResult>"))
+            if req.method == "PUT" and "uploadId" in req.query:
+                upload_id = self._upload_id(req)
+                if upload_id is None:
+                    return self._error(400, "NoSuchUpload", key)
+                try:
+                    part = int(req.query.get("partNumber", "1"))
+                except ValueError:
+                    part = 0
+                if not 1 <= part <= 10_000:
+                    return self._error(400, "InvalidPartNumber", key)
+                data = await req.read()
+                await self.client.write_all(
+                    f"/.s3mpu/{upload_id}/part-{part:05d}", data)
+                return web.Response(status=200,
+                                    headers={"ETag": f'"part-{part}"'})
+            if req.method == "POST" and "uploadId" in req.query:
+                upload_id = self._upload_id(req)
+                if upload_id is None:
+                    return self._error(400, "NoSuchUpload", key)
+                manifest = (await req.read()).decode(errors="replace")
+                uploaded = {st.name: st.path
+                            for st in await self.client.meta.list_status(
+                                f"/.s3mpu/{upload_id}")}
+                wanted = [int(m) for m in
+                          re.findall(r"<PartNumber>(\d+)</PartNumber>",
+                                     manifest)]
+                if wanted:
+                    # honor the client's manifest: only the LISTED parts,
+                    # in the listed order; a missing one is InvalidPart
+                    parts = []
+                    for n in wanted:
+                        name = f"part-{n:05d}"
+                        if name not in uploaded:
+                            return self._error(400, "InvalidPart", key)
+                        parts.append(uploaded[name])
+                else:
+                    parts = [uploaded[k] for k in sorted(uploaded)]
+                if not parts:
+                    return self._error(400, "InvalidPart", key)
+                w = await self.client.create(path, overwrite=True)
+                for p_path in parts:
+                    reader = await self.client.open(p_path)
+                    off = 0
+                    while off < reader.len:
+                        chunk = await reader.pread(off, 4 * 1024 * 1024)
+                        if not chunk:
+                            break
+                        await w.write(chunk)
+                        off += len(chunk)
+                    await reader.close()
+                await w.close()
+                await self.client.meta.delete(f"/.s3mpu/{upload_id}",
+                                              recursive=True)
+                return web.Response(content_type="application/xml", text=(
+                    f'<?xml version="1.0"?>'
+                    f"<CompleteMultipartUploadResult {_NS}>"
+                    f"<Bucket>{bucket}</Bucket><Key>{sax.escape(key)}</Key>"
+                    f'<ETag>"ok"</ETag>'
+                    f"</CompleteMultipartUploadResult>"))
+            if req.method == "DELETE" and "uploadId" in req.query:
+                upload_id = self._upload_id(req)
+                if upload_id is not None:
+                    try:
+                        await self.client.meta.delete(
+                            f"/.s3mpu/{upload_id}", recursive=True)
+                    except cerr.FileNotFound:
+                        pass
+                return web.Response(status=204)
             if req.method == "PUT":
                 data = await req.read()
                 await self.client.write_all(path, data)
@@ -184,6 +268,29 @@ class S3Gateway:
         await resp.write_eof()
         await reader.close()
         return resp
+
+    @staticmethod
+    def _upload_id(req) -> str | None:
+        """uploadIds are self-issued 20-hex tokens; anything else (e.g.
+        '../somebucket') is a traversal attempt, never a path component."""
+        uid = req.query.get("uploadId", "")
+        return uid if re.fullmatch(r"[0-9a-f]{20}", uid) else None
+
+    async def _gc_stale_uploads(self, max_age_ms: int = 24 * 3600 * 1000):
+        """Abandoned multipart scratch dirs (no complete/abort) age out —
+        real S3 needs lifecycle rules; the gateway sweeps lazily on each
+        initiate."""
+        from curvine_tpu.common.types import now_ms
+        try:
+            cutoff = now_ms() - max_age_ms
+            for st in await self.client.meta.list_status("/.s3mpu"):
+                if st.is_dir and st.mtime < cutoff:
+                    try:
+                        await self.client.meta.delete(st.path, recursive=True)
+                    except cerr.CurvineError:
+                        pass
+        except cerr.CurvineError:
+            pass
 
     def _error(self, status: int, code: str, resource: str) -> web.Response:
         body = (f'<?xml version="1.0"?><Error><Code>{code}</Code>'

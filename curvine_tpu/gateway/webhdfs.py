@@ -105,6 +105,18 @@ class WebHdfsGateway:
             return web.json_response({"boolean": True})
         if op == "CREATE":
             data = await req.read()
+            if not data and req.query.get("data") != "true":
+                # protocol-correct two-step: real hdfs clients PUT without
+                # a body first and expect a 307 redirect to the datanode
+                # — redirect back to ourselves with data=true
+                import urllib.parse
+                qs = req.query_string
+                qs += ("&" if qs else "") + "data=true"
+                loc = (f"http://{req.host}/webhdfs/v1"
+                       f"{urllib.parse.quote(path)}?{qs}")
+                if req.query.get("noredirect") == "true":
+                    return web.json_response({"Location": loc})
+                return web.Response(status=307, headers={"Location": loc})
             await c.write_all(path, data,
                               **({"replicas": int(req.query["replication"])}
                                  if "replication" in req.query else {}))

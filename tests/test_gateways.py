@@ -116,3 +116,114 @@ async def test_s3_gateway_rejects_bucket_escape():
                     assert r.status == 400
         finally:
             await gw.stop()
+
+
+async def test_s3_gateway_multipart_upload():
+    """boto3-style multipart: initiate → parts → complete → ranged read;
+    abort cleans up. Real S3 clients multipart anything over ~8 MiB."""
+    import aiohttp
+    from curvine_tpu.gateway.s3 import S3Gateway
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.meta.mkdir("/mpbkt")
+        gw = S3Gateway(c, port=0, host="127.0.0.1")
+        await gw.start()
+        try:
+            import os
+            base = f"http://127.0.0.1:{gw.port}"
+            parts = [os.urandom(1 << 20) for _ in range(3)]
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"{base}/mpbkt/big.bin?uploads") as r:
+                    assert r.status == 200
+                    body = await r.text()
+                    uid = body.split("<UploadId>")[1].split("<")[0]
+                for i, p in enumerate(parts, start=1):
+                    async with s.put(
+                            f"{base}/mpbkt/big.bin?partNumber={i}"
+                            f"&uploadId={uid}", data=p) as r:
+                        assert r.status == 200
+                async with s.post(f"{base}/mpbkt/big.bin?uploadId={uid}",
+                                  data=b"<CompleteMultipartUpload/>") as r:
+                    assert r.status == 200
+                async with s.get(f"{base}/mpbkt/big.bin") as r:
+                    assert await r.read() == b"".join(parts)
+                # scratch space is gone
+                assert not await c.meta.exists(f"/.s3mpu/{uid}")
+                # abort path
+                async with s.post(f"{base}/mpbkt/x.bin?uploads") as r:
+                    uid2 = (await r.text()).split(
+                        "<UploadId>")[1].split("<")[0]
+                async with s.put(f"{base}/mpbkt/x.bin?partNumber=1"
+                                 f"&uploadId={uid2}", data=b"zz") as r:
+                    assert r.status == 200
+                async with s.delete(
+                        f"{base}/mpbkt/x.bin?uploadId={uid2}") as r:
+                    assert r.status == 204
+                assert not await c.meta.exists(f"/.s3mpu/{uid2}")
+                assert not await c.meta.exists("/mpbkt/x.bin")
+        finally:
+            await gw.stop()
+
+
+async def test_webhdfs_gateway_two_step_create():
+    """Real hdfs clients PUT op=CREATE with no body and follow a 307 to
+    the data target — the gateway serves that protocol (and noredirect)."""
+    import aiohttp
+    from curvine_tpu.gateway.webhdfs import WebHdfsGateway
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        gw = WebHdfsGateway(c, port=0, host="127.0.0.1")
+        await gw.start()
+        try:
+            base = f"http://127.0.0.1:{gw.port}"
+            async with aiohttp.ClientSession() as s:
+                # step 1: bodyless PUT → 307 with a Location
+                async with s.put(f"{base}/webhdfs/v1/two/step.bin"
+                                 f"?op=CREATE&overwrite=true",
+                                 allow_redirects=False) as r:
+                    assert r.status == 307
+                    loc = r.headers["Location"]
+                    assert "data=true" in loc
+                # step 2: PUT the bytes at the redirect target
+                async with s.put(loc, data=b"two-step!") as r:
+                    assert r.status == 201
+                async with s.get(f"{base}/webhdfs/v1/two/step.bin"
+                                 f"?op=OPEN") as r:
+                    assert await r.read() == b"two-step!"
+                # noredirect=true returns the Location as JSON
+                async with s.put(f"{base}/webhdfs/v1/two/nr.bin"
+                                 f"?op=CREATE&noredirect=true",
+                                 allow_redirects=False) as r:
+                    assert r.status == 200
+                    assert "Location" in await r.json()
+        finally:
+            await gw.stop()
+
+
+async def test_s3_multipart_uploadid_traversal_rejected():
+    """uploadId is a self-issued token, never a path: traversal attempts
+    ('../bucket') must be rejected, not resolved into the namespace."""
+    import aiohttp
+    from curvine_tpu.gateway.s3 import S3Gateway
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.write_all("/victim/data.bin", b"precious")
+        gw = S3Gateway(c, port=0, host="127.0.0.1")
+        await gw.start()
+        try:
+            base = f"http://127.0.0.1:{gw.port}"
+            async with aiohttp.ClientSession() as s:
+                async with s.delete(f"{base}/b/k?uploadId=../victim") as r:
+                    assert r.status == 204          # no-op, not a delete
+                assert await c.meta.exists("/victim/data.bin")
+                async with s.put(f"{base}/b/k?partNumber=1"
+                                 f"&uploadId=../victim", data=b"x") as r:
+                    assert r.status == 400
+                async with s.post(f"{base}/b/k?uploadId=../victim") as r:
+                    assert r.status == 400
+                async with s.put(f"{base}/b/k?partNumber=abc"
+                                 f"&uploadId={'0'*20}", data=b"x") as r:
+                    assert r.status == 400          # XML error, not HTML 500
+                    assert "InvalidPartNumber" in await r.text()
+        finally:
+            await gw.stop()

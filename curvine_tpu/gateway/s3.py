@@ -6,8 +6,10 @@ without code changes. Path-style addressing: ``/<bucket>/<key>`` maps to
 ``/<bucket>/<key>`` in the namespace.
 
 Implemented: GET/PUT/HEAD/DELETE object, ListObjectsV2 (delimiter +
-prefix), CreateBucket (mkdir), ranged GETs. Authentication is accepted
-but not enforced (cluster-internal gateway, like the reference's default).
+prefix), ListBuckets, CreateBucket (mkdir), ranged GETs, multipart
+uploads (initiate/UploadPart/complete/abort with validated uploadIds and
+stale-upload GC). Authentication is accepted but not enforced
+(cluster-internal gateway, like the reference's default).
 """
 
 from __future__ import annotations
@@ -33,6 +35,7 @@ class S3Gateway:
         self.host = host
         self.port = port
         self.app = web.Application(client_max_size=1024 ** 3)
+        self.app.router.add_route("GET", "/", self._list_buckets)
         self.app.router.add_route("*", "/{bucket}", self._bucket)
         self.app.router.add_route("*", "/{bucket}/{key:.*}", self._object)
         self._runner: web.AppRunner | None = None
@@ -51,6 +54,24 @@ class S3Gateway:
             await self._runner.cleanup()
 
     # ---------------- bucket ops ----------------
+
+    async def _list_buckets(self, req: web.Request) -> web.Response:
+        """ListBuckets: top-level dirs (dot-prefixed scratch dirs like
+        /.s3mpu are internal and hidden)."""
+        sts = await self.client.meta.list_status("/")
+        def iso(ms: int) -> str:
+            import datetime
+            return datetime.datetime.fromtimestamp(
+                ms / 1000, datetime.timezone.utc).strftime(
+                "%Y-%m-%dT%H:%M:%S.000Z")
+        items = "".join(
+            f"<Bucket><Name>{sax.escape(st.name)}</Name>"
+            f"<CreationDate>{iso(st.mtime)}</CreationDate></Bucket>"
+            for st in sts if st.is_dir and not st.name.startswith("."))
+        return web.Response(content_type="application/xml", text=(
+            f'<?xml version="1.0"?><ListAllMyBucketsResult {_NS}>'
+            f"<Owner><ID>curvine</ID></Owner>"
+            f"<Buckets>{items}</Buckets></ListAllMyBucketsResult>"))
 
     async def _bucket(self, req: web.Request) -> web.StreamResponse:
         bucket = req.match_info["bucket"]

@@ -227,3 +227,25 @@ async def test_s3_multipart_uploadid_traversal_rejected():
                     assert "InvalidPartNumber" in await r.text()
         finally:
             await gw.stop()
+
+
+async def test_s3_list_buckets():
+    import aiohttp
+    from curvine_tpu.gateway.s3 import S3Gateway
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.meta.mkdir("/alpha")
+        await c.meta.mkdir("/beta")
+        await c.meta.mkdir("/.s3mpu")       # internal: hidden
+        gw = S3Gateway(c, port=0, host="127.0.0.1")
+        await gw.start()
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"http://127.0.0.1:{gw.port}/") as r:
+                    assert r.status == 200
+                    body = await r.text()
+                    assert "<Name>alpha</Name>" in body
+                    assert "<Name>beta</Name>" in body
+                    assert ".s3mpu" not in body
+        finally:
+            await gw.stop()

@@ -189,17 +189,24 @@ class WorkerServer:
             "bytes.written": self.metrics.counters.get("bytes.written", 0),
         }})
         deletes: set[int] = set()
-        ok = 0
-        for addr in self.conf.client.master_addrs:
+
+        async def beat(addr: str) -> bool:
             try:
                 conn = await self.master_pool.get(addr)
-                rep = await conn.call(RpcCode.WORKER_HEARTBEAT, data=payload)
-                ok += 1
+                rep = await asyncio.wait_for(
+                    conn.call(RpcCode.WORKER_HEARTBEAT, data=payload), 5.0)
                 for bid in (unpack(rep.data) or {}).get("delete_blocks", []):
                     deletes.add(bid)
+                return True
             except Exception as e:  # noqa: BLE001 — peer down is routine
                 log.debug("heartbeat to %s failed: %s", addr, e)
-        if not ok:
+                return False
+
+        # CONCURRENT fan-out: one dead/unroutable master must not stall
+        # the beat to the others
+        oks = await asyncio.gather(*(beat(a)
+                                     for a in self.conf.client.master_addrs))
+        if not any(oks):
             raise err.ConnectError("no master reachable for heartbeat")
         for bid in deletes:
             self.store.delete(bid)
@@ -209,15 +216,20 @@ class WorkerServer:
         payload = pack({"worker_id": self.worker_id, "blocks": held,
                         "storage_types": types})
         deletes: set[int] = set()
-        for addr in self.conf.client.master_addrs:
+
+        async def report(addr: str) -> None:
             try:
                 conn = await self.master_pool.get(addr)
-                rep = await conn.call(RpcCode.WORKER_BLOCK_REPORT,
-                                      data=payload)
+                rep = await asyncio.wait_for(
+                    conn.call(RpcCode.WORKER_BLOCK_REPORT, data=payload),
+                    30.0)
                 for bid in (unpack(rep.data) or {}).get("delete_blocks", []):
                     deletes.add(bid)
             except Exception as e:  # noqa: BLE001
                 log.debug("block report to %s failed: %s", addr, e)
+
+        await asyncio.gather(*(report(a)
+                               for a in self.conf.client.master_addrs))
         for bid in deletes:
             self.store.delete(bid)
 

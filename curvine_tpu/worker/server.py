@@ -155,6 +155,19 @@ class WorkerServer:
                 last = e
         raise last or err.NotLeader("no reachable master")
 
+    async def _bounded_master_call(self, addr: str, code, payload: bytes,
+                                   connect_s: float, call_s: float):
+        """Deadline covers BOTH the dial and the RPC. A call that times
+        out may have cancelled a send mid-frame, so that connection is
+        poisoned — close it so the pool never reuses it."""
+        conn = await asyncio.wait_for(self.master_pool.get(addr), connect_s)
+        try:
+            return await asyncio.wait_for(conn.call(code, data=payload),
+                                          call_s)
+        except (asyncio.TimeoutError, asyncio.CancelledError):
+            await conn.close()
+            raise
+
     def _info(self) -> WorkerInfo:
         storages = self.store.storages()
         if self.hbm is not None:
@@ -192,9 +205,9 @@ class WorkerServer:
 
         async def beat(addr: str) -> bool:
             try:
-                conn = await self.master_pool.get(addr)
-                rep = await asyncio.wait_for(
-                    conn.call(RpcCode.WORKER_HEARTBEAT, data=payload), 5.0)
+                rep = await self._bounded_master_call(
+                    addr, RpcCode.WORKER_HEARTBEAT, payload,
+                    connect_s=3.0, call_s=5.0)
                 for bid in (unpack(rep.data) or {}).get("delete_blocks", []):
                     deletes.add(bid)
                 return True
@@ -219,10 +232,9 @@ class WorkerServer:
 
         async def report(addr: str) -> None:
             try:
-                conn = await self.master_pool.get(addr)
-                rep = await asyncio.wait_for(
-                    conn.call(RpcCode.WORKER_BLOCK_REPORT, data=payload),
-                    30.0)
+                rep = await self._bounded_master_call(
+                    addr, RpcCode.WORKER_BLOCK_REPORT, payload,
+                    connect_s=5.0, call_s=30.0)
                 for bid in (unpack(rep.data) or {}).get("delete_blocks", []):
                     deletes.add(bid)
             except Exception as e:  # noqa: BLE001

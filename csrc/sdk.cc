@@ -24,7 +24,21 @@
 //   int   cv_sdk_rename(void* h, const char* src, const char* dst)
 //   int   cv_sdk_exists(void* h, const char* path)   // 1/0/-1
 //   char* cv_sdk_list(void* h, const char* path)     // JSON; cv_sdk_free
+//   char* cv_sdk_stat(void* h, const char* path)     // JSON; cv_sdk_free
 //   void  cv_sdk_free(char* p)
+//
+// Streaming handles (curvine-libsdk lib_fs_reader.rs / lib_fs_writer.rs
+// parity — open/read/seek and create/write/flush stream surfaces):
+//   void* cv_sdk_open_reader(void* h, const char* path)
+//   int64 cv_sdk_read(void* r, void* buf, int64 cap)  // 0 at EOF
+//   int64 cv_sdk_seek(void* r, int64 pos)             // new pos or -1
+//   int64 cv_sdk_reader_len(void* r)
+//   int   cv_sdk_close_reader(void* r)
+//   void* cv_sdk_open_writer(void* h, const char* path, int overwrite)
+//   int   cv_sdk_write(void* w, const void* buf, int64 n)
+//   int   cv_sdk_flush(void* w)
+//   int64 cv_sdk_writer_pos(void* w)
+//   int   cv_sdk_close_writer(void* w)   // completes the file
 
 #include <arpa/inet.h>
 #include <netdb.h>
@@ -449,6 +463,35 @@ enum : uint16_t {
   WRITE_BLOCK = 80, READ_BLOCK = 81,
 };
 
+std::string worker_key(const Value& loc) {
+  const Value* ip = loc.get("ip_addr");
+  const Value* hostname = loc.get("hostname");
+  const Value* port = loc.get("rpc_port");
+  std::string addr = ((ip && !ip->s.empty()) ? ip->s
+                      : hostname ? hostname->s : "127.0.0.1");
+  int p = port ? static_cast<int>(port->as_int()) : 0;
+  return addr + ":" + std::to_string(p);
+}
+
+// One cached connection per worker address. Every failure path must
+// drop() the key: a socket with a half-sent frame or an abandoned
+// stream on it is desynchronized and must never be reused.
+struct ConnCache {
+  std::map<std::string, std::unique_ptr<Conn>> conns;
+
+  Conn* get(const std::string& key) {
+    auto it = conns.find(key);
+    if (it != conns.end()) return it->second.get();
+    auto pos = key.rfind(':');
+    auto c = std::make_unique<Conn>();
+    if (!c->dial(key.substr(0, pos), atoi(key.c_str() + pos + 1)))
+      return nullptr;
+    return conns.emplace(key, std::move(c)).first->second.get();
+  }
+
+  void drop(const std::string& key) { conns.erase(key); }
+};
+
 struct Client {
   Conn master;
   std::string host;
@@ -456,8 +499,6 @@ struct Client {
   std::string client_id;
   uint64_t next_req = 1;
   int64_t next_call = 1;
-  // one pooled conn per worker addr
-  std::map<std::string, std::unique_ptr<Conn>> workers;
 
   bool call(Conn& c, uint16_t code, const Value& req, Value& rep) {
     std::string body;
@@ -498,31 +539,140 @@ struct Client {
     return r;
   }
 
-  static std::string worker_key(const Value& loc) {
-    const Value* ip = loc.get("ip_addr");
-    const Value* hostname = loc.get("hostname");
-    const Value* port = loc.get("rpc_port");
-    std::string addr = ((ip && !ip->s.empty()) ? ip->s
-                        : hostname ? hostname->s : "127.0.0.1");
-    int p = port ? static_cast<int>(port->as_int()) : 0;
-    return addr + ":" + std::to_string(p);
+};
+
+// ---------------------------------------------------------------- streams
+//
+// Reader/Writer own their worker connections (not the Client pool): a
+// stream held open across user calls must never interleave with another
+// handle's frames on a shared socket.
+
+struct Reader {
+  Client* c;
+  struct BlockRef {
+    int64_t id;
+    int64_t len;
+    int64_t start;                       // file offset of this block
+    Value loc;                           // first live location
+  };
+  std::vector<BlockRef> blocks;
+  int64_t flen = 0;
+  int64_t pos = 0;
+  bool broken = false;
+
+  ConnCache conns;
+  Conn* stream = nullptr;                // active block stream (borrowed)
+  std::string stream_key;
+  bool streaming = false;                // frames pending until EOF flag
+  int64_t stream_expect = 0;             // bytes the open stream owes
+  int64_t stream_got = 0;                // bytes it has delivered
+  std::string pending;                   // chunk bytes beyond caller's buf
+  size_t pend_off = 0;
+
+  Conn* conn_for(const Value& loc) {
+    stream_key = worker_key(loc);
+    return conns.get(stream_key);
   }
 
-  Conn* worker_conn(const Value& loc) {
-    std::string key = worker_key(loc);
-    auto it = workers.find(key);
-    if (it != workers.end()) return it->second.get();
-    auto pos = key.rfind(':');
-    auto c = std::make_unique<Conn>();
-    if (!c->dial(key.substr(0, pos), atoi(key.c_str() + pos + 1)))
-      return nullptr;
-    return workers.emplace(key, std::move(c)).first->second.get();
+  void abandon_stream() {
+    // mid-stream abandon desynchronizes the socket: drop the connection
+    if (streaming) {
+      conns.drop(stream_key);
+      streaming = false;
+    }
+    stream = nullptr;
+    pending.clear();
+    pend_off = 0;
   }
 
-  void evict_worker(const Value& loc) {
-    // a connection abandoned mid-stream is desynchronized: drop it so the
-    // next op dials fresh instead of reading leftover chunk frames
-    workers.erase(worker_key(loc));
+  const BlockRef* block_at(int64_t off) const {
+    for (auto& b : blocks)
+      if (off >= b.start && off < b.start + b.len) return &b;
+    return nullptr;
+  }
+};
+
+struct Writer {
+  Client* c;
+  std::string path;
+  int64_t block_size = 64 << 20;
+  int64_t total = 0;
+  Value commits;                         // ARR of pending commit records
+  bool broken = false;
+  bool closed = false;
+
+  // open block stream state (conns cached across blocks — one worker
+  // usually receives every block, so no per-block reconnect)
+  ConnCache conns;
+  std::string cur_key;
+  Conn* conn = nullptr;
+  bool open = false;
+  int64_t block_id = 0;
+  int64_t block_sent = 0;
+  uint64_t req_id = 0;
+  uint32_t crc = 0;
+
+  void drop_conn() {
+    conns.drop(cur_key);
+    conn = nullptr;
+  }
+
+  bool next_block() {
+    Value ab = c->base_req(path, true);
+    ab.map.emplace_back("client_host", S("csdk"));
+    ab.map.emplace_back("commit_blocks", commits);
+    commits = A();
+    Value rep;
+    if (!c->call(c->master, ADD_BLOCK, ab, rep)) return false;
+    const Value* blk = rep.get("block");
+    const Value* binfo = blk ? blk->get("block") : nullptr;
+    const Value* locs = blk ? blk->get("locs") : nullptr;
+    if (!binfo || !locs || locs->arr.empty()) {
+      set_err("add_block returned no locations");
+      return false;
+    }
+    block_id = binfo->get("id")->as_int();
+    cur_key = worker_key(locs->arr[0]);
+    conn = conns.get(cur_key);
+    if (!conn) return false;
+    Frame f;
+    f.code = WRITE_BLOCK;
+    f.req_id = c->next_req++;
+    f.header = M();
+    f.header.map.emplace_back("block_id", I(block_id));
+    f.header.map.emplace_back("storage_type", I(0));
+    f.header.map.emplace_back("len_hint", I(block_size));
+    if (!conn->send_frame(f)) { drop_conn(); return false; }
+    req_id = f.req_id;
+    block_sent = 0;
+    crc = 0;
+    open = true;
+    return true;
+  }
+
+  bool finish_block() {
+    if (!open) return true;
+    Frame eof;
+    eof.code = WRITE_BLOCK;
+    eof.req_id = req_id;
+    eof.flags = kFlagEof;
+    eof.header = M();
+    eof.header.map.emplace_back("crc32", I(static_cast<int64_t>(crc)));
+    if (!conn->send_frame(eof)) { drop_conn(); return false; }
+    Frame ack;
+    if (!conn->recv_frame(ack)) { drop_conn(); return false; }
+    if (frame_error(ack)) { drop_conn(); return false; }
+    const Value* wid = ack.header.get("worker_id");
+    Value commit = M();
+    commit.map.emplace_back("block_id", I(block_id));
+    commit.map.emplace_back("block_len", I(block_sent));
+    Value wids = A();
+    wids.arr.push_back(I(wid ? wid->as_int() : 0));
+    commit.map.emplace_back("worker_ids", wids);
+    commit.map.emplace_back("storage_type", I(0));
+    commits.arr.push_back(commit);
+    open = false;
+    return true;
   }
 };
 
@@ -530,6 +680,15 @@ struct Client {
 
 // ---------------------------------------------------------------- C ABI
 extern "C" {
+
+// stream primitives (defined below; put/get are built on them)
+void* cv_sdk_open_reader(void* h, const char* path);
+int64_t cv_sdk_read(void* rh, void* buf, int64_t cap);
+int64_t cv_sdk_reader_len(void* rh);
+int cv_sdk_close_reader(void* rh);
+void* cv_sdk_open_writer(void* h, const char* path, int overwrite);
+int cv_sdk_write(void* wh, const void* buf, int64_t n);
+int cv_sdk_close_writer(void* wh);
 
 const char* cv_sdk_last_error() { return g_err.c_str(); }
 
@@ -594,146 +753,36 @@ int64_t cv_sdk_len(void* h, const char* path) {
 }
 
 int cv_sdk_put(void* h, const char* path, const void* buf, int64_t n) {
-  auto* c = static_cast<Client*>(h);
-  // 1. create
-  Value req = c->base_req(path, true);
-  req.map.emplace_back("overwrite", B(true));
-  Value rep;
-  if (!c->call(c->master, CREATE_FILE, req, rep)) return -1;
-  const Value* st = rep.get("status");
-  const Value* bs = st ? st->get("block_size") : nullptr;
-  int64_t block_size = bs ? bs->as_int() : 64 << 20;
-  const uint8_t* p = static_cast<const uint8_t*>(buf);
-  int64_t pos = 0;
-  Value commits = A();
-  while (pos < n || (n == 0 && pos == 0)) {
-    // 2. add_block (flushes prior commits)
-    Value ab = c->base_req(path, true);
-    ab.map.emplace_back("client_host", S("csdk"));
-    {
-      Value cb = commits;
-      ab.map.emplace_back("commit_blocks", cb);
-    }
-    commits = A();
-    Value abrep;
-    if (!c->call(c->master, ADD_BLOCK, ab, abrep)) return -1;
-    const Value* blk = abrep.get("block");
-    const Value* binfo = blk ? blk->get("block") : nullptr;
-    const Value* locs = blk ? blk->get("locs") : nullptr;
-    if (!binfo || !locs || locs->arr.empty()) {
-      set_err("add_block returned no locations");
-      return -1;
-    }
-    int64_t block_id = binfo->get("id")->as_int();
-    Conn* w = c->worker_conn(locs->arr[0]);
-    if (!w) return -1;
-    // 3. stream the block
-    int64_t take = std::min(block_size, n - pos);
-    Frame open;
-    open.code = WRITE_BLOCK;
-    open.req_id = c->next_req++;
-    open.header = M();
-    open.header.map.emplace_back("block_id", I(block_id));
-    open.header.map.emplace_back("storage_type", I(0));
-    open.header.map.emplace_back("len_hint", I(take));
-    if (!w->send_frame(open)) return -1;
-    uint32_t crc = 0;
-    int64_t sent = 0;
-    while (sent < take) {
-      int64_t k = std::min<int64_t>(4 << 20, take - sent);
-      crc = crc32(p + pos + sent, static_cast<size_t>(k), crc);
-      Frame ch;
-      ch.code = WRITE_BLOCK;
-      ch.req_id = open.req_id;
-      ch.flags = kFlagChunk;
-      ch.data.assign(reinterpret_cast<const char*>(p + pos + sent),
-                     static_cast<size_t>(k));
-      if (!w->send_frame(ch)) return -1;
-      sent += k;
-    }
-    Frame eof;
-    eof.code = WRITE_BLOCK;
-    eof.req_id = open.req_id;
-    eof.flags = kFlagEof;
-    eof.header = M();
-    eof.header.map.emplace_back("crc32", I(static_cast<int64_t>(crc)));
-    if (!w->send_frame(eof)) return -1;
-    Frame ack;
-    if (!w->recv_frame(ack)) return -1;
-    if (frame_error(ack)) return -1;
-    const Value* wid = ack.header.get("worker_id");
-    Value commit = M();
-    commit.map.emplace_back("block_id", I(block_id));
-    commit.map.emplace_back("block_len", I(take));
-    Value wids = A();
-    wids.arr.push_back(I(wid ? wid->as_int() : 0));
-    commit.map.emplace_back("worker_ids", wids);
-    commit.map.emplace_back("storage_type", I(0));
-    commits.arr.push_back(commit);
-    pos += take;
-    if (n == 0) break;
+  // whole-file put expressed over the streaming writer (one protocol
+  // implementation: Writer::next_block/finish_block own the block dance)
+  void* w = cv_sdk_open_writer(h, path, 1);
+  if (!w) return -1;
+  if (cv_sdk_write(w, buf, n) != 0) {
+    // free directly — close_writer's broken-check would clobber g_err
+    // and mask the root cause the failed write recorded
+    delete static_cast<Writer*>(w);
+    return -1;
   }
-  // 4. complete
-  Value done = c->base_req(path, true);
-  done.map.emplace_back("len", I(n));
-  done.map.emplace_back("commit_blocks", commits);
-  Value drep;
-  return c->call(c->master, COMPLETE_FILE, done, drep) ? 0 : -1;
+  return cv_sdk_close_writer(w);
 }
 
 int64_t cv_sdk_get(void* h, const char* path, void* buf, int64_t cap) {
-  auto* c = static_cast<Client*>(h);
-  Value rep;
-  if (!c->call(c->master, GET_BLOCK_LOCATIONS, c->base_req(path, false),
-               rep))
-    return -1;
-  const Value* fb = rep.get("file_blocks");
-  const Value* blocks = fb ? fb->get("block_locs") : nullptr;
-  if (!blocks) {
-    set_err("no block locations");
+  void* r = cv_sdk_open_reader(h, path);
+  if (!r) return -1;
+  if (cv_sdk_reader_len(r) > cap) {
+    set_err("buffer too small");
+    cv_sdk_close_reader(r);
     return -1;
   }
-  uint8_t* out = static_cast<uint8_t*>(buf);
   int64_t got = 0;
-  for (auto& lb : blocks->arr) {
-    const Value* binfo = lb.get("block");
-    const Value* locs = lb.get("locs");
-    if (!binfo || !locs || locs->arr.empty()) {
-      set_err("block has no live locations");
-      return -1;
-    }
-    int64_t block_id = binfo->get("id")->as_int();
-    int64_t blen = binfo->get("len")->as_int();
-    Conn* w = c->worker_conn(locs->arr[0]);
-    if (!w) return -1;
-    Value req = M();
-    req.map.emplace_back("block_id", I(block_id));
-    req.map.emplace_back("offset", I(0));
-    req.map.emplace_back("len", I(blen));
-    std::string body;
-    pack_value(body, req);
-    Frame f;
-    f.code = READ_BLOCK;
-    f.req_id = c->next_req++;
-    f.data = body;
-    if (!w->send_frame(f)) return -1;
-    for (;;) {
-      Frame ch;
-      if (!w->recv_frame(ch)) return -1;
-      if (frame_error(ch)) return -1;
-      if (!ch.data.empty()) {
-        int64_t k = static_cast<int64_t>(ch.data.size());
-        if (got + k > cap) {
-          set_err("buffer too small");
-          c->evict_worker(locs->arr[0]);   // mid-stream abandon: desync
-          return -1;
-        }
-        memcpy(out + got, ch.data.data(), static_cast<size_t>(k));
-        got += k;
-      }
-      if (ch.flags & kFlagEof) break;
-    }
+  uint8_t* out = static_cast<uint8_t*>(buf);
+  while (got < cap) {
+    int64_t k = cv_sdk_read(r, out + got, cap - got);
+    if (k < 0) { cv_sdk_close_reader(r); return -1; }
+    if (k == 0) break;
+    got += k;
   }
+  cv_sdk_close_reader(r);
   return got;
 }
 
@@ -784,5 +833,301 @@ char* cv_sdk_list(void* h, const char* path) {
 }
 
 void cv_sdk_free(char* p) { free(p); }
+
+char* cv_sdk_stat(void* h, const char* path) {
+  auto* c = static_cast<Client*>(h);
+  Value rep;
+  if (!c->call(c->master, FILE_STATUS, c->base_req(path, false), rep))
+    return nullptr;
+  const Value* st = rep.get("status");
+  if (!st) {
+    set_err("file_status returned no status");
+    return nullptr;
+  }
+  auto num = [&](const char* k) -> int64_t {
+    const Value* v = st->get(k);
+    return v ? v->as_int() : 0;
+  };
+  std::string out = "{\"name\":";
+  const Value* name = st->get("name");
+  json_escape(out, name ? name->s : "");
+  out += ",\"len\":" + std::to_string(num("len"));
+  out += std::string(",\"is_dir\":") +
+         (st->get("is_dir") && st->get("is_dir")->as_bool() ? "true"
+                                                            : "false");
+  out += ",\"mtime\":" + std::to_string(num("mtime"));
+  out += ",\"atime\":" + std::to_string(num("atime"));
+  out += ",\"mode\":" + std::to_string(num("mode"));
+  out += ",\"replicas\":" + std::to_string(num("replicas"));
+  out += ",\"block_size\":" + std::to_string(num("block_size"));
+  out += std::string(",\"is_complete\":") +
+         (st->get("is_complete") && st->get("is_complete")->as_bool()
+              ? "true" : "false");
+  const Value* owner = st->get("owner");
+  const Value* group = st->get("group");
+  out += ",\"owner\":";
+  json_escape(out, owner ? owner->s : "");
+  out += ",\"group\":";
+  json_escape(out, group ? group->s : "");
+  out += "}";
+  char* ret = static_cast<char*>(malloc(out.size() + 1));
+  memcpy(ret, out.c_str(), out.size() + 1);
+  return ret;
+}
+
+// ------------------------------------------------------------- reader
+
+void* cv_sdk_open_reader(void* h, const char* path) {
+  auto* c = static_cast<Client*>(h);
+  Value rep;
+  if (!c->call(c->master, GET_BLOCK_LOCATIONS, c->base_req(path, false),
+               rep))
+    return nullptr;
+  const Value* fb = rep.get("file_blocks");
+  const Value* blocks = fb ? fb->get("block_locs") : nullptr;
+  if (!blocks) {
+    set_err("no block locations");
+    return nullptr;
+  }
+  auto r = std::make_unique<Reader>();
+  r->c = c;
+  int64_t off = 0;
+  for (auto& lb : blocks->arr) {
+    const Value* binfo = lb.get("block");
+    const Value* locs = lb.get("locs");
+    if (!binfo || !locs || locs->arr.empty()) {
+      set_err("block has no live locations");
+      return nullptr;
+    }
+    Reader::BlockRef b;
+    b.id = binfo->get("id")->as_int();
+    b.len = binfo->get("len")->as_int();
+    b.start = off;
+    b.loc = locs->arr[0];
+    off += b.len;
+    r->blocks.push_back(std::move(b));
+  }
+  r->flen = off;
+  return r.release();
+}
+
+int64_t cv_sdk_reader_len(void* rh) {
+  return static_cast<Reader*>(rh)->flen;
+}
+
+int64_t cv_sdk_reader_pos(void* rh) {
+  return static_cast<Reader*>(rh)->pos;
+}
+
+int64_t cv_sdk_seek(void* rh, int64_t pos) {
+  auto* r = static_cast<Reader*>(rh);
+  if (pos < 0 || pos > r->flen) {
+    set_err("seek out of range");
+    return -1;
+  }
+  int64_t skip = pos - r->pos;
+  int64_t buffered = static_cast<int64_t>(r->pending.size() - r->pend_off);
+  if (skip > 0 && skip <= buffered && !r->broken) {
+    // small forward hop within already-received bytes: no reconnect
+    r->pend_off += static_cast<size_t>(skip);
+    if (r->pend_off == r->pending.size()) {
+      r->pending.clear();
+      r->pend_off = 0;
+    }
+    r->pos = pos;
+  } else if (pos != r->pos) {
+    r->abandon_stream();
+    r->pos = pos;
+  }
+  r->broken = false;
+  return pos;
+}
+
+int64_t cv_sdk_read(void* rh, void* buf, int64_t cap) {
+  auto* r = static_cast<Reader*>(rh);
+  if (r->broken) {
+    set_err("reader is in a failed state; seek() to reset");
+    return -1;
+  }
+  uint8_t* out = static_cast<uint8_t*>(buf);
+  int64_t got = 0;
+  // on error: roll pos back over bytes already copied this call — the
+  // caller discards its buffer on -1, so tell() must not point past data
+  // it never saw; resume-after-seek(tell()) then rereads them
+  auto fail = [&](bool drop_conn) -> int64_t {
+    if (drop_conn) r->conns.drop(r->stream_key);
+    r->abandon_stream();
+    r->broken = true;
+    r->pos -= got;
+    return -1;
+  };
+  while (got < cap && r->pos < r->flen) {
+    // 1. drain buffered chunk bytes
+    if (r->pend_off < r->pending.size()) {
+      int64_t k = std::min<int64_t>(cap - got,
+                                    r->pending.size() - r->pend_off);
+      memcpy(out + got, r->pending.data() + r->pend_off,
+             static_cast<size_t>(k));
+      r->pend_off += static_cast<size_t>(k);
+      r->pos += k;
+      got += k;
+      if (r->pend_off == r->pending.size()) {
+        r->pending.clear();
+        r->pend_off = 0;
+      }
+      continue;
+    }
+    // 2. pull the next frame of the active stream
+    if (r->streaming) {
+      Frame ch;
+      if (!r->stream->recv_frame(ch) || frame_error(ch)) return fail(true);
+      if (!ch.data.empty()) {
+        r->stream_got += static_cast<int64_t>(ch.data.size());
+        int64_t k = std::min<int64_t>(cap - got, ch.data.size());
+        memcpy(out + got, ch.data.data(), static_cast<size_t>(k));
+        r->pos += k;
+        got += k;
+        if (static_cast<size_t>(k) < ch.data.size()) {
+          r->pending.assign(ch.data, static_cast<size_t>(k),
+                            ch.data.size() - static_cast<size_t>(k));
+          r->pend_off = 0;
+        }
+      }
+      if (ch.flags & kFlagEof) {
+        r->streaming = false;
+        if (r->stream_got < r->stream_expect) {
+          // the worker's copy is shorter than the master-reported block
+          // length: surface it instead of re-requesting the same range
+          // forever (a truncated replica would otherwise busy-loop here)
+          set_err("short block stream: worker served " +
+                  std::to_string(r->stream_got) + " of " +
+                  std::to_string(r->stream_expect) + " bytes");
+          return fail(false);            // EOF consumed: socket is clean
+        }
+      }
+      continue;
+    }
+    // 3. open a stream for the remainder of the block under pos
+    const Reader::BlockRef* b = r->block_at(r->pos);
+    if (!b) break;                      // zero-len tail blocks
+    Conn* w = r->conn_for(b->loc);
+    if (!w) return fail(false);
+    Value req = M();
+    req.map.emplace_back("block_id", I(b->id));
+    req.map.emplace_back("offset", I(r->pos - b->start));
+    req.map.emplace_back("len", I(b->len - (r->pos - b->start)));
+    std::string body;
+    pack_value(body, req);
+    Frame f;
+    f.code = READ_BLOCK;
+    f.req_id = r->c->next_req++;
+    f.data = body;
+    if (!w->send_frame(f)) return fail(true);  // half-sent frame: poison
+    r->stream = w;
+    r->streaming = true;
+    r->stream_expect = b->len - (r->pos - b->start);
+    r->stream_got = 0;
+  }
+  return got;
+}
+
+int cv_sdk_close_reader(void* rh) {
+  auto* r = static_cast<Reader*>(rh);
+  r->abandon_stream();
+  delete r;
+  return 0;
+}
+
+// ------------------------------------------------------------- writer
+
+void* cv_sdk_open_writer(void* h, const char* path, int overwrite) {
+  auto* c = static_cast<Client*>(h);
+  Value req = c->base_req(path, true);
+  req.map.emplace_back("overwrite", B(overwrite != 0));
+  Value rep;
+  if (!c->call(c->master, CREATE_FILE, req, rep)) return nullptr;
+  auto w = std::make_unique<Writer>();
+  w->c = c;
+  w->path = path;
+  w->commits = A();
+  const Value* st = rep.get("status");
+  const Value* bs = st ? st->get("block_size") : nullptr;
+  if (bs && bs->as_int() > 0) w->block_size = bs->as_int();
+  return w.release();
+}
+
+int64_t cv_sdk_writer_pos(void* wh) {
+  return static_cast<Writer*>(wh)->total;
+}
+
+int cv_sdk_write(void* wh, const void* buf, int64_t n) {
+  auto* w = static_cast<Writer*>(wh);
+  if (w->broken || w->closed) {
+    set_err(w->closed ? "writer is closed" : "writer is in a failed state");
+    return -1;
+  }
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  int64_t done = 0;
+  while (done < n) {
+    if (w->open && w->block_sent == w->block_size) {
+      if (!w->finish_block()) { w->broken = true; return -1; }
+    }
+    if (!w->open) {
+      if (!w->next_block()) { w->broken = true; return -1; }
+    }
+    int64_t take = std::min(n - done, w->block_size - w->block_sent);
+    int64_t sent = 0;
+    while (sent < take) {
+      int64_t k = std::min<int64_t>(4 << 20, take - sent);
+      w->crc = crc32(p + done + sent, static_cast<size_t>(k), w->crc);
+      Frame ch;
+      ch.code = WRITE_BLOCK;
+      ch.req_id = w->req_id;
+      ch.flags = kFlagChunk;
+      ch.data.assign(reinterpret_cast<const char*>(p + done + sent),
+                     static_cast<size_t>(k));
+      if (!w->conn->send_frame(ch)) {
+        w->drop_conn();
+        w->broken = true;
+        return -1;
+      }
+      sent += k;
+    }
+    w->block_sent += take;
+    w->total += take;
+    done += take;
+  }
+  return 0;
+}
+
+int cv_sdk_flush(void* wh) {
+  // chunks are sent eagerly; flush is a barrier only on the local side
+  auto* w = static_cast<Writer*>(wh);
+  if (w->broken) { set_err("writer is in a failed state"); return -1; }
+  return 0;
+}
+
+int cv_sdk_close_writer(void* wh) {
+  auto* w = static_cast<Writer*>(wh);
+  std::unique_ptr<Writer> own(w);
+  if (w->broken || w->closed) {
+    set_err(w->closed ? "writer already closed"
+                      : "writer is in a failed state");
+    return -1;
+  }
+  // an empty file still records one zero-length block (cv_sdk_put parity:
+  // complete_file derives commit worker ids from it)
+  if (w->total == 0 && !w->open) {
+    if (!w->next_block()) return -1;
+  }
+  if (!w->finish_block()) return -1;
+  Value done = w->c->base_req(w->path, true);
+  done.map.emplace_back("len", I(w->total));
+  done.map.emplace_back("commit_blocks", w->commits);
+  Value rep;
+  if (!w->c->call(w->c->master, COMPLETE_FILE, done, rep)) return -1;
+  w->closed = true;
+  return 0;
+}
 
 }  // extern "C"

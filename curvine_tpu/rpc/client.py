@@ -300,11 +300,18 @@ class ConnectionPool:
         # dial outside the lock: slow/retrying connects must not stall
         # other addresses
         conn = await self._dial(addr)
-        async with self._lock:
-            conns = self._conns.setdefault(addr, [])
-            if len(conns) < self.size:
-                conns.append(conn)
-            return conn
+        try:
+            async with self._lock:
+                conns = self._conns.setdefault(addr, [])
+                if len(conns) < self.size:
+                    conns.append(conn)
+                return conn
+        except asyncio.CancelledError:
+            # a caller deadline (wait_for) can cancel between dial success
+            # and registration: close the orphan or its read loop holds
+            # the socket open forever
+            await conn.close()
+            raise
 
     async def _dial(self, addr: str, attempts: int = 3) -> Connection:
         # transient connect failures (sandboxed loopback occasionally

@@ -61,7 +61,30 @@ def _load():
         lib.cv_sdk_exists.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.cv_sdk_list.restype = ctypes.c_void_p
         lib.cv_sdk_list.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.cv_sdk_stat.restype = ctypes.c_void_p
+        lib.cv_sdk_stat.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.cv_sdk_free.argtypes = [ctypes.c_void_p]
+        lib.cv_sdk_open_reader.restype = ctypes.c_void_p
+        lib.cv_sdk_open_reader.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.cv_sdk_read.restype = ctypes.c_int64
+        lib.cv_sdk_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                    ctypes.c_int64]
+        lib.cv_sdk_seek.restype = ctypes.c_int64
+        lib.cv_sdk_seek.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.cv_sdk_reader_len.restype = ctypes.c_int64
+        lib.cv_sdk_reader_len.argtypes = [ctypes.c_void_p]
+        lib.cv_sdk_reader_pos.restype = ctypes.c_int64
+        lib.cv_sdk_reader_pos.argtypes = [ctypes.c_void_p]
+        lib.cv_sdk_close_reader.argtypes = [ctypes.c_void_p]
+        lib.cv_sdk_open_writer.restype = ctypes.c_void_p
+        lib.cv_sdk_open_writer.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                           ctypes.c_int]
+        lib.cv_sdk_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int64]
+        lib.cv_sdk_flush.argtypes = [ctypes.c_void_p]
+        lib.cv_sdk_writer_pos.restype = ctypes.c_int64
+        lib.cv_sdk_writer_pos.argtypes = [ctypes.c_void_p]
+        lib.cv_sdk_close_writer.argtypes = [ctypes.c_void_p]
         _lib = lib
     return _lib
 
@@ -147,7 +170,115 @@ class NativeCurvineClient:
         finally:
             self._lib.cv_sdk_free(p)
 
+    def stat(self, path: str) -> dict:
+        p = self._lib.cv_sdk_stat(self._h, path.encode())
+        if not p:
+            self._raise()
+        try:
+            return json.loads(ctypes.string_at(p).decode())
+        finally:
+            self._lib.cv_sdk_free(p)
+
+    def open_reader(self, path: str) -> "NativeReader":
+        h = self._lib.cv_sdk_open_reader(self._h, path.encode())
+        if not h:
+            self._raise()
+        return NativeReader(self, h)
+
+    def open_writer(self, path: str,
+                    overwrite: bool = True) -> "NativeWriter":
+        h = self._lib.cv_sdk_open_writer(self._h, path.encode(),
+                                         1 if overwrite else 0)
+        if not h:
+            self._raise()
+        return NativeWriter(self, h)
+
     def __enter__(self) -> "NativeCurvineClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class NativeReader:
+    """Streaming file reader over a native handle (lib_fs_reader parity:
+    read/seek/len on an open stream, block streams reopened at offset
+    after a seek)."""
+
+    def __init__(self, client: NativeCurvineClient, handle: int):
+        self._c = client
+        self._h = handle
+
+    def _handle(self) -> int:
+        if not self._h:
+            raise ValueError("I/O operation on closed reader")
+        return self._h
+
+    def __len__(self) -> int:
+        return self._c._lib.cv_sdk_reader_len(self._handle())
+
+    def read(self, n: int = -1) -> bytes:
+        if n < 0:
+            n = max(0, len(self) - self.tell())
+        buf = ctypes.create_string_buffer(max(1, n))
+        got = self._c._lib.cv_sdk_read(self._handle(), buf, n)
+        if got < 0:
+            self._c._raise()
+        return buf.raw[:got]
+
+    def tell(self) -> int:
+        return self._c._lib.cv_sdk_reader_pos(self._handle())
+
+    def seek(self, pos: int) -> int:
+        rc = self._c._lib.cv_sdk_seek(self._handle(), pos)
+        if rc < 0:
+            self._c._raise()
+        return rc
+
+    def close(self) -> None:
+        if self._h:
+            self._c._lib.cv_sdk_close_reader(self._h)
+            self._h = None
+
+    def __enter__(self) -> "NativeReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class NativeWriter:
+    """Streaming file writer over a native handle (lib_fs_writer parity);
+    close() commits outstanding blocks and completes the file."""
+
+    def __init__(self, client: NativeCurvineClient, handle: int):
+        self._c = client
+        self._h = handle
+
+    def _handle(self) -> int:
+        if not self._h:
+            raise ValueError("I/O operation on closed writer")
+        return self._h
+
+    def write(self, data: bytes) -> int:
+        if self._c._lib.cv_sdk_write(self._handle(), data, len(data)) != 0:
+            self._c._raise()
+        return len(data)
+
+    def flush(self) -> None:
+        if self._c._lib.cv_sdk_flush(self._handle()) != 0:
+            self._c._raise()
+
+    def tell(self) -> int:
+        return self._c._lib.cv_sdk_writer_pos(self._handle())
+
+    def close(self) -> None:
+        if self._h:
+            h, self._h = self._h, None
+            if self._c._lib.cv_sdk_close_writer(h) != 0:
+                self._c._raise()
+
+    def __enter__(self) -> "NativeWriter":
         return self
 
     def __exit__(self, *exc) -> None:

@@ -123,3 +123,66 @@ def test_native_sdk_end_to_end(cluster_loop_native):
         # errors surface with messages
         with pytest.raises(Exception):
             c.get("/csdk/nope")
+
+
+def test_native_sdk_streams(cluster_loop_native):
+    """Streaming handles (lib_fs_reader/lib_fs_writer parity): chunked
+    writes spanning blocks, sequential + seek reads, stat JSON."""
+    import pytest
+    from curvine_tpu.sdk import native_sdk
+    if not native_sdk.available():
+        pytest.skip("libcurvine_sdk.so not built")
+    mc = cluster_loop_native
+    host, port = mc.master.addr.rsplit(":", 1)
+    payload = os.urandom(9 * MB + 12345)            # spans 3 blocks @ 4MB
+    with native_sdk.NativeCurvineClient(host, int(port)) as c:
+        with c.open_writer("/csdk/stream.bin") as w:
+            # uneven chunk sizes straddle block boundaries
+            pos = 0
+            for n in (1, 3 * MB, 5 * MB + 7, MB, len(payload)):
+                chunk = payload[pos:min(n + pos, len(payload))]
+                if not chunk:
+                    break
+                w.write(chunk)
+                pos += len(chunk)
+                assert w.tell() == pos
+            w.flush()
+        st = c.stat("/csdk/stream.bin")
+        assert st["len"] == len(payload)
+        assert st["is_complete"] is True and st["is_dir"] is False
+        with c.open_reader("/csdk/stream.bin") as r:
+            assert len(r) == len(payload)
+            # sequential read across block boundaries in odd sizes
+            got = bytearray()
+            while True:
+                b = r.read(1_000_003)
+                if not b:
+                    break
+                got.extend(b)
+            assert bytes(got) == payload
+            # seek back mid-file (abandons the stream) and re-read a slice
+            at = 4 * MB - 100
+            assert r.seek(at) == at
+            assert r.tell() == at
+            assert r.read(300) == payload[at:at + 300]
+            # small forward hop is served from the buffered stream
+            here = r.tell()
+            r.seek(here + 64)
+            assert r.read(100) == payload[here + 64:here + 164]
+            # seek to EOF → read returns empty
+            r.seek(len(payload))
+            assert r.read(10) == b""
+        # whole-file read() convenience
+        with c.open_reader("/csdk/stream.bin") as r:
+            assert r.read() == payload
+        # streamed empty file
+        with c.open_writer("/csdk/stream_empty") as w:
+            pass
+        assert c.stat("/csdk/stream_empty")["len"] == 0
+        with c.open_reader("/csdk/stream_empty") as r:
+            assert r.read() == b""
+        # post-close use raises instead of crashing on a NULL handle
+        with pytest.raises(ValueError):
+            r.read(1)
+        with pytest.raises(ValueError):
+            w.write(b"x")

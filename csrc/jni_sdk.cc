@@ -1,0 +1,222 @@
+// libcurvine_jni — JNI shim binding java/src/main/java/io/curvinetpu/
+// NativeSdk.java to the C-ABI client (sdk.cc / libcurvine_sdk.so).
+//
+// Parity: curvine-libsdk/src/java/java_abi.rs — the reference's JNI
+// layer over its Rust client; this is the same thin adapter over the
+// rebuild's C++ client. Every function is a direct translation of one
+// NativeSdk native method; all protocol logic lives in sdk.cc.
+//
+// Build (requires a JDK for jni.h; gated — this image has none):
+//   make -C csrc jni JAVA_HOME=/path/to/jdk
+// Tests: tests/test_java_sdk.py checks every NativeSdk native method
+// has a matching Java_ symbol here even without a JDK, and compiles +
+// runs the Java suite against a live cluster when javac exists.
+
+#include <jni.h>
+
+#include <cstdint>
+#include <string>
+
+extern "C" {
+// C ABI from sdk.cc
+const char* cv_sdk_last_error();
+int cv_sdk_last_error_code();
+void* cv_sdk_connect(const char* host, int port, const char* user);
+void cv_sdk_close(void* h);
+int cv_sdk_mkdir(void* h, const char* path);
+int cv_sdk_delete(void* h, const char* path, int recursive);
+int cv_sdk_rename(void* h, const char* src, const char* dst);
+int cv_sdk_exists(void* h, const char* path);
+int64_t cv_sdk_len(void* h, const char* path);
+char* cv_sdk_list(void* h, const char* path);
+char* cv_sdk_stat(void* h, const char* path);
+void cv_sdk_free(char* p);
+int cv_sdk_put(void* h, const char* path, const void* buf, int64_t n);
+int64_t cv_sdk_get(void* h, const char* path, void* buf, int64_t cap);
+void* cv_sdk_open_reader(void* h, const char* path);
+int64_t cv_sdk_read(void* r, void* buf, int64_t cap);
+int64_t cv_sdk_seek(void* r, int64_t pos);
+int64_t cv_sdk_reader_len(void* r);
+int64_t cv_sdk_reader_pos(void* r);
+int cv_sdk_close_reader(void* r);
+void* cv_sdk_open_writer(void* h, const char* path, int overwrite);
+int cv_sdk_write(void* w, const void* buf, int64_t n);
+int cv_sdk_flush(void* w);
+int64_t cv_sdk_writer_pos(void* w);
+int cv_sdk_close_writer(void* w);
+}
+
+namespace {
+
+// RAII UTF-8 view of a jstring
+struct JStr {
+  JNIEnv* env;
+  jstring js;
+  const char* p;
+  JStr(JNIEnv* e, jstring s) : env(e), js(s) {
+    p = s ? env->GetStringUTFChars(s, nullptr) : "";
+  }
+  ~JStr() {
+    if (js) env->ReleaseStringUTFChars(js, p);
+  }
+};
+
+jstring own_to_jstring(JNIEnv* env, char* owned) {
+  if (!owned) return nullptr;
+  jstring out = env->NewStringUTF(owned);
+  cv_sdk_free(owned);
+  return out;
+}
+
+void* H(jlong h) { return reinterpret_cast<void*>(h); }
+
+}  // namespace
+
+extern "C" {
+
+JNIEXPORT jlong JNICALL Java_io_curvinetpu_NativeSdk_connect(
+    JNIEnv* env, jclass, jstring host, jint port, jstring user) {
+  JStr h(env, host), u(env, user);
+  return reinterpret_cast<jlong>(cv_sdk_connect(h.p, port, u.p));
+}
+
+JNIEXPORT void JNICALL Java_io_curvinetpu_NativeSdk_close(
+    JNIEnv*, jclass, jlong h) {
+  cv_sdk_close(H(h));
+}
+
+JNIEXPORT jstring JNICALL Java_io_curvinetpu_NativeSdk_lastError(
+    JNIEnv* env, jclass) {
+  return env->NewStringUTF(cv_sdk_last_error());
+}
+
+JNIEXPORT jint JNICALL Java_io_curvinetpu_NativeSdk_lastErrorCode(
+    JNIEnv*, jclass) {
+  return cv_sdk_last_error_code();
+}
+
+JNIEXPORT jint JNICALL Java_io_curvinetpu_NativeSdk_mkdir(
+    JNIEnv* env, jclass, jlong h, jstring path) {
+  JStr p(env, path);
+  return cv_sdk_mkdir(H(h), p.p);
+}
+
+JNIEXPORT jint JNICALL Java_io_curvinetpu_NativeSdk_delete(
+    JNIEnv* env, jclass, jlong h, jstring path, jboolean recursive) {
+  JStr p(env, path);
+  return cv_sdk_delete(H(h), p.p, recursive ? 1 : 0);
+}
+
+JNIEXPORT jint JNICALL Java_io_curvinetpu_NativeSdk_rename(
+    JNIEnv* env, jclass, jlong h, jstring src, jstring dst) {
+  JStr s(env, src), d(env, dst);
+  return cv_sdk_rename(H(h), s.p, d.p);
+}
+
+JNIEXPORT jint JNICALL Java_io_curvinetpu_NativeSdk_exists(
+    JNIEnv* env, jclass, jlong h, jstring path) {
+  JStr p(env, path);
+  return cv_sdk_exists(H(h), p.p);
+}
+
+JNIEXPORT jlong JNICALL Java_io_curvinetpu_NativeSdk_len(
+    JNIEnv* env, jclass, jlong h, jstring path) {
+  JStr p(env, path);
+  return cv_sdk_len(H(h), p.p);
+}
+
+JNIEXPORT jstring JNICALL Java_io_curvinetpu_NativeSdk_list(
+    JNIEnv* env, jclass, jlong h, jstring path) {
+  JStr p(env, path);
+  return own_to_jstring(env, cv_sdk_list(H(h), p.p));
+}
+
+JNIEXPORT jstring JNICALL Java_io_curvinetpu_NativeSdk_stat(
+    JNIEnv* env, jclass, jlong h, jstring path) {
+  JStr p(env, path);
+  return own_to_jstring(env, cv_sdk_stat(H(h), p.p));
+}
+
+JNIEXPORT jint JNICALL Java_io_curvinetpu_NativeSdk_put(
+    JNIEnv* env, jclass, jlong h, jstring path, jbyteArray data, jlong n) {
+  JStr p(env, path);
+  jbyte* buf = env->GetByteArrayElements(data, nullptr);
+  int rc = cv_sdk_put(H(h), p.p, buf, n);
+  env->ReleaseByteArrayElements(data, buf, JNI_ABORT);
+  return rc;
+}
+
+JNIEXPORT jlong JNICALL Java_io_curvinetpu_NativeSdk_get(
+    JNIEnv* env, jclass, jlong h, jstring path, jbyteArray out, jlong cap) {
+  JStr p(env, path);
+  jbyte* buf = env->GetByteArrayElements(out, nullptr);
+  int64_t got = cv_sdk_get(H(h), p.p, buf, cap);
+  env->ReleaseByteArrayElements(out, buf, 0);  // copy back
+  return got;
+}
+
+JNIEXPORT jlong JNICALL Java_io_curvinetpu_NativeSdk_openReader(
+    JNIEnv* env, jclass, jlong h, jstring path) {
+  JStr p(env, path);
+  return reinterpret_cast<jlong>(cv_sdk_open_reader(H(h), p.p));
+}
+
+JNIEXPORT jlong JNICALL Java_io_curvinetpu_NativeSdk_read(
+    JNIEnv* env, jclass, jlong r, jbyteArray out, jint off, jint cap) {
+  jbyte* buf = env->GetByteArrayElements(out, nullptr);
+  int64_t got = cv_sdk_read(H(r), buf + off, cap);
+  env->ReleaseByteArrayElements(out, buf, 0);  // copy back
+  return got;
+}
+
+JNIEXPORT jlong JNICALL Java_io_curvinetpu_NativeSdk_seek(
+    JNIEnv*, jclass, jlong r, jlong pos) {
+  return cv_sdk_seek(H(r), pos);
+}
+
+JNIEXPORT jlong JNICALL Java_io_curvinetpu_NativeSdk_readerLen(
+    JNIEnv*, jclass, jlong r) {
+  return cv_sdk_reader_len(H(r));
+}
+
+JNIEXPORT jlong JNICALL Java_io_curvinetpu_NativeSdk_readerPos(
+    JNIEnv*, jclass, jlong r) {
+  return cv_sdk_reader_pos(H(r));
+}
+
+JNIEXPORT jint JNICALL Java_io_curvinetpu_NativeSdk_closeReader(
+    JNIEnv*, jclass, jlong r) {
+  return cv_sdk_close_reader(H(r));
+}
+
+JNIEXPORT jlong JNICALL Java_io_curvinetpu_NativeSdk_openWriter(
+    JNIEnv* env, jclass, jlong h, jstring path, jboolean overwrite) {
+  JStr p(env, path);
+  return reinterpret_cast<jlong>(
+      cv_sdk_open_writer(H(h), p.p, overwrite ? 1 : 0));
+}
+
+JNIEXPORT jint JNICALL Java_io_curvinetpu_NativeSdk_write(
+    JNIEnv* env, jclass, jlong w, jbyteArray data, jint off, jint n) {
+  jbyte* buf = env->GetByteArrayElements(data, nullptr);
+  int rc = cv_sdk_write(H(w), buf + off, n);
+  env->ReleaseByteArrayElements(data, buf, JNI_ABORT);
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_io_curvinetpu_NativeSdk_flush(
+    JNIEnv*, jclass, jlong w) {
+  return cv_sdk_flush(H(w));
+}
+
+JNIEXPORT jlong JNICALL Java_io_curvinetpu_NativeSdk_writerPos(
+    JNIEnv*, jclass, jlong w) {
+  return cv_sdk_writer_pos(H(w));
+}
+
+JNIEXPORT jint JNICALL Java_io_curvinetpu_NativeSdk_closeWriter(
+    JNIEnv*, jclass, jlong w) {
+  return cv_sdk_close_writer(H(w));
+}
+
+}  // extern "C"

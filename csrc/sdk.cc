@@ -39,6 +39,9 @@
 //   int   cv_sdk_flush(void* w)
 //   int64 cv_sdk_writer_pos(void* w)
 //   int   cv_sdk_close_writer(void* w)   // completes the file
+//
+// Lifetime: close every reader/writer BEFORE closing the client that
+// opened it (handles borrow the client's pooled worker connections).
 
 #include <arpa/inet.h>
 #include <netdb.h>
@@ -490,6 +493,20 @@ struct ConnCache {
   }
 
   void drop(const std::string& key) { conns.erase(key); }
+
+  // hand a connection over (stream handles steal from the client pool
+  // while open — exclusivity — and return clean conns on close)
+  std::unique_ptr<Conn> take(const std::string& key) {
+    auto it = conns.find(key);
+    if (it == conns.end()) return nullptr;
+    auto c = std::move(it->second);
+    conns.erase(it);
+    return c;
+  }
+
+  void put(const std::string& key, std::unique_ptr<Conn> c) {
+    conns.emplace(key, std::move(c));  // dup key: new conn closes
+  }
 };
 
 struct Client {
@@ -499,6 +516,9 @@ struct Client {
   std::string client_id;
   uint64_t next_req = 1;
   int64_t next_call = 1;
+  // idle worker conns returned by finished readers/writers; the next
+  // stream handle (incl. put/get) steals instead of redialing
+  ConnCache workers;
 
   bool call(Conn& c, uint16_t code, const Value& req, Value& rep) {
     std::string body;
@@ -571,6 +591,10 @@ struct Reader {
 
   Conn* conn_for(const Value& loc) {
     stream_key = worker_key(loc);
+    if (!conns.conns.count(stream_key)) {
+      if (auto idle = c->workers.take(stream_key))
+        conns.put(stream_key, std::move(idle));
+    }
     return conns.get(stream_key);
   }
 
@@ -583,6 +607,14 @@ struct Reader {
     stream = nullptr;
     pending.clear();
     pend_off = 0;
+  }
+
+  void release_conns() {
+    // every conn here is between frames (mid-stream ones were dropped by
+    // abandon_stream): give them back to the client pool
+    for (auto& kv : conns.conns)
+      c->workers.put(kv.first, std::move(kv.second));
+    conns.conns.clear();
   }
 
   const BlockRef* block_at(int64_t off) const {
@@ -617,6 +649,13 @@ struct Writer {
     conn = nullptr;
   }
 
+  void release_conns() {
+    if (open) drop_conn();               // unterminated stream: poisoned
+    for (auto& kv : conns.conns)
+      c->workers.put(kv.first, std::move(kv.second));
+    conns.conns.clear();
+  }
+
   bool next_block() {
     Value ab = c->base_req(path, true);
     ab.map.emplace_back("client_host", S("csdk"));
@@ -633,6 +672,10 @@ struct Writer {
     }
     block_id = binfo->get("id")->as_int();
     cur_key = worker_key(locs->arr[0]);
+    if (!conns.conns.count(cur_key)) {
+      if (auto idle = c->workers.take(cur_key))
+        conns.put(cur_key, std::move(idle));
+    }
     conn = conns.get(cur_key);
     if (!conn) return false;
     Frame f;
@@ -1034,6 +1077,7 @@ int64_t cv_sdk_read(void* rh, void* buf, int64_t cap) {
 int cv_sdk_close_reader(void* rh) {
   auto* r = static_cast<Reader*>(rh);
   r->abandon_stream();
+  r->release_conns();
   delete r;
   return 0;
 }
@@ -1121,6 +1165,7 @@ int cv_sdk_close_writer(void* wh) {
     if (!w->next_block()) return -1;
   }
   if (!w->finish_block()) return -1;
+  w->release_conns();
   Value done = w->c->base_req(w->path, true);
   done.map.emplace_back("len", I(w->total));
   done.map.emplace_back("commit_blocks", w->commits);

@@ -1,0 +1,29 @@
+package io.curvinetpu;
+
+import java.io.IOException;
+
+/**
+ * IOException carrying the wire ErrorCode of a remote failure (0 for
+ * local/transport errors). Parity:
+ * curvine-libsdk/java .../exception/CurvineException.java.
+ */
+public class CurvineException extends IOException {
+
+    private final int code;
+
+    public CurvineException(String message, int code) {
+        super(message);
+        this.code = code;
+    }
+
+    /** Wire ErrorCode (curvine_tpu.common.errors.ErrorCode), 0 = local. */
+    public int getCode() {
+        return code;
+    }
+
+    /** Build from the native thread-local last-error state. */
+    static CurvineException fromNative() {
+        return new CurvineException(NativeSdk.lastError(),
+                NativeSdk.lastErrorCode());
+    }
+}

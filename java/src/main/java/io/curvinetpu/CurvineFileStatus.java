@@ -1,0 +1,202 @@
+package io.curvinetpu;
+
+import java.util.ArrayList;
+import java.util.HashMap;
+import java.util.List;
+import java.util.Map;
+
+/**
+ * File metadata as returned by the master (parity:
+ * curvine-libsdk/java .../CurvineFsStat.java). Parsed from the flat
+ * JSON objects the native ABI emits (cv_sdk_stat / cv_sdk_list) with a
+ * small built-in parser so the SDK has zero third-party dependencies.
+ */
+public final class CurvineFileStatus {
+
+    public final String name;
+    public final long len;
+    public final boolean isDir;
+    public final long mtime;
+    public final long atime;
+    public final int mode;
+    public final int replicas;
+    public final long blockSize;
+    public final boolean isComplete;
+    public final String owner;
+    public final String group;
+
+    CurvineFileStatus(Map<String, Object> m) {
+        this.name = str(m, "name");
+        this.len = num(m, "len");
+        this.isDir = bool(m, "is_dir");
+        this.mtime = num(m, "mtime");
+        this.atime = num(m, "atime");
+        this.mode = (int) num(m, "mode");
+        this.replicas = (int) num(m, "replicas");
+        this.blockSize = num(m, "block_size");
+        this.isComplete = bool(m, "is_complete");
+        this.owner = str(m, "owner");
+        this.group = str(m, "group");
+    }
+
+    private static String str(Map<String, Object> m, String k) {
+        Object v = m.get(k);
+        return v instanceof String ? (String) v : "";
+    }
+
+    private static long num(Map<String, Object> m, String k) {
+        Object v = m.get(k);
+        return v instanceof Long ? (Long) v : 0L;
+    }
+
+    private static boolean bool(Map<String, Object> m, String k) {
+        Object v = m.get(k);
+        return v instanceof Boolean && (Boolean) v;
+    }
+
+    @Override
+    public String toString() {
+        return String.format("%s%s len=%d owner=%s:%s mode=%o",
+                name, isDir ? "/" : "", len, owner, group, mode);
+    }
+
+    // ------------------------------------------------------------------
+    // Minimal JSON reader for the flat objects/arrays the C ABI produces
+    // (string/long/boolean values only; strings use \uXXXX and \" \\
+    // escapes — exactly what csrc/sdk.cc json_escape emits).
+    // ------------------------------------------------------------------
+
+    static final class Json {
+        private final String s;
+        private int i;
+
+        Json(String s) {
+            this.s = s;
+        }
+
+        static Map<String, Object> object(String text) {
+            Json j = new Json(text);
+            j.ws();
+            Map<String, Object> m = j.obj();
+            return m;
+        }
+
+        static List<Map<String, Object>> array(String text) {
+            Json j = new Json(text);
+            j.ws();
+            j.expect('[');
+            List<Map<String, Object>> out = new ArrayList<>();
+            j.ws();
+            if (j.peek() == ']') {
+                j.i++;
+                return out;
+            }
+            while (true) {
+                j.ws();
+                out.add(j.obj());
+                j.ws();
+                char c = j.next();
+                if (c == ']') {
+                    return out;
+                }
+                if (c != ',') {
+                    throw new IllegalArgumentException("bad JSON array");
+                }
+            }
+        }
+
+        private Map<String, Object> obj() {
+            expect('{');
+            Map<String, Object> m = new HashMap<>();
+            ws();
+            if (peek() == '}') {
+                i++;
+                return m;
+            }
+            while (true) {
+                ws();
+                String key = string();
+                ws();
+                expect(':');
+                ws();
+                m.put(key, value());
+                ws();
+                char c = next();
+                if (c == '}') {
+                    return m;
+                }
+                if (c != ',') {
+                    throw new IllegalArgumentException("bad JSON object");
+                }
+            }
+        }
+
+        private Object value() {
+            char c = peek();
+            if (c == '"') {
+                return string();
+            }
+            if (s.startsWith("true", i)) {
+                i += 4;
+                return Boolean.TRUE;
+            }
+            if (s.startsWith("false", i)) {
+                i += 5;
+                return Boolean.FALSE;
+            }
+            int start = i;
+            while (i < s.length() && (s.charAt(i) == '-' || s.charAt(i) == '+'
+                    || Character.isDigit(s.charAt(i)))) {
+                i++;
+            }
+            if (i == start) {
+                throw new IllegalArgumentException("bad JSON value at " + i);
+            }
+            return Long.parseLong(s.substring(start, i));
+        }
+
+        private String string() {
+            expect('"');
+            StringBuilder b = new StringBuilder();
+            while (true) {
+                char c = next();
+                if (c == '"') {
+                    return b.toString();
+                }
+                if (c == '\\') {
+                    char e = next();
+                    if (e == 'u') {
+                        b.append((char) Integer.parseInt(
+                                s.substring(i, i + 4), 16));
+                        i += 4;
+                    } else {
+                        b.append(e); // \" and \\ pass through
+                    }
+                } else {
+                    b.append(c);
+                }
+            }
+        }
+
+        private void ws() {
+            while (i < s.length() && Character.isWhitespace(s.charAt(i))) {
+                i++;
+            }
+        }
+
+        private char peek() {
+            return s.charAt(i);
+        }
+
+        private char next() {
+            return s.charAt(i++);
+        }
+
+        private void expect(char c) {
+            if (next() != c) {
+                throw new IllegalArgumentException(
+                        "expected '" + c + "' at " + (i - 1));
+            }
+        }
+    }
+}

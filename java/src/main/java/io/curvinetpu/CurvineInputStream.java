@@ -1,0 +1,84 @@
+package io.curvinetpu;
+
+import java.io.IOException;
+import java.io.InputStream;
+
+/**
+ * Seekable InputStream over a native streaming reader handle (parity:
+ * curvine-libsdk/java .../CurvineInputStream.java over lib_fs_reader).
+ * Block streams are opened lazily and reopened at offset after seek().
+ */
+public final class CurvineInputStream extends InputStream {
+
+    private long handle;
+    private final byte[] one = new byte[1];
+
+    CurvineInputStream(long handle) {
+        this.handle = handle;
+    }
+
+    private long h() throws IOException {
+        if (handle == 0) {
+            throw new IOException("stream closed");
+        }
+        return handle;
+    }
+
+    @Override
+    public int read() throws IOException {
+        int n = read(one, 0, 1);
+        return n <= 0 ? -1 : one[0] & 0xFF;
+    }
+
+    @Override
+    public int read(byte[] b, int off, int len) throws IOException {
+        if (off < 0 || len < 0 || off + len > b.length) {
+            throw new IndexOutOfBoundsException();
+        }
+        if (len == 0) {
+            return 0;
+        }
+        long got = NativeSdk.read(h(), b, off, len);
+        if (got < 0) {
+            throw CurvineException.fromNative();
+        }
+        return got == 0 ? -1 : (int) got;
+    }
+
+    /** Absolute seek; small forward hops reuse the open block stream. */
+    public void seek(long pos) throws IOException {
+        if (NativeSdk.seek(h(), pos) < 0) {
+            throw CurvineException.fromNative();
+        }
+    }
+
+    public long getPos() throws IOException {
+        return NativeSdk.readerPos(h());
+    }
+
+    /** Total file length. */
+    public long length() throws IOException {
+        return NativeSdk.readerLen(h());
+    }
+
+    @Override
+    public long skip(long n) throws IOException {
+        long cur = getPos();
+        long to = Math.min(length(), cur + Math.max(0, n));
+        seek(to);
+        return to - cur;
+    }
+
+    @Override
+    public int available() throws IOException {
+        return (int) Math.min(Integer.MAX_VALUE, length() - getPos());
+    }
+
+    @Override
+    public void close() {
+        if (handle != 0) {
+            NativeSdk.closeReader(handle);
+            handle = 0;
+        }
+    }
+}

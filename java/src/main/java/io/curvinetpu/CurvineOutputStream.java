@@ -1,0 +1,69 @@
+package io.curvinetpu;
+
+import java.io.IOException;
+import java.io.OutputStream;
+
+/**
+ * OutputStream over a native streaming writer handle (parity:
+ * curvine-libsdk/java .../CurvineOutputStream.java over lib_fs_writer).
+ * Bytes stream to workers block by block as they are written; close()
+ * commits outstanding blocks and completes the file on the master —
+ * until then the file is visible but incomplete.
+ */
+public final class CurvineOutputStream extends OutputStream {
+
+    private long handle;
+    private final byte[] one = new byte[1];
+
+    CurvineOutputStream(long handle) {
+        this.handle = handle;
+    }
+
+    private long h() throws IOException {
+        if (handle == 0) {
+            throw new IOException("stream closed");
+        }
+        return handle;
+    }
+
+    @Override
+    public void write(int b) throws IOException {
+        one[0] = (byte) b;
+        write(one, 0, 1);
+    }
+
+    @Override
+    public void write(byte[] b, int off, int len) throws IOException {
+        if (off < 0 || len < 0 || off + len > b.length) {
+            throw new IndexOutOfBoundsException();
+        }
+        if (len == 0) {
+            return;
+        }
+        if (NativeSdk.write(h(), b, off, len) != 0) {
+            throw CurvineException.fromNative();
+        }
+    }
+
+    public long getPos() throws IOException {
+        return NativeSdk.writerPos(h());
+    }
+
+    @Override
+    public void flush() throws IOException {
+        if (NativeSdk.flush(h()) != 0) {
+            throw CurvineException.fromNative();
+        }
+    }
+
+    @Override
+    public void close() throws IOException {
+        if (handle != 0) {
+            long h = handle;
+            handle = 0;
+            if (NativeSdk.closeWriter(h) != 0) {
+                throw CurvineException.fromNative();
+            }
+        }
+    }
+}

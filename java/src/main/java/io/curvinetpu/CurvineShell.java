@@ -1,0 +1,97 @@
+package io.curvinetpu;
+
+import java.io.FileInputStream;
+import java.io.FileOutputStream;
+import java.io.IOException;
+import java.io.InputStream;
+import java.io.OutputStream;
+
+/**
+ * Minimal command-line shell over the SDK (parity:
+ * curvine-libsdk/java .../CurvineShell.java). Doubles as the smoke test
+ * a JDK-equipped environment runs against a live cluster:
+ *
+ * <pre>
+ * java -cp curvine-tpu-sdk.jar io.curvinetpu.CurvineShell \
+ *     --master host:port ls /          # also: mkdir put get cat rm stat
+ * </pre>
+ */
+public final class CurvineShell {
+
+    private CurvineShell() {}
+
+    public static void main(String[] args) throws IOException {
+        String master = "127.0.0.1:8995";
+        int i = 0;
+        if (args.length >= 2 && args[0].equals("--master")) {
+            master = args[1];
+            i = 2;
+        }
+        if (args.length - i < 1) {
+            usage();
+            return;
+        }
+        String host = master.substring(0, master.lastIndexOf(':'));
+        int port = Integer.parseInt(
+                master.substring(master.lastIndexOf(':') + 1));
+        String cmd = args[i];
+        try (CurvineTpuFileSystem fs =
+                CurvineTpuFileSystem.connect(host, port, "")) {
+            switch (cmd) {
+                case "ls":
+                    for (CurvineFileStatus st : fs.listStatus(args[i + 1])) {
+                        System.out.println(st);
+                    }
+                    break;
+                case "mkdir":
+                    fs.mkdir(args[i + 1]);
+                    break;
+                case "put": { // put <local> <remote>
+                    try (InputStream in = new FileInputStream(args[i + 1]);
+                            CurvineOutputStream out =
+                                    fs.create(args[i + 2], true)) {
+                        copy(in, out);
+                    }
+                    break;
+                }
+                case "get": { // get <remote> <local>
+                    try (CurvineInputStream in = fs.open(args[i + 1]);
+                            OutputStream out =
+                                    new FileOutputStream(args[i + 2])) {
+                        copy(in, out);
+                    }
+                    break;
+                }
+                case "cat": {
+                    try (CurvineInputStream in = fs.open(args[i + 1])) {
+                        copy(in, System.out);
+                    }
+                    break;
+                }
+                case "rm":
+                    fs.delete(args[i + 1], true);
+                    break;
+                case "stat":
+                    System.out.println(fs.getFileStatus(args[i + 1]));
+                    break;
+                default:
+                    usage();
+            }
+        }
+    }
+
+    private static void copy(InputStream in, OutputStream out)
+            throws IOException {
+        byte[] buf = new byte[1 << 20];
+        int n;
+        while ((n = in.read(buf)) > 0) {
+            out.write(buf, 0, n);
+        }
+        out.flush();
+    }
+
+    private static void usage() {
+        System.err.println("usage: CurvineShell [--master host:port] "
+                + "ls|mkdir|put|get|cat|rm|stat <args>");
+    }
+}

@@ -1,0 +1,155 @@
+package io.curvinetpu;
+
+import java.io.IOException;
+import java.util.ArrayList;
+import java.util.List;
+import java.util.Map;
+
+/**
+ * Java client for a curvine-tpu cluster over the native SDK (parity:
+ * curvine-libsdk/java .../CurvineFileSystem.java). One instance wraps
+ * one native client handle; use from one thread at a time, or one
+ * instance per thread (connections are cheap).
+ *
+ * <pre>
+ * try (CurvineTpuFileSystem fs =
+ *         CurvineTpuFileSystem.connect("master-host", 8995, "alice")) {
+ *     fs.mkdir("/data");
+ *     try (CurvineOutputStream out = fs.create("/data/x.bin", true)) {
+ *         out.write(bytes);
+ *     }
+ *     try (CurvineInputStream in = fs.open("/data/x.bin")) {
+ *         in.read(buf);
+ *     }
+ * }
+ * </pre>
+ */
+public final class CurvineTpuFileSystem implements AutoCloseable {
+
+    private long handle;
+
+    private CurvineTpuFileSystem(long handle) {
+        this.handle = handle;
+    }
+
+    /** Dial the master. user "" means root (superuser in default conf). */
+    public static CurvineTpuFileSystem connect(String host, int port,
+            String user) throws IOException {
+        long h = NativeSdk.connect(host, port, user == null ? "" : user);
+        if (h == 0) {
+            throw CurvineException.fromNative();
+        }
+        return new CurvineTpuFileSystem(h);
+    }
+
+    private long h() throws IOException {
+        if (handle == 0) {
+            throw new IOException("filesystem closed");
+        }
+        return handle;
+    }
+
+    public void mkdir(String path) throws IOException {
+        if (NativeSdk.mkdir(h(), path) != 0) {
+            throw CurvineException.fromNative();
+        }
+    }
+
+    public void delete(String path, boolean recursive) throws IOException {
+        if (NativeSdk.delete(h(), path, recursive) != 0) {
+            throw CurvineException.fromNative();
+        }
+    }
+
+    public void rename(String src, String dst) throws IOException {
+        if (NativeSdk.rename(h(), src, dst) != 0) {
+            throw CurvineException.fromNative();
+        }
+    }
+
+    public boolean exists(String path) throws IOException {
+        int rc = NativeSdk.exists(h(), path);
+        if (rc < 0) {
+            throw CurvineException.fromNative();
+        }
+        return rc == 1;
+    }
+
+    public CurvineFileStatus getFileStatus(String path) throws IOException {
+        String json = NativeSdk.stat(h(), path);
+        if (json == null) {
+            throw CurvineException.fromNative();
+        }
+        return new CurvineFileStatus(CurvineFileStatus.Json.object(json));
+    }
+
+    public List<CurvineFileStatus> listStatus(String path)
+            throws IOException {
+        String json = NativeSdk.list(h(), path);
+        if (json == null) {
+            throw CurvineException.fromNative();
+        }
+        List<CurvineFileStatus> out = new ArrayList<>();
+        for (Map<String, Object> m : CurvineFileStatus.Json.array(json)) {
+            out.add(new CurvineFileStatus(m));
+        }
+        return out;
+    }
+
+    /** Open a seekable read stream. */
+    public CurvineInputStream open(String path) throws IOException {
+        long r = NativeSdk.openReader(h(), path);
+        if (r == 0) {
+            throw CurvineException.fromNative();
+        }
+        return new CurvineInputStream(r);
+    }
+
+    /** Create a file and return its write stream. */
+    public CurvineOutputStream create(String path, boolean overwrite)
+            throws IOException {
+        long w = NativeSdk.openWriter(h(), path, overwrite);
+        if (w == 0) {
+            throw CurvineException.fromNative();
+        }
+        return new CurvineOutputStream(w);
+    }
+
+    /** Whole-file write (creates with overwrite). */
+    public void put(String path, byte[] data) throws IOException {
+        if (NativeSdk.put(h(), path, data, data.length) != 0) {
+            throw CurvineException.fromNative();
+        }
+    }
+
+    /** Whole-file read. Files beyond a byte[]'s reach need open(). */
+    public byte[] get(String path) throws IOException {
+        long n = NativeSdk.len(h(), path);
+        if (n < 0) {
+            throw CurvineException.fromNative();
+        }
+        if (n > Integer.MAX_VALUE - 8) {
+            throw new IOException("file too large for get(): " + n
+                    + " bytes; use open() and stream");
+        }
+        byte[] buf = new byte[(int) n];
+        long got = NativeSdk.get(h(), path, buf, buf.length);
+        if (got < 0) {
+            throw CurvineException.fromNative();
+        }
+        if (got != n) {
+            byte[] trim = new byte[(int) got];
+            System.arraycopy(buf, 0, trim, 0, (int) got);
+            return trim;
+        }
+        return buf;
+    }
+
+    @Override
+    public void close() {
+        if (handle != 0) {
+            NativeSdk.close(handle);
+            handle = 0;
+        }
+    }
+}

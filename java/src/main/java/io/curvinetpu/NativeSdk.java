@@ -1,0 +1,75 @@
+package io.curvinetpu;
+
+/**
+ * JNI binding of the native curvine-tpu client ABI (csrc/sdk.cc,
+ * libcurvine_sdk.so). Parity: the reference's Java SDK binds a native
+ * client the same way (curvine-libsdk/src/java/java_abi.rs behind
+ * io/curvine/CurvineNative.java); here the native client is the C++
+ * wire-protocol SDK and this class is its Java face.
+ *
+ * All handles are opaque native pointers. Methods returning int follow
+ * the C ABI convention: 0 success, -1 failure (read lastError()).
+ * Thread-safety: a client handle and any streams derived from it must
+ * be confined to one thread at a time (the C client is not locked).
+ */
+final class NativeSdk {
+
+    static {
+        System.loadLibrary("curvine_jni"); // libcurvine_jni.so wraps libcurvine_sdk
+    }
+
+    private NativeSdk() {}
+
+    // ---- client lifecycle ----
+    static native long connect(String host, int port, String user);
+
+    static native void close(long handle);
+
+    static native String lastError();
+
+    static native int lastErrorCode();
+
+    // ---- metadata ----
+    static native int mkdir(long handle, String path);
+
+    static native int delete(long handle, String path, boolean recursive);
+
+    static native int rename(long handle, String src, String dst);
+
+    static native int exists(long handle, String path); // 1/0/-1
+
+    static native long len(long handle, String path);   // -1: not found
+
+    static native String list(long handle, String path); // JSON array
+
+    static native String stat(long handle, String path); // JSON object
+
+    // ---- whole-file ----
+    static native int put(long handle, String path, byte[] data, long n);
+
+    static native long get(long handle, String path, byte[] buf, long cap);
+
+    // ---- streaming reader ----
+    static native long openReader(long handle, String path);
+
+    static native long read(long reader, byte[] buf, int off, int cap);
+
+    static native long seek(long reader, long pos);
+
+    static native long readerLen(long reader);
+
+    static native long readerPos(long reader);
+
+    static native int closeReader(long reader);
+
+    // ---- streaming writer ----
+    static native long openWriter(long handle, String path, boolean overwrite);
+
+    static native int write(long writer, byte[] buf, int off, int n);
+
+    static native int flush(long writer);
+
+    static native long writerPos(long writer);
+
+    static native int closeWriter(long writer);
+}

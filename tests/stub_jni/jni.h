@@ -1,0 +1,34 @@
+// Minimal jni.h STUB for syntax-checking csrc/jni_sdk.cc in images
+// without a JDK (tests/test_java_sdk.py runs g++ -fsyntax-only with
+// this on the include path). It declares only the names the shim uses;
+// struct layouts are NOT the real ABI — never link against this.
+#ifndef STUB_JNI_H
+#define STUB_JNI_H
+
+#include <cstdint>
+
+using jint = int32_t;
+using jlong = int64_t;
+using jbyte = int8_t;
+using jboolean = uint8_t;
+
+class _jobject {};
+using jobject = _jobject*;
+using jclass = jobject;
+using jstring = jobject;
+using jbyteArray = jobject;
+
+constexpr jint JNI_ABORT = 2;
+
+struct JNIEnv {
+  const char* GetStringUTFChars(jstring, jboolean*);
+  void ReleaseStringUTFChars(jstring, const char*);
+  jstring NewStringUTF(const char*);
+  jbyte* GetByteArrayElements(jbyteArray, jboolean*);
+  void ReleaseByteArrayElements(jbyteArray, jbyte*, jint);
+};
+
+#define JNIEXPORT
+#define JNICALL
+
+#endif  // STUB_JNI_H

@@ -186,6 +186,23 @@ async def run_bench(total_mb: int = 256, block_mb: int = 64,
         await asyncio.gather(*(stat_worker(k) for k in range(conc)))
         results["meta_qps"] = total_calls / (time.perf_counter() - t0)
 
+        # ---- native metadata read plane (C++ mirror, fast port) ----
+        # the C++ load generator pipelines stats at the C++ server so
+        # neither side is bounded by Python (this is the path that meets
+        # the reference's multithreaded-Rust 100K+ headline)
+        try:
+            from curvine_tpu.master import fastmeta as _fm
+            fast_port = getattr(mc.master.fastmeta, "port", None) \
+                if getattr(mc.master, "fastmeta", None) else None
+            if fast_port:
+                host = mc.master.addr.rsplit(":", 1)[0]
+                loop = asyncio.get_running_loop()
+                results["meta_qps_native"] = await loop.run_in_executor(
+                    None, _fm.bench_stat, host, fast_port,
+                    "/bench/meta/f00", "root", 150_000, 64)
+        except Exception as e:  # noqa: BLE001 — bench must not die here
+            print(f"# native meta bench skipped: {e}", file=sys.stderr)
+
         # ---- p99 block-fetch latency ----
         await c.write_all("/bench/small",
                           rng.integers(0, 255, latency_block_mb * MB,
@@ -440,6 +457,7 @@ def main():
         "link_gibs": round(results["link_gibs"], 3),
         "pipeline_vs_link": round(results.get("pipeline_vs_link", 0), 3),
         "meta_qps": round(results.get("meta_qps", 0), 1),
+        "meta_qps_native": round(results.get("meta_qps_native", 0), 1),
         "p99_block_fetch_ms": round(results["p99_block_fetch_ms"], 3),
         "p50_block_fetch_ms": round(results["p50_block_fetch_ms"], 3),
         "read_gibs_host": round(results["read_gibs_host"], 3),

@@ -1,0 +1,637 @@
+// libcurvine_meta — native metadata read plane for the master.
+//
+// The Python master owns the namespace (journal, KV store, mutations);
+// this library keeps a read-only MIRROR of the inode tree in C++ and
+// serves the hot read-only metadata RPCs (FILE_STATUS, EXISTS) from
+// native threads on a separate "fast port", speaking the exact same
+// frame + msgpack wire protocol (wire.h). Python pushes every committed
+// mutation into the mirror through the C ABI (master/fastmeta.py wraps
+// the MetaStore and flushes per journal commit), so fast-path reads are
+// read-your-writes consistent with the single-writer master actor.
+//
+// Anything the mirror cannot answer authoritatively — path absent from
+// the cache namespace (mounted UFS passthrough may still resolve it),
+// server gated off (non-leader), unsupported op — returns error_code
+// FAST_MISS and the client retries on the Python port. ACL traverse
+// checks are replicated exactly (master/acl.py `check(ctx, path, 0)`),
+// so denials are served natively with identical messages.
+//
+// Parity note: the reference master is multithreaded Rust serving 100K+
+// metadata QPS (curvine-server/src/master/master_handler.rs); a Python
+// asyncio master tops out ~10K on one core. This sidecar is the
+// rebuild's answer: the mutation plane stays Python (journaled,
+// raft-replicated), the read plane is native.
+
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <shared_mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "wire.h"
+
+namespace {
+
+using namespace cvwire;
+
+constexpr uint16_t kFileStatus = 7, kExists = 9;
+constexpr uint8_t kFlagsReply = 1 | 4;             // RESPONSE | EOF
+constexpr int kErrPermissionDenied = 23;           // errors.py ErrorCode
+constexpr int kErrFastMiss = 28;                   // errors.py ErrorCode
+constexpr int64_t kRootId = 1;
+constexpr uint32_t kMaxFrame = 1 << 20;            // metadata reqs are small
+
+struct Rec {
+  int64_t id = 0, parent_id = 0, mtime = 0, atime = 0, len = 0,
+          block_size = 0, children_num = 0;
+  int32_t mode = 0, replicas = 1, nlink = 1, ftype = 1;
+  bool is_complete = true, has_target = false;
+  std::string owner, group, target, xattr_mp;      // xattr: msgpack map
+  int64_t sp_ttl = 0, sp_ufs_mtime = 0;
+  int32_t sp_type = 0, sp_action = 0, sp_state = 0;
+
+  bool is_dir() const { return ftype == 0; }        // FileType.DIR == 0
+};
+
+// --- manual msgpack assembly (streams straight into the reply body;
+//     lets the pre-packed x_attr map splice in verbatim) ---
+void mp_map(std::string& o, uint32_t n) {
+  if (n < 16) {
+    o.push_back(static_cast<char>(0x80 | n));
+  } else {
+    o.push_back('\xde');
+    o.push_back(static_cast<char>(n >> 8));
+    o.push_back(static_cast<char>(n & 0xFF));
+  }
+}
+
+void mp_bool(std::string& o, bool b) { o.push_back(b ? '\xc3' : '\xc2'); }
+
+void mp_nil(std::string& o) { o.push_back('\xc0'); }
+
+void encode_status(std::string& o, const Rec& r, const std::string& path) {
+  std::string tail = path;
+  while (tail.size() > 1 && tail.back() == '/') tail.pop_back();
+  auto pos = tail.rfind('/');
+  std::string name = pos == std::string::npos ? tail : tail.substr(pos + 1);
+  // FileStatus.to_wire() key-for-key (common/types.py)
+  mp_map(o, 19);
+  pack_str(o, "id");             pack_int(o, r.id);
+  pack_str(o, "path");           pack_str(o, path);
+  pack_str(o, "name");           pack_str(o, name);
+  pack_str(o, "is_dir");         mp_bool(o, r.is_dir());
+  pack_str(o, "mtime");          pack_int(o, r.mtime);
+  pack_str(o, "atime");          pack_int(o, r.atime);
+  pack_str(o, "children_num");   pack_int(o, r.children_num);
+  pack_str(o, "is_complete");    mp_bool(o, r.is_complete);
+  pack_str(o, "len");            pack_int(o, r.len);
+  pack_str(o, "replicas");       pack_int(o, r.replicas);
+  pack_str(o, "block_size");     pack_int(o, r.block_size);
+  pack_str(o, "file_type");      pack_int(o, r.ftype);
+  pack_str(o, "x_attr");
+  if (r.xattr_mp.empty()) {
+    mp_map(o, 0);
+  } else {
+    o += r.xattr_mp;                               // verbatim splice
+  }
+  pack_str(o, "storage_policy");
+  mp_map(o, 5);
+  pack_str(o, "storage_type");   pack_int(o, r.sp_type);
+  pack_str(o, "ttl_ms");         pack_int(o, r.sp_ttl);
+  pack_str(o, "ttl_action");     pack_int(o, r.sp_action);
+  pack_str(o, "ufs_mtime");      pack_int(o, r.sp_ufs_mtime);
+  pack_str(o, "state");          pack_int(o, r.sp_state);
+  pack_str(o, "owner");          pack_str(o, r.owner);
+  pack_str(o, "group");          pack_str(o, r.group);
+  pack_str(o, "mode");           pack_int(o, r.mode);
+  pack_str(o, "target");
+  if (r.has_target) {
+    pack_str(o, r.target);
+  } else {
+    mp_nil(o);
+  }
+  pack_str(o, "nlink");          pack_int(o, r.nlink);
+}
+
+// The Python port normalizes every request path (scheme strip, "..",
+// "//", trailing "/") before resolving AND echoes the normalized path
+// in the reply. The mirror serves only already-canonical paths — for
+// those, echo == input == what Python would produce; everything else
+// falls back so the two ports never disagree.
+bool canonical_path(const std::string& p) {
+  if (p.empty() || p[0] != '/') return false;
+  if (p.size() > 1 && p.back() == '/') return false;
+  if (p.find("//") != std::string::npos) return false;
+  size_t i = 1;
+  while (i < p.size()) {
+    size_t j = p.find('/', i);
+    if (j == std::string::npos) j = p.size();
+    size_t len = j - i;
+    if (len == 0) return false;
+    if (p[i] == '.' && (len == 1 || (len == 2 && p[i + 1] == '.')))
+      return false;
+    i = j + 1;
+  }
+  return true;
+}
+
+bool send_all_fd(int fd, const char* p, size_t n) {
+  while (n) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all_fd(int fd, char* p, size_t n) {
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct Mirror {
+  mutable std::shared_mutex mu;
+  std::unordered_map<int64_t, Rec> inodes;
+  std::unordered_map<int64_t, std::unordered_map<std::string, int64_t>> dents;
+
+  bool acl_enabled = true;
+  std::string superuser = "root", supergroup = "supergroup";
+
+  std::atomic<bool> serving{false};
+  std::atomic<bool> stopping{false};
+  std::atomic<uint64_t> served{0}, fallbacks{0}, denied{0};
+
+  int listen_fd = -1;
+  std::thread acceptor;
+  std::mutex conns_mu;
+  // live connections only: conn_loop deregisters its fd on exit and
+  // parks its (self-unjoinable) thread handle in `finished`, which the
+  // acceptor reaps per accept and stop() drains — no unbounded growth,
+  // and stop() never shutdown()s an fd number the kernel has reused
+  std::unordered_map<int, std::thread> conns;
+  std::vector<std::thread> finished;
+
+  ~Mirror() { stop(); }
+
+  void reap_finished() {
+    std::vector<std::thread> done;
+    {
+      std::lock_guard<std::mutex> g(conns_mu);
+      done.swap(finished);
+    }
+    for (auto& t : done)
+      if (t.joinable()) t.join();
+  }
+
+  void stop() {
+    stopping = true;
+    serving = false;
+    if (listen_fd >= 0) {
+      ::shutdown(listen_fd, SHUT_RDWR);
+      ::close(listen_fd);
+      listen_fd = -1;
+    }
+    // join the acceptor FIRST: afterwards no new connection can register,
+    // so the shutdown sweep below cannot miss one
+    if (acceptor.joinable()) acceptor.join();
+    std::vector<std::thread> ts;
+    {
+      std::lock_guard<std::mutex> g(conns_mu);
+      for (auto& kv : conns) ::shutdown(kv.first, SHUT_RDWR);
+      for (auto& kv : conns) ts.push_back(std::move(kv.second));
+      conns.clear();
+    }
+    for (auto& t : ts)
+      if (t.joinable()) t.join();
+    reap_finished();
+  }
+
+  // ---------------- resolution + ACL ----------------
+
+  static int posix_bits(const Rec& r, const std::string& user,
+                        const std::vector<std::string>& groups) {
+    if (user == r.owner) return (r.mode >> 6) & 7;
+    for (auto& g : groups)
+      if (g == r.group) return (r.mode >> 3) & 7;
+    return r.mode & 7;
+  }
+
+  bool is_super(const std::string& user,
+                const std::vector<std::string>& groups) const {
+    if (user == superuser) return true;
+    for (auto& g : groups)
+      if (g == supergroup) return true;
+    return false;
+  }
+
+  enum class Res { OK, MISS, DENIED };
+
+  // Resolve `path` with traverse-x on every existing ancestor dir
+  // (acl.py check(ctx, path, 0) semantics: the target's own bits are
+  // the op's business; stat needs none). MISS covers both truly-absent
+  // paths and anything odd — the Python port settles those.
+  Res resolve(const std::string& path, const std::string& user,
+              const std::vector<std::string>& groups, Rec& out,
+              std::string& denied_sub) const {
+    if (!canonical_path(path)) return Res::MISS;
+    bool skip_acl = !acl_enabled || is_super(user, groups);
+    std::shared_lock<std::shared_mutex> lk(mu);
+    auto it = inodes.find(kRootId);
+    if (it == inodes.end()) return Res::MISS;
+    const Rec* node = &it->second;
+    std::string sub;
+    size_t i = 0, n = path.size();
+    while (i < n) {
+      while (i < n && path[i] == '/') i++;
+      if (i >= n) break;
+      size_t j = i;
+      while (j < n && path[j] != '/') j++;
+      std::string comp = path.substr(i, j - i);
+      i = j;
+      if (comp == "." || comp == "..") return Res::MISS;  // Python's call
+      // `node` is an ancestor of the remaining components: traverse x
+      if (!node->is_dir()) return Res::MISS;
+      if (!skip_acl && !(posix_bits(*node, user, groups) & 1)) {
+        denied_sub = sub.empty() ? "/" : sub;
+        return Res::DENIED;
+      }
+      auto dit = dents.find(node->id);
+      if (dit == dents.end()) return Res::MISS;
+      auto cit = dit->second.find(comp);
+      if (cit == dit->second.end()) return Res::MISS;
+      auto nit = inodes.find(cit->second);
+      if (nit == inodes.end()) return Res::MISS;
+      node = &nit->second;
+      sub += "/" + comp;
+    }
+    out = *node;
+    return Res::OK;
+  }
+
+  // ---------------- serving ----------------
+
+  void reply(int fd, const Frame& req, uint8_t status,
+             const Value& header, const std::string& body) {
+    Frame f;
+    f.code = req.code;
+    f.req_id = req.req_id;
+    f.status = status;
+    f.flags = kFlagsReply;
+    f.header = header;
+    f.data = body;
+    std::string wire = encode_frame(f);
+    send_all_fd(fd, wire.data(), wire.size());
+  }
+
+  void reply_error(int fd, const Frame& req, int code,
+                   const std::string& msg) {
+    Value h = M();
+    h.map.emplace_back("error_code", I(code));
+    h.map.emplace_back("error", S(msg));
+    reply(fd, req, 1, h, "");
+  }
+
+  void handle(int fd, const Frame& req) {
+    if (!serving.load(std::memory_order_relaxed)) {
+      // distinct message: a gated-off (non-leader) plane answers miss
+      // for EVERYTHING, so the client should drop this address and
+      // rediscover the leader's — unlike a per-path miss
+      fallbacks++;
+      reply_error(fd, req, kErrFastMiss, "fast-gated");
+      return;
+    }
+    if (req.code != kFileStatus && req.code != kExists) {
+      fallbacks++;
+      reply_error(fd, req, kErrFastMiss, "fast-miss");
+      return;
+    }
+    std::string path, user = "root";
+    std::vector<std::string> groups;
+    try {
+      Cursor c{reinterpret_cast<const uint8_t*>(req.data.data()),
+               req.data.size()};
+      Value q = unpack_value(c);
+      if (const Value* p = q.get("path")) path = p->s;
+      if (const Value* u = q.get("user")) {
+        if (!u->s.empty()) user = u->s;
+      }
+      if (const Value* g = q.get("groups"))
+        for (auto& e : g->arr) groups.push_back(e.s);
+    } catch (const std::exception&) {
+      fallbacks++;
+      reply_error(fd, req, kErrFastMiss, "fast-miss");
+      return;
+    }
+    Rec rec;
+    std::string denied_sub;
+    switch (resolve(path, user, groups, rec, denied_sub)) {
+      case Res::OK: {
+        served++;
+        std::string body;
+        if (req.code == kExists) {
+          mp_map(body, 1);
+          pack_str(body, "exists");
+          mp_bool(body, true);
+        } else {
+          mp_map(body, 1);
+          pack_str(body, "status");
+          encode_status(body, rec, path);
+        }
+        reply(fd, req, 0, Value(), body);
+        return;
+      }
+      case Res::DENIED:
+        // identical wording to acl.py _deny(..., "traverse (x)")
+        denied++;
+        reply_error(fd, req, kErrPermissionDenied,
+                    "user=" + user + " lacks traverse (x) on " + denied_sub);
+        return;
+      case Res::MISS:
+        fallbacks++;
+        reply_error(fd, req, kErrFastMiss, "fast-miss");
+        return;
+    }
+  }
+
+  void conn_loop(int fd) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::string body;
+    while (!stopping) {
+      char pre[4];
+      if (!recv_all_fd(fd, pre, 4)) break;
+      uint32_t total = (uint8_t(pre[0]) << 24) | (uint8_t(pre[1]) << 16) |
+                       (uint8_t(pre[2]) << 8) | uint8_t(pre[3]);
+      if (total < 17 || total > kMaxFrame) break;
+      body.resize(total);
+      if (!recv_all_fd(fd, body.data(), total)) break;
+      Frame req;
+      std::string err;
+      if (!parse_frame_body(reinterpret_cast<const uint8_t*>(body.data()),
+                            total, req, &err))
+        break;
+      handle(fd, req);
+    }
+    // deregister BEFORE close: once the fd is closed the kernel may hand
+    // the same number to a new accept, and a stale map entry under that
+    // key would make the acceptor destroy a joinable std::thread
+    // (std::terminate). The handle moves to `finished` for reaping — a
+    // thread cannot join itself.
+    {
+      std::lock_guard<std::mutex> g(conns_mu);
+      auto it = conns.find(fd);
+      if (it != conns.end()) {
+        finished.push_back(std::move(it->second));
+        conns.erase(it);
+      }
+    }
+    ::close(fd);
+  }
+
+  bool serve(const std::string& host, int port, int* bound_port) {
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    hints.ai_flags = AI_PASSIVE;
+    if (getaddrinfo(host.empty() ? nullptr : host.c_str(),
+                    std::to_string(port).c_str(), &hints, &res) != 0 ||
+        !res)
+      return false;
+    listen_fd = socket(res->ai_family, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    if (bind(listen_fd, res->ai_addr, res->ai_addrlen) != 0 ||
+        listen(listen_fd, 128) != 0) {
+      freeaddrinfo(res);
+      ::close(listen_fd);
+      listen_fd = -1;
+      return false;
+    }
+    freeaddrinfo(res);
+    sockaddr_in sa{};
+    socklen_t sl = sizeof(sa);
+    getsockname(listen_fd, reinterpret_cast<sockaddr*>(&sa), &sl);
+    *bound_port = ntohs(sa.sin_port);
+    acceptor = std::thread([this] {
+      while (!stopping) {
+        int fd = ::accept(listen_fd, nullptr, nullptr);
+        if (fd < 0) {
+          if (stopping) break;
+          continue;
+        }
+        reap_finished();
+        std::lock_guard<std::mutex> g(conns_mu);
+        conns.emplace(fd, std::thread([this, fd] { conn_loop(fd); }));
+      }
+    });
+    return true;
+  }
+
+  uint64_t counter(int which) const {
+    switch (which) {
+      case 0: {
+        std::shared_lock<std::shared_mutex> lk(mu);
+        return inodes.size();
+      }
+      case 1: return served.load();
+      case 2: return fallbacks.load();
+      case 3: return denied.load();
+    }
+    return 0;
+  }
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------- C ABI
+extern "C" {
+
+void* mm_new(int acl_enabled, const char* superuser,
+             const char* supergroup) {
+  auto* m = new Mirror();
+  m->acl_enabled = acl_enabled != 0;
+  if (superuser && *superuser) m->superuser = superuser;
+  if (supergroup && *supergroup) m->supergroup = supergroup;
+  return m;
+}
+
+void mm_free(void* h) { delete static_cast<Mirror*>(h); }
+
+void mm_stop(void* h) { static_cast<Mirror*>(h)->stop(); }
+
+void mm_clear(void* h) {
+  auto* m = static_cast<Mirror*>(h);
+  std::unique_lock<std::shared_mutex> lk(m->mu);
+  m->inodes.clear();
+  m->dents.clear();
+}
+
+void mm_put(void* h, int64_t id, int64_t parent_id, int ftype,
+            int64_t mtime, int64_t atime, int mode, const char* owner,
+            const char* group, int64_t len, int64_t block_size,
+            int replicas, int is_complete, int nlink, int64_t children_num,
+            const char* target, const char* xattr_mp, int xattr_len,
+            int sp_type, long long sp_ttl, int sp_action,
+            long long sp_ufs_mtime, int sp_state) {
+  auto* m = static_cast<Mirror*>(h);
+  Rec r;
+  r.id = id;
+  r.parent_id = parent_id;
+  r.ftype = ftype;
+  r.mtime = mtime;
+  r.atime = atime;
+  r.mode = mode;
+  r.owner = owner ? owner : "";
+  r.group = group ? group : "";
+  r.len = len;
+  r.block_size = block_size;
+  r.replicas = replicas;
+  r.is_complete = is_complete != 0;
+  r.nlink = nlink;
+  r.children_num = children_num;
+  if (target) {
+    r.has_target = true;
+    r.target = target;
+  }
+  if (xattr_mp && xattr_len > 0) r.xattr_mp.assign(xattr_mp, xattr_len);
+  r.sp_type = sp_type;
+  r.sp_ttl = sp_ttl;
+  r.sp_action = sp_action;
+  r.sp_ufs_mtime = sp_ufs_mtime;
+  r.sp_state = sp_state;
+  std::unique_lock<std::shared_mutex> lk(m->mu);
+  m->inodes[id] = std::move(r);
+}
+
+void mm_remove(void* h, int64_t id) {
+  auto* m = static_cast<Mirror*>(h);
+  std::unique_lock<std::shared_mutex> lk(m->mu);
+  m->inodes.erase(id);
+  m->dents.erase(id);
+}
+
+void mm_child_put(void* h, int64_t parent_id, const char* name,
+                  int64_t child_id) {
+  auto* m = static_cast<Mirror*>(h);
+  std::unique_lock<std::shared_mutex> lk(m->mu);
+  m->dents[parent_id][name] = child_id;
+}
+
+void mm_child_remove(void* h, int64_t parent_id, const char* name) {
+  auto* m = static_cast<Mirror*>(h);
+  std::unique_lock<std::shared_mutex> lk(m->mu);
+  auto it = m->dents.find(parent_id);
+  if (it != m->dents.end()) it->second.erase(name);
+}
+
+int mm_serve(void* h, const char* host, int port) {
+  auto* m = static_cast<Mirror*>(h);
+  int bound = -1;
+  if (!m->serve(host ? host : "", port, &bound)) return -1;
+  return bound;
+}
+
+void mm_set_serving(void* h, int on) {
+  static_cast<Mirror*>(h)->serving = on != 0;
+}
+
+unsigned long long mm_counter(void* h, int which) {
+  return static_cast<Mirror*>(h)->counter(which);
+}
+
+// ---------------- bench client (pipelined stat storm) ----------------
+//
+// Drives `n` FILE_STATUS requests at a fast port with `pipeline`
+// requests in flight; returns achieved QPS (<0 on error). Lives here so
+// bench.py can measure the native read plane without Python client
+// overhead bounding the number.
+double mm_bench_stat(const char* host, int port, const char* path,
+                     const char* user, int n, int pipeline) {
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  if (getaddrinfo(host, std::to_string(port).c_str(), &hints, &res) != 0 ||
+      !res)
+    return -1;
+  int fd = socket(res->ai_family, SOCK_STREAM, 0);
+  if (fd < 0 || connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+    freeaddrinfo(res);
+    if (fd >= 0) ::close(fd);
+    return -1;
+  }
+  freeaddrinfo(res);
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+
+  Value q = M();
+  q.map.emplace_back("path", S(path));
+  q.map.emplace_back("user", S(user));
+  Value groups = A();
+  groups.arr.push_back(S(user));
+  q.map.emplace_back("groups", groups);
+  std::string body;
+  pack_value(body, q);
+
+  auto send_req = [&](uint64_t rid) {
+    Frame f;
+    f.code = kFileStatus;
+    f.req_id = rid;
+    f.data = body;
+    std::string wire = encode_frame(f);
+    return send_all_fd(fd, wire.data(), wire.size());
+  };
+  auto recv_rep = [&]() -> int {
+    char pre[4];
+    if (!recv_all_fd(fd, pre, 4)) return -1;
+    uint32_t total = (uint8_t(pre[0]) << 24) | (uint8_t(pre[1]) << 16) |
+                     (uint8_t(pre[2]) << 8) | uint8_t(pre[3]);
+    if (total < 17 || total > kMaxFrame) return -1;
+    std::string b(total, '\0');
+    if (!recv_all_fd(fd, b.data(), total)) return -1;
+    return b[11];                                   // status byte
+  };
+
+  auto t0 = std::chrono::steady_clock::now();
+  uint64_t rid = 1;
+  int inflight = 0;
+  int ok = 0;
+  for (int i = 0; i < pipeline && i < n; i++) {
+    if (!send_req(rid++)) { ::close(fd); return -1; }
+    inflight++;
+  }
+  for (int done = 0; done < n; done++) {
+    int st = recv_rep();
+    if (st < 0) { ::close(fd); return -1; }
+    if (st == 0) ok++;
+    inflight--;
+    if (static_cast<int>(rid) <= n) {
+      if (!send_req(rid++)) { ::close(fd); return -1; }
+      inflight++;
+    }
+  }
+  auto dt = std::chrono::duration<double>(
+      std::chrono::steady_clock::now() - t0).count();
+  ::close(fd);
+  if (ok == 0) return -2;                           // nothing served fast
+  return n / dt;
+}
+
+}  // extern "C"

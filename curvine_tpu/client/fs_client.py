@@ -68,6 +68,10 @@ class FsClient:
         # identity for master-side ACL checks (acl_feature.rs parity)
         self.user = cc.user or _os_user()
         self.groups = list(cc.groups) or _os_groups(self.user)
+        # native metadata fast path (master advertises it in MasterInfo)
+        self._fast_enabled = cc.fast_meta
+        self._fast_addr: str | None = None
+        self._fast_probe_after = 0.0     # monotonic; throttles rediscovery
 
     async def close(self) -> None:
         await self.pool.close()
@@ -91,9 +95,53 @@ class FsClient:
             except err.CurvineError as e:
                 if e.code in (err.ErrorCode.NOT_LEADER, err.ErrorCode.CONNECT):
                     self._active = (self._active + 1) % len(self.masters)
+                    # the fast plane follows the leader: rediscover it
+                    self._fast_addr = None
+                    self._fast_probe_after = 0.0
                 raise
 
         return await self.retry.run(once)
+
+    # ---------------- native metadata fast path ----------------
+
+    async def _fast_call(self, code: RpcCode, req: dict) -> dict | None:
+        """Try the master's native read plane; None → use the Python
+        port (not discovered, gated off, or the mirror can't answer).
+        Authoritative errors (e.g. PermissionDenied) propagate."""
+        import time as _time
+        if not self._fast_enabled:
+            return None
+        if self._fast_addr is None:
+            now = _time.monotonic()
+            if now < self._fast_probe_after:
+                return None
+            self._fast_probe_after = now + 30.0
+            try:
+                info = await self.master_info()
+                self._fast_addr = info.fast_addr or None
+            except Exception:  # noqa: BLE001 — discovery is best-effort
+                return None
+            if self._fast_addr is None:
+                return None
+        req = dict(req)
+        req.setdefault("user", self.user)
+        req.setdefault("groups", self.groups)
+        try:
+            conn = await self.pool.get(self._fast_addr)
+            rep = await conn.call(code, data=pack(req))
+            return unpack(rep.data) or {}
+        except err.CurvineError as e:
+            if e.code == err.ErrorCode.FAST_MISS:
+                if str(e) == "fast-gated":
+                    # non-leader plane: drop it so the next probe finds
+                    # the current leader's (otherwise every stat pays a
+                    # wasted round-trip here forever after a failover)
+                    self._fast_addr = None
+                return None
+            if e.code in (err.ErrorCode.CONNECT, err.ErrorCode.TIMEOUT):
+                self._fast_addr = None   # rediscover after the throttle
+                return None
+            raise
 
     # ---------------- namespace API ----------------
 
@@ -120,10 +168,15 @@ class FsClient:
         return FileBlocks.from_wire(rep["file_blocks"])
 
     async def exists(self, path: str) -> bool:
+        rep = await self._fast_call(RpcCode.EXISTS, {"path": path})
+        if rep is not None:
+            return rep["exists"]
         return (await self.call(RpcCode.EXISTS, {"path": path}))["exists"]
 
     async def file_status(self, path: str) -> FileStatus:
-        rep = await self.call(RpcCode.FILE_STATUS, {"path": path})
+        rep = await self._fast_call(RpcCode.FILE_STATUS, {"path": path})
+        if rep is None:
+            rep = await self.call(RpcCode.FILE_STATUS, {"path": path})
         return FileStatus.from_wire(rep["status"])
 
     async def list_status(self, path: str) -> list[FileStatus]:

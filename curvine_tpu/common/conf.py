@@ -47,6 +47,10 @@ class MasterConf:
     acl_enabled: bool = True
     superuser: str = "root"
     supergroup: str = "supergroup"
+    # native metadata read plane (csrc/meta_mirror.cc): FILE_STATUS and
+    # EXISTS served by C++ threads on a separate fast port; 0 = ephemeral
+    fast_meta: bool = True
+    fast_port: int = 0
     # audit/metrics
     audit_log: bool = False
     # raft (HA); empty peers → single-node journal mode
@@ -101,6 +105,8 @@ class ClientConf:
     conn_retry_max: int = 3
     conn_retry_base_ms: int = 100
     conn_pool_size: int = 4
+    # route stat/exists to the master's native fast port when advertised
+    fast_meta: bool = True
 
 
 @dataclass

@@ -38,6 +38,9 @@ class ErrorCode(enum.IntEnum):
     JOB_NOT_FOUND = 25
     CONNECT = 26
     UNCOMPLETED = 27
+    # the native metadata fast path cannot answer authoritatively;
+    # the caller must retry on the Python master port
+    FAST_MISS = 28
 
     # Errors where the operation may succeed if retried (possibly against a
     # different master/worker).
@@ -110,6 +113,7 @@ PermissionDenied = _make("PermissionDenied", ErrorCode.PERMISSION_DENIED)
 JobNotFound = _make("JobNotFound", ErrorCode.JOB_NOT_FOUND)
 ConnectError = _make("ConnectError", ErrorCode.CONNECT)
 Uncompleted = _make("Uncompleted", ErrorCode.UNCOMPLETED)
+FastMiss = _make("FastMiss", ErrorCode.FAST_MISS)
 
 _CODE_TO_CLASS: dict[ErrorCode, type[CurvineError]] = {
     c.code: c
@@ -119,6 +123,6 @@ _CODE_TO_CLASS: dict[ErrorCode, type[CurvineError]] = {
         BlockNotFound, WorkerNotFound, NoAvailableWorker, CapacityExceeded,
         QuotaExceeded, NotLeader, RpcTimeout, Cancelled, Unsupported,
         AbnormalData, UfsError, MountNotFound, PermissionDenied, JobNotFound,
-        ConnectError, Uncompleted,
+        ConnectError, Uncompleted, FastMiss,
     ]
 }

@@ -305,6 +305,8 @@ class CommitBlock(Wire):
 @dataclass
 class MasterInfo(Wire):
     active_master: str = ""
+    # native metadata read plane, when serving ("host:port"; empty = none)
+    fast_addr: str = ""
     journal_nodes: list[str] = field(default_factory=list)
     inode_num: int = 0
     block_num: int = 0

@@ -40,6 +40,19 @@ class MasterServer:
             meta_dir = mc.meta_dir or mc.journal_dir.rstrip("/") + "-meta"
             store = KvMetaStore(meta_dir, fsync=mc.journal_fsync,
                                 cache_inodes=mc.meta_cache_inodes)
+        # native metadata read plane: mirror every committed namespace
+        # mutation into C++ and serve stat/exists from native threads
+        self.fastmeta = None
+        if mc.fast_meta:
+            from curvine_tpu.master import fastmeta
+            if fastmeta.available():
+                if store is None:
+                    from curvine_tpu.master.store import MemMetaStore
+                    store = MemMetaStore()
+                self.fastmeta = fastmeta.FastMeta(
+                    acl_enabled=mc.acl_enabled, superuser=mc.superuser,
+                    supergroup=mc.supergroup)
+                store = fastmeta.MirroredStore(store, self.fastmeta)
         self.fs = MasterFilesystem(
             journal=j, placement=mc.block_placement_policy,
             lost_timeout_ms=mc.worker_lost_timeout_ms,
@@ -94,6 +107,17 @@ class MasterServer:
         gate = self._is_leader
         self.executor.submit_periodic("heartbeat-check",
                                       self._heartbeat_tick, interval)
+        if self.fastmeta is not None:
+            # bulk load AFTER recover (KV cold starts never replay old
+            # inodes through the store wrapper), then keep serving in
+            # lockstep with leadership
+            self.fastmeta.serve(self.conf.master.hostname,
+                                self.conf.master.fast_port)
+            self.fastmeta.load_from_store(self.fs.store)
+            self._fast_serving = False
+            self._fast_gate_tick()
+            self.executor.submit_periodic("fastmeta-gate",
+                                          self._fast_gate_tick, 1.0)
         self.executor.submit_periodic("lease-recovery",
                                       self._lease_recovery_tick, 30.0)
         self.executor.submit("ttl", self.ttl.run(leader_gate=gate))
@@ -105,6 +129,18 @@ class MasterServer:
 
     def _is_leader(self) -> bool:
         return self.raft is None or self.raft.is_leader
+
+    def _fast_gate_tick(self) -> None:
+        """Fast-path serving tracks leadership: followers mirror the
+        namespace (replicated applies flow through the same store
+        wrapper) but must not serve reads that bypass the leader."""
+        want = self._is_leader()
+        if want != self._fast_serving:
+            self.fastmeta.set_serving(want)
+            self._fast_serving = want
+            log.info("fast metadata plane %s (port %s)",
+                     "serving" if want else "gated off",
+                     self.fastmeta.port)
 
     def _lease_recovery_tick(self) -> None:
         if self._is_leader():
@@ -139,6 +175,8 @@ class MasterServer:
         await self.rpc.stop()
         if self.fs.journal:
             self.fs.journal.close()
+        if self.fastmeta is not None:
+            self.fastmeta.close()
         self.fs.store.close()
 
     # ---------------- handlers ----------------
@@ -393,7 +431,11 @@ class MasterServer:
         return {"file_blocks": self.fs.get_block_locations(q["path"]).to_wire()}
 
     def _master_info(self, q):
-        return {"info": self.fs.master_info(self.addr).to_wire()}
+        info = self.fs.master_info(self.addr)
+        if self.fastmeta is not None and self.fastmeta.port:
+            host = self.addr.rsplit(":", 1)[0]
+            info.fast_addr = f"{host}:{self.fastmeta.port}"
+        return {"info": info.to_wire()}
 
     def _set_attr(self, q):
         opts = SetAttrOpts.from_wire(q.get("opts", {}))

@@ -91,6 +91,9 @@ async def test_fault_injection_delay_error_drop():
     async with MiniCluster(workers=1) as mc:
         inj = FaultInjector().install(mc.master.rpc)
         c = mc.client()
+        # faults are injected into the PYTHON rpc server; stat/exists
+        # must not ride the native fast port around the injector here
+        c.meta._fast_enabled = False
         # error injection on FILE_STATUS
         fid = inj.add(FaultSpec(kind="error", codes=[int(RpcCode.FILE_STATUS)],
                                 error_code=int(cerr.ErrorCode.IO)))

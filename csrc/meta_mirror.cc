@@ -28,6 +28,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <cstdint>
@@ -46,10 +47,11 @@ namespace {
 
 using namespace cvwire;
 
-constexpr uint16_t kFileStatus = 7, kExists = 9;
+constexpr uint16_t kFileStatus = 7, kListStatus = 8, kExists = 9;
 constexpr uint8_t kFlagsReply = 1 | 4;             // RESPONSE | EOF
 constexpr int kErrPermissionDenied = 23;           // errors.py ErrorCode
 constexpr int kErrFastMiss = 28;                   // errors.py ErrorCode
+constexpr int kErrFastGated = 29;                  // errors.py ErrorCode
 constexpr int64_t kRootId = 1;
 constexpr uint32_t kMaxFrame = 1 << 20;            // metadata reqs are small
 
@@ -171,6 +173,9 @@ struct Mirror {
   mutable std::shared_mutex mu;
   std::unordered_map<int64_t, Rec> inodes;
   std::unordered_map<int64_t, std::unordered_map<std::string, int64_t>> dents;
+  // mount cv_paths: listings that intersect a mount merge UFS entries on
+  // the Python port, so the mirror must not answer them
+  std::vector<std::string> mounts;
 
   bool acl_enabled = true;
   std::string superuser = "root", supergroup = "supergroup";
@@ -244,16 +249,29 @@ struct Mirror {
 
   enum class Res { OK, MISS, DENIED };
 
+  // does `path` intersect any mount (equal, inside one, or an ancestor
+  // of one)? Caller holds mu.
+  bool mounts_intersect(const std::string& path) const {
+    for (auto& m : mounts) {
+      if (path == m || m == "/") return true;
+      if (path.compare(0, m.size(), m) == 0 && path[m.size()] == '/')
+        return true;                         // path inside mount
+      if (path == "/" ||
+          (m.compare(0, path.size(), path) == 0 && m[path.size()] == '/'))
+        return true;                         // path is a mount ancestor
+    }
+    return false;
+  }
+
   // Resolve `path` with traverse-x on every existing ancestor dir
   // (acl.py check(ctx, path, 0) semantics: the target's own bits are
   // the op's business; stat needs none). MISS covers both truly-absent
   // paths and anything odd — the Python port settles those.
-  Res resolve(const std::string& path, const std::string& user,
-              const std::vector<std::string>& groups, Rec& out,
-              std::string& denied_sub) const {
+  // Caller holds a shared lock on mu.
+  Res resolve_locked(const std::string& path, const std::string& user,
+                     const std::vector<std::string>& groups, bool skip_acl,
+                     const Rec** out, std::string& denied_sub) const {
     if (!canonical_path(path)) return Res::MISS;
-    bool skip_acl = !acl_enabled || is_super(user, groups);
-    std::shared_lock<std::shared_mutex> lk(mu);
     auto it = inodes.find(kRootId);
     if (it == inodes.end()) return Res::MISS;
     const Rec* node = &it->second;
@@ -266,7 +284,6 @@ struct Mirror {
       while (j < n && path[j] != '/') j++;
       std::string comp = path.substr(i, j - i);
       i = j;
-      if (comp == "." || comp == "..") return Res::MISS;  // Python's call
       // `node` is an ancestor of the remaining components: traverse x
       if (!node->is_dir()) return Res::MISS;
       if (!skip_acl && !(posix_bits(*node, user, groups) & 1)) {
@@ -282,8 +299,85 @@ struct Mirror {
       node = &nit->second;
       sub += "/" + comp;
     }
-    out = *node;
+    *out = node;
     return Res::OK;
+  }
+
+  Res resolve(const std::string& path, const std::string& user,
+              const std::vector<std::string>& groups, Rec& out,
+              std::string& denied_sub) const {
+    bool skip_acl = !acl_enabled || is_super(user, groups);
+    std::shared_lock<std::shared_mutex> lk(mu);
+    const Rec* node = nullptr;
+    Res r = resolve_locked(path, user, groups, skip_acl, &node, denied_sub);
+    if (r == Res::OK) out = *node;
+    return r;
+  }
+
+  // LIST_STATUS: master/server.py _list_status semantics minus the UFS
+  // merge (mount-intersecting paths fall back). Traverse on ancestors,
+  // R on the target when it is a dir; statuses sorted by entry name;
+  // a file lists as itself under the request path.
+  Res list_statuses(const std::string& path, const std::string& user,
+                    const std::vector<std::string>& groups,
+                    std::string& body, std::string& denied_sub,
+                    std::string& denied_perm) const {
+    bool skip_acl = !acl_enabled || is_super(user, groups);
+    std::shared_lock<std::shared_mutex> lk(mu);
+    if (mounts_intersect(path)) return Res::MISS;
+    const Rec* node = nullptr;
+    Res r = resolve_locked(path, user, groups, skip_acl, &node, denied_sub);
+    if (r != Res::OK) {
+      denied_perm = "traverse (x)";
+      return r;
+    }
+    if (node->is_dir() && !skip_acl &&
+        !(posix_bits(*node, user, groups) & 4)) {
+      denied_sub = path;
+      denied_perm = "r";
+      return Res::DENIED;
+    }
+    std::string base = path == "/" ? "" : path;
+    std::vector<std::pair<std::string, const Rec*>> entries;
+    mp_map(body, 1);
+    if (!node->is_dir()) {
+      pack_str(body, "statuses");
+      out_arr(body, 1);
+      encode_status(body, *node, path);
+      return Res::OK;
+    }
+    auto dit = dents.find(node->id);
+    if (dit != dents.end()) {
+      entries.reserve(dit->second.size());
+      for (auto& kv : dit->second) {
+        auto nit = inodes.find(kv.second);
+        if (nit != inodes.end())
+          entries.emplace_back(kv.first, &nit->second);
+      }
+    }
+    std::sort(entries.begin(), entries.end(),
+              [](auto& a, auto& b) { return a.first < b.first; });
+    pack_str(body, "statuses");
+    out_arr(body, static_cast<uint32_t>(entries.size()));
+    for (auto& e : entries)
+      encode_status(body, *e.second, base + "/" + e.first);
+    return Res::OK;
+  }
+
+  static void out_arr(std::string& o, uint32_t n) {
+    if (n < 16) {
+      o.push_back(static_cast<char>(0x90 | n));
+    } else if (n <= 0xFFFF) {
+      o.push_back('\xdc');
+      o.push_back(static_cast<char>(n >> 8));
+      o.push_back(static_cast<char>(n & 0xFF));
+    } else {
+      o.push_back('\xdd');
+      o.push_back(static_cast<char>(n >> 24));
+      o.push_back(static_cast<char>((n >> 16) & 0xFF));
+      o.push_back(static_cast<char>((n >> 8) & 0xFF));
+      o.push_back(static_cast<char>(n & 0xFF));
+    }
   }
 
   // ---------------- serving ----------------
@@ -311,14 +405,15 @@ struct Mirror {
 
   void handle(int fd, const Frame& req) {
     if (!serving.load(std::memory_order_relaxed)) {
-      // distinct message: a gated-off (non-leader) plane answers miss
-      // for EVERYTHING, so the client should drop this address and
-      // rediscover the leader's — unlike a per-path miss
+      // distinct CODE: a gated-off (non-leader) plane answers miss for
+      // EVERYTHING, so the client should drop this address and
+      // rediscover the leader's — unlike a per-path FAST_MISS
       fallbacks++;
-      reply_error(fd, req, kErrFastMiss, "fast-gated");
+      reply_error(fd, req, kErrFastGated, "fast-gated");
       return;
     }
-    if (req.code != kFileStatus && req.code != kExists) {
+    if (req.code != kFileStatus && req.code != kExists &&
+        req.code != kListStatus) {
       fallbacks++;
       reply_error(fd, req, kErrFastMiss, "fast-miss");
       return;
@@ -340,12 +435,15 @@ struct Mirror {
       reply_error(fd, req, kErrFastMiss, "fast-miss");
       return;
     }
-    Rec rec;
-    std::string denied_sub;
-    switch (resolve(path, user, groups, rec, denied_sub)) {
-      case Res::OK: {
-        served++;
-        std::string body;
+    std::string denied_sub, denied_perm = "traverse (x)";
+    std::string body;
+    Res r;
+    if (req.code == kListStatus) {
+      r = list_statuses(path, user, groups, body, denied_sub, denied_perm);
+    } else {
+      Rec rec;
+      r = resolve(path, user, groups, rec, denied_sub);
+      if (r == Res::OK) {
         if (req.code == kExists) {
           mp_map(body, 1);
           pack_str(body, "exists");
@@ -355,14 +453,19 @@ struct Mirror {
           pack_str(body, "status");
           encode_status(body, rec, path);
         }
+      }
+    }
+    switch (r) {
+      case Res::OK:
+        served++;
         reply(fd, req, 0, Value(), body);
         return;
-      }
       case Res::DENIED:
-        // identical wording to acl.py _deny(..., "traverse (x)")
+        // identical wording to acl.py _deny()
         denied++;
         reply_error(fd, req, kErrPermissionDenied,
-                    "user=" + user + " lacks traverse (x) on " + denied_sub);
+                    "user=" + user + " lacks " + denied_perm + " on " +
+                    denied_sub);
         return;
       case Res::MISS:
         fallbacks++;
@@ -482,6 +585,23 @@ void mm_clear(void* h) {
   std::unique_lock<std::shared_mutex> lk(m->mu);
   m->inodes.clear();
   m->dents.clear();
+  m->mounts.clear();
+}
+
+void mm_mount_add(void* h, const char* cv_path) {
+  auto* m = static_cast<Mirror*>(h);
+  std::unique_lock<std::shared_mutex> lk(m->mu);
+  std::string p = cv_path ? cv_path : "";
+  if (std::find(m->mounts.begin(), m->mounts.end(), p) == m->mounts.end())
+    m->mounts.push_back(p);
+}
+
+void mm_mount_remove(void* h, const char* cv_path) {
+  auto* m = static_cast<Mirror*>(h);
+  std::unique_lock<std::shared_mutex> lk(m->mu);
+  std::string p = cv_path ? cv_path : "";
+  m->mounts.erase(std::remove(m->mounts.begin(), m->mounts.end(), p),
+                  m->mounts.end());
 }
 
 void mm_put(void* h, int64_t id, int64_t parent_id, int ftype,
@@ -580,6 +700,11 @@ double mm_bench_stat(const char* host, int port, const char* path,
   freeaddrinfo(res);
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  // a wedged server must fail the bench, not hang it (and the callers'
+  // executor threads with it)
+  timeval tv{10, 0};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
 
   Value q = M();
   q.map.emplace_back("path", S(path));

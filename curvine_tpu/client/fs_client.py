@@ -8,6 +8,7 @@ from __future__ import annotations
 import itertools
 import logging
 import socket
+import time
 import uuid
 
 from curvine_tpu.common import errors as err
@@ -108,11 +109,10 @@ class FsClient:
         """Try the master's native read plane; None → use the Python
         port (not discovered, gated off, or the mirror can't answer).
         Authoritative errors (e.g. PermissionDenied) propagate."""
-        import time as _time
         if not self._fast_enabled:
             return None
         if self._fast_addr is None:
-            now = _time.monotonic()
+            now = time.monotonic()
             if now < self._fast_probe_after:
                 return None
             self._fast_probe_after = now + 30.0
@@ -132,11 +132,12 @@ class FsClient:
             return unpack(rep.data) or {}
         except err.CurvineError as e:
             if e.code == err.ErrorCode.FAST_MISS:
-                if str(e) == "fast-gated":
-                    # non-leader plane: drop it so the next probe finds
-                    # the current leader's (otherwise every stat pays a
-                    # wasted round-trip here forever after a failover)
-                    self._fast_addr = None
+                return None
+            if e.code == err.ErrorCode.FAST_GATED:
+                # non-leader plane: drop it so the next probe finds the
+                # current leader's (otherwise every stat pays a wasted
+                # round-trip here forever after a failover)
+                self._fast_addr = None
                 return None
             if e.code in (err.ErrorCode.CONNECT, err.ErrorCode.TIMEOUT):
                 self._fast_addr = None   # rediscover after the throttle
@@ -180,7 +181,9 @@ class FsClient:
         return FileStatus.from_wire(rep["status"])
 
     async def list_status(self, path: str) -> list[FileStatus]:
-        rep = await self.call(RpcCode.LIST_STATUS, {"path": path})
+        rep = await self._fast_call(RpcCode.LIST_STATUS, {"path": path})
+        if rep is None:
+            rep = await self.call(RpcCode.LIST_STATUS, {"path": path})
         return [FileStatus.from_wire(s) for s in rep["statuses"]]
 
     async def delete(self, path: str, recursive: bool = False) -> None:

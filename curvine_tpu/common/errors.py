@@ -41,6 +41,9 @@ class ErrorCode(enum.IntEnum):
     # the native metadata fast path cannot answer authoritatively;
     # the caller must retry on the Python master port
     FAST_MISS = 28
+    # the fast plane is gated off (non-leader): EVERY request will miss,
+    # so the caller should drop the address and rediscover the leader's
+    FAST_GATED = 29
 
     # Errors where the operation may succeed if retried (possibly against a
     # different master/worker).
@@ -114,6 +117,7 @@ JobNotFound = _make("JobNotFound", ErrorCode.JOB_NOT_FOUND)
 ConnectError = _make("ConnectError", ErrorCode.CONNECT)
 Uncompleted = _make("Uncompleted", ErrorCode.UNCOMPLETED)
 FastMiss = _make("FastMiss", ErrorCode.FAST_MISS)
+FastGated = _make("FastGated", ErrorCode.FAST_GATED)
 
 _CODE_TO_CLASS: dict[ErrorCode, type[CurvineError]] = {
     c.code: c
@@ -123,6 +127,6 @@ _CODE_TO_CLASS: dict[ErrorCode, type[CurvineError]] = {
         BlockNotFound, WorkerNotFound, NoAvailableWorker, CapacityExceeded,
         QuotaExceeded, NotLeader, RpcTimeout, Cancelled, Unsupported,
         AbnormalData, UfsError, MountNotFound, PermissionDenied, JobNotFound,
-        ConnectError, Uncompleted, FastMiss,
+        ConnectError, Uncompleted, FastMiss, FastGated,
     ]
 }

@@ -23,6 +23,7 @@ rebuild's native read plane over the Python mutation plane.
 
 from __future__ import annotations
 
+import copy
 import ctypes
 import logging
 import os
@@ -78,6 +79,8 @@ def _load():
     lib.mm_child_put.argtypes = [ctypes.c_void_p, c_i64, ctypes.c_char_p,
                                  c_i64]
     lib.mm_child_remove.argtypes = [ctypes.c_void_p, c_i64, ctypes.c_char_p]
+    lib.mm_mount_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.mm_mount_remove.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.mm_serve.restype = ctypes.c_int
     lib.mm_serve.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
     lib.mm_set_serving.argtypes = [ctypes.c_void_p, ctypes.c_int]
@@ -127,8 +130,12 @@ class FastMeta:
             self._h = None
 
     # ---- mirror maintenance (single writer: the master actor loop) ----
+    # Every method no-ops after close(): the MirroredStore wrapper keeps
+    # feeding mutations even if the serve plane was disabled at startup.
 
     def put_inode(self, node) -> None:
+        if not self._h:
+            return
         x = msgpack.packb(node.x_attr, use_bin_type=True) if node.x_attr \
             else b""
         sp = node.storage_policy
@@ -142,16 +149,29 @@ class FastMeta:
             int(sp.ttl_action), sp.ufs_mtime, int(sp.state))
 
     def remove_inode(self, inode_id: int) -> None:
-        self._lib.mm_remove(self._h, inode_id)
+        if self._h:
+            self._lib.mm_remove(self._h, inode_id)
 
     def child_put(self, parent_id: int, name: str, child_id: int) -> None:
-        self._lib.mm_child_put(self._h, parent_id, name.encode(), child_id)
+        if self._h:
+            self._lib.mm_child_put(self._h, parent_id, name.encode(),
+                                   child_id)
 
     def child_remove(self, parent_id: int, name: str) -> None:
-        self._lib.mm_child_remove(self._h, parent_id, name.encode())
+        if self._h:
+            self._lib.mm_child_remove(self._h, parent_id, name.encode())
+
+    def mount_add(self, cv_path: str) -> None:
+        if self._h:
+            self._lib.mm_mount_add(self._h, cv_path.encode())
+
+    def mount_remove(self, cv_path: str) -> None:
+        if self._h:
+            self._lib.mm_mount_remove(self._h, cv_path.encode())
 
     def clear(self) -> None:
-        self._lib.mm_clear(self._h)
+        if self._h:
+            self._lib.mm_clear(self._h)
 
     def load_from_store(self, store) -> None:
         """Bulk (re)load — called before enabling serving, on the master
@@ -161,6 +181,8 @@ class FastMeta:
             self.put_inode(node)
         for pid, name, cid in store.iter_children_all():
             self.child_put(pid, name, cid)
+        for wire in store.iter_mounts():
+            self.mount_add(wire["cv_path"])
 
     # ---- serving control ----
 
@@ -219,6 +241,10 @@ class MirroredStore:
             self._mirror.child_put(op[1], op[2], op[3])
         elif kind == "cdel":
             self._mirror.child_remove(op[1], op[2])
+        elif kind == "mput":
+            self._mirror.mount_add(op[1])
+        elif kind == "mdel":
+            self._mirror.mount_remove(op[1])
 
     def put(self, inode, new: bool = False) -> None:
         self._inner.put(inode, new=new)
@@ -226,7 +252,6 @@ class MirroredStore:
         # mutated again before commit — the last put wins either way,
         # but a buffered reference could also be mutated by a LATER
         # failed apply that rolls back, so copy at capture time)
-        import copy
         self._op(("put", copy.copy(inode) if not self._eager else inode))
 
     def remove(self, inode_id: int) -> None:
@@ -240,6 +265,14 @@ class MirroredStore:
     def child_remove(self, parent_id: int, name: str) -> None:
         self._inner.child_remove(parent_id, name)
         self._op(("cdel", parent_id, name))
+
+    def mount_put(self, cv_path: str, wire: dict) -> None:
+        self._inner.mount_put(cv_path, wire)
+        self._op(("mput", cv_path))
+
+    def mount_remove(self, cv_path: str) -> None:
+        self._inner.mount_remove(cv_path)
+        self._op(("mdel", cv_path))
 
     # -- commit surface --
     def commit_applied(self, seq: int) -> None:

@@ -110,14 +110,21 @@ class MasterServer:
         if self.fastmeta is not None:
             # bulk load AFTER recover (KV cold starts never replay old
             # inodes through the store wrapper), then keep serving in
-            # lockstep with leadership
-            self.fastmeta.serve(self.conf.master.hostname,
-                                self.conf.master.fast_port)
-            self.fastmeta.load_from_store(self.fs.store)
-            self._fast_serving = False
-            self._fast_gate_tick()
-            self.executor.submit_periodic("fastmeta-gate",
-                                          self._fast_gate_tick, 1.0)
+            # lockstep with leadership. The plane is best-effort: a bind
+            # failure degrades to Python-only, never a dead master.
+            try:
+                self.fastmeta.serve(self.conf.master.hostname,
+                                    self.conf.master.fast_port)
+                self.fastmeta.load_from_store(self.fs.store)
+            except Exception as e:  # noqa: BLE001 — degrade, don't die
+                log.warning("fast metadata plane disabled: %s", e)
+                self.fastmeta.close()
+                self.fastmeta = None
+            else:
+                self._fast_serving = False
+                self._fast_gate_tick()
+                self.executor.submit_periodic("fastmeta-gate",
+                                              self._fast_gate_tick, 1.0)
         self.executor.submit_periodic("lease-recovery",
                                       self._lease_recovery_tick, 30.0)
         self.executor.submit("ttl", self.ttl.run(leader_gate=gate))
@@ -432,7 +439,11 @@ class MasterServer:
 
     def _master_info(self, q):
         info = self.fs.master_info(self.addr)
-        if self.fastmeta is not None and self.fastmeta.port:
+        # advertise only a SERVING plane: a follower's fast port answers
+        # fast-gated for everything, and a client attached to a follower
+        # for reads would otherwise keep rediscovering the useless addr
+        if (self.fastmeta is not None and self.fastmeta.port
+                and self._is_leader()):
             host = self.addr.rsplit(":", 1)[0]
             info.fast_addr = f"{host}:{self.fastmeta.port}"
         return {"info": info.to_wire()}

@@ -51,6 +51,59 @@ def _attrs(**kw):
     return SetAttrOpts(**kw)
 
 
+async def _raw_list(client, addr: str, path: str, user="root",
+                    groups=None):
+    conn = await client.meta.pool.get(addr)
+    rep = await conn.call(RpcCode.LIST_STATUS, data=pack(
+        {"path": path, "user": user, "groups": groups or [user]}))
+    return unpack(rep.data)["statuses"]
+
+
+async def test_fast_list_wire_identical_to_python_port():
+    """LIST_STATUS: entry-for-entry, key-for-key parity incl. sort
+    order, file-as-target listing, empty dirs, and root."""
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.meta.mkdir("/ls/empty", create_parent=True)
+        for name in ("zz", "aa", "m.bin"):
+            w = await c.create(f"/ls/{name}")
+            await w.write(name.encode())
+            await w.close()
+        host = mc.master.addr.rsplit(":", 1)[0]
+        fast = f"{host}:{mc.master.fastmeta.port}"
+        for path in ("/ls", "/ls/empty", "/ls/m.bin", "/"):
+            slow = await _raw_list(c, mc.master.addr, path)
+            quick = await _raw_list(c, fast, path)
+            assert quick == slow, f"list divergence for {path}"
+        # via the client wrapper
+        names = [s.name for s in await c.meta.list_status("/ls")]
+        assert names == ["aa", "empty", "m.bin", "zz"]
+        await c.close()
+
+
+async def test_fast_list_mounted_paths_fall_back(tmp_path):
+    """Listings that intersect a mount merge UFS entries — the mirror
+    must decline them (before AND after the mount exists)."""
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        (tmp_path / "u.bin").write_bytes(b"z" * 9)
+        await c.meta.mkdir("/plain")
+        await c.meta.mount("/m/pt", f"file://{tmp_path}")
+        fb0 = mc.master.fastmeta.counters()["fallbacks"]
+        # inside the mount: uncached UFS object must appear
+        names = [s.name for s in await c.meta.list_status("/m/pt")]
+        assert "u.bin" in names
+        # ancestor of the mount: must also fall back (mount point dirs
+        # ride the cache namespace, but Python owns the merge semantics)
+        await c.meta.list_status("/m")
+        assert mc.master.fastmeta.counters()["fallbacks"] > fb0
+        # unrelated dir still serves fast
+        s0 = mc.master.fastmeta.counters()["served"]
+        await c.meta.list_status("/plain")
+        assert mc.master.fastmeta.counters()["served"] > s0
+        await c.close()
+
+
 async def test_fast_path_read_your_writes():
     """Every mutation kind is visible on the fast port immediately."""
     async with MiniCluster(workers=1) as mc:
@@ -180,7 +233,9 @@ async def test_fast_gating_tracks_leadership(tmp_path):
                 st = await _raw_status(_C, fast, "/gate")
                 assert st["is_dir"] is True
             else:
-                with pytest.raises(err.FastMiss):
+                # gated (non-leader) planes answer with the DISTINCT
+                # code that tells clients to drop the address
+                with pytest.raises(err.FastGated):
                     await _raw_status(_C, fast, "/gate")
 
         # failover: kill the leader, a follower takes over and its fast

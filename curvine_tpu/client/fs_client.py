@@ -133,16 +133,14 @@ class FsClient:
         except err.CurvineError as e:
             if e.code == err.ErrorCode.FAST_MISS:
                 return None
-            if e.code == err.ErrorCode.FAST_GATED:
-                # non-leader plane: drop it so the next probe finds the
-                # current leader's (otherwise every stat pays a wasted
-                # round-trip here forever after a failover)
-                self._fast_addr = None
-                return None
-            if e.code in (err.ErrorCode.CONNECT, err.ErrorCode.TIMEOUT):
-                self._fast_addr = None   # rediscover after the throttle
-                return None
-            raise
+            if e.code == err.ErrorCode.PERMISSION_DENIED:
+                raise                    # authoritative: ACL-exact denial
+            # FAST_GATED (non-leader), CONNECT/TIMEOUT, and anything
+            # unexpected: drop the address and use the Python port —
+            # the fast plane is best-effort and must never turn an
+            # answerable request into a hard failure
+            self._fast_addr = None
+            return None
 
     # ---------------- namespace API ----------------
 

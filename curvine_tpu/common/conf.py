@@ -117,6 +117,9 @@ class FuseConf:
     entry_ttl_ms: int = 1_000
     max_write: int = 1024 * 1024
     workers: int = 2
+    # in-place/random writes: files up to this size are staged in RAM and
+    # rewritten to the cache at release (0 disables → EOPNOTSUPP)
+    inplace_max_mb: int = 256
 
 
 @dataclass

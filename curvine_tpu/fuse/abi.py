@@ -73,6 +73,7 @@ class Errno:
     EISDIR = 21
     EINVAL = 22
     ENOSPC = 28
+    EFBIG = 27
     EROFS = 30
     ENOSYS = 38
     ENOTEMPTY = 39

@@ -66,7 +66,8 @@ async def mount_and_serve(conf: ClusterConf) -> None:
                        attr_ttl_ms=conf.fuse.attr_ttl_ms,
                        entry_ttl_ms=conf.fuse.entry_ttl_ms,
                        max_write=conf.fuse.max_write,
-                       uid=os.getuid(), gid=os.getgid())
+                       uid=os.getuid(), gid=os.getgid(),
+                       inplace_max_mb=conf.fuse.inplace_max_mb)
     session = FuseSession(fs, fd, max_write=conf.fuse.max_write)
     log.info("fuse mounted at %s", conf.fuse.mount_point)
     try:

@@ -71,12 +71,76 @@ def _fuse_errno(e: cerr.CurvineError) -> int:
     return _ERRNO_MAP.get(e.code, Errno.EIO)
 
 
-class _Handle:
-    __slots__ = ("reader", "writer", "entries", "path", "lock", "pending")
+class _StagedFile:
+    """RAM-staged file content for in-place / random-offset writes.
 
-    def __init__(self, reader=None, writer=None, entries=None, path=""):
+    Cache files are immutable once complete (sequential-write object
+    semantics, matching curvine-fuse/src/fs/fuse_writer.rs); an in-place
+    open therefore stages the WHOLE file in memory, applies writes at
+    arbitrary offsets, and rewrites the object at release (or fsync).
+    This is what makes editors, fio rand-write, and O_RDWR
+    read-after-write patterns work over the mount for files up to
+    fuse.inplace_max_mb; larger files keep the honest EOPNOTSUPP.
+    Last-close-wins across concurrent handles (no shared page cache)."""
+
+    __slots__ = ("client", "path", "buf", "dirty", "cap")
+
+    def __init__(self, client, path: str, data: bytes, cap: int,
+                 dirty: bool = False):
+        self.client = client
+        self.path = path
+        self.buf = bytearray(data)
+        self.dirty = dirty
+        self.cap = cap
+
+    @property
+    def pos(self) -> int:           # _open_writers live-size contract
+        return len(self.buf)
+
+    exact_size = True               # getattr: len(buf) IS the size
+
+    def _check_cap(self, size: int) -> None:
+        # growth through the handle honors the same bound as the open
+        # (a 1TB ftruncate must not OOM the mount process)
+        if size > self.cap:
+            raise FuseError(Errno.EFBIG)
+
+    def pwrite(self, offset: int, data) -> None:
+        end = offset + len(data)
+        self._check_cap(end)
+        if offset > len(self.buf):
+            self.buf.extend(b"\x00" * (offset - len(self.buf)))
+        if end > len(self.buf):
+            self.buf.extend(b"\x00" * (end - len(self.buf)))
+        self.buf[offset:end] = data
+        self.dirty = True
+
+    def pread(self, offset: int, size: int) -> bytes:
+        return bytes(self.buf[offset:offset + size])
+
+    def truncate(self, size: int) -> None:
+        self._check_cap(size)
+        if size < len(self.buf):
+            del self.buf[size:]
+        else:
+            self.buf.extend(b"\x00" * (size - len(self.buf)))
+        self.dirty = True
+
+    async def persist(self) -> None:
+        if self.dirty:
+            await self.client.write_all(self.path, bytes(self.buf))
+            self.dirty = False
+
+
+class _Handle:
+    __slots__ = ("reader", "writer", "staged", "entries", "path", "lock",
+                 "pending")
+
+    def __init__(self, reader=None, writer=None, staged=None, entries=None,
+                 path=""):
         self.reader = reader
         self.writer = writer
+        self.staged = staged
         self.entries = entries
         self.path = path
         import asyncio
@@ -88,12 +152,14 @@ class _Handle:
 class CurvineFuseFs:
     def __init__(self, client, fs_root: str = "/", attr_ttl_ms: int = 1000,
                  entry_ttl_ms: int = 1000, max_write: int = 1024 * 1024,
-                 uid: int = 0, gid: int = 0):
+                 uid: int = 0, gid: int = 0,
+                 inplace_max_mb: int = 256):
         self.client = client
         self.fs_root = fs_root.rstrip("/") or ""
         self.attr_ttl = attr_ttl_ms
         self.entry_ttl = entry_ttl_ms
         self.max_write = max_write
+        self.inplace_max = inplace_max_mb * 1024 * 1024
         self.uid, self.gid = uid, gid
         self.nodes: dict[int, str] = {ROOT_ID: self.fs_root or "/"}
         self.ids: dict[str, int] = {self.fs_root or "/": ROOT_ID}
@@ -234,7 +300,10 @@ class CurvineFuseFs:
         st = await self.client.meta.file_status(path)
         w = self._open_writers.get(path)
         if w is not None:
-            st.len = max(st.len, w.pos)     # in-flight write: live size
+            if getattr(w, "exact_size", False):
+                st.len = w.pos              # staged handle: buffer IS size
+            else:
+                st.len = max(st.len, w.pos)  # in-flight write: live size
         av, avn = divmod(self.attr_ttl, 1000)
         return abi.ATTR_OUT.pack(av, avn * 1_000_000, 0) + \
             self._attr(hdr.nodeid, st)
@@ -260,14 +329,30 @@ class CurvineFuseFs:
                (opts.mode, opts.atime, opts.mtime)):
             await self.client.meta.set_attr(path, opts)
         if valid & abi.SetattrValid.SIZE:
-            st = await self.client.meta.file_status(path)
-            if size == 0 and st.len != 0:
-                await self.client.write_all(path, b"")
-            elif size < st.len:
-                await self.client.meta.resize_file(path, size)
-            elif size > st.len:
-                raise FuseError(Errno.EOPNOTSUPP)
+            w = self._open_writers.get(path)
+            if getattr(w, "exact_size", False):
+                # ftruncate on an open in-place handle: buffer-only; the
+                # object rewrites at release
+                w.truncate(size)
+            else:
+                st = await self.client.meta.file_status(path)
+                if size == 0 and st.len != 0:
+                    await self.client.write_all(path, b"")
+                elif size < st.len:
+                    await self.client.meta.resize_file(path, size)
+                elif size > st.len:
+                    # truncate(2) EXTEND: zero-pad and rewrite (bounded
+                    # like the in-place open path)
+                    if size > self.inplace_max:
+                        raise FuseError(Errno.EOPNOTSUPP)
+                    data = await self.client.read_all(path) if st.len \
+                        else b""
+                    await self.client.write_all(
+                        path, data + b"\x00" * (size - len(data)))
         st = await self.client.meta.file_status(path)
+        w = self._open_writers.get(path)
+        if getattr(w, "exact_size", False):
+            st.len = w.pos                  # staged: buffer IS the size
         av, avn = divmod(self.attr_ttl, 1000)
         return abi.ATTR_OUT.pack(av, avn * 1_000_000, 0) + \
             self._attr(hdr.nodeid, st)
@@ -354,19 +439,36 @@ class CurvineFuseFs:
             if flags & os.O_APPEND:
                 writer = await self.client.append(path)
             elif flags & os.O_TRUNC:
+                if acc == os.O_RDWR:
+                    # reads come through this fd too: stage (empty after
+                    # trunc; dirty when the trunc itself must persist)
+                    st = await self.client.meta.file_status(path)
+                    return self._open_staged(path, b"", dirty=st.len != 0)
                 writer = await self.client.create(path, overwrite=True)
             else:
                 # kernels without ATOMIC_O_TRUNC truncate via SETATTR then
-                # open without O_TRUNC — a zero-length target is fine; an
-                # in-place rewrite of real data is not (sequential-write
-                # cache semantics)
+                # open without O_TRUNC — a zero-length target streams; a
+                # non-empty target is an IN-PLACE open: stage the content
+                # in RAM and rewrite the object at release (bounded by
+                # fuse.inplace_max_mb; beyond that, honest EOPNOTSUPP)
                 st = await self.client.meta.file_status(path)
-                if st.len == 0:
+                if st.len == 0 and acc != os.O_RDWR:
                     writer = await self.client.create(path, overwrite=True)
+                elif st.len <= self.inplace_max:
+                    data = await self.client.read_all(path) if st.len else b""
+                    return self._open_staged(path, data)
                 else:
                     raise FuseError(Errno.EOPNOTSUPP)
             fh = self._new_fh(_Handle(writer=writer, path=path))
             self._open_writers[path] = writer
+        return abi.OPEN_OUT.pack(fh, 0, 0)
+
+    def _open_staged(self, path: str, data: bytes,
+                     dirty: bool = False) -> bytes:
+        staged = _StagedFile(self.client, path, data, self.inplace_max,
+                             dirty=dirty)
+        fh = self._new_fh(_Handle(staged=staged, path=path))
+        self._open_writers[path] = staged
         return abi.OPEN_OUT.pack(fh, 0, 0)
 
     async def op_create(self, hdr, payload) -> bytes:
@@ -375,26 +477,46 @@ class CurvineFuseFs:
         path = self._child(hdr.nodeid, name)
         await self._await_local_release(path)
         exists = await self.client.meta.exists(path)
-        if exists:
-            if flags & os.O_EXCL:
-                raise FuseError(Errno.EEXIST)
-            if not flags & os.O_TRUNC:
-                # mirror op_open's allowance: a stale negative dentry can
-                # turn open(O_CREAT) of an EMPTY existing file into CREATE
-                # — overwriting zero bytes is not an in-place rewrite
-                st = await self.client.meta.file_status(path)
-                if st.len != 0:
+        acc = flags & os.O_ACCMODE
+        staged = None
+        if exists and not flags & os.O_EXCL and not flags & os.O_TRUNC:
+            # stale negative dentry turned open(O_CREAT) of an existing
+            # file into CREATE: empty targets stream; non-empty targets
+            # take the staged in-place path (op_open parity)
+            st0 = await self.client.meta.file_status(path)
+            if st0.len != 0:
+                if st0.len > self.inplace_max:
                     raise FuseError(Errno.EOPNOTSUPP)
-        writer = await self.client.create(path, overwrite=exists)
+                staged = _StagedFile(self.client, path,
+                                     await self.client.read_all(path),
+                                     self.inplace_max)
+        elif exists and flags & os.O_EXCL:
+            raise FuseError(Errno.EEXIST)
+        if staged is None:
+            if acc == os.O_RDWR:
+                # reads ride this fd: persist an empty object now, stage
+                # content in RAM (read-after-write within the handle)
+                await self.client.write_all(path, b"")
+                staged = _StagedFile(self.client, path, b"",
+                                     self.inplace_max)
+            else:
+                writer = await self.client.create(path, overwrite=exists)
         await self.client.meta.set_attr(path, SetAttrOpts(mode=mode & 0o7777))
         st = await self.client.meta.file_status(path)
-        fh = self._new_fh(_Handle(writer=writer, path=path))
-        self._open_writers[path] = writer
+        if staged is not None:
+            fh = self._new_fh(_Handle(staged=staged, path=path))
+            self._open_writers[path] = staged
+        else:
+            fh = self._new_fh(_Handle(writer=writer, path=path))
+            self._open_writers[path] = writer
         return self._entry(path, st) + abi.OPEN_OUT.pack(fh, 0, 0)
 
     async def op_read(self, hdr, payload):
         fh, offset, size, *_ = abi.READ_IN.unpack_from(payload, 0)
         h = self._fh(fh)
+        if h.staged is not None:
+            async with h.lock:
+                return h.staged.pread(offset, size)
         if h.reader is None:
             raise FuseError(Errno.EINVAL)
         # numpy buffer (preadv fast path); the session writes it with
@@ -405,6 +527,11 @@ class CurvineFuseFs:
         fh, offset, size, *_ = abi.WRITE_IN.unpack_from(payload, 0)
         data = payload[abi.WRITE_IN.size:abi.WRITE_IN.size + size]
         h = self._fh(fh)
+        if h.staged is not None:
+            # in-place handle: any offset, no ordering constraints
+            async with h.lock:
+                h.staged.pwrite(offset, data)
+            return abi.WRITE_OUT.pack(size, 0)
         if h.writer is None:
             raise FuseError(Errno.EINVAL)
         # the kernel issues writes concurrently: serialize per handle and
@@ -441,6 +568,13 @@ class CurvineFuseFs:
                     # still-open dup may yet fill the gap before RELEASE
                     raise FuseError(Errno.EIO)
                 await h.writer.hflush()
+        # staged handles persist at FLUSH too: close(2) is the only
+        # syscall that can surface a failed rewrite to the caller
+        # (RELEASE errors vanish in the kernel). persist() no-ops when
+        # clean, so dup-close storms rewrite at most once per dirty span
+        if h and h.staged is not None:
+            async with h.lock:
+                await h.staged.persist()
         return b""
 
     async def op_fsync(self, hdr, payload) -> bytes:
@@ -448,6 +582,9 @@ class CurvineFuseFs:
         h = self.handles.get(fh)
         if h and h.writer is not None:
             await h.writer.flush()
+        if h and h.staged is not None:      # fsync(2) demands durability
+            async with h.lock:
+                await h.staged.persist()
         return b""
 
     async def op_release(self, hdr, payload) -> bytes:
@@ -461,6 +598,12 @@ class CurvineFuseFs:
                     else:
                         await h.writer.close()
                     self._open_writers.pop(h.path, None)
+            if h.staged is not None:        # rewrite the object if dirty
+                async with h.lock:
+                    try:
+                        await h.staged.persist()
+                    finally:
+                        self._open_writers.pop(h.path, None)
             if h.reader is not None:
                 await h.reader.close()
         return b""

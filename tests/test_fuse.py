@@ -210,7 +210,8 @@ def test_fuse_over_ufs_mount(tmp_path):
 
 async def test_create_excl_and_trunc_semantics():
     """O_CREAT|O_EXCL on an existing file must fail EEXIST (not truncate);
-    non-truncating write opens are rejected, O_TRUNC ones succeed."""
+    non-truncating write opens stage in-place up to the cap and are
+    rejected beyond it; O_TRUNC ones succeed."""
     from curvine_tpu.fuse.ops import CurvineFuseFs, FuseError
 
     async with MiniCluster(workers=1) as mc:
@@ -230,10 +231,25 @@ async def test_create_excl_and_trunc_semantics():
         assert ei.value.errno == abi.Errno.EEXIST
         assert await (await c.open("/keep.txt")).read_all() == b"precious"
 
-        # non-truncating write open of an existing file: EOPNOTSUPP
+        # non-truncating write open of an existing file: staged in-place
+        # handle (content preserved until the handle mutates it); with
+        # the cap disabled it stays EOPNOTSUPP
         wr = os.O_WRONLY | os.O_CREAT
+        out = await fs.op_create(
+            hdr(abi.Op.CREATE),
+            memoryview(abi.CREATE_IN.pack(wr, 0o644, 0o022, 0)
+                       + b"keep.txt\x00"))
+        fh0, _, _ = abi.OPEN_OUT.unpack_from(out, abi.ENTRY_OUT.size
+                                             + abi.ATTR.size)
+        await fs.op_release(hdr(abi.Op.RELEASE),
+                            memoryview(abi.RELEASE_IN.pack(fh0, 0, 0, 0)))
+        assert await (await c.open("/keep.txt")).read_all() == b"precious"
+        fs_nocap = CurvineFuseFs(c, inplace_max_mb=0)
+        await fs_nocap.op_init(hdr(abi.Op.INIT),
+                               memoryview(abi.INIT_IN.pack(7, 31, 65536,
+                                                           0xFFFFFFFF)))
         with pytest.raises(FuseError) as ei:
-            await fs.op_create(
+            await fs_nocap.op_create(
                 hdr(abi.Op.CREATE),
                 memoryview(abi.CREATE_IN.pack(wr, 0o644, 0o022, 0)
                            + b"keep.txt\x00"))
@@ -320,9 +336,10 @@ def test_real_mount_fio_style_workloads(tmp_path):
     """The reference's headline bench is fio over FUSE; this runs the
     same access patterns (seq write, seq read, random 4k reads) as POSIX
     IO against a real kernel mount and asserts they complete correctly.
-    In-place rewrite of committed data is the documented unsupported
-    pattern (docs/fuse-semantics.md) and must fail EOPNOTSUPP, not
-    corrupt."""
+    In-place rewrite beyond fuse.inplace_max_mb is the documented
+    unsupported pattern (docs/fuse-semantics.md) and must fail
+    EOPNOTSUPP, not corrupt (smaller files stage in RAM — see
+    test_real_mount_inplace_writes)."""
     import errno
     import random
     from curvine_tpu.fuse.mount import fusermount_mount, fusermount_umount
@@ -340,7 +357,8 @@ def test_real_mount_fio_style_workloads(tmp_path):
         client = asyncio.run_coroutine_threadsafe(
             asyncio.sleep(0, result=mc.client()), loop).result(10)
         fd = fusermount_mount(mnt)
-        fs = CurvineFuseFs(client, uid=os.getuid(), gid=os.getgid())
+        fs = CurvineFuseFs(client, uid=os.getuid(), gid=os.getgid(),
+                           inplace_max_mb=4)   # 8MB file stays unsupported
         session = FuseSession(fs, fd)
         asyncio.run_coroutine_threadsafe(session.run(), loop)
 
@@ -363,13 +381,122 @@ def test_real_mount_fio_style_workloads(tmp_path):
             off = rng.randrange(0, total - 4096)
             assert os.pread(fd2, 4096, off) == payload[off:off + 4096]
         os.close(fd2)
-        # documented unsupported pattern: in-place rewrite of committed
-        # data fails loudly (EOPNOTSUPP at open), never corrupts
+        # beyond the in-place cap: rewrite of committed data fails
+        # loudly (EOPNOTSUPP at open), never corrupts
         with pytest.raises(OSError) as ei:
-            os.open(f"{mnt}/fio.bin", os.O_WRONLY)   # no O_TRUNC
+            os.open(f"{mnt}/fio.bin", os.O_WRONLY)   # no O_TRUNC, 8MB > cap
         assert ei.value.errno == errno.EOPNOTSUPP
         with open(f"{mnt}/fio.bin", "rb", buffering=0) as f:
             assert f.read(bs) == payload[:bs]        # intact
+    finally:
+        fusermount_umount(mnt)
+        if session is not None:
+            session.stop()
+        asyncio.run_coroutine_threadsafe(mc.stop(), loop).result(30)
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(5)
+
+
+@pytest.mark.skipif(not FUSE_AVAILABLE, reason="no /dev/fuse")
+def test_real_mount_inplace_writes(tmp_path):
+    """In-place / random-offset writes over the kernel mount: files up
+    to fuse.inplace_max_mb stage in RAM and rewrite at close. Covers
+    the editor pattern (r+b seek/patch), fio-style random writes,
+    O_RDWR read-after-write, ftruncate shrink+extend, and fsync
+    durability mid-handle."""
+    from curvine_tpu.fuse.mount import fusermount_mount, fusermount_umount
+    from curvine_tpu.fuse.ops import CurvineFuseFs
+    from curvine_tpu.fuse.session import FuseSession
+
+    mnt = str(tmp_path / "mnt")
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    mc = MiniCluster(workers=1)
+    asyncio.run_coroutine_threadsafe(mc.start(), loop).result(30)
+    session = None
+    try:
+        client = asyncio.run_coroutine_threadsafe(
+            asyncio.sleep(0, result=mc.client()), loop).result(10)
+        fd = fusermount_mount(mnt)
+        fs = CurvineFuseFs(client, uid=os.getuid(), gid=os.getgid())
+        session = FuseSession(fs, fd)
+        asyncio.run_coroutine_threadsafe(session.run(), loop)
+
+        p = f"{mnt}/doc.bin"
+        base = bytearray(os.urandom(2 * 1024 * 1024))
+        with open(p, "wb") as f:
+            f.write(bytes(base))
+
+        # editor pattern: open r+, patch the middle, close
+        with open(p, "r+b") as f:
+            f.seek(100_000)
+            f.write(b"PATCHED")
+            f.seek(0)
+            head = f.read(16)           # read through the same fd
+            assert head == bytes(base[:16])
+        base[100_000:100_007] = b"PATCHED"
+        with open(p, "rb", buffering=0) as f:
+            assert f.read() == bytes(base)
+
+        # fio-style random 4k writes via os.pwrite
+        import random
+        rng = random.Random(1)
+        fd2 = os.open(p, os.O_WRONLY)
+        for _ in range(32):
+            off = rng.randrange(0, len(base) - 4096)
+            blob = os.urandom(4096)
+            os.pwrite(fd2, blob, off)
+            base[off:off + 4096] = blob
+        os.close(fd2)
+        with open(p, "rb", buffering=0) as f:
+            assert f.read() == bytes(base)
+
+        # write past EOF extends with zero fill in the hole
+        fd3 = os.open(p, os.O_WRONLY)
+        os.pwrite(fd3, b"tail", len(base) + 5000)
+        os.close(fd3)
+        base.extend(b"\x00" * 5000 + b"tail")
+        assert os.stat(p).st_size == len(base)
+        with open(p, "rb", buffering=0) as f:
+            assert f.read() == bytes(base)
+
+        # ftruncate on an open handle: shrink then extend
+        fd4 = os.open(p, os.O_RDWR)
+        os.ftruncate(fd4, 1000)
+        assert os.fstat(fd4).st_size == 1000
+        os.ftruncate(fd4, 2000)
+        os.fsync(fd4)                    # durability point mid-handle
+        os.close(fd4)
+        base = base[:1000] + b"\x00" * 1000
+        with open(p, "rb", buffering=0) as f:
+            assert f.read() == bytes(base)
+
+        # truncate(2) extend without an open handle
+        os.truncate(p, len(base) + 100)
+        assert os.stat(p).st_size == len(base) + 100
+        with open(p, "rb", buffering=0) as f:
+            assert f.read() == bytes(base) + b"\x00" * 100
+
+        # O_RDWR|O_CREAT new file: read-after-write within the handle
+        q = f"{mnt}/new.bin"
+        fd5 = os.open(q, os.O_RDWR | os.O_CREAT, 0o644)
+        os.pwrite(fd5, b"abcdef", 0)
+        assert os.pread(fd5, 6, 0) == b"abcdef"
+        os.close(fd5)
+        with open(q, "rb", buffering=0) as f:
+            assert f.read() == b"abcdef"
+
+        # growth through an open handle honors the cap (EFBIG, no OOM)
+        import errno as _errno
+        fd6 = os.open(q, os.O_RDWR)
+        with pytest.raises(OSError) as ei:
+            os.ftruncate(fd6, 300 * 1024 * 1024)   # > 256MB default cap
+        assert ei.value.errno == _errno.EFBIG
+        with pytest.raises(OSError) as ei:
+            os.pwrite(fd6, b"x", 400 * 1024 * 1024)
+        assert ei.value.errno == _errno.EFBIG
+        os.close(fd6)
     finally:
         fusermount_umount(mnt)
         if session is not None:

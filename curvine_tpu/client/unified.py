@@ -87,14 +87,16 @@ class CurvineClient:
     async def create(self, path: str, overwrite: bool = False,
                      replicas: int | None = None,
                      block_size: int | None = None,
-                     storage_type: str | None = None) -> FsWriter:
+                     storage_type: str | None = None,
+                     storage_policy: dict | None = None) -> FsWriter:
         cc = self.conf.client
         st = _TIERS.get(storage_type or cc.storage_type, StorageType.MEM)
         self._ensure_metrics_task()
+        extra = {"storage_policy": storage_policy} if storage_policy else {}
         await self.meta.create_file(
             path, overwrite=overwrite,
             replicas=replicas if replicas is not None else cc.replicas,
-            block_size=block_size or cc.block_size)
+            block_size=block_size or cc.block_size, **extra)
         return FsWriter(self.meta, path, self.pool,
                         block_size=block_size or cc.block_size,
                         chunk_size=cc.write_chunk_size, storage_type=st,
@@ -217,26 +219,34 @@ class CurvineClient:
 
     async def unified_open(self, path: str):
         """Open preferring cache; uncached files under a mount stream
-        directly from the UFS (FsReader-compatible UfsReader)."""
+        directly from the UFS (FsReader-compatible UfsReader). Cached
+        reads are wrapped so a mid-stream replica loss falls back to
+        the mounted object transparently (FallbackReader)."""
         st = await self.meta.file_status(path)
         try:
             cached = st.len == 0 or await self._has_cached_blocks(path, st)
         except err.FileNotFound:
             cached = False      # UFS-only object: no inode yet
         if cached:
-            return await self.open(path)
+            return FallbackReader(self, path, await self.open(path), st)
         from curvine_tpu.client.ufs_reader import UfsReader
         mount, ufs, uri = await self._ufs_for(path)
         return UfsReader(ufs, uri, st.len,
                          chunk_size=self.conf.client.read_chunk_size)
 
     async def load_from_ufs(self, path: str, replicas: int | None = None) -> int:
-        """Warm one file: UFS → cache (the worker-side of load tasks)."""
+        """Warm one file: UFS → cache (the worker-side of load tasks).
+        Records the UFS object's mtime in the storage policy so fallback
+        readers can detect a changed underlying object (ufs_mtime guard,
+        reference state::StoragePolicy parity)."""
         mount, ufs, uri = await self._ufs_for(path)
         st = await ufs.stat(uri)
         if st is None:
             raise err.FileNotFound(uri)
-        w = await self.create(path, overwrite=True, replicas=replicas)
+        from curvine_tpu.common.types import StoragePolicy
+        sp = StoragePolicy(ufs_mtime=st.mtime).to_wire()
+        w = await self.create(path, overwrite=True, replicas=replicas,
+                              storage_policy=sp)
         total = 0
         try:
             async for chunk in ufs.read(uri):
@@ -266,3 +276,135 @@ class CurvineClient:
             await self.write_all(path, data)
         except err.CurvineError as e:
             log.debug("cache copy of %s failed: %s", path, e)
+
+
+# errors that mean "the cached copy is unreachable", not "the request is
+# wrong" — only these divert a read to the UFS
+_FALLBACK_CODES = frozenset({
+    err.ErrorCode.BLOCK_NOT_FOUND, err.ErrorCode.WORKER_NOT_FOUND,
+    err.ErrorCode.NO_AVAILABLE_WORKER, err.ErrorCode.CONNECT,
+    err.ErrorCode.TIMEOUT, err.ErrorCode.IO, err.ErrorCode.ABNORMAL_DATA,
+})
+
+
+class FallbackReader:
+    """Cached read stream that survives losing every replica mid-read.
+
+    Parity: curvine-client/src/unified/ FallbackFsReader (and the Java
+    SDK's CurvineFallbackInputStream): when a cached block becomes
+    unreadable (workers died, block evicted under us), the stream
+    reopens against the mounted UFS object and RESUMES at the position
+    the caller's operation STARTED at — partial progress inside a
+    failed read() is re-read, never silently skipped. Consistency
+    follows the mount's write mode (reference fallback_read_test.rs
+    TC-12..21): FS-mode mounts (write-through) require the recorded
+    storage_policy.ufs_mtime to match the object or fail ABNORMAL_DATA;
+    CACHE-mode mounts serve the CURRENT object (it may have grown or
+    shrunk — a resume past its end fails instead of fabricating EOF).
+    Files outside any mount simply re-raise the original cache error.
+    """
+
+    def __init__(self, client: CurvineClient, path: str, primary, st):
+        self._client = client
+        self._path = path
+        self._r = primary            # FsReader until fallback, then UfsReader
+        self._st = st
+        self._fell_back = False
+
+    # reader surface delegates (len/pos live on the active reader)
+    @property
+    def len(self):
+        return self._r.len
+
+    @property
+    def pos(self):
+        return self._r.pos
+
+    def seek(self, pos: int) -> None:
+        self._r.seek(pos)
+
+    async def _fallback(self, cause: err.CurvineError, resume: int):
+        if self._fell_back or cause.code not in _FALLBACK_CODES:
+            raise cause
+        try:
+            mount, ufs, uri = await self._client._ufs_for(self._path)
+        except err.CurvineError:
+            raise cause              # not mounted: nothing to fall back to
+        ust = await ufs.stat(uri)
+        if ust is None:
+            raise cause
+        from curvine_tpu.common.types import WriteType
+        recorded = self._st.storage_policy.ufs_mtime
+        if mount.write_type == WriteType.FS:
+            # write-through mount: the object must be the exact
+            # generation that was cached — unknown mtimes refuse too
+            if not recorded or not ust.mtime or ust.mtime != recorded:
+                raise err.AbnormalData(
+                    f"{self._path}: UFS object generation unknown or "
+                    f"changed (mtime {ust.mtime} != recorded {recorded})"
+                ) from cause
+        elif ust.len < resume:
+            # CACHE mode serves the current object, but it shrank past
+            # the caller's offset (TC-18): resuming would fabricate EOF
+            raise err.AbnormalData(
+                f"{self._path}: UFS object shrank to {ust.len} below "
+                f"read offset {resume}") from cause
+        from curvine_tpu.client.ufs_reader import UfsReader
+        try:
+            await self._r.close()
+        except Exception:            # noqa: BLE001 — old stream is dead
+            pass
+        log.warning("read fallback to UFS for %s at offset %d (%s)",
+                    self._path, resume, cause)
+        self._r = UfsReader(ufs, uri, ust.len,
+                            chunk_size=self._client.conf.client
+                            .read_chunk_size)
+        self._r.seek(resume)
+        self._fell_back = True
+
+    async def _do(self, op: str, *args):
+        # resume point = the position the caller's op STARTED at; a
+        # failed read() may have advanced pos past bytes it then threw
+        # away, and those must be re-read on the fallback stream.
+        # read_all and the positional ops start from their own offsets,
+        # not pos (pread retries re-run with the same args).
+        resume = 0 if op != "read" else getattr(self._r, "pos", 0)
+        try:
+            return await getattr(self._r, op)(*args)
+        except err.CurvineError as e:
+            await self._fallback(e, resume)
+            return await getattr(self._r, op)(*args)
+
+    async def read(self, n: int = -1) -> bytes:
+        return await self._do("read", n)
+
+    async def read_all(self) -> bytes:
+        return await self._do("read_all")
+
+    async def pread(self, offset: int, n: int) -> bytes:
+        return await self._do("pread", offset, n)
+
+    async def pread_view(self, offset: int, n: int):
+        return await self._do("pread_view", offset, n)
+
+    async def mmap_view(self, offset: int, n: int):
+        # mmap is a short-circuit-only optimization; a None return makes
+        # callers take the pread path (which carries the fallback)
+        try:
+            return await self._r.mmap_view(offset, n)
+        except err.CurvineError:
+            return None
+
+    async def chunks(self, chunk_size: int | None = None):
+        # stream from the current position; a mid-iteration failure
+        # restarts chunking on the fallback reader at the same offset
+        while True:
+            data = await self._do("read", chunk_size
+                                  or self._client.conf.client
+                                  .read_chunk_size)
+            if not data:
+                return
+            yield data
+
+    async def close(self) -> None:
+        await self._r.close()

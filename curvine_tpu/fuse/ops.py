@@ -439,7 +439,7 @@ class CurvineFuseFs:
             if flags & os.O_APPEND:
                 writer = await self.client.append(path)
             elif flags & os.O_TRUNC:
-                if acc == os.O_RDWR:
+                if acc == os.O_RDWR and self.inplace_max > 0:
                     # reads come through this fd too: stage (empty after
                     # trunc; dirty when the trunc itself must persist)
                     st = await self.client.meta.file_status(path)
@@ -450,11 +450,13 @@ class CurvineFuseFs:
                 # open without O_TRUNC — a zero-length target streams; a
                 # non-empty target is an IN-PLACE open: stage the content
                 # in RAM and rewrite the object at release (bounded by
-                # fuse.inplace_max_mb; beyond that, honest EOPNOTSUPP)
+                # fuse.inplace_max_mb; 0 disables staging entirely and
+                # restores the honest EOPNOTSUPP)
                 st = await self.client.meta.file_status(path)
-                if st.len == 0 and acc != os.O_RDWR:
+                if st.len == 0 and (acc != os.O_RDWR
+                                    or self.inplace_max == 0):
                     writer = await self.client.create(path, overwrite=True)
-                elif st.len <= self.inplace_max:
+                elif st.len <= self.inplace_max and self.inplace_max > 0:
                     data = await self.client.read_all(path) if st.len else b""
                     return self._open_staged(path, data)
                 else:
@@ -493,7 +495,7 @@ class CurvineFuseFs:
         elif exists and flags & os.O_EXCL:
             raise FuseError(Errno.EEXIST)
         if staged is None:
-            if acc == os.O_RDWR:
+            if acc == os.O_RDWR and self.inplace_max > 0:
                 # reads ride this fd: persist an empty object now, stage
                 # content in RAM (read-after-write within the handle)
                 await self.client.write_all(path, b"")

@@ -228,3 +228,109 @@ async def test_load_job_resumes_after_master_restart():
         for i in range(6):
             st = await c.meta.file_status(f"/res/d/obj{i}.bin")
             assert st.len == 2048
+
+
+async def test_fallback_reader_survives_worker_loss(tmp_path):
+    """FallbackFsReader parity: a cached read that loses every replica
+    mid-stream continues transparently from the mounted UFS object at
+    the same offset; a CHANGED underlying object (ufs_mtime mismatch)
+    fails with ABNORMAL_DATA instead of serving mixed generations."""
+    import os
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        c.conf.client.short_circuit = False     # force the worker path
+        payload = os.urandom(512 * 1024)
+        (tmp_path / "obj.bin").write_bytes(payload)
+        await c.meta.mount("/fb", f"file://{tmp_path}")
+        n = await c.load_from_ufs("/fb/obj.bin")
+        assert n == len(payload)
+        # recorded consistency guard
+        st = await c.meta.file_status("/fb/obj.bin")
+        assert st.storage_policy.ufs_mtime > 0
+
+        r = await c.unified_open("/fb/obj.bin")
+        head = await r.pread(0, 100_000)
+        assert head == payload[:100_000]
+        await mc.kill_worker(0)                 # every replica gone
+        rest = await r.pread(100_000, len(payload) - 100_000)
+        assert head + rest == payload           # continued from the UFS
+        await r.close()
+
+        # sequential read() stream falls back mid-iteration too
+        r2 = await c.unified_open("/fb/obj.bin")
+        got = await r2.read(1000)
+        got += await r2.read(-1)
+        assert got == payload
+        await r2.close()
+
+
+async def test_fallback_reader_fs_mode_detects_changed_object(tmp_path):
+    """FS-mode (write-through) mounts demand the exact cached
+    generation: a changed UFS object fails ABNORMAL_DATA (reference
+    fallback_read_test.rs TC-12)."""
+    import os
+    from curvine_tpu.common.types import WriteType
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        c.conf.client.short_circuit = False
+        payload = os.urandom(64 * 1024)
+        f = tmp_path / "obj.bin"
+        f.write_bytes(payload)
+        await c.meta.mount("/fb2", f"file://{tmp_path}",
+                           write_type=int(WriteType.FS))
+        await c.load_from_ufs("/fb2/obj.bin")
+        # the UNDERLYING object changes after caching
+        f.write_bytes(os.urandom(64 * 1024))
+        os.utime(f, (1_700_000_000, 1_700_000_000))
+        r = await c.unified_open("/fb2/obj.bin")
+        await mc.kill_worker(0)
+        with pytest.raises(err.AbnormalData):
+            await r.read_all()
+        await r.close()
+
+
+async def test_fallback_reader_cache_mode_serves_current_object(tmp_path):
+    """CACHE-mode mounts serve the CURRENT object on fallback even if it
+    changed (reference TC-17/19/20/21) — but shrinking below the read
+    offset fails instead of fabricating EOF (TC-18)."""
+    import os
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        c.conf.client.short_circuit = False
+        f = tmp_path / "obj.bin"
+        f.write_bytes(os.urandom(64 * 1024))
+        f2 = tmp_path / "obj2.bin"
+        f2.write_bytes(os.urandom(64 * 1024))
+        await c.meta.mount("/fb3", f"file://{tmp_path}")
+        await c.load_from_ufs("/fb3/obj.bin")
+        await c.load_from_ufs("/fb3/obj2.bin")
+        grown = os.urandom(128 * 1024)              # grown AND changed
+        f.write_bytes(grown)
+        os.utime(f, (1_700_000_000, 1_700_000_000))
+        f2.write_bytes(b"tiny")                     # shrunk
+        os.utime(f2, (1_700_000_001, 1_700_000_001))
+        r = await c.unified_open("/fb3/obj.bin")
+        r2 = await c.unified_open("/fb3/obj2.bin")
+        await mc.kill_worker(0)
+        assert await r.read_all() == grown          # current generation
+        await r.close()
+        # shrink below the caller's offset: resume would lie about EOF
+        r2.seek(32 * 1024)
+        with pytest.raises(err.AbnormalData):
+            await r2.read(1024)
+        await r2.close()
+
+
+async def test_fallback_reader_unmounted_file_reraises():
+    """A plain cached file (no mount) with dead replicas keeps its
+    original cache error — there is nothing to fall back to."""
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        c.conf.client.short_circuit = False
+        await c.write_all("/plain.bin", b"x" * 4096)
+        r = await c.unified_open("/plain.bin")
+        await mc.kill_worker(0)
+        with pytest.raises(err.CurvineError) as ei:
+            await r.read_all()
+        assert not isinstance(ei.value, err.AbnormalData)
+        await r.close()

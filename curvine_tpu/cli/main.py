@@ -264,7 +264,28 @@ async def cmd_report(args):
 
 
 async def cmd_node(args):
-    await cmd_report(args)
+    c = await _client(args)
+    try:
+        action = getattr(args, "action", "list") or "list"
+        if action == "list":
+            info = await c.meta.master_info()
+            for w in info.live_workers + info.lost_workers:
+                print(f"{w.address.worker_id}\t"
+                      f"{w.address.hostname}:{w.address.rpc_port}\t"
+                      f"{w.state.name}")
+            return
+        from curvine_tpu.common.types import WorkerState
+        if not args.worker_id or not str(args.worker_id).isdigit():
+            print(f"usage: cv node {action} <worker_id>  "
+                  f"(see `cv node list`)", file=sys.stderr)
+            raise SystemExit(2)
+        state = await c.meta.decommission_worker(
+            int(args.worker_id), on=action == "decommission")
+        print(f"worker {args.worker_id}: {WorkerState(state).name}"
+              if state >= 0 else
+              f"worker {args.worker_id}: intent cleared (not registered)")
+    finally:
+        await c.close()
 
 
 async def cmd_mount(args):
@@ -495,7 +516,10 @@ def build_parser() -> argparse.ArgumentParser:
         A("-r", "--recursive", action="store_true"))
     add("blocks", cmd_blocks, A("path"))
     add("report", cmd_report)
-    add("node", cmd_node)
+    add("node", cmd_node,
+        A("action", nargs="?", default="list",
+          choices=["list", "decommission", "recommission"]),
+        A("worker_id", nargs="?"))
     add("mount", cmd_mount, A("cv_path"), A("ufs_path"),
         A("--auto-cache", dest="auto_cache", action="store_true"),
         A("--prop", action="append"))

@@ -289,6 +289,15 @@ class FsClient:
     async def report_metrics(self, counters: dict) -> None:
         await self.call(RpcCode.METRICS_REPORT, {"counters": counters})
 
+    async def decommission_worker(self, worker_id: int,
+                                  on: bool = True) -> int:
+        """Mark a worker draining (no new blocks; replicas re-replicate
+        elsewhere; DECOMMISSIONED once drained) or restore it."""
+        rep = await self.call(RpcCode.DECOMMISSION_WORKER,
+                              {"worker_id": worker_id, "on": on},
+                              mutate=True)
+        return rep["state"]
+
     # ---------------- mounts / jobs ----------------
 
     async def mount(self, cv_path: str, ufs_path: str,

@@ -359,20 +359,26 @@ class FallbackReader:
         self._r = UfsReader(ufs, uri, ust.len,
                             chunk_size=self._client.conf.client
                             .read_chunk_size)
-        self._r.seek(resume)
         self._fell_back = True
 
     async def _do(self, op: str, *args):
-        # resume point = the position the caller's op STARTED at; a
-        # failed read() may have advanced pos past bytes it then threw
-        # away, and those must be re-read on the fallback stream.
-        # read_all and the positional ops start from their own offsets,
-        # not pos (pread retries re-run with the same args).
-        resume = 0 if op != "read" else getattr(self._r, "pos", 0)
+        # resume point = the offset the caller's op STARTED at; a failed
+        # read() may have advanced pos past bytes it then threw away,
+        # and those must be re-read on the fallback stream. Positional
+        # ops resume at their own offset (the shrink guard needs it:
+        # a pread mid-file on a shrunken object must error, not EOF).
+        if op in ("pread", "pread_view"):
+            resume = args[0]
+        elif op == "read":
+            resume = getattr(self._r, "pos", 0)
+        else:
+            resume = 0
         try:
             return await getattr(self._r, op)(*args)
         except err.CurvineError as e:
             await self._fallback(e, resume)
+            if op == "read":
+                self._r.seek(resume)
             return await getattr(self._r, op)(*args)
 
     async def read(self, n: int = -1) -> bytes:

@@ -88,16 +88,19 @@ class BlockMap:
             self.locs.get(bid, {}).pop(worker_id, None)
         return affected
 
+    def desired_of(self, block_id: int) -> int:
+        d = self.desired.get(block_id)
+        if d is None:
+            durable = self.store.block_get(block_id)
+            d = self.desired[block_id] = durable[2] if durable else 1
+        return d
+
     def under_replicated(self) -> list[BlockMeta]:
         out = []
         for bid, locs in self.locs.items():
             if not locs:
                 continue
-            d = self.desired.get(bid)
-            if d is None:
-                durable = self.store.block_get(bid)
-                d = self.desired[bid] = durable[2] if durable else 1
-            if len(locs) < d:
+            if len(locs) < self.desired_of(bid):
                 meta = self.get(bid)
                 if meta is not None:
                     out.append(meta)

@@ -24,7 +24,7 @@ from curvine_tpu.common.journal import Journal
 from curvine_tpu.common.types import (
     CommitBlock, ExtendedBlock, FileBlocks, FileStatus, FileType, LocatedBlock,
     MasterInfo, SetAttrOpts, StoragePolicy, StorageState, StorageType,
-    TtlAction, WorkerInfo, now_ms,
+    TtlAction, WorkerInfo, WorkerState, now_ms,
 )
 from curvine_tpu.master.block_map import BlockMap
 from curvine_tpu.master.inode import Inode, InodeTree, ROOT_ID
@@ -228,7 +228,8 @@ class MasterFilesystem:
         state = {"next_id": self.store.get_counter("next_id", ROOT_ID + 1),
                  "next_block_id": self.store.get_counter("next_block_id", 1),
                  "inodes": inodes, "blocks": blocks,
-                 "jobs": list(self.store.iter_jobs())}
+                 "jobs": list(self.store.iter_jobs()),
+                 "deco": sorted(self.workers.deco_ids)}
         if self.mounts is not None:
             state["mounts"] = self.mounts.snapshot_state()
         return state
@@ -272,6 +273,9 @@ class MasterFilesystem:
             self.store.block_put(bid, blen, iid, rep)
         for wire in snap.get("jobs", []):
             self.store.job_put(wire["job_id"], wire)
+        self.workers.deco_ids = set(snap.get("deco", []))
+        for wid in self.workers.deco_ids:
+            self.store.deco_put(wid)
         if self.mounts is not None and "mounts" in snap:
             self.mounts.load_snapshot_state(snap["mounts"])
 
@@ -283,6 +287,24 @@ class MasterFilesystem:
 
     def _apply_noop(self) -> None:
         """Term-opening no-op (raft leader turnover)."""
+
+    def decommission_worker(self, worker_id: int, on: bool = True) -> None:
+        """Journaled decommission intent: survives restarts/failovers
+        (workers re-register from heartbeats, so intents can't live only
+        in the runtime worker map). Recommission is allowed for ABSENT
+        workers too — a durable intent for a long-gone worker must be
+        clearable. Parity: curvine-cli node --add/remove-decommission."""
+        if on:
+            self.workers.get(worker_id)      # raises WorkerNotFound
+        self._log("worker_deco", dict(worker_id=worker_id, on=on))
+
+    def _apply_worker_deco(self, worker_id: int, on: bool) -> None:
+        if on:
+            self.store.deco_put(worker_id)
+            self.workers.decommission(worker_id)
+        else:
+            self.store.deco_remove(worker_id)
+            self.workers.recommission(worker_id)
 
     def _apply_job_put(self, job: dict) -> None:
         """Durable job record (resume after restart/failover)."""
@@ -710,7 +732,9 @@ class MasterFilesystem:
                     w = self.workers.get(wid)
                 except err.WorkerNotFound:
                     continue
-                if w.state.value == 0:  # LIVE
+                # LIVE and DECOMMISSIONING replicas both serve reads
+                # (draining workers keep their data until re-replicated)
+                if w.state.value in (0, 2):
                     locs.append(w.address)
                     sts.append(loc.storage_type)
             out.append(LocatedBlock(
@@ -733,6 +757,12 @@ class MasterFilesystem:
     def worker_block_report(self, worker_id: int, held: dict,
                             storage_types: dict,
                             incremental: bool = False) -> dict:
+        w = self.workers.workers.get(worker_id)
+        if w is not None and w.state == WorkerState.DECOMMISSIONED:
+            # a drained worker's copies are surplus and were purged from
+            # the block map at drain completion — a report must not
+            # resurrect them as countable locations
+            return {"delete_blocks": []}
         held = {int(k): int(v) for k, v in held.items()}
         storage_types = {int(k): int(v) for k, v in storage_types.items()}
         orphans = self.blocks.apply_report(worker_id, held, storage_types,
@@ -798,7 +828,10 @@ class MasterFilesystem:
         return MasterInfo(
             active_master=addr, inode_num=self.tree.count(),
             block_num=self.blocks.count(), capacity=cap, available=avail,
-            fs_used=cap - avail, live_workers=self.workers.live_workers(),
+            fs_used=cap - avail,
+            # draining workers still serve and still report in: they
+            # belong in the live list (their state field says the rest)
+            live_workers=self.workers.serving_workers(),
             lost_workers=self.workers.lost_workers())
 
     # ==================== helpers ====================

@@ -76,14 +76,65 @@ class ReplicationManager:
             if under:
                 log.info("scan: %d under-replicated blocks", len(under))
                 self.enqueue(under)
+            self._drain_scan()
+
+    def _live_replicas(self, block_id: int) -> int:
+        from curvine_tpu.common.types import WorkerState
+        n = 0
+        for wid in self.fs.blocks.locs.get(block_id, {}):
+            w = self.fs.workers.workers.get(wid)
+            if w is not None and w.state == WorkerState.LIVE:
+                n += 1
+        return n
+
+    def _drain_scan(self) -> None:
+        """Decommission drain: every block on a DRAINING worker needs its
+        full replica count on LIVE workers; once a draining worker holds
+        no such deficit it flips to DECOMMISSIONED and can be removed.
+        Parity: the reference's decommission flow (node.rs +
+        replication manager)."""
+        from curvine_tpu.common.types import WorkerState
+        for w in self.fs.workers.decommissioning_workers():
+            wid = w.address.worker_id
+            held = self.fs.blocks.worker_blocks.get(wid, set())
+            pending = [bid for bid in held
+                       if self._live_replicas(bid)
+                       < self.fs.blocks.desired_of(bid)]
+            if pending:
+                log.info("drain: worker %d has %d blocks to re-replicate",
+                         wid, len(pending))
+                self.enqueue(pending)
+            else:
+                w.state = WorkerState.DECOMMISSIONED
+                # purge its block-map entries NOW: reads already exclude
+                # state-3 replicas, and stale locations would otherwise
+                # count toward replica totals forever, masking real
+                # under-replication after later failures
+                self.fs.blocks.worker_lost(wid)
+                log.info("worker %d fully drained: DECOMMISSIONED", wid)
 
     async def _replicate(self, block_id: int) -> None:
+        from curvine_tpu.common.types import WorkerState
         meta = self.fs.blocks.get(block_id)
-        if meta is None or len(meta.locs) >= meta.replicas or not meta.locs:
+        if meta is None or not meta.locs:
             return
-        src_id = next(iter(meta.locs))
+        # only LIVE replicas count toward the goal (a draining worker's
+        # copy is leaving); both LIVE and draining copies can be sources
+        serving = []
+        live = 0
+        for wid in meta.locs:
+            w = self.fs.workers.workers.get(wid)
+            if w is None:
+                continue
+            if w.state == WorkerState.LIVE:
+                live += 1
+                serving.append(w)
+            elif w.state == WorkerState.DECOMMISSIONING:
+                serving.insert(0, w)   # prefer draining the leaver
+        if live >= self.fs.blocks.desired_of(block_id) or not serving:
+            return
+        src = serving[0]
         try:
-            src = self.fs.workers.get(src_id)
             dst = self.replacement_worker(block_id, exclude=set())
         except err.CurvineError as e:
             log.debug("no replication target for block %d: %s", block_id, e)

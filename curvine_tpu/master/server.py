@@ -94,6 +94,9 @@ class MasterServer:
     async def start(self) -> None:
         self.fs.recover()
         self.mounts.load_from_store()
+        # durable decommission intents (KV cold starts skip replay, so
+        # runtime-only state would otherwise vanish on restart)
+        self.fs.workers.deco_ids |= set(self.fs.store.iter_deco())
         await self.rpc.start()
         if self.raft is not None:
             await self.raft.start()
@@ -163,7 +166,10 @@ class MasterServer:
         self._prune_worker_counters()
 
     def _prune_worker_counters(self) -> None:
-        live = {w.address.worker_id for w in self.fs.workers.live_workers()}
+        # draining workers still serve and still report: keep their
+        # counters or the aggregate gauges flap for the whole drain
+        live = {w.address.worker_id
+                for w in self.fs.workers.serving_workers()}
         if any(k not in live for k in self._worker_counters):
             self._worker_counters = {k: v for k, v
                                      in self._worker_counters.items()
@@ -224,6 +230,8 @@ class MasterServer:
         r(C.REQUEST_REPLACEMENT_WORKER, self._h(self._replacement_worker))
         r(C.REPORT_UNDER_REPLICATED_BLOCKS, self._h(self._report_under_replicated))
         r(C.REPORT_BLOCK_REPLICATION_RESULT, self._h(self._replication_result))
+        r(C.DECOMMISSION_WORKER, self._h(self._decommission_worker,
+                                         mutate=True))
         # mounts
         r(C.MOUNT, self._h(self._mount, mutate=True))
         r(C.UNMOUNT, self._h(self._umount, mutate=True))
@@ -576,6 +584,19 @@ class MasterServer:
         w = self.replication.replacement_worker(
             q["block_id"], set(q.get("exclude_workers", [])))
         return {"worker": w.address.to_wire()}
+
+    def _decommission_worker(self, q):
+        """cv node decommission/recommission: journaled intent, so it
+        survives restarts and failovers. Admin (superuser) only."""
+        ctx = UserCtx.from_req(q)
+        if self.acl.enabled and not self.acl._is_super(ctx):
+            from curvine_tpu.common import errors as cerr
+            raise cerr.PermissionDenied(
+                f"user={ctx.user}: decommission is superuser-only")
+        self.fs.decommission_worker(q["worker_id"],
+                                    on=q.get("on", True))
+        w = self.fs.workers.workers.get(q["worker_id"])
+        return {"state": int(w.state) if w is not None else -1}
 
     def _report_under_replicated(self, q):
         if not self._is_leader():

@@ -52,6 +52,7 @@ class MemMetaStore:
         self.counters: dict[str, int] = {}
         self.mounts_tbl: dict[str, dict] = {}
         self.jobs_tbl: dict[str, dict] = {}
+        self.deco_tbl: set[int] = set()
 
     # inodes
     def get(self, inode_id: int):
@@ -125,6 +126,16 @@ class MemMetaStore:
     def iter_jobs(self):
         return iter(list(self.jobs_tbl.values()))
 
+    # worker decommission intents (durable: KV cold starts skip replay)
+    def deco_put(self, worker_id: int) -> None:
+        self.deco_tbl.add(worker_id)
+
+    def deco_remove(self, worker_id: int) -> None:
+        self.deco_tbl.discard(worker_id)
+
+    def iter_deco(self):
+        return iter(sorted(self.deco_tbl))
+
     # counters
     def get_counter(self, name: str, default: int = 0) -> int:
         return self.counters.get(name, default)
@@ -152,6 +163,7 @@ class MemMetaStore:
         self.counters.clear()
         self.mounts_tbl.clear()
         self.jobs_tbl.clear()
+        self.deco_tbl.clear()
 
     def close(self) -> None:
         pass
@@ -349,6 +361,17 @@ class KvMetaStore:
     def iter_jobs(self):
         for _k, raw in self.kv.scan(prefix=b"J"):
             yield msgpack.unpackb(raw, raw=False, strict_map_key=False)
+
+    # ---- worker decommission intents ----
+    def deco_put(self, worker_id: int) -> None:
+        self._pending[b"D" + _U64.pack(worker_id)] = b"1"
+
+    def deco_remove(self, worker_id: int) -> None:
+        self._pending[b"D" + _U64.pack(worker_id)] = None
+
+    def iter_deco(self):
+        for k, _raw in self.kv.scan(prefix=b"D"):
+            yield _U64.unpack(k[1:])[0]
 
     # ---- counters ----
     def get_counter(self, name: str, default: int = 0) -> int:

@@ -19,6 +19,9 @@ class WorkerMap:
     def __init__(self, lost_timeout_ms: int = 30_000):
         self.workers: dict[int, WorkerInfo] = {}
         self.lost_timeout_ms = lost_timeout_ms
+        # decommission intents survive re-registration (and, journaled
+        # through MasterFilesystem, restarts and failovers)
+        self.deco_ids: set[int] = set()
 
     def heartbeat(self, address: WorkerAddress, storages: list[StorageInfo],
                   ici_coords: list[int] | None = None) -> WorkerInfo:
@@ -32,8 +35,13 @@ class WorkerMap:
         info.last_heartbeat_ms = now_ms()
         if ici_coords is not None:
             info.ici_coords = list(ici_coords)
-        if info.state == WorkerState.LOST:
-            log.info("worker %d back alive", address.worker_id)
+        if address.worker_id in self.deco_ids:
+            # a heartbeat must never resurrect a draining worker to LIVE
+            if info.state in (WorkerState.LIVE, WorkerState.LOST):
+                info.state = WorkerState.DECOMMISSIONING
+        elif info.state != WorkerState.LIVE:
+            if info.state == WorkerState.LOST:
+                log.info("worker %d back alive", address.worker_id)
             info.state = WorkerState.LIVE
         return info
 
@@ -50,11 +58,14 @@ class WorkerMap:
         return [w for w in self.workers.values() if w.state == WorkerState.LOST]
 
     def check_lost(self) -> list[WorkerInfo]:
-        """Mark workers whose heartbeat expired; returns newly-lost ones."""
+        """Mark workers whose heartbeat expired; returns newly-lost ones.
+        A DECOMMISSIONING worker that stops heartbeating is LOST too —
+        its replicas are gone, not merely draining."""
         deadline = now_ms() - self.lost_timeout_ms
         newly_lost = []
         for w in self.workers.values():
-            if w.state == WorkerState.LIVE and w.last_heartbeat_ms < deadline:
+            if w.state in (WorkerState.LIVE, WorkerState.DECOMMISSIONING) \
+                    and w.last_heartbeat_ms < deadline:
                 w.state = WorkerState.LOST
                 newly_lost.append(w)
                 log.warning("worker %d lost (no heartbeat for %dms)",
@@ -62,7 +73,30 @@ class WorkerMap:
         return newly_lost
 
     def decommission(self, worker_id: int) -> None:
-        self.get(worker_id).state = WorkerState.DECOMMISSIONING
+        """Stop placing new blocks on the worker; existing replicas keep
+        serving while the drain re-replicates them elsewhere. Parity:
+        curvine-cli node --add-decommission."""
+        self.deco_ids.add(worker_id)
+        w = self.workers.get(worker_id)
+        if w is not None and w.state == WorkerState.LIVE:
+            w.state = WorkerState.DECOMMISSIONING
+
+    def recommission(self, worker_id: int) -> None:
+        self.deco_ids.discard(worker_id)
+        w = self.workers.get(worker_id)
+        if w is not None and w.state in (WorkerState.DECOMMISSIONING,
+                                         WorkerState.DECOMMISSIONED):
+            w.state = WorkerState.LIVE
+
+    def decommissioning_workers(self) -> list[WorkerInfo]:
+        return [w for w in self.workers.values()
+                if w.state == WorkerState.DECOMMISSIONING]
+
+    def serving_workers(self) -> list[WorkerInfo]:
+        """Workers whose replicas are readable (LIVE + draining)."""
+        return [w for w in self.workers.values()
+                if w.state in (WorkerState.LIVE,
+                               WorkerState.DECOMMISSIONING)]
 
     def capacity(self) -> tuple[int, int]:
         cap = avail = 0

@@ -59,6 +59,7 @@ class RpcCode(enum.IntEnum):
     REPORT_BLOCK_REPLICATION_RESULT = 43
     REQUEST_REPLACEMENT_WORKER = 44
     REPORT_UNDER_REPLICATED_BLOCKS = 45
+    DECOMMISSION_WORKER = 46
 
     METRICS_REPORT = 60
 

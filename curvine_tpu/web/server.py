@@ -99,8 +99,11 @@ class WebServer:
         if self.master is None:
             return self._json([])
         fs = self.master.fs
-        return self._json([w.to_wire() for w in
-                           fs.workers.live_workers() + fs.workers.lost_workers()])
+        # EVERY known worker, whatever its state — an operator watching a
+        # drain must see the DECOMMISSIONING worker progress, and a
+        # DECOMMISSIONED one must stay visible as safe-to-remove
+        return self._json([w.to_wire()
+                           for w in fs.workers.workers.values()])
 
     async def _metrics_json(self, req):
         """Flat {name: value} of counters+gauges — feeds the dashboard's
@@ -113,6 +116,11 @@ class WebServer:
 
     async def _metrics(self, req):
         src = self.master or self.worker
+        if (self.master is not None
+                and getattr(self.master, "fastmeta", None) is not None):
+            # native read plane counters ride the same scrape
+            for k, v in self.master.fastmeta.counters().items():
+                self.master.metrics.gauge(f"fastmeta.{k}", v)
         text = src.metrics.prometheus_text() if src else ""
         return web.Response(text=text, content_type="text/plain")
 

@@ -1,0 +1,130 @@
+"""Worker decommission: drain without data loss.
+
+Parity: curvine-cli node --add/remove-decommission + the reference's
+replication-manager drain. A draining worker takes no new blocks, keeps
+serving its replicas, gets every block re-replicated onto LIVE workers,
+then flips DECOMMISSIONED; the intent is journaled so restarts and
+failovers keep honoring it.
+"""
+
+import asyncio
+
+import pytest
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.common.types import WorkerState
+from curvine_tpu.testing import MiniCluster
+
+
+async def _drain_until(mc, wid, state, timeout=15.0):
+    async def wait():
+        while True:
+            mc.master.replication._drain_scan()
+            w = mc.master.fs.workers.workers.get(wid)
+            if w is not None and w.state == state:
+                return w
+            await asyncio.sleep(0.1)
+    return await asyncio.wait_for(wait(), timeout)
+
+
+async def test_decommission_drains_then_completes():
+    async with MiniCluster(workers=2) as mc:
+        c = mc.client()
+        payload = b"d" * (256 * 1024)
+        await c.write_all("/deco/f.bin", payload)
+        fb = await c.meta.get_block_locations("/deco/f.bin")
+        holder = fb.block_locs[0].locs[0].worker_id
+        other = next(w.address.worker_id
+                     for w in mc.master.fs.workers.live_workers()
+                     if w.address.worker_id != holder)
+
+        state = await c.meta.decommission_worker(holder)
+        assert state == int(WorkerState.DECOMMISSIONING)
+        # replicas on the draining worker still serve reads
+        assert await c.read_all("/deco/f.bin") == payload
+        # placement skips it: new files land on the other worker only
+        for i in range(4):
+            await c.write_all(f"/deco/n{i}.bin", b"x" * 1024)
+            fb2 = await c.meta.get_block_locations(f"/deco/n{i}.bin")
+            assert all(loc.worker_id != holder
+                       for lb in fb2.block_locs for loc in lb.locs)
+
+        # the drain re-replicates its block and completes
+        await _drain_until(mc, holder, WorkerState.DECOMMISSIONED)
+        fb3 = await c.meta.get_block_locations("/deco/f.bin")
+        ids = {loc.worker_id for lb in fb3.block_locs for loc in lb.locs}
+        assert other in ids
+        assert await c.read_all("/deco/f.bin") == payload
+
+        # recommission restores LIVE placement eligibility
+        state = await c.meta.decommission_worker(holder, on=False)
+        assert state == int(WorkerState.LIVE)
+        assert holder in {w.address.worker_id
+                          for w in mc.master.fs.workers.live_workers()}
+        await c.close()
+
+
+async def test_drained_worker_locations_purged():
+    """After the drain completes, the worker's block-map entries are
+    gone (stale locations must not count toward replica totals and mask
+    under-replication later) and its block reports don't resurrect
+    them."""
+    async with MiniCluster(workers=2) as mc:
+        c = mc.client()
+        await c.write_all("/purge/f.bin", b"p" * 8192)
+        fb = await c.meta.get_block_locations("/purge/f.bin")
+        bid = fb.block_locs[0].block.id
+        holder = fb.block_locs[0].locs[0].worker_id
+        await c.meta.decommission_worker(holder)
+        await _drain_until(mc, holder, WorkerState.DECOMMISSIONED)
+        bm = mc.master.fs.blocks
+        assert holder not in bm.locs.get(bid, {})
+        assert bid not in bm.worker_blocks.get(holder, set())
+        # a full report from the drained worker must not re-add the loc
+        mc.master.fs.worker_block_report(holder, {bid: 8192}, {bid: 1})
+        assert holder not in bm.locs.get(bid, {})
+        # and the remaining live copy still reads back
+        assert await c.read_all("/purge/f.bin") == b"p" * 8192
+        await c.close()
+
+
+async def test_decommission_intent_survives_restart():
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.write_all("/deco2/f.bin", b"y" * 4096)
+        fb = await c.meta.get_block_locations("/deco2/f.bin")
+        wid = fb.block_locs[0].locs[0].worker_id
+        await c.meta.decommission_worker(wid)
+        await mc.restart_master()
+        # the worker re-registers via heartbeat; the journaled intent
+        # pins it to DECOMMISSIONING, not LIVE
+        async def wait():
+            while True:
+                w = mc.master.fs.workers.workers.get(wid)
+                if w is not None:
+                    return w
+                await asyncio.sleep(0.1)
+        w = await asyncio.wait_for(wait(), 15)
+        assert wid in mc.master.fs.workers.deco_ids
+        assert w.state == WorkerState.DECOMMISSIONING
+        c2 = mc.client()
+        await c2.close()
+        await c.close()
+
+
+async def test_decommission_requires_superuser():
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        wid = mc.master.fs.workers.live_workers()[0].address.worker_id
+        c.meta.user, c.meta.groups = "mallory", ["mallory"]
+        with pytest.raises(err.PermissionDenied):
+            await c.meta.decommission_worker(wid)
+        await c.close()
+
+
+async def test_decommission_unknown_worker():
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        with pytest.raises(err.WorkerNotFound):
+            await c.meta.decommission_worker(999_999)
+        await c.close()

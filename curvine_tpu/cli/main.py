@@ -250,8 +250,12 @@ async def cmd_report(args):
         print(f"Inodes: {info.inode_num}  Blocks: {info.block_num}")
         print(f"Capacity: {_human(info.capacity)}  "
               f"Available: {_human(info.available)}")
+        from curvine_tpu.common.types import WorkerState
+        retired = [w for w in info.lost_workers
+                   if w.state == WorkerState.DECOMMISSIONED]
         print(f"Live workers: {len(info.live_workers)}  "
-              f"Lost workers: {len(info.lost_workers)}")
+              f"Lost workers: {len(info.lost_workers) - len(retired)}"
+              + (f"  Decommissioned: {len(retired)}" if retired else ""))
         for w in info.live_workers:
             tiers = ", ".join(
                 f"{s.storage_type.name}:{_human(s.available)}/{_human(s.capacity)}"

@@ -143,8 +143,9 @@ class FsReader:
             lb, block_off = located
             seg = min(n - filled, lb.block.len - block_off)
             local = await self._local_path(lb)
-            if local is not None:
-                fd = self._fd_for(lb.block.id, local)
+            fd = self._fd_for(lb.block.id, local) if local is not None \
+                else None
+            if fd is not None:
                 base = self._local_offs.get(lb.block.id, 0)
                 got = os.preadv(fd, [memoryview(out[filled:filled + seg])],
                                 base + block_off)
@@ -181,10 +182,21 @@ class FsReader:
                 last_err = e
         raise last_err or err.BlockNotFound(f"block {lb.block.id} unreadable")
 
-    def _fd_for(self, block_id: int, path: str) -> int:
+    def _fd_for(self, block_id: int, path: str) -> int | None:
+        """Open (and cache) the block file fd. Once open, the fd stays
+        valid even if the worker moves the block between tiers (POSIX
+        unlink semantics keep the old copy complete); if the path is
+        already gone — the block was promoted/demoted/evicted between the
+        probe and this open — drop the cached path and let the caller
+        fall back to the socket read."""
         fd = self._local_fds.get(block_id)
         if fd is None:
-            fd = os.open(path, os.O_RDONLY)
+            try:
+                fd = os.open(path, os.O_RDONLY)
+            except OSError:
+                self._local_paths.pop(block_id, None)
+                self._local_offs.pop(block_id, None)
+                return None
             self._local_fds[block_id] = fd
         return fd
 
@@ -206,6 +218,8 @@ class FsReader:
         if local is None:
             return None
         fd = self._fd_for(lb.block.id, local)
+        if fd is None:
+            return None
         buf = np.empty(n, dtype=np.uint8)
         base = self._local_offs.get(lb.block.id, 0)
         got = os.preadv(fd, [memoryview(buf)], base + block_off)
@@ -222,8 +236,8 @@ class FsReader:
         lb, block_off = located
         n = min(n, lb.block.len - block_off)
         local = await self._local_path(lb)
-        if local is not None:
-            fd = self._fd_for(lb.block.id, local)
+        fd = self._fd_for(lb.block.id, local) if local is not None else None
+        if fd is not None:
             base = self._local_offs.get(lb.block.id, 0)
             data = os.pread(fd, n, base + block_off)
             self.counters["sc.bytes.read"] = \

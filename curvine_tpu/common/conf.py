@@ -80,6 +80,10 @@ class WorkerConf:
     # eviction watermarks (fraction of tier capacity)
     eviction_high_water: float = 0.95
     eviction_low_water: float = 0.80
+    # hot-data promotion: blocks read >= min_reads since the last scan
+    # move up to the fastest tier (0 disables the scan)
+    promote_interval_ms: int = 30_000
+    promote_min_reads: int = 3
     # TPU/ICI placement
     ici_coords: list[int] = field(default_factory=list)
     # hbm tier (bytes reserved on device for cache; 0 disables)
@@ -132,14 +136,22 @@ class ClusterConf:
     data_dir: str = "data"
 
     @staticmethod
-    def load(path: str | None = None) -> "ClusterConf":
-        """Load from TOML; CURVINE_CONF env var is the fallback location."""
-        path = path or os.environ.get("CURVINE_CONF", "")
+    def load(path: str | None = None,
+             env: dict | None = None) -> "ClusterConf":
+        """Load from TOML; CURVINE_CONF env var is the fallback location.
+        ``CURVINE_<SECTION>_<FIELD>`` env vars override file values
+        (container/k8s deployments configure through these):
+        ``CURVINE_CLIENT_MASTER_ADDRS=m1:8995,m2:8995``,
+        ``CURVINE_WORKER_RPC_PORT=9996``, ``CURVINE_DATA_DIR=/data``.
+        Values are coerced to the field's type (int/float/bool/list)."""
+        env = os.environ if env is None else env
+        path = path or env.get("CURVINE_CONF", "")
         conf = ClusterConf()
         if path and os.path.exists(path):
             with open(path, "rb") as f:
                 data = tomllib.load(f)
             _apply(conf, data)
+        _apply_env(conf, env)
         return conf
 
     def master_addr(self) -> str:
@@ -157,3 +169,37 @@ def _apply(obj, data: dict) -> None:
             obj.tiers = [TierConf(**t) for t in v]
         else:
             setattr(obj, k, v)
+
+
+def _coerce(cur, raw: str):
+    if isinstance(cur, bool):
+        return raw.strip().lower() in ("1", "true", "yes", "on")
+    if isinstance(cur, int):
+        return int(raw)
+    if isinstance(cur, float):
+        return float(raw)
+    if isinstance(cur, list):
+        return [s.strip() for s in raw.split(",") if s.strip()]
+    return raw
+
+
+def _apply_env(conf: "ClusterConf", env: dict) -> None:
+    sections = {"master": conf.master, "worker": conf.worker,
+                "client": conf.client, "fuse": conf.fuse}
+    for key, raw in env.items():
+        if not key.startswith("CURVINE_") or key == "CURVINE_CONF":
+            continue
+        rest = key[len("CURVINE_"):].lower()
+        section, _, field_name = rest.partition("_")
+        target = sections.get(section)
+        if target is None:          # top-level field: CURVINE_DATA_DIR
+            target, field_name = conf, rest
+        if not field_name or not hasattr(target, field_name):
+            continue
+        cur = getattr(target, field_name)
+        if dataclasses.is_dataclass(cur) or field_name == "tiers":
+            continue                # structured fields stay TOML-only
+        try:
+            setattr(target, field_name, _coerce(cur, raw))
+        except (TypeError, ValueError):
+            pass

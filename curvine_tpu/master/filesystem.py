@@ -767,6 +767,8 @@ class MasterFilesystem:
         storage_types = {int(k): int(v) for k, v in storage_types.items()}
         orphans = self.blocks.apply_report(worker_id, held, storage_types,
                                            incremental=incremental)
+        if not incremental:
+            self.workers.mark_reported(worker_id)
         # report-driven len bumps are durable but not journaled: persist
         # them now so they don't ride some later entry's atomic batch
         self.store.commit_runtime()
@@ -830,9 +832,12 @@ class MasterFilesystem:
             block_num=self.blocks.count(), capacity=cap, available=avail,
             fs_used=cap - avail,
             # draining workers still serve and still report in: they
-            # belong in the live list (their state field says the rest)
+            # belong in the live list (their state field says the rest);
+            # fully-drained DECOMMISSIONED workers ride the lost list so
+            # `cv node list` keeps showing the safe-to-remove signal
             live_workers=self.workers.serving_workers(),
-            lost_workers=self.workers.lost_workers())
+            lost_workers=(self.workers.lost_workers()
+                          + self.workers.retired_workers()))
 
     # ==================== helpers ====================
 

@@ -91,15 +91,36 @@ class ReplicationManager:
         """Decommission drain: every block on a DRAINING worker needs its
         full replica count on LIVE workers; once a draining worker holds
         no such deficit it flips to DECOMMISSIONED and can be removed.
-        Parity: the reference's decommission flow (node.rs +
-        replication manager)."""
+        A block whose desired count simply CANNOT be met by the remaining
+        LIVE workers (cluster too small — no non-holder target exists)
+        doesn't wedge the drain forever: availability is preserved as
+        long as it has at least one LIVE replica, so it counts as
+        satisfied (the normal under-replication scan keeps trying if the
+        cluster later grows). Zero LIVE replicas always blocks the drain
+        — flipping then would lose the only copy. Parity: the reference's
+        decommission flow (node.rs + replication manager)."""
         from curvine_tpu.common.types import WorkerState
+        live_ids = {lw.address.worker_id
+                    for lw in self.fs.workers.live_workers()}
         for w in self.fs.workers.decommissioning_workers():
             wid = w.address.worker_id
+            if not self.fs.workers.has_current_report(wid):
+                # no full block report since this worker (re)registered or
+                # returned from LOST: the block map's view of its holdings
+                # is empty/stale, and flipping DECOMMISSIONED on that
+                # would silently discard replicas it still carries
+                continue
             held = self.fs.blocks.worker_blocks.get(wid, set())
-            pending = [bid for bid in held
-                       if self._live_replicas(bid)
-                       < self.fs.blocks.desired_of(bid)]
+            pending, capped = [], 0
+            for bid in held:
+                live = self._live_replicas(bid)
+                if live >= self.fs.blocks.desired_of(bid):
+                    continue
+                holders = set(self.fs.blocks.locs.get(bid, {}))
+                if live >= 1 and not (live_ids - holders):
+                    capped += 1     # no target could raise the count
+                    continue
+                pending.append(bid)
             if pending:
                 log.info("drain: worker %d has %d blocks to re-replicate",
                          wid, len(pending))
@@ -111,7 +132,13 @@ class ReplicationManager:
                 # count toward replica totals forever, masking real
                 # under-replication after later failures
                 self.fs.blocks.worker_lost(wid)
-                log.info("worker %d fully drained: DECOMMISSIONED", wid)
+                if capped:
+                    log.warning(
+                        "worker %d drained: DECOMMISSIONED, but %d blocks "
+                        "stay under-replicated (not enough LIVE workers "
+                        "for their replica counts)", wid, capped)
+                else:
+                    log.info("worker %d fully drained: DECOMMISSIONED", wid)
 
     async def _replicate(self, block_id: int) -> None:
         from curvine_tpu.common.types import WorkerState

@@ -22,6 +22,10 @@ class WorkerMap:
         # decommission intents survive re-registration (and, journaled
         # through MasterFilesystem, restarts and failovers)
         self.deco_ids: set[int] = set()
+        # per-worker: last block-report time vs last registration/return-
+        # from-LOST time — drain completion needs report > return
+        self.report_ms: dict[int, int] = {}
+        self.return_ms: dict[int, int] = {}
 
     def heartbeat(self, address: WorkerAddress, storages: list[StorageInfo],
                   ici_coords: list[int] | None = None) -> WorkerInfo:
@@ -29,12 +33,19 @@ class WorkerMap:
         if info is None:
             info = WorkerInfo(address=address)
             self.workers[address.worker_id] = info
+            # no block report seen yet for this incarnation
+            self.return_ms[address.worker_id] = now_ms()
             log.info("worker registered: %s", address)
         info.address = address
         info.storages = storages
         info.last_heartbeat_ms = now_ms()
         if ici_coords is not None:
             info.ici_coords = list(ici_coords)
+        if info.state == WorkerState.LOST:
+            # back from the dead: its block-map entries were purged on
+            # LOST, so nothing it holds is countable until its next full
+            # report (drain completion gates on this)
+            self.return_ms[address.worker_id] = now_ms()
         if address.worker_id in self.deco_ids:
             # a heartbeat must never resurrect a draining worker to LIVE
             if info.state in (WorkerState.LIVE, WorkerState.LOST):
@@ -44,6 +55,16 @@ class WorkerMap:
                 log.info("worker %d back alive", address.worker_id)
             info.state = WorkerState.LIVE
         return info
+
+    def mark_reported(self, worker_id: int) -> None:
+        self.report_ms[worker_id] = now_ms()
+
+    def has_current_report(self, worker_id: int) -> bool:
+        """True when a block report has arrived since the worker's last
+        registration / return from LOST — i.e. the block map's view of
+        its holdings is trustworthy."""
+        return self.report_ms.get(worker_id, 0) \
+            > self.return_ms.get(worker_id, 0)
 
     def get(self, worker_id: int) -> WorkerInfo:
         info = self.workers.get(worker_id)
@@ -56,6 +77,12 @@ class WorkerMap:
 
     def lost_workers(self) -> list[WorkerInfo]:
         return [w for w in self.workers.values() if w.state == WorkerState.LOST]
+
+    def retired_workers(self) -> list[WorkerInfo]:
+        """Fully drained workers: DECOMMISSIONED is the safe-to-remove
+        signal, so these must stay visible in cluster reports."""
+        return [w for w in self.workers.values()
+                if w.state == WorkerState.DECOMMISSIONED]
 
     def check_lost(self) -> list[WorkerInfo]:
         """Mark workers whose heartbeat expired; returns newly-lost ones.

@@ -110,10 +110,17 @@ class WorkerServer:
         self.executor.submit_periodic("heartbeat", self.heartbeat_once,
                                       wc.heartbeat_ms / 1000,
                                       initial_delay_s=0.0)
+        # first full report right after the first heartbeat registers us:
+        # the master's drain/replication logic distrusts its view of this
+        # worker's holdings until one arrives
         self.executor.submit_periodic("block-report", self.block_report_once,
-                                      wc.block_report_interval_ms / 1000)
+                                      wc.block_report_interval_ms / 1000,
+                                      initial_delay_s=1.0)
         self.executor.submit_periodic("eviction", self._evict_once, 1.0)
         self.executor.submit_periodic("scrub", self._scrub_once, 60.0)
+        if wc.promote_interval_ms > 0 and len(self.store.tiers) > 1:
+            self.executor.submit_periodic("promote", self._promote_once,
+                                          wc.promote_interval_ms / 1000)
         log.info("worker %d started at %s", self.worker_id, self.addr)
 
     async def stop(self) -> None:
@@ -246,9 +253,25 @@ class WorkerServer:
             self.store.delete(bid)
 
     async def _evict_once(self) -> None:
-        evicted = await asyncio.to_thread(self.store.maybe_evict)
-        if evicted:
-            self.metrics.inc("blocks.evicted", len(evicted))
+        dropped0 = self.store.dropped_total
+        demoted0 = self.store.demoted_total
+        await asyncio.to_thread(self.store.maybe_evict)
+        # evicted counts only blocks that LEFT the cache; demotions moved
+        # tiers without losing data and get their own counter
+        if self.store.dropped_total > dropped0:
+            self.metrics.inc("blocks.evicted",
+                             self.store.dropped_total - dropped0)
+        if self.store.demoted_total > demoted0:
+            self.metrics.inc("blocks.demoted",
+                             self.store.demoted_total - demoted0)
+
+    async def _promote_once(self) -> None:
+        """Hot-data promotion scan; tier changes reach the master on the
+        next block report (storage types reconcile there)."""
+        promoted = await asyncio.to_thread(
+            self.store.promote_scan, self.conf.worker.promote_min_reads)
+        if promoted:
+            self.metrics.inc("blocks.promoted", len(promoted))
 
     async def _scrub_once(self) -> None:
         """Checksum scrub; corrupt blocks get dropped and the master is

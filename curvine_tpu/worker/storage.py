@@ -36,6 +36,7 @@ class BlockInfo:
     # bdev layout: extent inside the tier's single backing file
     offset: int = 0
     alloc_len: int = 0
+    heat: int = 0                 # reads since the last promotion scan
 
     @property
     def is_extent(self) -> bool:
@@ -194,6 +195,13 @@ class BlockStore:
         self.high_water = high_water
         self.low_water = low_water
         self._lock = threading.Lock()
+        # block ids mid-tier-move (copy runs lock-free; see _move_block)
+        self._moving: set[int] = set()
+        # lifetime tier-movement stats (dropped = data actually left the
+        # cache; demoted/promoted = moved between tiers, nothing lost)
+        self.dropped_total = 0
+        self.demoted_total = 0
+        self.promoted_total = 0
         self._load_existing()
 
     def _load_existing(self) -> None:
@@ -214,8 +222,8 @@ class BlockStore:
                     continue
                 for name in os.listdir(subdir):
                     full = os.path.join(subdir, name)
-                    if name.endswith(".tmp"):
-                        os.unlink(full)  # torn write from a previous run
+                    if name.endswith((".tmp", ".mov")):
+                        os.unlink(full)  # torn write/move from a prior run
                         continue
                     if not name.endswith(".blk"):
                         continue
@@ -240,7 +248,7 @@ class BlockStore:
                 return tier
         # under pressure: evict on the preferred tier
         tier = ordered[0]
-        self.evict(tier, size_hint)
+        self._evict_locked(tier, size_hint)
         if tier.available < size_hint:
             raise err.CapacityExceeded(
                 f"tier {tier.dir_id}: need {size_hint}, have {tier.available}")
@@ -348,6 +356,7 @@ class BlockStore:
             info = self._get_locked(block_id)
             if touch:
                 info.atime = time.time()
+                info.heat += 1
             return info
 
     def contains(self, block_id: int) -> bool:
@@ -380,14 +389,156 @@ class BlockStore:
             raise err.BlockNotFound(f"block {block_id}")
         return info
 
-    # ---------- eviction ----------
-    def evict(self, tier: TierDir, need: int) -> list[int]:
-        """LRU-evict committed blocks from `tier` until `need` fits or the
-        low-water mark is reached. Returns evicted block ids."""
+    # ---------- tier movement ----------
+    @staticmethod
+    def _copy_bytes(sf, df, block_id: int, length: int, src_id: str) -> None:
+        left = length
+        while left > 0:
+            chunk = sf.read(min(4 << 20, left))
+            if not chunk:
+                raise err.AbnormalData(
+                    f"block {block_id} truncated on {src_id}")
+            df.write(chunk)
+            left -= len(chunk)
+
+    def _move_block(self, block_id: int, dest: TierDir) -> bool:
+        """Move a committed block's bytes to `dest` and swap the index
+        entry. Returns False (leaving the block where it is) when dest
+        lacks room or the block changed underneath. The byte copy runs
+        WITHOUT the store lock (a multi-MB copy must not stall every
+        other block op on the worker): space is reserved under the lock,
+        the copy streams lock-free, and the swap revalidates under the
+        lock — a block deleted or evicted mid-copy just discards the new
+        copy. Readers holding an fd on the old file keep a complete,
+        consistent view (POSIX unlink semantics); new opens resolve the
+        new location via GET_BLOCK_INFO."""
+        # Phase 1 (locked): validate + reserve destination space.
+        with self._lock:
+            info = self.blocks.get(block_id)
+            if info is None or info.state != BlockState.COMMITTED \
+                    or info.tier is dest or block_id in self._moving:
+                return False
+            src_path, src_off, src_tier = info.path, info.offset, info.tier
+            length = info.len
+            if dest.available < length:
+                return False
+            if isinstance(dest, BdevTier):
+                try:
+                    new_off = dest.alloc(block_id, length)
+                except err.CapacityExceeded:   # fragmented free list
+                    return False
+                new_alloc = length
+            else:
+                dest.used += length            # reservation
+                new_off, new_alloc = 0, 0
+            self._moving.add(block_id)
+
+        def release_dest():
+            if isinstance(dest, BdevTier):
+                dest.free(block_id)
+            else:
+                dest.used -= length
+
+        # Phase 2 (unlocked): stream the bytes.
+        try:
+            with open(src_path, "rb") as sf:
+                sf.seek(src_off)
+                if isinstance(dest, BdevTier):
+                    with open(dest.path, "r+b") as df:
+                        df.seek(new_off)
+                        self._copy_bytes(sf, df, block_id, length,
+                                         src_tier.dir_id)
+                else:
+                    dst_path = dest.block_path(block_id, ".mov")
+                    with open(dst_path, "wb") as df:
+                        self._copy_bytes(sf, df, block_id, length,
+                                         src_tier.dir_id)
+                    os.replace(dst_path, dest.block_path(block_id, ".blk"))
+        except (OSError, err.CurvineError) as e:
+            log.warning("move block %d %s -> %s failed: %s", block_id,
+                        src_tier.dir_id, dest.dir_id, e)
+            if not isinstance(dest, BdevTier):
+                try:     # don't leak the partial copy
+                    os.unlink(dest.block_path(block_id, ".mov"))
+                except OSError:
+                    pass
+            with self._lock:
+                release_dest()
+                self._moving.discard(block_id)
+            return False
+
+        # Phase 3 (locked): revalidate and swap, or discard the copy.
+        with self._lock:
+            self._moving.discard(block_id)
+            info = self.blocks.get(block_id)
+            if info is None or info.state != BlockState.COMMITTED \
+                    or info.tier is not src_tier or info.len != length:
+                # deleted/evicted/re-written mid-copy: ours is stale
+                release_dest()
+                if not isinstance(dest, BdevTier):
+                    try:
+                        os.unlink(dest.block_path(block_id, ".blk"))
+                    except OSError:
+                        pass
+                return False
+            was_extent = info.is_extent
+            if was_extent:
+                src_tier.free(block_id)
+            else:
+                try:
+                    os.unlink(src_path)
+                except FileNotFoundError:
+                    pass
+                src_tier.used -= length
+            # dest accounting already reserved; just swap the entry
+            info.tier, info.offset, info.alloc_len = dest, new_off, new_alloc
+            if was_extent:
+                src_tier.save_index(self.blocks)
+            if isinstance(dest, BdevTier):
+                dest.save_index(self.blocks)
+            return True
+
+    def _move_candidates_locked(self, tier: TierDir, need: int,
+                                demote: bool) -> tuple[list, int]:
+        """Under the lock: pick LRU victims on `tier` until `need` (or the
+        low-water trim target) fits, deciding drop-vs-demote per victim.
+        Returns (plan, still_needed) where plan is [(block_id, dest|None)]
+        — dest None means drop."""
         target_free = max(need, int(tier.capacity * (1 - self.low_water)))
         victims = sorted(
             (b for b in self.blocks.values()
-             if b.tier is tier and b.state == BlockState.COMMITTED),
+             if b.tier is tier and b.state == BlockState.COMMITTED
+             and b.block_id not in self._moving),
+            key=lambda b: b.atime)
+        plan: list[tuple[int, TierDir | None]] = []
+        freed = tier.available
+        for b in victims:
+            if freed >= target_free:
+                break
+            dest = self._slower_tier_for(tier, b.len) if demote else None
+            plan.append((b.block_id, dest))
+            freed += b.len if not isinstance(tier, BdevTier) else b.alloc_len
+        return plan, target_free
+
+    def _slower_tier_for(self, tier: TierDir, size: int) -> TierDir | None:
+        """Next tier strictly slower than `tier` with room for `size`."""
+        for t in self.tiers:
+            if int(t.storage_type) > int(tier.storage_type) \
+                    and t.available >= size:
+                return t
+        return None
+
+    # ---------- eviction / demotion ----------
+    def _evict_locked(self, tier: TierDir, need: int) -> list[int]:
+        """Drop-only LRU trim, for callers already holding the lock (the
+        synchronous create path): when this fires every tier is full, so
+        there is no demotion target anyway — dropping is the only move,
+        and it must not stall the write behind multi-MB copies."""
+        target_free = max(need, int(tier.capacity * (1 - self.low_water)))
+        victims = sorted(
+            (b for b in self.blocks.values()
+             if b.tier is tier and b.state == BlockState.COMMITTED
+             and b.block_id not in self._moving),
             key=lambda b: b.atime)
         evicted = []
         for b in victims:
@@ -395,18 +546,127 @@ class BlockStore:
                 break
             self._remove_locked(b)
             evicted.append(b.block_id)
+            self.dropped_total += 1
         if evicted:
             log.info("evicted %d blocks from %s", len(evicted), tier.dir_id)
         return evicted
 
+    def trim(self, tier: TierDir, need: int,
+             demote: bool = True) -> list[int]:
+        """LRU-trim committed blocks from `tier` until `need` fits or the
+        low-water mark is reached. Cold blocks spill DOWN to the next
+        slower tier with room (demotion); only when no slower tier can
+        take them are they dropped. Byte copies run without the store
+        lock (see _move_block). Returns ids no longer on `tier`."""
+        removed, demoted = [], 0
+        for _attempt in range(2):      # one retry if planned moves failed
+            with self._lock:
+                plan, target = self._move_candidates_locked(
+                    tier, need, demote)
+            if not plan:
+                break
+            progress = False
+            for bid, dest in plan:
+                with self._lock:
+                    if tier.available >= target:
+                        break
+                if dest is not None and self._move_block(bid, dest):
+                    removed.append(bid)
+                    demoted += 1
+                    progress = True
+                    continue
+                if demote:
+                    # the planned destination filled up (the plan shares
+                    # one availability snapshot) or the copy failed:
+                    # replan against LIVE availability before giving up
+                    with self._lock:
+                        info = self.blocks.get(bid)
+                        dest2 = (self._slower_tier_for(tier, info.len)
+                                 if info is not None
+                                 and info.tier is tier else None)
+                    if dest2 is not None:
+                        if dest2 is not dest and \
+                                self._move_block(bid, dest2):
+                            removed.append(bid)
+                            demoted += 1
+                            progress = True
+                        # a demotion target EXISTS but the copy failed
+                        # (transient IO): never destroy a healthy replica
+                        # over that — leave the block for the next scan
+                        continue
+                with self._lock:
+                    info = self.blocks.get(bid)
+                    if info is not None and info.tier is tier \
+                            and info.state == BlockState.COMMITTED \
+                            and bid not in self._moving:
+                        self._remove_locked(info)
+                        removed.append(bid)
+                        self.dropped_total += 1
+                        progress = True
+            with self._lock:
+                if tier.available >= target:
+                    break
+            if not progress:
+                break
+        if removed:
+            self.demoted_total += demoted
+            log.info("trimmed %d blocks from %s (%d demoted, %d dropped)",
+                     len(removed), tier.dir_id, demoted,
+                     len(removed) - demoted)
+        return removed
+
     def maybe_evict(self) -> list[int]:
         """Background check: any tier above high-water gets trimmed."""
         out = []
-        with self._lock:
-            for tier in self.tiers:
-                if tier.capacity and tier.used > tier.capacity * self.high_water:
-                    out.extend(self.evict(tier, 0))
+        for tier in self.tiers:
+            with self._lock:
+                over = tier.capacity \
+                    and tier.used > tier.capacity * self.high_water
+            if over:
+                out.extend(self.trim(tier, 0))
         return out
+
+    # ---------- promotion ----------
+    def promote_scan(self, min_reads: int = 3,
+                     max_bytes: int = 256 << 20) -> list[int]:
+        """Hot-data promotion: blocks on slower tiers read >= `min_reads`
+        times since the last scan move to the fastest tier with room,
+        hottest first; the move may demote the destination's coldest
+        blocks downward to make space (never dropping them when a slower
+        tier has room). Heat decays by half each scan so a once-hot block
+        cools off. Byte copies run without the store lock. Parity: the
+        reference README's transparent hot-data promotion headline (its
+        code ships write-time tiering only — this EXCEEDS parity)."""
+        with self._lock:
+            fastest = self.tiers[0]
+            hot = [(b.block_id, b.len) for b in sorted(
+                (b for b in self.blocks.values()
+                 if b.state == BlockState.COMMITTED and b.tier is not fastest
+                 and b.heat >= min_reads),
+                key=lambda b: b.heat, reverse=True)]
+        promoted: list[int] = []
+        budget = max_bytes
+        for bid, blen in hot:
+            if blen > budget:
+                continue
+            if blen > fastest.available:
+                # demote the destination's coldest blocks to make space
+                # (the background high-water trim restores headroom after
+                # a scan that fills the tier)
+                self.trim(fastest, blen, demote=True)
+                if blen > fastest.available:
+                    continue
+            if self._move_block(bid, fastest):
+                promoted.append(bid)
+                budget -= blen
+        with self._lock:
+            for b in self.blocks.values():
+                b.heat //= 2
+        if promoted:
+            self.promoted_total += len(promoted)
+            log.info("promoted %d hot blocks to %s", len(promoted),
+                     self.tiers[0].dir_id)
+        return promoted
 
     # ---------- reporting ----------
     def storages(self) -> list[StorageInfo]:

@@ -251,3 +251,26 @@ def test_hand_rolled_codecs_cover_all_fields():
         inst = cls()
         back = cls.from_wire(inst.to_wire())
         assert back == inst
+
+
+def test_conf_env_overrides(tmp_path):
+    """CURVINE_<SECTION>_<FIELD> env vars beat file values — the
+    container/k8s configuration path (deploy/)."""
+    f = tmp_path / "c.toml"
+    f.write_text('[worker]\nrpc_port = 8996\n')
+    c = ClusterConf.load(str(f), env={
+        "CURVINE_WORKER_RPC_PORT": "9996",
+        "CURVINE_CLIENT_MASTER_ADDRS": "m1:8995,m2:8995",
+        "CURVINE_MASTER_HOSTNAME": "0.0.0.0",
+        "CURVINE_CLIENT_SHORT_CIRCUIT": "false",
+        "CURVINE_DATA_DIR": "/data",
+        "CURVINE_CONF": "/ignored",
+        "CURVINE_NO_SUCH_FIELD": "x",
+        "CURVINE_WORKER_TIERS": "not-applied",   # structured: TOML-only
+    })
+    assert c.worker.rpc_port == 9996
+    assert c.client.master_addrs == ["m1:8995", "m2:8995"]
+    assert c.master.hostname == "0.0.0.0"
+    assert c.client.short_circuit is False
+    assert c.data_dir == "/data"
+    assert c.worker.tiers and c.worker.tiers[0].storage_type == "mem"

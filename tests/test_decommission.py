@@ -128,3 +128,68 @@ async def test_decommission_unknown_worker():
         with pytest.raises(err.WorkerNotFound):
             await c.meta.decommission_worker(999_999)
         await c.close()
+
+
+async def test_drain_completes_when_replica_count_unreachable():
+    """2 workers, replicas=2: decommissioning one can never restore the
+    desired count (no non-holder LIVE target exists). The drain must
+    still complete — availability is preserved by the surviving LIVE
+    replica — instead of wedging DECOMMISSIONING forever."""
+    async with MiniCluster(workers=2) as mc:
+        c = mc.client()
+        payload = b"c" * (64 * 1024)
+        w = await c.create("/capped.bin", replicas=2)
+        await w.write(payload)
+        await w.close()
+        fb = await c.meta.get_block_locations("/capped.bin")
+        assert len(fb.block_locs[0].locs) == 2
+        victim = fb.block_locs[0].locs[0].worker_id
+
+        await c.meta.decommission_worker(victim)
+        await _drain_until(mc, victim, WorkerState.DECOMMISSIONED,
+                           timeout=10.0)
+        # data still readable from the surviving replica
+        assert await c.read_all("/capped.bin") == payload
+
+        # the drained worker stays visible as safe-to-remove
+        info = await c.meta.master_info()
+        drained = [x for x in info.lost_workers
+                   if x.state == WorkerState.DECOMMISSIONED]
+        assert [x.address.worker_id for x in drained] == [victim]
+
+
+async def test_drain_waits_for_block_report_after_lost_return():
+    """A draining worker that goes LOST (purging its block-map entries)
+    and then returns must NOT flip DECOMMISSIONED until a full block
+    report rebuilds the master's view of its holdings — flipping early
+    would silently discard the replicas it still carries."""
+    async with MiniCluster(workers=2) as mc:
+        c = mc.client()
+        payload = b"L" * (64 * 1024)
+        await c.write_all("/lostret.bin", payload)
+        fb = await c.meta.get_block_locations("/lostret.bin")
+        victim = fb.block_locs[0].locs[0].worker_id
+        await c.meta.decommission_worker(victim)
+
+        # simulate a partition: LOST purges the worker's block map entries
+        wmap = mc.master.fs.workers
+        w = wmap.workers[victim]
+        w.state = WorkerState.LOST
+        mc.master.fs.blocks.worker_lost(victim)
+        # ... which heals: the next heartbeat re-pins DECOMMISSIONING
+        async def back():
+            while wmap.workers[victim].state != WorkerState.DECOMMISSIONING:
+                await asyncio.sleep(0.05)
+        await asyncio.wait_for(back(), 5.0)
+
+        # drain scans must NOT flip before a fresh full report
+        for _ in range(5):
+            mc.master.replication._drain_scan()
+            await asyncio.sleep(0.05)
+        assert wmap.workers[victim].state == WorkerState.DECOMMISSIONING
+
+        # a full report restores the view; the drain can then finish
+        worker = next(x for x in mc.workers if x.worker_id == victim)
+        await worker.block_report_once()
+        await _drain_until(mc, victim, WorkerState.DECOMMISSIONED)
+        assert await c.read_all("/lostret.bin") == payload

@@ -1,0 +1,247 @@
+"""Tier movement: demotion under pressure, hot-data promotion.
+
+Parity: the reference README's "hot data is transparently promoted to
+faster tiers" headline (its code ships write-time tiering only, so the
+promotion scan EXCEEDS parity); demotion mirrors the spill-down story in
+curvine-server/src/worker/storage/ policy ordering.
+"""
+
+import os
+
+import pytest
+
+from curvine_tpu.common.types import BlockState, StorageType
+from curvine_tpu.worker.storage import BdevTier, BlockStore, TierDir
+
+KB = 1024
+MB = 1024 * 1024
+
+
+def make_store(tmp_path, mem_cap=4 * KB, ssd_cap=64 * KB, bdev=False):
+    mem = TierDir(StorageType.MEM, str(tmp_path / "mem"), mem_cap)
+    if bdev:
+        ssd = BdevTier(StorageType.SSD, str(tmp_path / "ssd.bdev"), ssd_cap)
+    else:
+        ssd = TierDir(StorageType.SSD, str(tmp_path / "ssd"), ssd_cap)
+    return BlockStore([mem, ssd], high_water=0.9, low_water=0.5), mem, ssd
+
+
+def put_block(store, bid, data, hint=StorageType.MEM):
+    info = store.create_temp(bid, hint=hint, size_hint=len(data))
+    with open(info.path, "r+b" if info.is_extent else "wb") as f:
+        f.seek(info.offset)
+        f.write(data)
+    return store.commit(bid, len(data))
+
+
+def read_block(store, bid):
+    info = store.get(bid, touch=False)
+    with open(info.path, "rb") as f:
+        f.seek(info.offset)
+        return f.read(info.len)
+
+
+def test_evict_demotes_to_slower_tier(tmp_path):
+    store, mem, ssd = make_store(tmp_path)
+    data = {}
+    for bid in range(4):
+        data[bid] = bytes([bid]) * KB
+        put_block(store, bid, data[bid])
+    # mem (4 KB cap) is at 100% > high-water: the background trim must
+    # demote the coldest blocks down to SSD, never dropping them
+    store.get(3)  # block 3 is hottest/newest
+    moved = store.maybe_evict()
+    assert moved
+    tiers = {bid: store.get(bid, touch=False).tier.storage_type
+             for bid in data}
+    assert tiers[3] == StorageType.MEM, "hottest block stays in MEM"
+    assert any(t == StorageType.SSD for t in tiers.values()), \
+        "pressure should have demoted cold blocks to SSD"
+    # nothing was dropped: every block still readable with intact bytes
+    for bid, want in data.items():
+        assert read_block(store, bid) == want, f"block {bid} corrupt"
+    assert mem.used <= mem.capacity * store.low_water
+
+
+def test_evict_drops_only_when_no_slower_tier(tmp_path):
+    mem = TierDir(StorageType.MEM, str(tmp_path / "m"), 4 * KB)
+    store = BlockStore([mem], high_water=0.9, low_water=0.5)
+    for bid in range(4):
+        put_block(store, bid, bytes([bid]) * KB)
+    put_block(store, 9, b"\x09" * KB)
+    held = [b for b in range(4) if store.contains(b)]
+    assert len(held) < 4  # single tier: eviction must drop
+    assert store.contains(9)
+
+
+def test_promote_hot_block(tmp_path):
+    store, mem, ssd = make_store(tmp_path, mem_cap=8 * KB)
+    cold = b"\x01" * KB
+    hot = b"\x02" * KB
+    put_block(store, 1, cold, hint=StorageType.SSD)
+    put_block(store, 2, hot, hint=StorageType.SSD)
+    for _ in range(5):
+        store.get(2)  # heat up block 2 only
+    promoted = store.promote_scan(min_reads=3)
+    assert promoted == [2]
+    assert store.get(2, touch=False).tier.storage_type == StorageType.MEM
+    assert store.get(1, touch=False).tier.storage_type == StorageType.SSD
+    assert read_block(store, 2) == hot
+
+
+def test_promote_respects_min_reads_and_decay(tmp_path):
+    store, mem, ssd = make_store(tmp_path)
+    put_block(store, 1, b"a" * KB, hint=StorageType.SSD)
+    store.get(1)
+    store.get(1)
+    assert store.promote_scan(min_reads=3) == []
+    # decay halved the heat (2 -> 1); two more reads reach 3
+    store.get(1)
+    store.get(1)
+    assert store.promote_scan(min_reads=3) == [1]
+
+
+def test_promote_demotes_dest_cold_blocks_for_space(tmp_path):
+    store, mem, ssd = make_store(tmp_path, mem_cap=2 * KB)
+    resident = b"r" * KB
+    put_block(store, 1, resident, hint=StorageType.MEM)
+    put_block(store, 2, resident, hint=StorageType.MEM)
+    hot = b"h" * KB
+    put_block(store, 3, hot, hint=StorageType.SSD)
+    for _ in range(4):
+        store.get(3)
+    promoted = store.promote_scan(min_reads=3)
+    assert promoted == [3]
+    assert store.get(3, touch=False).tier.storage_type == StorageType.MEM
+    # the displaced mem blocks were demoted, not dropped
+    for bid in (1, 2):
+        assert store.contains(bid)
+        assert read_block(store, bid) == resident
+
+
+def test_move_between_file_and_bdev_layouts(tmp_path):
+    store, mem, ssd = make_store(tmp_path, mem_cap=2 * KB, bdev=True)
+    data = os.urandom(KB)
+    put_block(store, 7, data, hint=StorageType.MEM)
+    # demote into the bdev extent layout
+    assert store._move_block(7, ssd)
+    info = store.get(7, touch=False)
+    assert info.is_extent and info.tier is ssd
+    assert read_block(store, 7) == data
+    # checksum still verifies at the new extent offset
+    assert store.verify(7)
+    # promote back out of the extent into the file layout
+    for _ in range(4):
+        store.get(7)
+    assert store.promote_scan(min_reads=3) == [7]
+    info = store.get(7, touch=False)
+    assert not info.is_extent and info.tier is mem
+    assert read_block(store, 7) == data
+    assert store.verify(7)
+    # the extent was freed back to the bdev free list
+    assert ssd.used == 0 and 7 not in ssd.extents
+
+
+def test_bdev_move_survives_restart(tmp_path):
+    store, mem, ssd = make_store(tmp_path, mem_cap=2 * KB, bdev=True)
+    data = os.urandom(KB)
+    put_block(store, 7, data, hint=StorageType.MEM)
+    assert store._move_block(7, ssd)
+    # a fresh store over the same roots sees the block in the bdev index
+    mem2 = TierDir(StorageType.MEM, mem.root, mem.capacity)
+    ssd2 = BdevTier(StorageType.SSD, ssd.path, ssd.capacity)
+    store2 = BlockStore([mem2, ssd2])
+    info = store2.get(7, touch=False)
+    assert info.is_extent and info.state == BlockState.COMMITTED
+    assert read_block(store2, 7) == data
+
+
+def test_report_reflects_tier_after_move(tmp_path):
+    store, mem, ssd = make_store(tmp_path)
+    put_block(store, 5, b"x" * KB, hint=StorageType.SSD)
+    held, types = store.report()
+    assert types[5] == int(StorageType.SSD)
+    for _ in range(4):
+        store.get(5)
+    store.promote_scan(min_reads=3)
+    held, types = store.report()
+    assert types[5] == int(StorageType.MEM)
+
+
+async def test_cluster_read_survives_promotion(tmp_path):
+    """End-to-end: a client mid-read keeps working while the worker
+    moves the block between tiers (fd stays valid; new opens re-probe)."""
+    from curvine_tpu.common.conf import ClusterConf, TierConf
+    from curvine_tpu.testing import MiniCluster
+
+    conf = ClusterConf()
+    conf.worker.tiers = [
+        TierConf(storage_type="mem", dir=str(tmp_path / "mem"),
+                 capacity=64 * MB),
+        TierConf(storage_type="ssd", dir=str(tmp_path / "ssd"),
+                 capacity=64 * MB),
+    ]
+    async with MiniCluster(workers=1, conf=conf, block_size=1 * MB) as mc:
+        c = mc.client()
+        data = os.urandom(3 * MB)
+        w = await c.create("/tiering", storage_type="ssd")
+        await w.write(data)
+        await w.close()
+        r = await c.open("/tiering")
+        first = await r.read(MB)
+        assert first == data[:MB]
+        # force a promotion scan on the worker mid-read
+        promoted = mc.workers[0].store.promote_scan(min_reads=0)
+        assert promoted, "ssd blocks should promote to the mem tier"
+        rest = await r.read()
+        assert first + rest == data
+        await r.close()
+        # a fresh open resolves the new (promoted) location
+        r2 = await c.open("/tiering")
+        assert await r2.read_all() == data
+        await r2.close()
+
+
+def test_trim_replans_to_next_slower_tier_when_dest_fills(tmp_path):
+    """The trim plan shares one availability snapshot: when the first
+    demotions fill SSD, remaining victims must replan down to HDD
+    instead of being dropped."""
+    mem = TierDir(StorageType.MEM, str(tmp_path / "mem"), 4 * KB)
+    ssd = TierDir(StorageType.SSD, str(tmp_path / "ssd"), 2 * KB)
+    hdd = TierDir(StorageType.HDD, str(tmp_path / "hdd"), 64 * KB)
+    store = BlockStore([mem, ssd, hdd], high_water=0.9, low_water=0.0)
+    data = {}
+    for bid in range(4):
+        data[bid] = bytes([bid]) * KB
+        put_block(store, bid, data[bid])
+    removed = store.trim(mem, 0)   # low_water=0: clear the whole tier
+    assert len(removed) == 4
+    # nothing dropped: 2 fit SSD, the other 2 replanned onto HDD
+    assert store.dropped_total == 0
+    by_tier = {}
+    for bid, want in data.items():
+        info = store.get(bid, touch=False)
+        by_tier.setdefault(info.tier.storage_type, []).append(bid)
+        assert read_block(store, bid) == want
+    assert len(by_tier[StorageType.SSD]) == 2
+    assert len(by_tier[StorageType.HDD]) == 2
+
+
+def test_move_failure_never_drops_with_target_present(tmp_path, monkeypatch):
+    """A transient copy failure must leave the block in place when a
+    demotion target exists — never destroy a healthy replica."""
+    store, mem, ssd = make_store(tmp_path)
+    put_block(store, 1, b"a" * 4 * KB)   # fills mem (cap 4 KB)
+    calls = {"n": 0}
+    orig = BlockStore._copy_bytes
+
+    def flaky(sf, df, block_id, length, src_id):
+        calls["n"] += 1
+        raise OSError("transient io error")
+
+    monkeypatch.setattr(BlockStore, "_copy_bytes", staticmethod(flaky))
+    removed = store.trim(mem, 0)
+    assert removed == [] and calls["n"] >= 1
+    assert store.contains(1) and store.dropped_total == 0
+    monkeypatch.setattr(BlockStore, "_copy_bytes", staticmethod(orig))
+    assert read_block(store, 1) == b"a" * 4 * KB

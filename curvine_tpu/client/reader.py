@@ -142,9 +142,7 @@ class FsReader:
                 break
             lb, block_off = located
             seg = min(n - filled, lb.block.len - block_off)
-            local = await self._local_path(lb)
-            fd = self._fd_for(lb.block.id, local) if local is not None \
-                else None
+            fd = await self._local_fd(lb)
             if fd is not None:
                 base = self._local_offs.get(lb.block.id, 0)
                 got = os.preadv(fd, [memoryview(out[filled:filled + seg])],
@@ -200,6 +198,14 @@ class FsReader:
             self._local_fds[block_id] = fd
         return fd
 
+    async def _local_fd(self, lb: LocatedBlock) -> int | None:
+        """Short-circuit probe + open in one step: None → use the socket
+        path."""
+        local = await self._local_path(lb)
+        if local is None:
+            return None
+        return self._fd_for(lb.block.id, local)
+
     async def mmap_view(self, offset: int, n: int):
         """Short-circuit read of a co-located block range into a fresh
         numpy buffer — one preadv from the page cache, handed straight to
@@ -214,10 +220,7 @@ class FsReader:
         lb, block_off = located
         if block_off + n > lb.block.len:
             return None
-        local = await self._local_path(lb)
-        if local is None:
-            return None
-        fd = self._fd_for(lb.block.id, local)
+        fd = await self._local_fd(lb)
         if fd is None:
             return None
         buf = np.empty(n, dtype=np.uint8)
@@ -235,8 +238,7 @@ class FsReader:
             return b""
         lb, block_off = located
         n = min(n, lb.block.len - block_off)
-        local = await self._local_path(lb)
-        fd = self._fd_for(lb.block.id, local) if local is not None else None
+        fd = await self._local_fd(lb)
         if fd is not None:
             base = self._local_offs.get(lb.block.id, 0)
             data = os.pread(fd, n, base + block_off)

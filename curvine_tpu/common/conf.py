@@ -171,7 +171,7 @@ def _apply(obj, data: dict) -> None:
             setattr(obj, k, v)
 
 
-def _coerce(cur, raw: str):
+def _coerce(cur, raw: str, annotation: str = ""):
     if isinstance(cur, bool):
         return raw.strip().lower() in ("1", "true", "yes", "on")
     if isinstance(cur, int):
@@ -179,7 +179,14 @@ def _coerce(cur, raw: str):
     if isinstance(cur, float):
         return float(raw)
     if isinstance(cur, list):
-        return [s.strip() for s in raw.split(",") if s.strip()]
+        items = [s.strip() for s in raw.split(",") if s.strip()]
+        # element type from the field annotation (defaults are often
+        # empty lists, so the current value can't tell us)
+        if "int" in annotation:
+            return [int(s) for s in items]
+        if "float" in annotation:
+            return [float(s) for s in items]
+        return items
     return raw
 
 
@@ -199,7 +206,12 @@ def _apply_env(conf: "ClusterConf", env: dict) -> None:
         cur = getattr(target, field_name)
         if dataclasses.is_dataclass(cur) or field_name == "tiers":
             continue                # structured fields stay TOML-only
+        ann = ""
+        for f in dataclasses.fields(target):
+            if f.name == field_name:
+                ann = str(f.type)
+                break
         try:
-            setattr(target, field_name, _coerce(cur, raw))
+            setattr(target, field_name, _coerce(cur, raw, ann))
         except (TypeError, ValueError):
             pass

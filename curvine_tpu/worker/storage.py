@@ -257,6 +257,12 @@ class BlockStore:
     def create_temp(self, block_id: int, hint: StorageType | None = None,
                     size_hint: int = 0) -> BlockInfo:
         with self._lock:
+            if block_id in self._moving:
+                # a tier move holds this id's paths/extents; a new
+                # incarnation now would collide with the move's phase-3
+                # cleanup (id-reuse data loss). Caller retries.
+                raise err.FileAlreadyExists(
+                    f"block {block_id} busy (tier move in flight)")
             if block_id in self.blocks:
                 old = self.blocks[block_id]
                 if old.state == BlockState.COMMITTED:
@@ -468,12 +474,15 @@ class BlockStore:
             return False
 
         # Phase 3 (locked): revalidate and swap, or discard the copy.
+        # create_temp refuses ids in _moving, so no NEW incarnation of
+        # this block can exist yet — the cleanup below only ever removes
+        # OUR copy.
         with self._lock:
             self._moving.discard(block_id)
             info = self.blocks.get(block_id)
             if info is None or info.state != BlockState.COMMITTED \
                     or info.tier is not src_tier or info.len != length:
-                # deleted/evicted/re-written mid-copy: ours is stale
+                # deleted/evicted mid-copy: ours is stale
                 release_dest()
                 if not isinstance(dest, BdevTier):
                     try:
@@ -534,18 +543,15 @@ class BlockStore:
         synchronous create path): when this fires every tier is full, so
         there is no demotion target anyway — dropping is the only move,
         and it must not stall the write behind multi-MB copies."""
-        target_free = max(need, int(tier.capacity * (1 - self.low_water)))
-        victims = sorted(
-            (b for b in self.blocks.values()
-             if b.tier is tier and b.state == BlockState.COMMITTED
-             and b.block_id not in self._moving),
-            key=lambda b: b.atime)
+        plan, _target = self._move_candidates_locked(tier, need,
+                                                     demote=False)
         evicted = []
-        for b in victims:
-            if tier.available >= target_free:
-                break
-            self._remove_locked(b)
-            evicted.append(b.block_id)
+        for bid, _dest in plan:
+            info = self.blocks.get(bid)
+            if info is None:
+                continue
+            self._remove_locked(info)
+            evicted.append(bid)
             self.dropped_total += 1
         if evicted:
             log.info("evicted %d blocks from %s", len(evicted), tier.dir_id)
@@ -609,7 +615,8 @@ class BlockStore:
             if not progress:
                 break
         if removed:
-            self.demoted_total += demoted
+            with self._lock:
+                self.demoted_total += demoted
             log.info("trimmed %d blocks from %s (%d demoted, %d dropped)",
                      len(removed), tier.dir_id, demoted,
                      len(removed) - demoted)
@@ -649,6 +656,10 @@ class BlockStore:
         for bid, blen in hot:
             if blen > budget:
                 continue
+            if blen > fastest.capacity:
+                # can never fit even an empty tier: don't flush the hot
+                # tier chasing an impossible promotion
+                continue
             if blen > fastest.available:
                 # demote the destination's coldest blocks to make space
                 # (the background high-water trim restores headroom after
@@ -663,7 +674,8 @@ class BlockStore:
             for b in self.blocks.values():
                 b.heat //= 2
         if promoted:
-            self.promoted_total += len(promoted)
+            with self._lock:
+                self.promoted_total += len(promoted)
             log.info("promoted %d hot blocks to %s", len(promoted),
                      self.tiers[0].dir_id)
         return promoted

@@ -1,0 +1,30 @@
+#!/bin/sh
+# Role dispatcher: master | worker | fuse | gateway | csi | cv <args...>
+# Parity: curvine-docker/deploy/entrypoint.sh. Env overrides ride the
+# conf loader's CURVINE_* mechanism (common/conf.py).
+set -e
+
+CONF="${CURVINE_CONF:-/opt/curvine/etc/curvine-cluster.toml}"
+ROLE="${1:-master}"
+[ $# -gt 0 ] && shift
+
+case "$ROLE" in
+  master|worker|gateway)
+    exec python -m curvine_tpu.cli.main --conf "$CONF" "$ROLE" "$@"
+    ;;
+  fuse)
+    MNT="${CURVINE_MOUNTPOINT:-/curvine}"
+    mkdir -p "$MNT"
+    exec python -m curvine_tpu.cli.main --conf "$CONF" fuse \
+        --mountpoint "$MNT" "$@"
+    ;;
+  csi)
+    exec python -m curvine_tpu.csi --conf "$CONF" "$@"
+    ;;
+  cv)
+    exec python -m curvine_tpu.cli.main --conf "$CONF" "$@"
+    ;;
+  *)
+    exec "$ROLE" "$@"
+    ;;
+esac

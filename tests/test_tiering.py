@@ -10,6 +10,7 @@ import os
 
 import pytest
 
+from curvine_tpu.common import errors as err
 from curvine_tpu.common.types import BlockState, StorageType
 from curvine_tpu.worker.storage import BdevTier, BlockStore, TierDir
 
@@ -245,3 +246,32 @@ def test_move_failure_never_drops_with_target_present(tmp_path, monkeypatch):
     assert store.contains(1) and store.dropped_total == 0
     monkeypatch.setattr(BlockStore, "_copy_bytes", staticmethod(orig))
     assert read_block(store, 1) == b"a" * 4 * KB
+
+
+def test_create_temp_refuses_id_mid_move(tmp_path):
+    """Block-id reuse during a tier move would collide with the move's
+    cleanup (phase-3 unlink / extent reservation): create_temp must
+    refuse while the id is mid-move."""
+    store, mem, ssd = make_store(tmp_path)
+    put_block(store, 1, b"a" * KB)
+    with store._lock:
+        store._moving.add(1)
+    with pytest.raises(err.FileAlreadyExists):
+        store.create_temp(1, size_hint=KB)
+    with store._lock:
+        store._moving.discard(1)
+
+
+def test_promote_skips_blocks_larger_than_fast_tier(tmp_path):
+    """A hot block that can never fit the fastest tier must not flush it
+    chasing an impossible promotion."""
+    store, mem, ssd = make_store(tmp_path, mem_cap=2 * KB, ssd_cap=64 * KB)
+    put_block(store, 1, b"m" * KB, hint=StorageType.MEM)   # resident
+    big = b"B" * (4 * KB)                                  # > mem capacity
+    put_block(store, 2, big, hint=StorageType.SSD)
+    for _ in range(5):
+        store.get(2)
+    assert store.promote_scan(min_reads=3) == []
+    # the resident mem block was NOT demoted/flushed
+    assert store.get(1, touch=False).tier.storage_type == StorageType.MEM
+    assert store.get(2, touch=False).tier.storage_type == StorageType.SSD

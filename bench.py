@@ -284,6 +284,19 @@ async def run_bench(total_mb: int = 256, block_mb: int = 64,
         assert int(ids[0, 0]) == 123 + reps - 1
         results["vector_scan_mrows_s"] = reps * n_rows / scan_s / 1e6
 
+        # ---- IVF-ANN scan over the same table (two-stage probe search) ----
+        await table.create_index(nlist=256, metric="cosine", iters=4,
+                                 device=dev)
+        await table.knn(vecs[0], k=8, device=dev, nprobe=8)  # warm-up
+        t0 = time.perf_counter()
+        outs = [await table.knn(vecs[123 + i], k=8, device=dev,
+                                materialize=False, nprobe=8)
+                for i in range(reps)]
+        ids = np.asarray(outs[-1][0])
+        ann_s = time.perf_counter() - t0
+        assert int(ids[0, 0]) == 123 + reps - 1
+        results["vector_ann_qps"] = reps / ann_s
+
         # ---- cache-fed train-step MFU (flagship model) ----
         results.update(await _mfu_bench(c, dev, jax))
 
@@ -466,6 +479,7 @@ def main():
         "hbm_tier_read_gibs": round(results.get("hbm_tier_read_gibs", 0), 3),
         "ckpt_broadcast_gibs": round(results.get("ckpt_broadcast_gibs", 0), 3),
         "vector_scan_mrows_s": round(results.get("vector_scan_mrows_s", 0), 3),
+        "vector_ann_qps": round(results.get("vector_ann_qps", 0), 1),
         "fuse_seq_read_gibs": round(results.get("fuse_seq_read_gibs", 0), 3),
         "fuse_seq_write_gibs": round(results.get("fuse_seq_write_gibs", 0), 3),
         "fuse_rand4k_iops": round(results.get("fuse_rand4k_iops", 0), 1),

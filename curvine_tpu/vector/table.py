@@ -34,12 +34,16 @@ def _scan_fn(metric: str, k: int):
         import jax.numpy as jnp
 
         def scan_knn(q, v, ids):
+            # v/ids carry a zero-vector sentinel row (id -1) at the end —
+            # ONE padded device copy serves both this exact scan and the
+            # IVF search's padded takes; the mask keeps the sentinel out
             if metric == "cosine":
                 qn = q / jnp.linalg.norm(q, axis=1, keepdims=True).clip(1e-12)
                 scores = qn @ v.T
             else:
                 scores = -(jnp.sum(q * q, 1)[:, None]
                            - 2 * q @ v.T + jnp.sum(v * v, 1)[None, :])
+            scores = jnp.where(ids[None, :] < 0, -jnp.inf, scores)
             s, dense = jax.lax.top_k(scores, min(k, scores.shape[1]))
             return s, jnp.take(ids, dense)   # dense idx → global row id
 
@@ -65,6 +69,9 @@ class VectorTable:
         # HBM (normalized per metric) + dense→global id map, so repeated
         # scans run at MXU speed instead of re-streaming host->device
         self._dev_cache: dict = {}
+        # lazily-loaded IVF index (vector/index.py); None = not probed
+        self._index = None
+        self._index_missing = False
 
     # ---------------- lifecycle ----------------
 
@@ -273,22 +280,13 @@ class VectorTable:
 
     # ---------------- TPU knn ----------------
 
-    async def _device_vectors(self, metric: str, device):
-        """LIVE rows of all row groups as ONE device-resident [N, D]
-        array (normalized for cosine) plus a dense→global row-id map,
-        pinned across calls — the table lives in HBM like an HBM-tier
-        block, and the scan is a single MXU matmul. Row groups are
-        fetched concurrently (prefetch) on a cache miss."""
+    async def _host_live(self) -> tuple[np.ndarray, np.ndarray]:
+        """All LIVE rows as one host [N, D] array + dense→global row-id
+        map, in ascending global-id order (index build and the pinned
+        device array must agree on this dense ordering)."""
         import asyncio
-        import jax
-        import jax.numpy as jnp
 
         dels = await self._load_deletes()
-        key = (metric, getattr(device, "id", device), self.row_groups,
-               len(dels))
-        hit = self._dev_cache.get(key)
-        if hit is not None:
-            return hit
         if self.row_groups == 0:
             raise err.FileNotFound(f"table {self.path} is empty")
         groups = await asyncio.gather(
@@ -304,6 +302,30 @@ class VectorTable:
             live = np.arange(host.shape[0], dtype=np.int32)
         if host.shape[0] == 0:
             raise err.FileNotFound(f"table {self.path} has no live rows")
+        return host, live
+
+    async def _device_vectors(self, metric: str, device):
+        """LIVE rows of all row groups as ONE device-resident [N, D]
+        array (normalized for cosine) plus a dense→global row-id map,
+        pinned across calls — the table lives in HBM like an HBM-tier
+        block, and the scan is a single MXU matmul. Row groups are
+        fetched concurrently (prefetch) on a cache miss."""
+        import jax
+        import jax.numpy as jnp
+
+        dels = await self._load_deletes()
+        key = (metric, getattr(device, "id", device), self.row_groups,
+               len(dels))
+        hit = self._dev_cache.get(key)
+        if hit is not None:
+            return hit
+        host, live = await self._host_live()
+        # sentinel-padded: one extra zero row (id -1) so the IVF search's
+        # padded takes stay in-bounds on the SAME resident array as the
+        # exact scan (no second device copy of the table)
+        host = np.concatenate(
+            [host, np.zeros((1, host.shape[1]), dtype=host.dtype)], axis=0)
+        live = np.concatenate([live, np.full(1, -1, dtype=live.dtype)])
         v = jax.device_put(host, device)
         if metric == "cosine":
             v = v / jnp.linalg.norm(v, axis=1, keepdims=True).clip(1e-12)
@@ -312,13 +334,80 @@ class VectorTable:
         self._dev_cache = {key: (v, ids)}   # one resident copy per table
         return v, ids
 
+    # ---------------- IVF index ----------------
+
+    async def create_index(self, nlist: int | None = None,
+                           metric: str = "cosine", iters: int = 10,
+                           device=None) -> "IvfIndex":
+        """Build (or rebuild) the IVF-flat ANN index on device and
+        persist it as a cached file. Follows the Lance model: the index
+        is a snapshot — table mutations leave it stale, and knn falls
+        back to the exact scan until the next create_index. See
+        vector/index.py for the TPU-first design."""
+        import jax
+        from curvine_tpu.vector.index import IvfIndex, table_snapshot
+
+        if metric not in ("cosine", "l2"):
+            raise err.InvalidArgument(f"metric {metric!r}")
+        host, live = await self._host_live()
+        if metric == "cosine":
+            host = host / np.linalg.norm(
+                host, axis=1, keepdims=True).clip(1e-12)
+        n = host.shape[0]
+        if nlist is None:
+            nlist = max(1, int(np.sqrt(n)))     # the usual IVF default
+        snap = table_snapshot(self)
+        snap["metric"] = metric
+        dev = device if device is not None else jax.devices()[0]
+        idx = IvfIndex.build(host, live, nlist, snap, iters=iters,
+                             device=dev)
+        await self.client.write_all(f"{self.path}/index.ivf",
+                                    idx.to_bytes())
+        self._index = idx
+        self._index_missing = False
+        return idx
+
+    async def _load_index(self):
+        from curvine_tpu.vector.index import IvfIndex
+
+        if self._index is not None or self._index_missing:
+            return self._index
+        try:
+            raw = await (await self.client.open(
+                f"{self.path}/index.ivf")).read_all()
+        except err.FileNotFound:
+            self._index_missing = True
+            return None
+        self._index = IvfIndex.from_bytes(raw)
+        return self._index
+
+    async def _fresh_index(self, metric: str):
+        """The persisted index, or None when absent/stale/other-metric
+        (knn then uses the exact scan)."""
+        from curvine_tpu.vector.index import table_snapshot
+
+        idx = await self._load_index()
+        if idx is None:
+            return None
+        await self._load_deletes()
+        snap = table_snapshot(self)
+        snap["metric"] = metric
+        return idx if idx.built_at == snap else None
+
     async def knn(self, query: np.ndarray, k: int = 10,
                   metric: str = "cosine", device=None,
-                  materialize: bool = True):
-        """Top-k nearest rows to `query` [D] or [Q, D]: ONE [Q, D]×[D, N]
-        matmul + top_k on the device over the pinned table — no per-group
-        host loop, no re-streaming (the round-2 per-group await+device_put
-        pattern benched at Python speed, not MXU speed).
+                  materialize: bool = True, use_index: bool = True,
+                  nprobe: int = 8):
+        """Top-k nearest rows to `query` [D] or [Q, D].
+
+        With a FRESH IVF index (create_index since the last mutation) and
+        use_index=True, the scan is two chained device stages — queries ×
+        centroids, then a gather+dot over only the probed lists (see
+        vector/index.py); results are approximate with recall set by
+        `nprobe`. Otherwise it is ONE exact [Q, D]×[D, N] matmul + top_k
+        over the pinned table — no per-group host loop, no re-streaming
+        (the round-2 per-group await+device_put pattern benched at Python
+        speed, not MXU speed).
 
         materialize=False returns device arrays without forcing a
         device→host sync — callers issuing a stream of scans can pipeline
@@ -332,8 +421,12 @@ class VectorTable:
             raise err.InvalidArgument(f"query dim {query.shape[1]} != {self.dim}")
         dev = device if device is not None else jax.devices()[0]
         v, ids = await self._device_vectors(metric, dev)
-        q = jax.device_put(query, dev)
-        s, i = _scan_fn(metric, k)(q, v, ids)
+        idx = await self._fresh_index(metric) if use_index else None
+        if idx is not None:
+            s, i = idx.search(query, v, ids, k, metric, nprobe, dev)
+        else:
+            q = jax.device_put(query, dev)
+            s, i = _scan_fn(metric, k)(q, v, ids)
         if not materialize:
             return i, s
         return np.asarray(i), np.asarray(s)

@@ -9,7 +9,20 @@ ROLE="${1:-master}"
 [ $# -gt 0 ] && shift
 
 case "$ROLE" in
-  master|worker|gateway)
+  master)
+    # StatefulSet pods: derive the raft node id from the hostname
+    # ordinal (cv-master-0 -> 1, ...) unless set explicitly — every
+    # replica sharing the default id 1 would break the quorum
+    if [ -z "$CURVINE_MASTER_RAFT_NODE_ID" ]; then
+      ord="${HOSTNAME%%.*}"; ord="${ord##*-}"
+      case "$ord" in
+        ''|*[!0-9]*) ;;
+        *) export CURVINE_MASTER_RAFT_NODE_ID="$((ord + 1))" ;;
+      esac
+    fi
+    exec python -m curvine_tpu.cli.main --conf "$CONF" master "$@"
+    ;;
+  worker|gateway)
     exec python -m curvine_tpu.cli.main --conf "$CONF" "$ROLE" "$@"
     ;;
   fuse)

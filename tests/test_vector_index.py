@@ -1,0 +1,137 @@
+"""IVF-flat ANN index over VectorTable.
+
+Parity surface: curvine-lancedb re-exports Lance's `index` module
+(lib.rs:25) so reference users get ANN over cached tables; here the
+index is TPU-native (k-means + probe search as jitted matmuls, dense
+padded lists for static shapes — vector/index.py).
+"""
+
+import numpy as np
+import pytest
+
+from curvine_tpu.testing import MiniCluster
+
+import jax
+
+CPU = jax.devices("cpu")[0]
+
+
+def clustered(rng, n_clusters=8, per=40, dim=16, spread=0.05):
+    centers = rng.normal(size=(n_clusters, dim)).astype(np.float32)
+    vecs = np.concatenate([
+        c + spread * rng.normal(size=(per, dim)).astype(np.float32)
+        for c in centers])
+    return vecs.astype(np.float32)
+
+
+async def _mk_table(c, path, vecs):
+    from curvine_tpu.vector import VectorTable
+    t = await VectorTable.create(c, path, vecs.shape[1])
+    # two row groups so dense-id mapping crosses group boundaries
+    half = vecs.shape[0] // 2
+    await t.append(vecs[:half])
+    await t.append(vecs[half:])
+    return t
+
+
+async def test_ivf_recall_vs_exact():
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        rng = np.random.default_rng(7)
+        vecs = clustered(rng)
+        t = await _mk_table(c, "/vec/ivf", vecs)
+        await t.create_index(nlist=8, metric="cosine", device=CPU)
+
+        q = vecs[rng.choice(vecs.shape[0], size=16, replace=False)] \
+            + 0.01 * rng.normal(size=(16, vecs.shape[1])).astype(np.float32)
+        exact_ids, _ = await t.knn(q, k=10, device=CPU, use_index=False)
+        ann_ids, ann_scores = await t.knn(q, k=10, device=CPU,
+                                          use_index=True, nprobe=3)
+        recall = np.mean([
+            len(set(exact_ids[i].tolist()) & set(ann_ids[i].tolist())) / 10
+            for i in range(q.shape[0])])
+        assert recall >= 0.9, f"recall {recall}"
+        # scores are real similarities (descending)
+        assert np.all(np.diff(ann_scores, axis=1) <= 1e-6)
+
+
+async def test_ivf_l2_and_self_hit():
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        rng = np.random.default_rng(3)
+        vecs = clustered(rng)
+        t = await _mk_table(c, "/vec/l2", vecs)
+        await t.create_index(nlist=8, metric="l2", device=CPU)
+        ids, _ = await t.knn(vecs[13], k=1, metric="l2", device=CPU,
+                             nprobe=2)
+        assert ids[0, 0] == 13   # a table row's nearest neighbor is itself
+
+
+async def test_ivf_persists_and_reloads():
+    from curvine_tpu.vector import VectorTable
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        rng = np.random.default_rng(11)
+        vecs = clustered(rng)
+        t = await _mk_table(c, "/vec/persist", vecs)
+        await t.create_index(nlist=8, device=CPU)
+
+        t2 = await VectorTable.open(c, "/vec/persist")
+        idx = await t2._fresh_index("cosine")
+        assert idx is not None and idx.nlist == 8
+        ids, _ = await t2.knn(vecs[5], k=1, device=CPU, nprobe=2)
+        assert ids[0, 0] == 5
+        # other metric -> not fresh for it
+        assert await t2._fresh_index("l2") is None
+
+
+async def test_ivf_stale_after_mutation_falls_back_exact():
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        rng = np.random.default_rng(5)
+        vecs = clustered(rng)
+        t = await _mk_table(c, "/vec/stale", vecs)
+        await t.create_index(nlist=8, device=CPU)
+        assert await t._fresh_index("cosine") is not None
+
+        # append a new exact-duplicate query target AFTER indexing
+        extra = rng.normal(size=(4, vecs.shape[1])).astype(np.float32)
+        await t.append(extra)
+        assert await t._fresh_index("cosine") is None   # stale
+        # knn still finds the new row because it fell back to exact scan
+        ids, _ = await t.knn(extra[2], k=1, device=CPU)
+        assert ids[0, 0] == vecs.shape[0] + 2
+
+        # deletes also invalidate; rebuilding re-enables the index and
+        # never returns tombstoned rows
+        await t.delete([int(ids[0, 0])])
+        await t.create_index(nlist=8, device=CPU)
+        assert await t._fresh_index("cosine") is not None
+        ids2, _ = await t.knn(extra[2], k=5, device=CPU, nprobe=8)
+        assert int(ids2[0, 0]) != vecs.shape[0] + 2
+        assert vecs.shape[0] + 2 not in set(ids2[0].tolist())
+
+
+async def test_ivf_nprobe_full_equals_exact():
+    """Probing every list must reproduce the exact top-k (same ids)."""
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        rng = np.random.default_rng(9)
+        vecs = clustered(rng, n_clusters=4, per=30)
+        t = await _mk_table(c, "/vec/full", vecs)
+        await t.create_index(nlist=4, device=CPU)
+        q = rng.normal(size=(5, vecs.shape[1])).astype(np.float32)
+        exact_ids, exact_s = await t.knn(q, k=7, device=CPU,
+                                         use_index=False)
+        ann_ids, ann_s = await t.knn(q, k=7, device=CPU, nprobe=4)
+        assert np.array_equal(exact_ids, ann_ids)
+        assert np.allclose(exact_s, ann_s, atol=1e-5)
+        # l2 too: scores must be IDENTICAL values (negative squared
+        # distance) on both paths, not just same ranking — callers
+        # thresholding on distance see no shift when an index goes stale
+        await t.create_index(nlist=4, metric="l2", device=CPU)
+        e_ids, e_s = await t.knn(q, k=7, metric="l2", device=CPU,
+                                 use_index=False)
+        a_ids, a_s = await t.knn(q, k=7, metric="l2", device=CPU, nprobe=4)
+        assert np.array_equal(e_ids, a_ids)
+        assert np.allclose(e_s, a_s, atol=1e-4)

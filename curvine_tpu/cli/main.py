@@ -88,7 +88,9 @@ async def cmd_put(args):
 async def cmd_get(args):
     c = await _client(args)
     try:
-        r = await c.open(args.src)
+        # unified open: freed/uncached files under mounts stream from
+        # the UFS instead of reading an empty cache entry
+        r = await c.unified_open(args.src)
         t0 = time.perf_counter()
         total = 0
         with open(args.dst, "wb") as f:
@@ -105,7 +107,7 @@ async def cmd_get(args):
 async def cmd_cat(args):
     c = await _client(args)
     try:
-        r = await c.open(args.path)
+        r = await c.unified_open(args.path)
         async for chunk in r.chunks():
             sys.stdout.buffer.write(chunk)
         sys.stdout.buffer.flush()
@@ -292,13 +294,41 @@ async def cmd_node(args):
         await c.close()
 
 
+_DUR = {"s": 1000, "m": 60_000, "h": 3_600_000, "d": 86_400_000}
+
+
+def _dur_ms(s: str | None) -> int:
+    if not s:
+        return 0
+    s = s.strip().lower()
+    if s[-1] in _DUR:
+        return int(float(s[:-1]) * _DUR[s[-1]])
+    return int(s)               # bare number: milliseconds
+
+
 async def cmd_mount(args):
+    from curvine_tpu.common.types import TtlAction
     c = await _client(args)
     try:
         props = dict(kv.split("=", 1) for kv in (args.prop or []))
-        m = await c.meta.mount(args.cv_path, args.ufs_path, properties=props,
-                               auto_cache=args.auto_cache)
-        print(f"mounted {m.ufs_path} at {m.cv_path} (id={m.mount_id})")
+        ttl_ms = _dur_ms(args.ttl)
+        m = await c.meta.mount(
+            args.cv_path, args.ufs_path, properties=props,
+            auto_cache=args.auto_cache, ttl_ms=ttl_ms,
+            ttl_action=int(TtlAction[args.ttl_action.upper()]) if ttl_ms
+            else 0,
+            storage_type=args.storage or "",
+            block_size=args.block_size, replicas=args.replicas,
+            access_mode="r" if args.read_only else "rw")
+        extras = []
+        if m.ttl_ms:
+            extras.append(f"ttl={m.ttl_ms}ms/{m.ttl_action.name.lower()}")
+        if m.access_mode == "r":
+            extras.append("read-only")
+        if m.storage_type:
+            extras.append(f"storage={m.storage_type}")
+        tail = f" [{', '.join(extras)}]" if extras else ""
+        print(f"mounted {m.ufs_path} at {m.cv_path} (id={m.mount_id}){tail}")
     finally:
         await c.close()
 
@@ -526,7 +556,16 @@ def build_parser() -> argparse.ArgumentParser:
         A("worker_id", nargs="?"))
     add("mount", cmd_mount, A("cv_path"), A("ufs_path"),
         A("--auto-cache", dest="auto_cache", action="store_true"),
-        A("--prop", action="append"))
+        A("--prop", action="append"),
+        A("--ttl", help="cached-copy TTL, e.g. 30s/10m/2h/7d"),
+        A("--ttl-action", dest="ttl_action", default="free",
+          choices=["none", "delete", "free"]),
+        A("--read-only", dest="read_only", action="store_true",
+          help="reject user mutations under the mount (loads still cache)"),
+        A("--storage", choices=["hbm", "mem", "ssd", "hdd"],
+          help="tier for cached copies"),
+        A("--block-size", dest="block_size", type=int, default=0),
+        A("--replicas", type=int, default=0))
     add("umount", cmd_umount, A("cv_path"))
     add("mounts", cmd_mounts)
     add("load", cmd_load, A("path"), A("--replicas", type=int, default=1),

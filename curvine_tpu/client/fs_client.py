@@ -302,15 +302,33 @@ class FsClient:
 
     async def mount(self, cv_path: str, ufs_path: str,
                     properties: dict | None = None, auto_cache: bool = False,
-                    write_type: int = 0) -> MountInfo:
+                    write_type: int = 0, ttl_ms: int = 0, ttl_action: int = 0,
+                    storage_type: str = "", block_size: int = 0,
+                    replicas: int = 0, access_mode: str = "rw") -> MountInfo:
         rep = await self.call(RpcCode.MOUNT, {
             "cv_path": cv_path, "ufs_path": ufs_path,
             "properties": properties or {}, "auto_cache": auto_cache,
-            "write_type": write_type}, mutate=True)
+            "write_type": write_type, "ttl_ms": ttl_ms,
+            "ttl_action": ttl_action, "storage_type": storage_type,
+            "block_size": block_size, "replicas": replicas,
+            "access_mode": access_mode}, mutate=True)
         return MountInfo.from_wire(rep["mount"])
 
     async def umount(self, cv_path: str) -> None:
         await self.call(RpcCode.UNMOUNT, {"cv_path": cv_path}, mutate=True)
+
+    async def update_mount(self, cv_path: str,
+                           properties: dict | None = None,
+                           auto_cache: bool | None = None,
+                           ttl_ms: int | None = None,
+                           ttl_action: int | None = None,
+                           access_mode: str | None = None) -> MountInfo:
+        rep = await self.call(RpcCode.UPDATE_MOUNT, {
+            "cv_path": cv_path, "properties": properties,
+            "auto_cache": auto_cache, "ttl_ms": ttl_ms,
+            "ttl_action": ttl_action, "access_mode": access_mode},
+            mutate=True)
+        return MountInfo.from_wire(rep["mount"])
 
     async def mount_table(self) -> list[MountInfo]:
         rep = await self.call(RpcCode.GET_MOUNT_TABLE, {})

@@ -238,15 +238,28 @@ class CurvineClient:
         """Warm one file: UFS → cache (the worker-side of load tasks).
         Records the UFS object's mtime in the storage policy so fallback
         readers can detect a changed underlying object (ufs_mtime guard,
-        reference state::StoragePolicy parity)."""
+        reference state::StoragePolicy parity). Per-mount caching policy
+        applies: the mount's ttl/storage/replica/block-size defaults
+        govern the cached copy (reference state/mount.rs MountInfo)."""
+        from curvine_tpu.common.types import TtlAction
         mount, ufs, uri = await self._ufs_for(path)
         st = await ufs.stat(uri)
         if st is None:
             raise err.FileNotFound(uri)
         from curvine_tpu.common.types import StoragePolicy
-        sp = StoragePolicy(ufs_mtime=st.mtime).to_wire()
-        w = await self.create(path, overwrite=True, replicas=replicas,
-                              storage_policy=sp)
+        sp = StoragePolicy(
+            # clamp: a UFS that reports mtime 0 must still mark this
+            # create as a cache-warming load (read-only-mount exemption)
+            ufs_mtime=max(int(st.mtime or 0), 1),
+            ttl_ms=getattr(mount, "ttl_ms", 0) or 0,
+            ttl_action=TtlAction(int(getattr(mount, "ttl_action", 0) or 0)))
+        storage_type = getattr(mount, "storage_type", "") or None
+        w = await self.create(
+            path, overwrite=True,
+            replicas=replicas if replicas is not None
+            else (getattr(mount, "replicas", 0) or None),
+            block_size=getattr(mount, "block_size", 0) or None,
+            storage_type=storage_type, storage_policy=sp.to_wire())
         total = 0
         try:
             async for chunk in ufs.read(uri):

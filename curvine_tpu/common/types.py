@@ -319,7 +319,12 @@ class MasterInfo(Wire):
 
 @dataclass
 class MountInfo(Wire):
-    """Parity: proto/mount.proto MountInfo — cv path ↔ ufs path binding."""
+    """Parity: proto/mount.proto MountInfo — cv path ↔ ufs path binding.
+    Per-mount caching policy mirrors the reference's
+    state/mount.rs MountInfo: TTL applied to cached copies, storage/
+    block-size/replica defaults for loads, and an access mode (\"r\"
+    rejects user mutations under the mount; cache-warming loads are
+    exempt)."""
 
     mount_id: int = 0
     cv_path: str = ""
@@ -327,6 +332,14 @@ class MountInfo(Wire):
     properties: dict = field(default_factory=dict)
     auto_cache: bool = False
     write_type: WriteType = WriteType.CACHE
+    # cached copies under this mount expire after ttl_ms (0 = never)
+    ttl_ms: int = 0
+    ttl_action: TtlAction = TtlAction.NONE
+    # defaults applied when loads cache files under this mount
+    storage_type: str = ""            # "" = client/conf default
+    block_size: int = 0               # 0  = client/conf default
+    replicas: int = 0                 # 0  = client/conf default
+    access_mode: str = "rw"           # "rw" | "r" (read-only mount)
 
 
 @dataclass
@@ -388,6 +401,6 @@ _register(LocatedBlock, block=ExtendedBlock, locs=(WorkerAddress,),
 _register(FileBlocks, status=FileStatus, block_locs=(LocatedBlock,))
 _register(CommitBlock, storage_type=StorageType)
 _register(MasterInfo, live_workers=(WorkerInfo,), lost_workers=(WorkerInfo,))
-_register(MountInfo, write_type=WriteType)
+_register(MountInfo, write_type=WriteType, ttl_action=TtlAction)
 _register(TaskInfo, state=JobState)
 _register(JobInfo, state=JobState, tasks=(TaskInfo,))

@@ -318,6 +318,7 @@ class MasterFilesystem:
     def mkdir(self, path: str, create_parent: bool = True, mode: int = 0o755,
               owner: str = "root", group: str = "root",
               x_attr: dict | None = None) -> FileStatus:
+        self._mount_write_guard(path)
         node = self.tree.resolve(path)
         if node is not None:
             if node.is_dir:
@@ -344,6 +345,10 @@ class MasterFilesystem:
                     client_name: str = "", x_attr: dict | None = None,
                     storage_policy: dict | None = None,
                     file_type: int = int(FileType.FILE)) -> FileStatus:
+        # cache-warming loads mark themselves with the ufs_mtime they
+        # observed; those creates are allowed on read-only mounts
+        caching = bool((storage_policy or {}).get("ufs_mtime"))
+        self._mount_write_guard(path, caching=caching)
         existing = self.tree.resolve(path)
         if existing is not None:
             if existing.is_dir:
@@ -387,6 +392,7 @@ class MasterFilesystem:
         return node.to_status(path)
 
     def append_file(self, path: str, client_name: str = "") -> FileBlocks:
+        self._mount_write_guard(path)
         node = self._file_or_raise(path)
         if not node.is_complete:
             raise err.LeaseConflict(f"{path} is being written")
@@ -422,6 +428,8 @@ class MasterFilesystem:
                 for name, child in self.tree.children(node)]
 
     def rename(self, src: str, dst: str) -> bool:
+        self._mount_write_guard(src, subtree=True)
+        self._mount_write_guard(dst)
         s = self.tree.resolve(src)
         if s is None:
             raise err.FileNotFound(src)
@@ -465,7 +473,12 @@ class MasterFilesystem:
         self.tree.save(new_parent)
         return True
 
-    def delete(self, path: str, recursive: bool = False) -> None:
+    def delete(self, path: str, recursive: bool = False,
+               system: bool = False) -> None:
+        # system=True: master-internal reclaim (TTL actions) bypasses the
+        # read-only-mount guard — the mount's own policy initiated it
+        if not system:
+            self._mount_write_guard(path, subtree=recursive)
         node = self.tree.resolve(path)
         if node is None:
             raise err.FileNotFound(path)
@@ -542,6 +555,7 @@ class MasterFilesystem:
         return n
 
     def set_attr(self, path: str, opts: SetAttrOpts) -> None:
+        self._mount_write_guard(path)
         if self.tree.resolve(path) is None:
             raise err.FileNotFound(path)
         self._log("set_attr", dict(path=path, opts=opts.to_wire()))
@@ -573,6 +587,7 @@ class MasterFilesystem:
         self.tree.save(node)
 
     def symlink(self, target: str, link: str) -> FileStatus:
+        self._mount_write_guard(link)
         if self.tree.resolve(link) is not None:
             raise err.FileAlreadyExists(link)
         parent, _ = self.tree.resolve_parent(link)
@@ -591,6 +606,7 @@ class MasterFilesystem:
         return node.to_status(link)
 
     def link(self, src: str, dst: str) -> FileStatus:
+        self._mount_write_guard(dst)
         self._file_or_raise(src)
         if self.tree.resolve(dst) is not None:
             raise err.FileAlreadyExists(dst)
@@ -608,6 +624,7 @@ class MasterFilesystem:
         return node.to_status(dst)
 
     def resize_file(self, path: str, new_len: int) -> None:
+        self._mount_write_guard(path)
         node = self._file_or_raise(path)
         if new_len > node.len:
             raise err.InvalidArgument("resize can only shrink")
@@ -840,6 +857,33 @@ class MasterFilesystem:
                           + self.workers.retired_workers()))
 
     # ==================== helpers ====================
+
+    def _mount_write_guard(self, path: str, caching: bool = False,
+                           subtree: bool = False) -> None:
+        """Reference parity: write RPCs under a read-only mount are
+        refused (curvine-client unified_filesystem.rs
+        is_mount_write_rpc + AccessMode); enforced master-side here so
+        every client/gateway/FUSE path also gets it without carrying the
+        mount table. Cache-warming loads are exempt — their creates
+        carry the ufs_mtime marker. Like the reference's client-side
+        gate, that marker is COOPERATIVE (a raw-RPC client can set it);
+        the access mode protects against accidental writes — authz is
+        the ACL layer's job. `subtree` ops (recursive delete, rename of
+        an ancestor) are refused when a read-only mount lies anywhere
+        UNDER the target too."""
+        if self.mounts is None or caching:
+            return
+        m = self.mounts.get_mount(path)
+        if m is not None and getattr(m, "access_mode", "rw") == "r":
+            raise err.Unsupported(
+                f"write on read-only mount {m.cv_path}: {path}")
+        if subtree:
+            prefix = path.rstrip("/") + "/"
+            for info in self.mounts.table():
+                if info.access_mode == "r" and \
+                        info.cv_path.startswith(prefix):
+                    raise err.Unsupported(
+                        f"{path} contains read-only mount {info.cv_path}")
 
     def _file_or_raise(self, path: str) -> Inode:
         node = self.tree.resolve(path)

@@ -10,7 +10,16 @@ from __future__ import annotations
 import itertools
 
 from curvine_tpu.common import errors as err
-from curvine_tpu.common.types import MountInfo, WriteType
+from curvine_tpu.common.types import MountInfo, TtlAction, WriteType
+
+
+def _check_ttl_action(v: int) -> None:
+    """Validate BEFORE journaling (WAL discipline: a bad value must
+    raise InvalidArgument pre-append, not ValueError in the apply)."""
+    try:
+        TtlAction(v)
+    except ValueError:
+        raise err.InvalidArgument(f"ttl_action {v!r}") from None
 
 
 class MountManager:
@@ -25,10 +34,16 @@ class MountManager:
 
     # ---------- mutations (journaled via fs._log) ----------
     def mount(self, cv_path: str, ufs_path: str, properties: dict | None = None,
-              auto_cache: bool = False, write_type: int = 0) -> MountInfo:
+              auto_cache: bool = False, write_type: int = 0,
+              ttl_ms: int = 0, ttl_action: int = 0, storage_type: str = "",
+              block_size: int = 0, replicas: int = 0,
+              access_mode: str = "rw") -> MountInfo:
         cv_path = cv_path.rstrip("/") or "/"
         if cv_path in self._mounts:
             raise err.FileAlreadyExists(f"mount point {cv_path} exists")
+        if access_mode not in ("rw", "r"):
+            raise err.InvalidArgument(f"access_mode {access_mode!r}")
+        _check_ttl_action(ttl_action)
         for existing in self._mounts:
             if cv_path.startswith(existing + "/") or existing.startswith(cv_path + "/"):
                 raise err.InvalidArgument(
@@ -37,14 +52,22 @@ class MountManager:
         return self.fs._log("mount_add", dict(
             cv_path=cv_path, ufs_path=ufs_path.rstrip("/"),
             properties=properties or {}, auto_cache=auto_cache,
-            write_type=write_type))
+            write_type=write_type, ttl_ms=ttl_ms, ttl_action=ttl_action,
+            storage_type=storage_type, block_size=block_size,
+            replicas=replicas, access_mode=access_mode))
 
     def _apply_add(self, cv_path: str, ufs_path: str, properties: dict,
-                   auto_cache: bool, write_type: int) -> MountInfo:
+                   auto_cache: bool, write_type: int, ttl_ms: int = 0,
+                   ttl_action: int = 0, storage_type: str = "",
+                   block_size: int = 0, replicas: int = 0,
+                   access_mode: str = "rw") -> MountInfo:
         info = MountInfo(mount_id=next(self._ids), cv_path=cv_path,
                          ufs_path=ufs_path, properties=properties,
                          auto_cache=auto_cache,
-                         write_type=WriteType(write_type))
+                         write_type=WriteType(write_type),
+                         ttl_ms=ttl_ms, ttl_action=TtlAction(ttl_action),
+                         storage_type=storage_type, block_size=block_size,
+                         replicas=replicas, access_mode=access_mode)
         self._mounts[cv_path] = info
         self.fs.store.mount_put(cv_path, info.to_wire())
         return info
@@ -60,20 +83,35 @@ class MountManager:
         self.fs.store.mount_remove(cv_path)
 
     def update(self, cv_path: str, properties: dict | None = None,
-               auto_cache: bool | None = None) -> MountInfo:
+               auto_cache: bool | None = None, ttl_ms: int | None = None,
+               ttl_action: int | None = None,
+               access_mode: str | None = None) -> MountInfo:
         cv_path = cv_path.rstrip("/") or "/"
         if cv_path not in self._mounts:
             raise err.MountNotFound(cv_path)
+        if access_mode is not None and access_mode not in ("rw", "r"):
+            raise err.InvalidArgument(f"access_mode {access_mode!r}")
+        if ttl_action is not None:
+            _check_ttl_action(ttl_action)
         return self.fs._log("mount_update", dict(
-            cv_path=cv_path, properties=properties, auto_cache=auto_cache))
+            cv_path=cv_path, properties=properties, auto_cache=auto_cache,
+            ttl_ms=ttl_ms, ttl_action=ttl_action, access_mode=access_mode))
 
     def _apply_update(self, cv_path: str, properties: dict | None,
-                      auto_cache: bool | None) -> MountInfo:
+                      auto_cache: bool | None, ttl_ms: int | None = None,
+                      ttl_action: int | None = None,
+                      access_mode: str | None = None) -> MountInfo:
         info = self._mounts[cv_path]
         if properties is not None:
             info.properties.update(properties)
         if auto_cache is not None:
             info.auto_cache = auto_cache
+        if ttl_ms is not None:
+            info.ttl_ms = ttl_ms
+        if ttl_action is not None:
+            info.ttl_action = TtlAction(ttl_action)
+        if access_mode is not None:
+            info.access_mode = access_mode
         self.fs.store.mount_put(cv_path, info.to_wire())
         return info
 

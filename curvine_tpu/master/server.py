@@ -624,7 +624,13 @@ class MasterServer:
         info = self.mounts.mount(q["cv_path"], q["ufs_path"],
                                  properties=q.get("properties"),
                                  auto_cache=q.get("auto_cache", False),
-                                 write_type=q.get("write_type", 0))
+                                 write_type=q.get("write_type", 0),
+                                 ttl_ms=q.get("ttl_ms", 0),
+                                 ttl_action=q.get("ttl_action", 0),
+                                 storage_type=q.get("storage_type", ""),
+                                 block_size=q.get("block_size", 0),
+                                 replicas=q.get("replicas", 0),
+                                 access_mode=q.get("access_mode", "rw"))
         return {"mount": info.to_wire()}
 
     def _umount(self, q):
@@ -633,7 +639,10 @@ class MasterServer:
 
     def _update_mount(self, q):
         info = self.mounts.update(q["cv_path"], properties=q.get("properties"),
-                                  auto_cache=q.get("auto_cache"))
+                                  auto_cache=q.get("auto_cache"),
+                                  ttl_ms=q.get("ttl_ms"),
+                                  ttl_action=q.get("ttl_action"),
+                                  access_mode=q.get("access_mode"))
         return {"mount": info.to_wire()}
 
     def _mount_table(self, q):

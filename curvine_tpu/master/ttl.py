@@ -120,7 +120,9 @@ class TtlManager:
             path = self.fs.tree.path_of(node)
             try:
                 if sp.ttl_action == TtlAction.DELETE:
-                    self.fs.delete(path, recursive=True)
+                    # system actor: TTL reclaim must work on read-only
+                    # mounts too (the mount's own ttl policy set it)
+                    self.fs.delete(path, recursive=True, system=True)
                 elif sp.ttl_action == TtlAction.FREE:
                     self.fs.free(path, recursive=True)
                 acted += 1

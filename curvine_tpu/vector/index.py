@@ -76,11 +76,11 @@ def _search_fn(metric: str, k: int, nprobe: int):
             sentinel-padded array pair ([N+1,D] with a zero row at index
             N / [N+1] with -1) — shared with the exact scan, no second
             device copy."""
+            qn = jnp.linalg.norm(q, axis=1, keepdims=True).clip(1e-12)
             if metric == "cosine":
-                q = q / jnp.linalg.norm(q, axis=1, keepdims=True).clip(1e-12)
                 cn = centroids / jnp.linalg.norm(
                     centroids, axis=1, keepdims=True).clip(1e-12)
-                cs = q @ cn.T
+                cs = (q / qn) @ cn.T
             else:
                 cs = 2.0 * (q @ centroids.T) \
                     - jnp.sum(centroids * centroids, axis=1)[None, :]
@@ -90,15 +90,18 @@ def _search_fn(metric: str, k: int, nprobe: int):
             sentinel = v_pad.shape[0] - 1
             slot = jnp.where(cand < 0, sentinel, cand)
             cv = jnp.take(v_pad, slot, axis=0)          # [Q, M, D]
+            # mirror the exact scan's arithmetic EXACTLY (same casts:
+            # bf16 q × bf16 table, f32 accumulation; norms in f32) —
+            # scores must not shift when the index goes stale and knn
+            # falls back to the exact scan
+            dots = jnp.einsum("qd,qmd->qm", q.astype(cv.dtype), cv,
+                              preferred_element_type=jnp.float32)
             if metric == "cosine":
-                scores = jnp.einsum("qd,qmd->qm", q, cv)
+                scores = dots / qn
             else:
-                # same value as the exact scan: -(|q|^2 - 2 q.v + |v|^2)
-                # (negative squared distance) — scores must not shift
-                # when the index goes stale and knn falls back
+                cvf = cv.astype(jnp.float32)
                 scores = -(jnp.sum(q * q, axis=1)[:, None]
-                           - 2.0 * jnp.einsum("qd,qmd->qm", q, cv)
-                           + jnp.sum(cv * cv, axis=2))
+                           - 2.0 * dots + jnp.sum(cvf * cvf, axis=2))
             scores = jnp.where(cand < 0, -jnp.inf, scores)
             kk = min(k, int(scores.shape[1]))
             s, idx = jax.lax.top_k(scores, kk)

@@ -27,7 +27,10 @@ _SCAN_FNS: dict = {}
 
 def _scan_fn(metric: str, k: int):
     """Jitted [Q,D]×[D,N] scan+top_k, cached per (metric, k) — a jit
-    defined per call would recompile every time."""
+    defined per call would recompile every time. The table array may be
+    bf16 (half the HBM traffic of f32 — the scan is bandwidth-bound);
+    the MXU accumulates in f32 either way
+    (preferred_element_type)."""
     fn = _SCAN_FNS.get((metric, k))
     if fn is None:
         import jax
@@ -37,12 +40,18 @@ def _scan_fn(metric: str, k: int):
             # v/ids carry a zero-vector sentinel row (id -1) at the end —
             # ONE padded device copy serves both this exact scan and the
             # IVF search's padded takes; the mask keeps the sentinel out
+            dots = jax.lax.dot_general(
+                q.astype(v.dtype), v,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)    # [Q, N]
             if metric == "cosine":
-                qn = q / jnp.linalg.norm(q, axis=1, keepdims=True).clip(1e-12)
-                scores = qn @ v.T
+                qn = jnp.linalg.norm(q, axis=1, keepdims=True).clip(1e-12)
+                scores = dots / qn
             else:
+                vv = jnp.sum(
+                    v.astype(jnp.float32) * v.astype(jnp.float32), 1)
                 scores = -(jnp.sum(q * q, 1)[:, None]
-                           - 2 * q @ v.T + jnp.sum(v * v, 1)[None, :])
+                           - 2 * dots + vv[None, :])
             scores = jnp.where(ids[None, :] < 0, -jnp.inf, scores)
             s, dense = jax.lax.top_k(scores, min(k, scores.shape[1]))
             return s, jnp.take(ids, dense)   # dense idx → global row id
@@ -304,18 +313,22 @@ class VectorTable:
             raise err.FileNotFound(f"table {self.path} has no live rows")
         return host, live
 
-    async def _device_vectors(self, metric: str, device):
+    async def _device_vectors(self, metric: str, device,
+                              dtype: str = "f32"):
         """LIVE rows of all row groups as ONE device-resident [N, D]
         array (normalized for cosine) plus a dense→global row-id map,
         pinned across calls — the table lives in HBM like an HBM-tier
         block, and the scan is a single MXU matmul. Row groups are
-        fetched concurrently (prefetch) on a cache miss."""
+        fetched concurrently (prefetch) on a cache miss. dtype=\"bf16\"
+        pins the table in bfloat16: half the HBM footprint AND half the
+        bandwidth of the bandwidth-bound scan (scores still accumulate
+        in f32 on the MXU); top-k order can differ for near-ties."""
         import jax
         import jax.numpy as jnp
 
         dels = await self._load_deletes()
-        key = (metric, getattr(device, "id", device), self.row_groups,
-               len(dels))
+        key = (metric, dtype, getattr(device, "id", device),
+               self.row_groups, len(dels))
         hit = self._dev_cache.get(key)
         if hit is not None:
             return hit
@@ -329,6 +342,8 @@ class VectorTable:
         v = jax.device_put(host, device)
         if metric == "cosine":
             v = v / jnp.linalg.norm(v, axis=1, keepdims=True).clip(1e-12)
+        if dtype == "bf16":
+            v = v.astype(jnp.bfloat16)
         v = jax.block_until_ready(v)
         ids = jax.block_until_ready(jax.device_put(live, device))
         self._dev_cache = {key: (v, ids)}   # one resident copy per table
@@ -397,7 +412,7 @@ class VectorTable:
     async def knn(self, query: np.ndarray, k: int = 10,
                   metric: str = "cosine", device=None,
                   materialize: bool = True, use_index: bool = True,
-                  nprobe: int = 8):
+                  nprobe: int = 8, dtype: str = "f32"):
         """Top-k nearest rows to `query` [D] or [Q, D].
 
         With a FRESH IVF index (create_index since the last mutation) and
@@ -416,11 +431,13 @@ class VectorTable:
 
         if metric not in ("cosine", "l2"):
             raise err.InvalidArgument(f"metric {metric!r}")
+        if dtype not in ("f32", "bf16"):
+            raise err.InvalidArgument(f"dtype {dtype!r}")
         query = np.atleast_2d(np.asarray(query, dtype=np.float32))
         if query.shape[1] != self.dim:
             raise err.InvalidArgument(f"query dim {query.shape[1]} != {self.dim}")
         dev = device if device is not None else jax.devices()[0]
-        v, ids = await self._device_vectors(metric, dev)
+        v, ids = await self._device_vectors(metric, dev, dtype=dtype)
         idx = await self._fresh_index(metric) if use_index else None
         if idx is not None:
             s, i = idx.search(query, v, ids, k, metric, nprobe, dev)

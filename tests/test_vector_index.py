@@ -135,3 +135,54 @@ async def test_ivf_nprobe_full_equals_exact():
         a_ids, a_s = await t.knn(q, k=7, metric="l2", device=CPU, nprobe=4)
         assert np.array_equal(e_ids, a_ids)
         assert np.allclose(e_s, a_s, atol=1e-4)
+
+
+async def test_bf16_scan_matches_f32_ranking():
+    """bf16-resident tables (half HBM footprint/bandwidth) keep ranking
+    quality: top-1 self-hits are exact and top-10 overlaps f32."""
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        rng = np.random.default_rng(21)
+        vecs = clustered(rng)
+        t = await _mk_table(c, "/vec/bf16", vecs)
+        ids, scores = await t.knn(vecs[42], k=1, device=CPU,
+                                  use_index=False, dtype="bf16")
+        assert ids[0, 0] == 42
+        q = vecs[rng.choice(vecs.shape[0], 8, replace=False)]
+        f32_ids, f32_s = await t.knn(q, k=10, device=CPU, use_index=False)
+        b16_ids, _ = await t.knn(q, k=10, device=CPU, use_index=False,
+                                 dtype="bf16")
+        # near-ties reshuffle under bf16; quality is judged by the TRUE
+        # (f32) similarity of whichever neighbors bf16 returned
+        qn = q / np.linalg.norm(q, axis=1, keepdims=True)
+        vn = vecs / np.linalg.norm(vecs, axis=1, keepdims=True)
+        true_b16 = np.sort((qn @ vn.T)[
+            np.arange(8)[:, None], b16_ids])[:, ::-1]
+        assert np.allclose(true_b16, f32_s, atol=2e-2), \
+            np.max(np.abs(true_b16 - f32_s))
+        # l2 works in bf16 too
+        ids2, _ = await t.knn(vecs[7], k=1, metric="l2", device=CPU,
+                              use_index=False, dtype="bf16")
+        assert ids2[0, 0] == 7
+
+
+async def test_bf16_with_ivf_index_scores_match_f32_accumulation():
+    """bf16 residency + IVF index: scores still accumulate in f32, so
+    full-probe ANN equals the exact bf16 scan on both metrics."""
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        rng = np.random.default_rng(31)
+        # well-separated random vectors: near-ties would make id order
+        # sensitive to f32 reduction-order noise between the two paths
+        vecs = rng.normal(size=(120, 32)).astype(np.float32)
+        t = await _mk_table(c, "/vec/bf16idx", vecs)
+        for metric in ("cosine", "l2"):
+            await t.create_index(nlist=4, metric=metric, device=CPU)
+            e_ids, e_s = await t.knn(vecs[11], k=5, metric=metric,
+                                     device=CPU, use_index=False,
+                                     dtype="bf16")
+            a_ids, a_s = await t.knn(vecs[11], k=5, metric=metric,
+                                     device=CPU, nprobe=4, dtype="bf16")
+            assert np.array_equal(e_ids, a_ids), metric
+            assert np.allclose(e_s, a_s, atol=1e-3), metric
+            assert a_ids[0, 0] == 11

@@ -173,27 +173,15 @@ async def cmd_chown(args):
         await c.close()
 
 
-async def _du(c, path: str) -> tuple[int, int, int]:
-    st = await c.meta.file_status(path)
-    if not st.is_dir:
-        return st.len, 1, 0
-    files = dirs = size = 0
-    for child in await c.meta.list_status(path):
-        if child.is_dir:
-            s, f, d = await _du(c, child.path)
-            size += s
-            files += f
-            dirs += d + 1
-        else:
-            size += child.len
-            files += 1
-    return size, files, dirs
+async def _summary(c, path):
+    cs = await c.content_summary(path)
+    return cs["length"], cs["file_count"], cs["directory_count"]
 
 
 async def cmd_du(args):
     c = await _client(args)
     try:
-        size, files, dirs = await _du(c, args.path)
+        size, files, dirs = await _summary(c, args.path)
         print(f"{_human(size)}\t{args.path}")
     finally:
         await c.close()
@@ -202,7 +190,7 @@ async def cmd_du(args):
 async def cmd_count(args):
     c = await _client(args)
     try:
-        size, files, dirs = await _du(c, args.path)
+        size, files, dirs = await _summary(c, args.path)
         print(f"{dirs:>12} {files:>12} {_human(size):>12} {args.path}")
     finally:
         await c.close()
@@ -390,7 +378,7 @@ async def cmd_quota(args):
             print(f"quota cleared on {args.path}")
         else:
             st = await c.meta.file_status(args.path)
-            size, files, dirs = await _du(c, args.path)
+            size, files, dirs = await _summary(c, args.path)
             qb = st.x_attr.get("quota.bytes")
             qf = st.x_attr.get("quota.files")
             fmt = lambda v: v.decode() if isinstance(v, bytes) else (v or "-")

@@ -300,6 +300,11 @@ class FsClient:
 
     # ---------------- mounts / jobs ----------------
 
+    async def content_summary(self, path: str) -> dict:
+        """length / file_count / directory_count of a subtree, computed
+        master-side in one RPC."""
+        return await self.call(RpcCode.CONTENT_SUMMARY, {"path": path})
+
     async def mount(self, cv_path: str, ufs_path: str,
                     properties: dict | None = None, auto_cache: bool = False,
                     write_type: int = 0, ttl_ms: int = 0, ttl_action: int = 0,

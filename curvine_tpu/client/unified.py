@@ -234,6 +234,34 @@ class CurvineClient:
         return UfsReader(ufs, uri, st.len,
                          chunk_size=self.conf.client.read_chunk_size)
 
+    async def content_summary(self, path: str) -> dict:
+        """Recursive length/file/dir counts: ONE master RPC for pure
+        cache subtrees; when the subtree intersects mounts (or the path
+        exists only in a UFS), aggregates the unified listing instead —
+        the master refuses to sum what partly lives in the UFS."""
+        try:
+            return await self.meta.content_summary(path)
+        except (err.Unsupported, err.FileNotFound):
+            pass
+        st = await self.meta.file_status(path)   # unified: UFS-aware
+        if not st.is_dir:
+            return {"length": st.len, "file_count": 1,
+                    "directory_count": 0}
+        length = file_count = 0
+        directory_count = 1                      # count the root itself
+        stack = [path]
+        while stack:
+            p = stack.pop()
+            for ch in await self.meta.list_status(p):
+                if ch.is_dir:
+                    directory_count += 1
+                    stack.append(ch.path)
+                else:
+                    file_count += 1
+                    length += ch.len
+        return {"length": length, "file_count": file_count,
+                "directory_count": directory_count}
+
     async def load_from_ufs(self, path: str, replicas: int | None = None) -> int:
         """Warm one file: UFS → cache (the worker-side of load tasks).
         Records the UFS object's mtime in the storage policy so fallback

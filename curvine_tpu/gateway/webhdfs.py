@@ -75,11 +75,12 @@ class WebHdfsGateway:
             return web.json_response(
                 {"FileStatuses": {"FileStatus": [_fs_json(s) for s in sts]}})
         if op == "GETCONTENTSUMMARY":
-            st = await c.meta.file_status(path)
+            cs = await c.content_summary(path)
             return web.json_response({"ContentSummary": {
-                "length": st.len, "fileCount": 0 if st.is_dir else 1,
-                "directoryCount": 1 if st.is_dir else 0,
-                "quota": -1, "spaceConsumed": st.len, "spaceQuota": -1}})
+                "length": cs["length"], "fileCount": cs["file_count"],
+                "directoryCount": cs["directory_count"],
+                "quota": -1, "spaceConsumed": cs["length"],
+                "spaceQuota": -1}})
         if op == "OPEN":
             reader = await c.unified_open(path)
             offset = int(req.query.get("offset", "0"))

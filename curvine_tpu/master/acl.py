@@ -126,6 +126,13 @@ class AclEnforcer:
         if (self._bits(node, ctx) & perm) != perm:
             self._deny(ctx, sub, _perm_str(perm))
 
+    def allows(self, node, ctx: UserCtx, perm: int) -> bool:
+        """Non-raising bit check on an already-resolved inode (subtree
+        walks check each directory without re-resolving paths)."""
+        if not self.enabled or self._is_super(ctx):
+            return True
+        return (self._bits(node, ctx) & perm) == perm
+
     def check_set_attr(self, ctx: UserCtx, path: str, opts) -> None:
         """chmod: owner or superuser. chown: superuser only. chgrp: owner
         AND member of the target group (or superuser). Everything else

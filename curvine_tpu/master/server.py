@@ -219,6 +219,7 @@ class MasterServer:
         r(C.ADD_BLOCKS_BATCH, self._h(self._add_blocks_batch, mutate=True))
         r(C.COMPLETE_FILES_BATCH, self._h(self._complete_files_batch, mutate=True))
         r(C.LIST_OPTIONS, self._h(self._list_options))
+        r(C.CONTENT_SUMMARY, self._h(self._content_summary))
         r(C.GET_LOCK, self._h(self._get_lock))
         r(C.SET_LOCK, self._h(self._set_lock))
         r(C.LIST_LOCK, self._h(self._list_lock))
@@ -364,6 +365,51 @@ class MasterServer:
             if st is None:
                 raise
             return {"status": st.to_wire()}
+
+    async def _content_summary(self, q):
+        """Recursive length/file/dir counts in ONE RPC, computed on the
+        master's inode tree (the reference's ContentSummary aggregates
+        client-side over N ListStatus calls — content_summary.rs). The
+        walk yields to the event loop periodically (a big subtree must
+        not stall heartbeats), requires R|X on every directory like HDFS
+        getContentSummary, and refuses subtrees intersecting mounts —
+        their totals live (partly) in the UFS, so clients aggregate the
+        unified listing instead (CurvineClient.content_summary does)."""
+        import asyncio as _aio
+        from curvine_tpu.common import errors as cerr
+        path = q["path"]
+        ctx = UserCtx.from_req(q)
+        node = self.fs.tree.resolve(path)
+        if node is None:
+            raise cerr.FileNotFound(path)
+        if self.mounts is not None:
+            prefix = (path.rstrip("/") or "") + "/"
+            if self.mounts.get_mount(path) is not None or any(
+                    m.cv_path.startswith(prefix)
+                    for m in self.mounts.table()):
+                raise cerr.Unsupported(
+                    f"{path} intersects mounts: aggregate the unified "
+                    "listing client-side")
+        self.acl.check(ctx, path, R if node.is_dir else 0)
+        length = file_count = dir_count = visited = 0
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if n.is_dir:
+                if not self.acl.allows(n, ctx, R | X):
+                    raise cerr.PermissionDenied(
+                        f"user={ctx.user} needs r-x on "
+                        f"{self.fs.tree.path_of(n)}")
+                dir_count += 1
+                stack.extend(ch for _nm, ch in self.fs.tree.children(n))
+            else:
+                file_count += 1
+                length += n.len
+            visited += 1
+            if visited % 2048 == 0:
+                await _aio.sleep(0)
+        return {"length": length, "file_count": file_count,
+                "directory_count": dir_count}
 
     async def _list_status(self, q):
         """Cached entries merged with the mounted UFS listing (unified

@@ -39,6 +39,7 @@ class RpcCode(enum.IntEnum):
     COMPLETE_FILES_BATCH = 25
     FREE = 26
     LIST_OPTIONS = 27
+    CONTENT_SUMMARY = 28
 
     # manager interface
     MOUNT = 30

@@ -88,6 +88,12 @@ async def test_webhdfs_gateway():
                 async with s.put(f"{base}/h/dir/f.bin?op=RENAME&"
                                  f"destination=/h/dir/g.bin") as r:
                     assert (await r.json())["boolean"] is True
+                async with s.get(f"{base}/h?op=GETCONTENTSUMMARY") as r:
+                    cs = (await r.json())["ContentSummary"]
+                    # /h + /h/dir, one 10-byte file (recursive counts)
+                    assert cs["length"] == 10
+                    assert cs["fileCount"] == 1
+                    assert cs["directoryCount"] == 2
                 async with s.delete(f"{base}/h?op=DELETE&recursive=true") as r:
                     assert (await r.json())["boolean"] is True
                 async with s.get(f"{base}/h?op=GETFILESTATUS") as r:

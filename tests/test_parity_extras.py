@@ -125,3 +125,80 @@ async def test_cache_pressure_eviction():
         assert st.len == 1024 * 1024
         data = await c.unified_read("/p/f0.bin")
         assert len(data) == 1024 * 1024
+
+
+async def test_content_summary_rpc():
+    """Master-side recursive content summary (one RPC; reference
+    aggregates client-side over ListStatus — content_summary.rs)."""
+    from curvine_tpu.testing import MiniCluster
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.write_all("/cs/a/f1", b"x" * 100)
+        await c.write_all("/cs/a/b/f2", b"y" * 50)
+        await c.write_all("/cs/f3", b"z" * 25)
+        cs = await c.meta.content_summary("/cs")
+        assert cs["length"] == 175
+        assert cs["file_count"] == 3
+        assert cs["directory_count"] == 3          # /cs, /cs/a, /cs/a/b
+        one = await c.meta.content_summary("/cs/f3")
+        assert one == {"length": 25, "file_count": 1,
+                       "directory_count": 0}
+        import pytest as _p
+        from curvine_tpu.common import errors as _err
+        with _p.raises(_err.FileNotFound):
+            await c.meta.content_summary("/nope")
+
+
+async def test_content_summary_under_mounts_uses_unified_walk():
+    """The master refuses to sum subtrees intersecting mounts (totals
+    live partly in the UFS); the client aggregates the unified listing
+    — uncached UFS objects count."""
+    from curvine_tpu.testing import MiniCluster
+    from curvine_tpu.ufs import create_ufs
+    from curvine_tpu.ufs import memory as memufs
+    from curvine_tpu.common import errors as _err
+    import pytest as _p
+    memufs.reset()
+    ufs = create_ufs("mem://cs")
+    await ufs.write_all("mem://cs/x/u1.bin", b"u" * 40)
+    await ufs.write_all("mem://cs/u2.bin", b"v" * 60)
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.meta.mount("/m", "mem://cs")
+        await c.load_from_ufs("/m/u2.bin")      # one cached, one not
+        # master-side RPC refuses (typed), client aggregates unified view
+        with _p.raises(_err.Unsupported):
+            await c.meta.content_summary("/m")
+        cs = await c.content_summary("/m")
+        assert cs["length"] == 100 and cs["file_count"] == 2
+        assert cs["directory_count"] == 2       # /m and /m/x
+        # an ancestor of the mount is also refused master-side and
+        # aggregated by the client instead
+        await c.write_all("/plain.bin", b"p" * 7)
+        root = await c.content_summary("/")
+        assert root["length"] == 107
+
+
+async def test_content_summary_acl_denies_unreadable_subdir():
+    """HDFS semantics: getContentSummary needs r-x on every subdirectory
+    — an unreadable subdir fails the whole call instead of leaking its
+    aggregate size."""
+    from curvine_tpu.testing import MiniCluster
+    from curvine_tpu.common import errors as _err
+    from curvine_tpu.common.types import SetAttrOpts
+    import pytest as _p
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()          # root/superuser
+        await c.write_all("/top/open/a.bin", b"a" * 10)
+        await c.write_all("/top/secret/b.bin", b"b" * 20)
+        await c.meta.set_attr("/top/secret", SetAttrOpts(mode=0o700))
+        await c.meta.set_attr("/top", SetAttrOpts(mode=0o755))
+        # superuser sees everything
+        cs = await c.meta.content_summary("/top")
+        assert cs["length"] == 30
+        # a plain user is denied on the unreadable subdir
+        mc.conf.client.user = "alice"
+        mc.conf.client.groups = ["users"]
+        c2 = mc.client()
+        with _p.raises(_err.PermissionDenied):
+            await c2.meta.content_summary("/top")

@@ -379,6 +379,9 @@ class MasterServer:
         from curvine_tpu.common import errors as cerr
         path = q["path"]
         ctx = UserCtx.from_req(q)
+        # traverse check FIRST: FileNotFound vs PermissionDenied must not
+        # become an existence oracle inside unreadable directories
+        self.acl.check(ctx, path, 0)
         node = self.fs.tree.resolve(path)
         if node is None:
             raise cerr.FileNotFound(path)
@@ -390,7 +393,8 @@ class MasterServer:
                 raise cerr.Unsupported(
                     f"{path} intersects mounts: aggregate the unified "
                     "listing client-side")
-        self.acl.check(ctx, path, R if node.is_dir else 0)
+        if node.is_dir:
+            self.acl.check(ctx, path, R)
         length = file_count = dir_count = visited = 0
         stack = [node]
         while stack:

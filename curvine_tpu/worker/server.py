@@ -15,7 +15,8 @@ from curvine_tpu.common import errors as err
 from curvine_tpu.common.conf import ClusterConf
 from curvine_tpu.common.metrics import MetricsRegistry
 from curvine_tpu.common.types import (
-    JobState, StorageType, TaskInfo, WorkerAddress, WorkerInfo, now_ms,
+    BlockState, JobState, StorageType, TaskInfo, WorkerAddress, WorkerInfo,
+    now_ms,
 )
 from curvine_tpu.rpc import Message, RpcCode, RpcServer, ServerConn
 from curvine_tpu.rpc.client import Connection, ConnectionPool
@@ -118,7 +119,10 @@ class WorkerServer:
                                       initial_delay_s=1.0)
         self.executor.submit_periodic("eviction", self._evict_once, 1.0)
         self.executor.submit_periodic("scrub", self._scrub_once, 60.0)
-        if wc.promote_interval_ms > 0 and len(self.store.tiers) > 1:
+        # host tiers to promote between, OR an HBM tier-0 to auto-pin
+        # into — either gives the promote cycle work to do
+        if wc.promote_interval_ms > 0 and (len(self.store.tiers) > 1
+                                           or self.hbm is not None):
             self.executor.submit_periodic("promote", self._promote_once,
                                           wc.promote_interval_ms / 1000)
         log.info("worker %d started at %s", self.worker_id, self.addr)
@@ -230,6 +234,8 @@ class WorkerServer:
             raise err.ConnectError("no master reachable for heartbeat")
         for bid in deletes:
             self.store.delete(bid)
+            if self.hbm is not None:
+                self.hbm.drop(bid)
 
     async def block_report_once(self) -> None:
         held, types = self.store.report()
@@ -251,11 +257,17 @@ class WorkerServer:
                                for a in self.conf.client.master_addrs))
         for bid in deletes:
             self.store.delete(bid)
+            if self.hbm is not None:
+                self.hbm.drop(bid)
 
     async def _evict_once(self) -> None:
         dropped0 = self.store.dropped_total
         demoted0 = self.store.demoted_total
-        await asyncio.to_thread(self.store.maybe_evict)
+        removed = await asyncio.to_thread(self.store.maybe_evict)
+        if self.hbm is not None:
+            for bid in removed:
+                if not self.store.contains(bid):   # dropped, not demoted
+                    self.hbm.drop(bid)
         # evicted counts only blocks that LEFT the cache; demotions moved
         # tiers without losing data and get their own counter
         if self.store.dropped_total > dropped0:
@@ -267,11 +279,70 @@ class WorkerServer:
 
     async def _promote_once(self) -> None:
         """Hot-data promotion scan; tier changes reach the master on the
-        next block report (storage types reconcile there)."""
+        next block report (storage types reconcile there). With an HBM
+        tier enabled, the hottest blocks additionally auto-pin into
+        device memory (tier-0 promotion — heat snapshot taken BEFORE the
+        host scan halves it)."""
+        wc = self.conf.worker
+        hbm_hot: list[tuple[int, int, int]] = []
+        if self.hbm is not None:
+            # per-chip share bounds what can EVER pin; snapshot before
+            # the host scan halves the heat counters
+            per_chip = min(t.capacity for t in self.hbm.tiers.values()) \
+                if hasattr(self.hbm, "tiers") else self.hbm.capacity
+            hbm_hot = [t for t in self.store.hot_blocks(
+                           wc.promote_min_reads, max_len=per_chip)
+                       if t[0] not in self.hbm]
         promoted = await asyncio.to_thread(
-            self.store.promote_scan, self.conf.worker.promote_min_reads)
+            self.store.promote_scan, wc.promote_min_reads)
         if promoted:
             self.metrics.inc("blocks.promoted", len(promoted))
+        pinned = 0
+        budget = 256 << 20            # bound device transfers per cycle
+        for bid, _heat, blen in hbm_hot:
+            if budget <= 0:
+                break
+            try:
+                n = await self._autopin_block(bid)
+            except (err.CurvineError, OSError, ValueError) as e:
+                # deleted/evicted since the snapshot, or the chip can't
+                # take it: skip this block, keep pinning colder ones
+                log.debug("hbm autopin of %d skipped: %s", bid, e)
+                continue
+            if n:
+                budget -= n
+                pinned += 1
+        if pinned:
+            self.metrics.inc("blocks.hbm_pinned", pinned)
+            self.metrics.gauge("hbm.used", self.hbm.used)
+
+    async def _autopin_block(self, block_id: int) -> int:
+        """Read a committed block and pin it on the least-used local chip
+        (the HBM tier's own LRU makes room). The read+put runs in a
+        worker thread — up to 256MB of IO per cycle must not stall the
+        event loop. Returns bytes pinned."""
+        import numpy as np
+        info = self.store.get(block_id, touch=False)
+        if info.state != BlockState.COMMITTED:
+            return 0
+
+        def work() -> int:
+            buf = np.empty(info.len, dtype=np.uint8)
+            fd = os.open(info.path, os.O_RDONLY)
+            try:
+                os.preadv(fd, [memoryview(buf)], info.offset)
+            finally:
+                os.close(fd)
+            self.hbm.put(block_id, buf)
+            return info.len
+
+        n = await asyncio.to_thread(work)
+        if not self.store.contains(block_id):
+            # deleted mid-pin: the delete path's hbm.drop may have run
+            # BEFORE our put landed — drop again so nothing orphans
+            self.hbm.drop(block_id)
+            return 0
+        return n
 
     async def _scrub_once(self) -> None:
         """Checksum scrub; corrupt blocks get dropped and the master is
@@ -514,6 +585,8 @@ class WorkerServer:
     async def _delete_block(self, msg: Message, conn: ServerConn):
         q = unpack(msg.data) or {}
         self.store.delete(q["block_id"])
+        if self.hbm is not None:
+            self.hbm.drop(q["block_id"])     # no orphaned device copies
         return {}
 
     async def _get_block_info(self, msg: Message, conn: ServerConn):

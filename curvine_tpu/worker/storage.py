@@ -633,6 +633,21 @@ class BlockStore:
                 out.extend(self.trim(tier, 0))
         return out
 
+    def hot_blocks(self, min_reads: int,
+                   max_len: int | None = None) -> list[tuple[int, int, int]]:
+        """Snapshot of committed blocks with heat >= min_reads, hottest
+        first, as (block_id, heat, len) — the single source of the
+        promotion predicate for both the host-tier scan and the worker's
+        HBM auto-pin."""
+        with self._lock:
+            return sorted(
+                ((b.block_id, b.heat, b.len)
+                 for b in self.blocks.values()
+                 if b.state == BlockState.COMMITTED
+                 and b.heat >= min_reads
+                 and (max_len is None or b.len <= max_len)),
+                key=lambda t: t[1], reverse=True)
+
     # ---------- promotion ----------
     def promote_scan(self, min_reads: int = 3,
                      max_bytes: int = 256 << 20) -> list[int]:

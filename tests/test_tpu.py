@@ -301,3 +301,42 @@ async def test_worker_advertises_per_chip_hbm():
         wi = mc.master.fs.workers.live_workers()[0]
         assert sum(1 for s in wi.storages
                    if s.storage_type == StorageType.HBM) == 8
+
+
+async def test_hbm_autopin_hot_blocks_and_orphan_cleanup():
+    """Tier-0 promotion: the promote cycle auto-pins the hottest cached
+    blocks into HBM; deleting a block drops its device copy (no
+    orphans)."""
+    from curvine_tpu.common.types import StorageType
+    from curvine_tpu.testing import MiniCluster
+    from curvine_tpu.tpu.hbm import MultiHbmTier
+
+    import jax
+    async with MiniCluster(workers=1) as mc:
+        w = mc.workers[0]
+        w.hbm = MultiHbmTier(64 << 20, devices=jax.devices("cpu"))
+        c = mc.client()
+        await c.write_all("/hot.bin", b"H" * 100_000)
+        await c.write_all("/cold.bin", b"C" * 100_000)
+        for _ in range(4):
+            await c.read_all("/hot.bin")     # heat the block
+        fb = await c.meta.get_block_locations("/hot.bin")
+        hot_bid = fb.block_locs[0].block.id
+        fb2 = await c.meta.get_block_locations("/cold.bin")
+        cold_bid = fb2.block_locs[0].block.id
+
+        await w._promote_once()
+        assert hot_bid in w.hbm, "hot block should auto-pin into HBM"
+        assert cold_bid not in w.hbm, "cold block must not pin"
+        arr = w.hbm.get(hot_bid)
+        assert bytes(jax.device_get(arr)[:5]) == b"HHHHH"
+
+        # deleting the file drops the device copy on the next heartbeat
+        await c.meta.delete("/hot.bin")
+        async def gone():
+            while hot_bid in w.hbm:
+                await w.heartbeat_once()
+                import asyncio as _a
+                await _a.sleep(0.1)
+        import asyncio
+        await asyncio.wait_for(gone(), 10.0)

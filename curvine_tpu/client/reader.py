@@ -10,6 +10,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import time
 
 from curvine_tpu.common import errors as err  # noqa: F401
 from curvine_tpu.common.types import FileBlocks, LocatedBlock
@@ -35,10 +36,22 @@ class FsReader:
         self.pos = 0
         self.len = file_blocks.status.len
         self._local_paths: dict[int, str | None] = {}
-        self._local_fds: dict[int, int] = {}
+        # block_id -> (fd, path it was opened for): a re-probe that
+        # lands on a new path (tier move) must not reuse the old fd
+        self._local_fds: dict[int, tuple[int, str]] = {}
         # bdev tiers: the block is an extent at this base offset inside
         # the tier's shared backing file
         self._local_offs: dict[int, int] = {}
+        # bdev grants carry a lease (worker quarantines freed extents for
+        # 2x this); past expiry the cached (path, offset) must be
+        # re-probed before the next fd read
+        self._local_expiry: dict[int, float] = {}
+        # short-circuit reads bypass the worker, so heat is reported
+        # back: per-block read counts, flushed periodically + on close
+        self._sc_reads: dict[int, int] = {}
+        self._sc_addr: dict[int, str] = {}
+        self._sc_pending = 0
+        self._sc_flush_task: asyncio.Task | None = None
         self.counters = counters if counters is not None else {}
 
     # ---------------- positioning ----------------
@@ -77,8 +90,8 @@ class FsReader:
             if self.fs.client_host in (loc.hostname, loc.ip_addr) or \
                     loc.ip_addr in ("127.0.0.1", "localhost"):
                 try:
-                    conn = await self.pool.get(
-                        f"{loc.ip_addr or loc.hostname}:{loc.rpc_port}")
+                    addr = f"{loc.ip_addr or loc.hostname}:{loc.rpc_port}"
+                    conn = await self.pool.get(addr)
                     rep = await conn.call(RpcCode.GET_BLOCK_INFO,
                                           data=pack({"block_id": bid}))
                     info = rep.header or unpack(rep.data) or {}
@@ -86,10 +99,64 @@ class FsReader:
                     if p and os.path.exists(p):
                         path = p
                         self._local_offs[bid] = info.get("offset", 0)
+                        self._sc_addr[bid] = addr
+                        lease = info.get("lease_ms")
+                        if lease:
+                            self._local_expiry[bid] = \
+                                time.time() + lease / 1000
                 except err.CurvineError as e:
                     log.debug("short-circuit probe failed for %d: %s", bid, e)
         self._local_paths[bid] = path
         return path
+
+    async def _revalidate(self, lb: LocatedBlock) -> None:
+        """A leased (bdev-extent) grant expired: re-probe GET_BLOCK_INFO
+        and, if the block moved (different path/offset) or left the
+        worker, drop the stale fd so reads can't land in a reallocated
+        extent of the shared backing file."""
+        bid = lb.block.id
+        old_path = self._local_paths.get(bid)
+        old_off = self._local_offs.get(bid, 0)
+        self._local_paths.pop(bid, None)
+        self._local_expiry.pop(bid, None)
+        path = await self._local_path(lb)   # fresh probe
+        if path != old_path or self._local_offs.get(bid, 0) != old_off:
+            cached = self._local_fds.pop(bid, None)
+            if cached is not None:
+                try:
+                    os.close(cached[0])
+                except OSError:
+                    pass
+
+    # ---------------- short-circuit read accounting ----------------
+
+    def _note_sc_read(self, block_id: int, nbytes: int) -> None:
+        self.counters["sc.bytes.read"] = \
+            self.counters.get("sc.bytes.read", 0) + max(0, nbytes)
+        self._sc_reads[block_id] = self._sc_reads.get(block_id, 0) + 1
+        self._sc_pending += 1
+        if self._sc_pending >= 512 and (self._sc_flush_task is None
+                                        or self._sc_flush_task.done()):
+            self._sc_flush_task = asyncio.ensure_future(
+                self._flush_sc_reads())
+
+    async def _flush_sc_reads(self) -> None:
+        """Report accumulated per-block short-circuit read counts to the
+        granting workers (fire-and-forget; heat accounting only)."""
+        reads, self._sc_reads = self._sc_reads, {}
+        self._sc_pending = 0
+        by_addr: dict[str, dict[int, int]] = {}
+        for bid, n in reads.items():
+            addr = self._sc_addr.get(bid)
+            if addr is not None:
+                by_addr.setdefault(addr, {})[bid] = n
+        for addr, block_reads in by_addr.items():
+            try:
+                conn = await self.pool.get(addr)
+                await conn.call(RpcCode.SC_READ_REPORT,
+                                data=pack({"block_reads": block_reads}))
+            except (err.CurvineError, OSError) as e:
+                log.debug("sc read report to %s failed: %s", addr, e)
 
     # ---------------- reads ----------------
 
@@ -147,8 +214,7 @@ class FsReader:
                 base = self._local_offs.get(lb.block.id, 0)
                 got = os.preadv(fd, [memoryview(out[filled:filled + seg])],
                                 base + block_off)
-                self.counters["sc.bytes.read"] = \
-                    self.counters.get("sc.bytes.read", 0) + max(0, got)
+                self._note_sc_read(lb.block.id, got)
                 if got < seg:
                     out = out[:filled + max(0, got)]
                     break
@@ -186,21 +252,34 @@ class FsReader:
         unlink semantics keep the old copy complete); if the path is
         already gone — the block was promoted/demoted/evicted between the
         probe and this open — drop the cached path and let the caller
-        fall back to the socket read."""
-        fd = self._local_fds.get(block_id)
-        if fd is None:
+        fall back to the socket read. The cache is keyed by the path the
+        fd was opened for: a concurrent revalidation that resolved a NEW
+        path (tier move) must not pair the old fd with the new offset."""
+        cached = self._local_fds.get(block_id)
+        if cached is not None:
+            fd, fd_path = cached
+            if fd_path == path:
+                return fd
             try:
-                fd = os.open(path, os.O_RDONLY)
+                os.close(fd)
             except OSError:
-                self._local_paths.pop(block_id, None)
-                self._local_offs.pop(block_id, None)
-                return None
-            self._local_fds[block_id] = fd
+                pass
+            self._local_fds.pop(block_id, None)
+        try:
+            fd = os.open(path, os.O_RDONLY)
+        except OSError:
+            self._local_paths.pop(block_id, None)
+            self._local_offs.pop(block_id, None)
+            return None
+        self._local_fds[block_id] = (fd, path)
         return fd
 
     async def _local_fd(self, lb: LocatedBlock) -> int | None:
         """Short-circuit probe + open in one step: None → use the socket
-        path."""
+        path. Leased grants (bdev extents) re-probe past expiry."""
+        exp = self._local_expiry.get(lb.block.id)
+        if exp is not None and time.time() >= exp:
+            await self._revalidate(lb)
         local = await self._local_path(lb)
         if local is None:
             return None
@@ -228,8 +307,7 @@ class FsReader:
         got = os.preadv(fd, [memoryview(buf)], base + block_off)
         if got != n:
             return None
-        self.counters["sc.bytes.read"] = \
-            self.counters.get("sc.bytes.read", 0) + n
+        self._note_sc_read(lb.block.id, n)
         return buf
 
     async def _read_some(self, offset: int, n: int) -> bytes:
@@ -242,8 +320,7 @@ class FsReader:
         if fd is not None:
             base = self._local_offs.get(lb.block.id, 0)
             data = os.pread(fd, n, base + block_off)
-            self.counters["sc.bytes.read"] = \
-                self.counters.get("sc.bytes.read", 0) + len(data)
+            self._note_sc_read(lb.block.id, len(data))
             return data
         # failover across replica locations (local-first ordering)
         preferred = self._pick_loc(lb)
@@ -324,7 +401,14 @@ class FsReader:
         return bytes(out)
 
     async def close(self) -> None:
-        for fd in self._local_fds.values():
+        if self._sc_flush_task is not None and not self._sc_flush_task.done():
+            try:
+                await self._sc_flush_task
+            except Exception:  # noqa: BLE001 — accounting only
+                pass
+        if self._sc_reads:
+            await self._flush_sc_reads()
+        for fd, _path in self._local_fds.values():
             try:
                 os.close(fd)
             except OSError:

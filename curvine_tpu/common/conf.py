@@ -6,6 +6,7 @@ loaded from a TOML file with programmatic overrides)."""
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
 import tomllib
 from dataclasses import dataclass, field
@@ -213,5 +214,8 @@ def _apply_env(conf: "ClusterConf", env: dict) -> None:
                 break
         try:
             setattr(target, field_name, _coerce(cur, raw, ann))
-        except (TypeError, ValueError):
-            pass
+        except (TypeError, ValueError) as e:
+            # a typo'd env override (CURVINE_WORKER_RPC_PORT=abc) must
+            # surface, not silently fall back to the default
+            logging.getLogger(__name__).warning(
+                "ignoring env override %s=%r: %s", key, raw, e)

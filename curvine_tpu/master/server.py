@@ -395,6 +395,11 @@ class MasterServer:
                     "listing client-side")
         if node.is_dir:
             self.acl.check(ctx, path, R)
+        # Weakly consistent (HDFS-style): the walk yields to the event
+        # loop every 2048 nodes, so concurrent delete/rename can detach
+        # subtrees mid-traversal — counts reflect no single namespace
+        # snapshot (path_of tolerates detached nodes: it stops at the
+        # first missing parent).
         length = file_count = dir_count = visited = 0
         stack = [node]
         while stack:

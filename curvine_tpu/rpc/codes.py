@@ -76,6 +76,9 @@ class RpcCode(enum.IntEnum):
     SC_WRITE_OPEN = 86
     SC_WRITE_COMMIT = 87
     SC_WRITE_ABORT = 88
+    # short-circuit read accounting: clients report per-block read
+    # counters so worker heat/atime reflect fd-path traffic
+    SC_READ_REPORT = 89
 
     # raft-lite (master HA journal replication)
     RAFT_VOTE = 90

@@ -322,21 +322,26 @@ class WorkerServer:
         worker thread — up to 256MB of IO per cycle must not stall the
         event loop. Returns bytes pinned."""
         import numpy as np
-        info = self.store.get(block_id, touch=False)
-        if info.state != BlockState.COMMITTED:
-            return 0
+        # pinned for the whole read+put: a bdev extent can't be freed
+        # and reallocated under the preadv (would pin foreign bytes)
+        info = self.store.pin_read(block_id, touch=False)
+        try:
+            if info.state != BlockState.COMMITTED:
+                return 0
 
-        def work() -> int:
-            buf = np.empty(info.len, dtype=np.uint8)
-            fd = os.open(info.path, os.O_RDONLY)
-            try:
-                os.preadv(fd, [memoryview(buf)], info.offset)
-            finally:
-                os.close(fd)
-            self.hbm.put(block_id, buf)
-            return info.len
+            def work() -> int:
+                buf = np.empty(info.len, dtype=np.uint8)
+                fd = os.open(info.path, os.O_RDONLY)
+                try:
+                    os.preadv(fd, [memoryview(buf)], info.offset)
+                finally:
+                    os.close(fd)
+                self.hbm.put(block_id, buf)
+                return info.len
 
-        n = await asyncio.to_thread(work)
+            n = await asyncio.to_thread(work)
+        finally:
+            self.store.unpin_read(block_id)
         if not self.store.contains(block_id):
             # deleted mid-pin: the delete path's hbm.drop may have run
             # BEFORE our put landed — drop again so nothing orphans
@@ -365,6 +370,7 @@ class WorkerServer:
         r(RpcCode.SC_WRITE_OPEN, self._sc_write_open)
         r(RpcCode.SC_WRITE_COMMIT, self._sc_write_commit)
         r(RpcCode.SC_WRITE_ABORT, self._sc_write_abort)
+        r(RpcCode.SC_READ_REPORT, self._sc_read_report)
         r(RpcCode.WRITE_BLOCKS_BATCH, self._write_blocks_batch)
         r(RpcCode.HBM_PIN, self._hbm_pin)
         r(RpcCode.HBM_UNPIN, self._hbm_unpin)
@@ -495,67 +501,74 @@ class WorkerServer:
         transport is set to drain fully so buffer reuse is safe."""
         import numpy as np
         q = unpack(msg.data) or msg.header
-        info = self.store.get(q["block_id"])
-        offset = q.get("offset", 0)
-        length = q.get("len", -1)
-        chunk_size = q.get("chunk_size", self.chunk_size)
-        end = info.len if length < 0 else min(info.len, offset + length)
-        inline_io = info.tier.storage_type <= StorageType.MEM
-        want_crc = bool(q.get("verify", False))
+        # read pin: while this stream runs, tier moves of bdev-resident
+        # blocks are refused, so the extent can't be freed and reused
+        # under us (file-layout moves stay safe via unlink semantics)
+        info = self.store.pin_read(q["block_id"])
+        try:
+            offset = q.get("offset", 0)
+            length = q.get("len", -1)
+            chunk_size = q.get("chunk_size", self.chunk_size)
+            end = info.len if length < 0 else min(info.len, offset + length)
+            inline_io = info.tier.storage_type <= StorageType.MEM
+            want_crc = bool(q.get("verify", False))
 
-        base = info.offset                  # bdev extents start mid-file
-        if not want_crc:
-            # zero-copy: chunk payloads leave via kernel sendfile, data
-            # never enters userspace (TCP checksums the wire; at-rest
-            # integrity is the scrubber's job)
-            f = open(info.path, "rb")
+            base = info.offset              # bdev extents start mid-file
+            if not want_crc:
+                # zero-copy: chunk payloads leave via kernel sendfile, data
+                # never enters userspace (TCP checksums the wire; at-rest
+                # integrity is the scrubber's job)
+                f = open(info.path, "rb")
+                try:
+                    pos = offset
+                    while pos < end:
+                        n = min(chunk_size, end - pos)
+                        sent = await conn.send_chunk_from_file(
+                            msg.code, msg.req_id, f, base + pos, n)
+                        if sent <= 0:
+                            break
+                        pos += sent
+                    await conn.send(response_for(
+                        msg, header={"len": pos - offset},
+                        flags=Flags.RESPONSE | Flags.EOF))
+                    self.metrics.inc("bytes.read", pos - offset)
+                finally:
+                    f.close()
+                return None
+
+            # verified path: preadv into one reusable buffer + streaming
+            # crc (sock_sendall completes only once the kernel took the
+            # bytes, so reusing the buffer between sends is safe)
+            fd = os.open(info.path, os.O_RDONLY)
+            buf = np.empty(min(chunk_size, max(1, end - offset)),
+                           dtype=np.uint8)
             try:
+                crc = 0
                 pos = offset
                 while pos < end:
                     n = min(chunk_size, end - pos)
-                    sent = await conn.send_chunk_from_file(
-                        msg.code, msg.req_id, f, base + pos, n)
-                    if sent <= 0:
+                    view = memoryview(buf[:n])
+                    if inline_io:
+                        got = os.preadv(fd, [view], base + pos)
+                    else:
+                        got = await asyncio.to_thread(os.preadv, fd, [view],
+                                                      base + pos)
+                    if got <= 0:
                         break
-                    pos += sent
+                    view = view[:got]
+                    crc = zlib.crc32(view, crc)
+                    pos += got
+                    await conn.send(response_for(
+                        msg, data=view, flags=Flags.RESPONSE | Flags.CHUNK))
                 await conn.send(response_for(
-                    msg, header={"len": pos - offset},
+                    msg, header={"crc32": crc, "len": pos - offset},
                     flags=Flags.RESPONSE | Flags.EOF))
                 self.metrics.inc("bytes.read", pos - offset)
             finally:
-                f.close()
+                os.close(fd)
             return None
-
-        # verified path: preadv into one reusable buffer + streaming crc
-        # (sock_sendall completes only once the kernel took the bytes, so
-        # reusing the buffer between sends is safe)
-        fd = os.open(info.path, os.O_RDONLY)
-        buf = np.empty(min(chunk_size, max(1, end - offset)), dtype=np.uint8)
-        try:
-            crc = 0
-            pos = offset
-            while pos < end:
-                n = min(chunk_size, end - pos)
-                view = memoryview(buf[:n])
-                if inline_io:
-                    got = os.preadv(fd, [view], base + pos)
-                else:
-                    got = await asyncio.to_thread(os.preadv, fd, [view],
-                                                  base + pos)
-                if got <= 0:
-                    break
-                view = view[:got]
-                crc = zlib.crc32(view, crc)
-                pos += got
-                await conn.send(response_for(
-                    msg, data=view, flags=Flags.RESPONSE | Flags.CHUNK))
-            await conn.send(response_for(
-                msg, header={"crc32": crc, "len": pos - offset},
-                flags=Flags.RESPONSE | Flags.EOF))
-            self.metrics.inc("bytes.read", pos - offset)
         finally:
-            os.close(fd)
-        return None
+            self.store.unpin_read(q["block_id"])
 
     async def _write_blocks_batch(self, msg: Message, conn: ServerConn):
         """Many small blocks in one request — the small-file fast path.
@@ -592,11 +605,29 @@ class WorkerServer:
     async def _get_block_info(self, msg: Message, conn: ServerConn):
         """Metadata + local path (enables client short-circuit reads)."""
         q = unpack(msg.data) or {}
-        info = self.store.get(q["block_id"])
-        return {"block_id": info.block_id, "len": info.len,
-                "storage_type": int(info.tier.storage_type),
-                "path": os.path.abspath(info.path),
-                "offset": info.offset}
+        # lookup + lease recording are one atomic store operation: a
+        # free slipping in between would lease an already-freed extent
+        info, lease_ms = self.store.grant_sc(q["block_id"])
+        rep = {"block_id": info.block_id, "len": info.len,
+               "storage_type": int(info.tier.storage_type),
+               "path": os.path.abspath(info.path),
+               "offset": info.offset}
+        if lease_ms:
+            # extent grants expire: the client must re-probe before the
+            # tier's quarantine can return the freed extent to reuse
+            rep["lease_ms"] = lease_ms
+        return rep
+
+    async def _sc_read_report(self, msg: Message, conn: ServerConn):
+        """Short-circuit read accounting: clients read through cached fds
+        (the store only sees the initial probe), so they periodically
+        report per-block read counts — heat/atime then track actual
+        traffic and the promotion/HBM-autopin scans target the truly hot
+        blocks instead of the most-probed ones."""
+        q = unpack(msg.data) or {}
+        for bid, reads in (q.get("block_reads") or {}).items():
+            self.store.touch_reads(int(bid), int(reads))
+        return {}
 
     async def _replicate_block(self, msg: Message, conn: ServerConn):
         """Pull a block replica from a peer worker and report to master.

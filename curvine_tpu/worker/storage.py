@@ -84,7 +84,20 @@ class BdevTier(TierDir):
 
     The allocation table persists in ``<path>.idx`` (msgpack, written
     atomically on commit/delete); uncommitted extents are reclaimed on
-    restart like ``.tmp`` files in the file layout."""
+    restart like ``.tmp`` files in the file layout.
+
+    LEASED extents are QUARANTINED on free: unlike the file layout,
+    where POSIX unlink semantics keep an open fd valid after the block
+    moves, a reused extent inside the shared backing file would hand a
+    stale reader another block's bytes. Serving GET_BLOCK_INFO for an
+    extent records a lease (quarantine_s / 2, after which the client
+    must re-probe); freeing a still-leased extent parks it in
+    quarantine until the lease expires, while never-leased extents
+    (fresh writes, aborted moves, never-probed victims) return to the
+    free list immediately. The quarantine persists in the allocation
+    index so a restart inside the window can't resurrect the space."""
+
+    quarantine_s: float = 60.0
 
     def __init__(self, storage_type: StorageType, path: str, capacity: int,
                  dir_id: str = ""):
@@ -100,11 +113,78 @@ class BdevTier(TierDir):
         # block_id -> (offset, alloc_len); free list of (offset, len)
         self.extents: dict[int, tuple[int, int]] = {}
         self._free: list[tuple[int, int]] = [(0, capacity)]
+        # freed-but-not-yet-reusable extents:
+        # (ready_time, off, len, block_id) — block_id lets reclaim skip
+        # extents whose (deleted) block still has an active read pin
+        self._quarantine: list[tuple[float, int, int, int]] = []
+        self._quarantined = 0
+        # block_id -> expiry of the latest short-circuit grant
+        self._leases: dict[int, float] = {}
 
     def block_path(self, block_id: int, suffix: str = ".blk") -> str:
         raise err.Unsupported("bdev tier has no per-block files")
 
+    @property
+    def available(self) -> int:
+        # pure read (heartbeat storages() reads it without the store
+        # lock); BlockStore._reclaim_locked harvests expired quarantine
+        # before every allocation/eviction decision
+        return max(0, self.capacity - self.used - self._quarantined)
+
+    @property
+    def lease_s(self) -> float:
+        return self.quarantine_s / 2
+
+    def note_lease(self, block_id: int, expiry: float) -> None:
+        if expiry > self._leases.get(block_id, 0.0):
+            self._leases[block_id] = expiry
+
+    def free_would_quarantine(self, block_id: int,
+                              now: float | None = None) -> bool:
+        """True when freeing this block yields no allocatable space yet
+        (an unexpired short-circuit lease forces quarantine) — eviction
+        planning skips such victims: dropping them destroys data without
+        helping the allocation that triggered the eviction."""
+        if self.quarantine_s <= 0:
+            return False
+        now = time.time() if now is None else now
+        return self._leases.get(block_id, 0.0) > now
+
     # ---- extent allocation (first-fit, merge on free) ----
+    def reclaim(self, now: float | None = None,
+                skip: frozenset | set = frozenset()) -> int:
+        """Move expired quarantine entries back to the free list,
+        leaving entries whose block id is in `skip` (active read pins)
+        parked. Returns bytes reclaimed. Callers hold the store lock."""
+        if not self._quarantine:
+            return 0
+        now = time.time() if now is None else now
+        ready = [q for q in self._quarantine
+                 if q[0] <= now and q[3] not in skip]
+        if not ready:
+            return 0
+        taken = set(map(id, ready))
+        self._quarantine = [q for q in self._quarantine
+                            if id(q) not in taken]
+        got = 0
+        for _t, off, size, _bid in ready:
+            self._free.append((off, size))
+            self._quarantined -= size
+            got += size
+        self._merge_free()
+        return got
+
+    def _merge_free(self) -> None:
+        # merge adjacent free extents (keeps the list from fragmenting)
+        self._free.sort()
+        merged: list[tuple[int, int]] = []
+        for o, ln in self._free:
+            if merged and merged[-1][0] + merged[-1][1] == o:
+                merged[-1] = (merged[-1][0], merged[-1][1] + ln)
+            else:
+                merged.append((o, ln))
+        self._free = merged
+
     def alloc(self, block_id: int, size: int) -> int:
         for i, (off, flen) in enumerate(self._free):
             if flen >= size:
@@ -124,16 +204,33 @@ class BdevTier(TierDir):
             return
         off, size = ext
         self.used -= size
-        self._free.append((off, size))
-        # merge adjacent free extents (keeps the list from fragmenting)
-        self._free.sort()
-        merged: list[tuple[int, int]] = []
-        for o, ln in self._free:
-            if merged and merged[-1][0] + merged[-1][1] == o:
-                merged[-1] = (merged[-1][0], merged[-1][1] + ln)
-            else:
-                merged.append((o, ln))
-        self._free = merged
+        lease = self._leases.pop(block_id, 0.0)
+        now = time.time()
+        if self.quarantine_s > 0 and lease > now:
+            # an unexpired short-circuit grant may still read this
+            # extent through a cached fd: unusable until the lease
+            # passes (+1s local-clock slack)
+            self._quarantine.append((lease + 1.0, off, size, block_id))
+            self._quarantined += size
+        else:
+            self._free.append((off, size))
+            self._merge_free()
+
+    def quarantine_block(self, block_id: int) -> None:
+        """Free a block's extent while an in-process reader still holds
+        a pin on it (delete-mid-stream): the extent goes straight to
+        quarantine — persisted via save_index, so a crash before the pin
+        drops can't resurrect the space — and reclaim skips it while the
+        pin lives."""
+        ext = self.extents.pop(block_id, None)
+        if ext is None:
+            return
+        off, size = ext
+        self.used -= size
+        lease = self._leases.pop(block_id, 0.0)
+        ready = max(time.time() + max(self.quarantine_s, 1.0), lease + 1.0)
+        self._quarantine.append((ready, off, size, block_id))
+        self._quarantined += size
 
     # ---- persistent allocation table ----
     @property
@@ -150,7 +247,11 @@ class BdevTier(TierDir):
         tmp = self.index_path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(msgpack.packb({"capacity": self.capacity,
-                                   "blocks": table}))
+                                   "blocks": table,
+                                   # live quarantine rides the index: a
+                                   # restart inside the window must not
+                                   # resurrect leased space
+                                   "quarantine": self._quarantine}))
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.index_path)
@@ -164,21 +265,34 @@ class BdevTier(TierDir):
         except (FileNotFoundError, ValueError, msgpack.UnpackException):
             return {}
         out = {}
+        now = time.time()
         for bid, (off, alen, ln, crc, algo) in d.get("blocks", {}).items():
             bid = int(bid)
             self.extents[bid] = (off, alen)
             out[bid] = (off, alen, ln, crc, algo)
-        # rebuild the free list from the allocated extents
-        allocated = sorted(self.extents.values())
+            # leases don't survive the restart, but the fds they cover
+            # might: assume every surviving block was granted one just
+            # before the crash, so an early free still quarantines
+            if self.quarantine_s > 0:
+                self._leases[bid] = now + self.lease_s
+        # restore the unexpired quarantine (reclaim() harvests the rest;
+        # pins don't survive a restart, so the ids only matter pre-crash)
+        self._quarantine = [
+            (t, off, ln, bid)
+            for t, off, ln, bid in d.get("quarantine", []) if t > now]
+        self._quarantined = sum(ln for _t, _o, ln, _b in self._quarantine)
+        quarantined = {(off, ln) for _t, off, ln, _b in self._quarantine}
+        # rebuild the free list from the allocated + quarantined extents
+        occupied = sorted(list(self.extents.values()) + list(quarantined))
         self._free = []
         pos = 0
-        for off, alen in allocated:
+        for off, alen in occupied:
             if off > pos:
                 self._free.append((pos, off - pos))
-            pos = off + alen
+            pos = max(pos, off + alen)
         if pos < self.capacity:
             self._free.append((pos, self.capacity - pos))
-        self.used = sum(alen for _, alen in allocated)
+        self.used = sum(alen for _, alen in self.extents.values())
         return out
 
 
@@ -197,6 +311,10 @@ class BlockStore:
         self._lock = threading.Lock()
         # block ids mid-tier-move (copy runs lock-free; see _move_block)
         self._moving: set[int] = set()
+        # active in-process readers per block (worker streaming reads,
+        # HBM autopin): a pinned bdev-resident block is never moved, so
+        # its extent can't be freed and reused under the reader
+        self._read_pins: dict[int, int] = {}
         # lifetime tier-movement stats (dropped = data actually left the
         # cache; demoted/promoted = moved between tiers, nothing lost)
         self.dropped_total = 0
@@ -239,6 +357,7 @@ class BlockStore:
     # ---------- lifecycle ----------
     def pick_tier(self, hint: StorageType | None, size_hint: int) -> TierDir:
         # Preferred tier first, then any tier fastest-first with room.
+        self._reclaim_locked()
         ordered = self.tiers
         if hint is not None:
             ordered = ([t for t in self.tiers if t.storage_type == hint]
@@ -365,6 +484,67 @@ class BlockStore:
                 info.heat += 1
             return info
 
+    def touch_reads(self, block_id: int, reads: int) -> None:
+        """Account reads that bypassed get() — short-circuit clients hit
+        the store once per open (the GET_BLOCK_INFO probe) and then read
+        through a cached fd; they report per-block read counters on
+        heartbeat so heat/atime reflect actual traffic and promotion
+        targets the right blocks."""
+        with self._lock:
+            info = self.blocks.get(block_id)
+            if info is not None and reads > 0:
+                info.atime = time.time()
+                info.heat += reads
+
+    def pin_read(self, block_id: int, touch: bool = True) -> BlockInfo:
+        """Atomically look up a block and take a read pin on it; pair
+        with unpin_read(). While pinned, tier moves of bdev-resident
+        blocks are refused (_move_block), so the extent under an active
+        reader can never be freed and reallocated mid-stream."""
+        with self._lock:
+            info = self._get_locked(block_id)
+            if touch:
+                info.atime = time.time()
+                info.heat += 1
+            self._read_pins[block_id] = self._read_pins.get(block_id, 0) + 1
+            return info
+
+    def unpin_read(self, block_id: int) -> None:
+        with self._lock:
+            n = self._read_pins.get(block_id, 0) - 1
+            if n <= 0:
+                self._read_pins.pop(block_id, None)
+            else:
+                self._read_pins[block_id] = n
+
+    def grant_sc(self, block_id: int) -> tuple[BlockInfo, int]:
+        """Short-circuit grant: look up the block and, for bdev
+        extents, record the lease ATOMICALLY with the lookup (a free
+        slipping between get() and note_lease would lease an extent
+        already on the free list). Returns (info, lease_ms) —
+        lease_ms 0 for file-layout blocks (unlink semantics, no lease
+        needed)."""
+        with self._lock:
+            info = self._get_locked(block_id)
+            info.atime = time.time()
+            info.heat += 1
+            lease_ms = 0
+            if isinstance(info.tier, BdevTier) \
+                    and info.tier.quarantine_s > 0:
+                ls = info.tier.lease_s
+                info.tier.note_lease(block_id, time.time() + ls)
+                lease_ms = int(ls * 1000)
+            return info, lease_ms
+
+    def _reclaim_locked(self) -> None:
+        """Harvest expired bdev quarantine before any allocation or
+        eviction decision, skipping extents whose (deleted) block still
+        has an active read pin."""
+        pinned = set(self._read_pins)
+        for t in self.tiers:
+            if isinstance(t, BdevTier):
+                t.reclaim(skip=pinned)
+
     def contains(self, block_id: int) -> bool:
         return block_id in self.blocks
 
@@ -376,7 +556,13 @@ class BlockStore:
 
     def _remove_locked(self, info: BlockInfo) -> None:
         if info.is_extent:
-            info.tier.free(info.block_id)      # adjusts used by alloc_len
+            if self._read_pins.get(info.block_id):
+                # an active stream holds (fd, offset) into the backing
+                # file: park the extent in quarantine (persisted below);
+                # reclaim skips it while the pin lives
+                info.tier.quarantine_block(info.block_id)
+            else:
+                info.tier.free(info.block_id)  # adjusts used by alloc_len
             self.blocks.pop(info.block_id, None)
             if info.state == BlockState.COMMITTED:
                 info.tier.save_index(self.blocks)
@@ -420,9 +606,16 @@ class BlockStore:
         new location via GET_BLOCK_INFO."""
         # Phase 1 (locked): validate + reserve destination space.
         with self._lock:
+            self._reclaim_locked()
             info = self.blocks.get(block_id)
             if info is None or info.state != BlockState.COMMITTED \
                     or info.tier is dest or block_id in self._moving:
+                return False
+            if self._read_pins.get(block_id):
+                # an active in-process reader snapshots (path, offset)
+                # lock-free; a move would tear that pair under it — for
+                # a bdev source it would even free the extent mid-read.
+                # Refuse moves of ANY pinned block.
                 return False
             src_path, src_off, src_tier = info.path, info.offset, info.tier
             length = info.len
@@ -481,8 +674,12 @@ class BlockStore:
             self._moving.discard(block_id)
             info = self.blocks.get(block_id)
             if info is None or info.state != BlockState.COMMITTED \
-                    or info.tier is not src_tier or info.len != length:
-                # deleted/evicted mid-copy: ours is stale
+                    or info.tier is not src_tier or info.len != length \
+                    or self._read_pins.get(block_id):
+                # deleted/evicted mid-copy, or a reader pinned the
+                # source during the lock-free copy (swapping tier/offset
+                # would tear the pair under their preadv; a bdev source
+                # would even free the extent): ours is the stale copy
                 release_dest()
                 if not isinstance(dest, BdevTier):
                     try:
@@ -513,11 +710,22 @@ class BlockStore:
         low-water trim target) fits, deciding drop-vs-demote per victim.
         Returns (plan, still_needed) where plan is [(block_id, dest|None)]
         — dest None means drop."""
+        self._reclaim_locked()
         target_free = max(need, int(tier.capacity * (1 - self.low_water)))
+        now = time.time()
         victims = sorted(
             (b for b in self.blocks.values()
              if b.tier is tier and b.state == BlockState.COMMITTED
-             and b.block_id not in self._moving),
+             and b.block_id not in self._moving
+             # never evict a block with an active reader, and skip
+             # leased bdev extents entirely: their free lands in
+             # quarantine, so dropping destroys data without making
+             # room and demoting burns copy IO for zero freed bytes —
+             # the lease lapses within lease_s and the next scan takes
+             # them
+             and not self._read_pins.get(b.block_id)
+             and not (isinstance(tier, BdevTier)
+                      and tier.free_would_quarantine(b.block_id, now))),
             key=lambda b: b.atime)
         plan: list[tuple[int, TierDir | None]] = []
         freed = tier.available
@@ -604,7 +812,13 @@ class BlockStore:
                     info = self.blocks.get(bid)
                     if info is not None and info.tier is tier \
                             and info.state == BlockState.COMMITTED \
-                            and bid not in self._moving:
+                            and bid not in self._moving \
+                            and not self._read_pins.get(bid) \
+                            and not (isinstance(tier, BdevTier)
+                                     and tier.free_would_quarantine(bid)):
+                        # same futile-drop guard as the planner: a leased
+                        # extent's free lands in quarantine — destroying
+                        # data without making room
                         self._remove_locked(info)
                         removed.append(bid)
                         self.dropped_total += 1

@@ -18,6 +18,7 @@ MB = 1024 * 1024
 
 def test_bdev_extent_allocation(tmp_path):
     tier = BdevTier(StorageType.SSD, str(tmp_path / "bdev.img"), 10 * MB)
+    tier.quarantine_s = 0            # allocator mechanics, no grace here
     a = tier.alloc(1, 4 * MB)
     b = tier.alloc(2, 4 * MB)
     assert (a, b) == (0, 4 * MB)
@@ -54,6 +55,7 @@ def test_bdev_store_lifecycle_and_restart(tmp_path):
     store.create_temp(8, StorageType.SSD, size_hint=MB)
 
     tier2 = BdevTier(StorageType.SSD, path, 16 * MB)
+    tier2.quarantine_s = 0
     store2 = BlockStore([tier2])
     assert store2.contains(7) and not store2.contains(8)
     info2 = store2.get(7)
@@ -63,6 +65,114 @@ def test_bdev_store_lifecycle_and_restart(tmp_path):
     # delete frees the extent
     store2.delete(7)
     assert tier2.used == 0 and tier2._free == [(0, 16 * MB)]
+
+
+def test_bdev_freed_extent_quarantined(tmp_path):
+    """A LEASED extent must NOT be immediately reusable after free:
+    short-circuit readers hold (fd, offset) into the shared backing file
+    for up to the advertised lease, so reuse inside the window would
+    hand them another block's bytes (round-3 advisor high finding).
+    Never-leased extents free instantly — eviction of unprobed blocks
+    keeps making room."""
+    import time
+
+    tier = BdevTier(StorageType.SSD, str(tmp_path / "bdev.img"), 10 * MB)
+    tier.quarantine_s = 60
+    a = tier.alloc(1, 4 * MB)
+    tier.alloc(2, 4 * MB)
+    tier.note_lease(1, time.time() + 30)    # a client probed block 1
+    tier.free(1)
+    # the leased extent is quarantined: not allocatable, not "available"
+    assert tier.used == 4 * MB
+    assert tier.available == 2 * MB
+    b = tier.alloc(3, 2 * MB)
+    assert b == 8 * MB                 # NOT the freed offset 0
+    with pytest.raises(err.CapacityExceeded):
+        tier.alloc(4, 4 * MB)          # quarantined space can't satisfy
+    # once the lease expires the extent returns to the free list
+    tier._quarantine = [(0.0, off, ln, bid)
+                        for _t, off, ln, bid in tier._quarantine]
+    got = tier.reclaim()
+    assert got == 4 * MB
+    c = tier.alloc(4, 4 * MB)
+    assert c == a                      # now reuse is safe
+    assert tier._quarantined == 0
+    # a never-leased extent frees straight back to the free list
+    tier.free(3)                       # blocks 2+4 still hold 8 MB
+    assert tier._quarantine == [] and tier.available == 2 * MB
+
+
+def test_bdev_quarantine_survives_restart(tmp_path):
+    """The quarantine rides the allocation index: a worker restart
+    inside the window must not hand a leased extent to a new block."""
+    import time
+
+    path = str(tmp_path / "bdev.img")
+    tier = BdevTier(StorageType.SSD, path, 10 * MB)
+    store = BlockStore([tier])
+    info = store.create_temp(1, StorageType.SSD, size_hint=4 * MB)
+    with open(info.path, "r+b") as f:
+        f.seek(info.offset)
+        f.write(b"a" * MB)
+    store.commit(1, MB, checksum=None)
+    tier.note_lease(1, time.time() + 30)
+    store.delete(1)                        # extent quarantined + persisted
+    assert tier._quarantined == 4 * MB
+
+    tier2 = BdevTier(StorageType.SSD, path, 10 * MB)
+    BlockStore([tier2])
+    assert tier2._quarantined == 4 * MB    # restored from the index
+    assert tier2.alloc(9, 4 * MB) == 4 * MB   # not the quarantined offset
+
+
+def test_bdev_delete_while_pinned_defers_free(tmp_path):
+    """Deleting a block mid-stream (read pin held) defers the extent
+    free until the pin drops — the streaming reader's preadv can never
+    land in a reallocated extent."""
+    tier = BdevTier(StorageType.SSD, str(tmp_path / "bdev.img"), 10 * MB)
+    store = BlockStore([tier])
+    info = store.create_temp(1, StorageType.SSD, size_hint=4 * MB)
+    with open(info.path, "r+b") as f:
+        f.seek(info.offset)
+        f.write(b"a" * MB)
+    store.commit(1, MB, checksum=None)
+
+    store.pin_read(1)
+    store.delete(1)
+    assert not store.contains(1)           # gone from the index...
+    assert tier._quarantined == 4 * MB     # ...extent parked, persisted
+    # reclaim skips the entry while the pin lives, even past its ready
+    # time — a slow stream can outlive the quarantine window
+    tier._quarantine = [(0.0, off, ln, bid)
+                        for _t, off, ln, bid in tier._quarantine]
+    with store._lock:
+        store._reclaim_locked()
+    assert tier._quarantined == 4 * MB
+    store.unpin_read(1)
+    with store._lock:
+        store._reclaim_locked()
+    assert tier._quarantined == 0          # harvested after the pin drops
+
+
+def test_bdev_pinned_block_not_moved(tmp_path):
+    """An active reader pin blocks tier moves of bdev-resident blocks —
+    the extent under a streaming read can never be freed mid-stream."""
+    bdev = BdevTier(StorageType.SSD, str(tmp_path / "bdev.img"), 16 * MB)
+    import curvine_tpu.worker.storage as stmod
+    mem = stmod.TierDir(StorageType.MEM, str(tmp_path / "mem"), 16 * MB)
+    store = BlockStore([mem, bdev])
+    info = store.create_temp(5, StorageType.SSD, size_hint=MB)
+    with open(info.path, "r+b") as f:
+        f.seek(info.offset)
+        f.write(b"x" * MB)
+    store.commit(5, MB, checksum=None)
+
+    pinned = store.pin_read(5)
+    assert pinned.block_id == 5
+    assert store._move_block(5, mem) is False      # refused while pinned
+    store.unpin_read(5)
+    assert store._move_block(5, mem) is True       # allowed after unpin
+    assert store.get(5).tier is mem
 
 
 async def test_bdev_cluster_roundtrip(tmp_path):

@@ -387,14 +387,26 @@ async def _fuse_bench(c) -> dict:
     out = {}
     session = None
     sess_task = None
-    try:
+
+    async def mount():
         fd = fusermount_mount(mnt)
         fs = CurvineFuseFs(c, uid=os.getuid(), gid=os.getgid())
-        session = FuseSession(fs, fd)
-        sess_task = asyncio.ensure_future(session.run())
+        s = FuseSession(fs, fd)
+        t = asyncio.ensure_future(s.run())
+        return s, t
 
-        def blocking():
-            total = 64 * MB
+    def remount_sync():
+        # cold phases: a fresh mount = fresh superblock = empty kernel
+        # page cache for the file (warm numbers measure the page cache
+        # that FOPEN_KEEP_CACHE + writeback leave behind — fio's own
+        # warm-cache semantics)
+        fusermount_umount(mnt)
+
+    try:
+        session, sess_task = await mount()
+        total = 64 * MB
+
+        def write_and_warm():
             buf = os.urandom(4 * MB)
             t0 = time.perf_counter()
             with open(f"{mnt}/fio.bin", "wb") as f:
@@ -402,13 +414,35 @@ async def _fuse_bench(c) -> dict:
                     f.write(buf)
             r = {"fuse_seq_write_gibs": total / (1024 ** 3)
                  / (time.perf_counter() - t0)}
-            # drop page cache effects by reading through a fresh fd
             t0 = time.perf_counter()
             n = 0
             with open(f"{mnt}/fio.bin", "rb", buffering=0) as f:
                 while chunk := f.read(4 * MB):
                     n += len(chunk)
-            r["fuse_seq_read_gibs"] = n / (1024 ** 3) / (time.perf_counter() - t0)
+            r["fuse_warm_read_gibs"] = n / (1024 ** 3) \
+                / (time.perf_counter() - t0)
+            import random
+            rng = random.Random(0)
+            fd2 = os.open(f"{mnt}/fio.bin", os.O_RDONLY)
+            iters = 2048
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                os.pread(fd2, 4096, rng.randrange(0, total - 4096))
+            os.close(fd2)
+            r["fuse_warm_rand4k_iops"] = iters / (time.perf_counter() - t0)
+            return r
+
+        # the mount is served by THIS event loop: POSIX calls must run in
+        # a thread or they deadlock against the FUSE session
+        out = await asyncio.to_thread(write_and_warm)
+
+        sess_task.cancel()
+        await asyncio.to_thread(remount_sync)
+        session.stop()
+        await asyncio.sleep(0.3)
+        session, sess_task = await mount()
+
+        def cold_rand():
             import random
             rng = random.Random(0)
             fd2 = os.open(f"{mnt}/fio.bin", os.O_RDONLY)
@@ -417,16 +451,29 @@ async def _fuse_bench(c) -> dict:
             for _ in range(iters):
                 os.pread(fd2, 4096, rng.randrange(0, total - 4096))
             os.close(fd2)
-            r["fuse_rand4k_iops"] = iters / (time.perf_counter() - t0)
-            return r
+            return {"fuse_rand4k_iops": iters / (time.perf_counter() - t0)}
 
-        # the mount is served by THIS event loop: POSIX calls must run in
-        # a thread or they deadlock against the FUSE session
-        out = await asyncio.to_thread(blocking)
+        out.update(await asyncio.to_thread(cold_rand))
+
+        sess_task.cancel()
+        await asyncio.to_thread(remount_sync)
+        session.stop()
+        await asyncio.sleep(0.3)
+        session, sess_task = await mount()
+
+        def cold_seq():
+            t0 = time.perf_counter()
+            n = 0
+            with open(f"{mnt}/fio.bin", "rb", buffering=0) as f:
+                while chunk := f.read(4 * MB):
+                    n += len(chunk)
+            return {"fuse_seq_read_gibs": n / (1024 ** 3)
+                    / (time.perf_counter() - t0)}
+
+        out.update(await asyncio.to_thread(cold_seq))
     except Exception as e:  # noqa: BLE001 — FUSE denied (container policy
         # etc.) must not discard every other measured result
         print(f"fuse bench skipped: {e}", file=sys.stderr)
-        out = {}
     finally:
         if sess_task is not None:
             sess_task.cancel()
@@ -497,6 +544,9 @@ def main():
         "fuse_seq_read_gibs": round(results.get("fuse_seq_read_gibs", 0), 3),
         "fuse_seq_write_gibs": round(results.get("fuse_seq_write_gibs", 0), 3),
         "fuse_rand4k_iops": round(results.get("fuse_rand4k_iops", 0), 1),
+        "fuse_warm_read_gibs": round(results.get("fuse_warm_read_gibs", 0), 3),
+        "fuse_warm_rand4k_iops": round(
+            results.get("fuse_warm_rand4k_iops", 0), 1),
         "mfu": round(results.get("mfu", 0), 4),
         "train_step_ms": round(results.get("train_step_ms", 0), 2),
         "model_params_m": round(results.get("model_params_m", 0), 1),

@@ -91,12 +91,27 @@ async def cmd_get(args):
         # unified open: freed/uncached files under mounts stream from
         # the UFS instead of reading an empty cache entry
         r = await c.unified_open(args.src)
+        cc = c.conf.client
         t0 = time.perf_counter()
         total = 0
         with open(args.dst, "wb") as f:
-            async for chunk in r.chunks():
-                f.write(chunk)
-                total += len(chunk)
+            if r.len >= cc.large_file_size and cc.read_parallel > 1:
+                # large file: sharded parallel windows (each window's
+                # slices stream from different workers concurrently)
+                window = max(cc.read_chunk_size * cc.read_parallel,
+                             64 << 20)
+                while total < r.len:
+                    buf = await r.read_range(total,
+                                             min(window, r.len - total),
+                                             cc.read_parallel)
+                    if len(buf) == 0:
+                        break
+                    f.write(buf)
+                    total += len(buf)
+            else:
+                async for chunk in r.chunks():
+                    f.write(chunk)
+                    total += len(chunk)
         dt = time.perf_counter() - t0
         print(f"get {args.src} -> {args.dst}: {_human(total)} "
               f"in {dt:.2f}s ({_human(total / max(dt, 1e-9))}/s)")
@@ -502,6 +517,8 @@ async def cmd_fuse(args):
     conf = _conf(args)
     if args.mountpoint:
         conf.fuse.mount_point = args.mountpoint
+    if getattr(args, "metrics_port", None):
+        conf.fuse.metrics_port = int(args.metrics_port)
     await mount_and_serve(conf)
 
 
@@ -566,7 +583,7 @@ def build_parser() -> argparse.ArgumentParser:
     add("bench", cmd_bench, A("--size-mb", type=int, default=256))
     add("master", cmd_master)
     add("worker", cmd_worker)
-    add("fuse", cmd_fuse, A("--mountpoint"))
+    add("fuse", cmd_fuse, A("--mountpoint"), A("--metrics-port"))
     add("gateway", cmd_gateway, A("--s3-port", type=int, default=9900),
         A("--webhdfs-port", type=int, default=9870))
     return p

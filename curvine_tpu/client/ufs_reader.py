@@ -41,6 +41,10 @@ class UfsReader:
         import numpy as np
         return np.frombuffer(await self.pread(offset, n), dtype=np.uint8)
 
+    async def read_range(self, offset: int, n: int, parallel: int = 1):
+        # UFS objects stream sequentially; parallel is a no-op here
+        return await self.pread_view(offset, n)
+
     async def mmap_view(self, offset: int, n: int):
         return None      # no local block files to map
 

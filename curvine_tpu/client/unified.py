@@ -124,7 +124,9 @@ class CurvineClient:
                         chunk_size=cc.read_chunk_size,
                         short_circuit=cc.short_circuit,
                         read_ahead=cc.read_ahead_chunks,
-                        counters=self.counters)
+                        counters=self.counters,
+                        smart_prefetch=cc.enable_smart_prefetch,
+                        seq_threshold=cc.sequential_read_threshold)
 
     async def write_all(self, path: str, data: bytes, **kw) -> None:
         async with await self.create(path, overwrite=True, **kw) as w:
@@ -408,7 +410,7 @@ class FallbackReader:
         # and those must be re-read on the fallback stream. Positional
         # ops resume at their own offset (the shrink guard needs it:
         # a pread mid-file on a shrunken object must error, not EOF).
-        if op in ("pread", "pread_view"):
+        if op in ("pread", "pread_view", "read_range"):
             resume = args[0]
         elif op == "read":
             resume = getattr(self._r, "pos", 0)
@@ -433,6 +435,9 @@ class FallbackReader:
 
     async def pread_view(self, offset: int, n: int):
         return await self._do("pread_view", offset, n)
+
+    async def read_range(self, offset: int, n: int, parallel: int = 1):
+        return await self._do("read_range", offset, n, parallel)
 
     async def mmap_view(self, offset: int, n: int):
         # mmap is a short-circuit-only optimization; a None return makes

@@ -103,6 +103,15 @@ class ClientConf:
     write_chunk_size: int = 4 * MB
     read_chunk_size: int = 4 * MB
     read_ahead_chunks: int = 4
+    # adaptive read path (parity: curvine-client read_detector.rs):
+    # positional reads prefetch ahead while the pattern is sequential,
+    # stop when it turns random
+    enable_smart_prefetch: bool = True
+    sequential_read_threshold: int = 3
+    # sharded parallel reads of one large file (fs_reader_parallel.rs):
+    # files >= large_file_size split into read_parallel concurrent slices
+    read_parallel: int = 4
+    large_file_size: int = 64 * MB
     short_circuit: bool = True
     storage_type: str = "mem"
     write_type: str = "cache"      # cache|fs
@@ -125,6 +134,10 @@ class FuseConf:
     # in-place/random writes: files up to this size are staged in RAM and
     # rewritten to the cache at release (0 disables → EOPNOTSUPP)
     inplace_max_mb: int = 256
+    # per-mount metrics HTTP endpoint (/metrics prometheus + /ops JSON
+    # with per-op latency quantiles); 0 disables.
+    # Parity: curvine-fuse/src/web_server.rs + fuse_metrics.rs
+    metrics_port: int = 0
 
 
 @dataclass

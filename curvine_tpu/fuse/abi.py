@@ -78,6 +78,8 @@ class Errno:
     ENOSYS = 38
     ENOTEMPTY = 39
     ENODATA = 61
+    EINTR = 4
+    EDEADLK = 35
     ESTALE = 116
     EOPNOTSUPP = 95
 
@@ -130,6 +132,12 @@ SETXATTR_IN = struct.Struct("<II")           # size,flags
 
 DIRENT_HDR = struct.Struct("<QQII")          # ino,off,namelen,type
 
+# fuse_lk_in: fh,owner + fuse_file_lock{start,end,type,pid} + lk_flags,pad
+LK_IN = struct.Struct("<QQQQIIII")
+LK_OUT = struct.Struct("<QQII")              # fuse_file_lock
+FUSE_LK_FLOCK = 1 << 0                       # lk_flags: flock, not fcntl
+FOPEN_KEEP_CACHE = 1 << 1                    # open_flags: keep page cache
+
 
 class SetattrValid:
     MODE = 1 << 0
@@ -145,10 +153,14 @@ class SetattrValid:
 
 class InitFlags:
     ASYNC_READ = 1 << 0
+    POSIX_LOCKS = 1 << 1
     ATOMIC_O_TRUNC = 1 << 3
     BIG_WRITES = 1 << 5
+    FLOCK_LOCKS = 1 << 10
+    AUTO_INVAL_DATA = 1 << 12
     DO_READDIRPLUS = 1 << 13
     READDIRPLUS_AUTO = 1 << 14
+    WRITEBACK_CACHE = 1 << 16
     PARALLEL_DIROPS = 1 << 18
     MAX_PAGES = 1 << 22
     CACHE_SYMLINKS = 1 << 23

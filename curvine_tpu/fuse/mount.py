@@ -70,9 +70,45 @@ async def mount_and_serve(conf: ClusterConf) -> None:
                        inplace_max_mb=conf.fuse.inplace_max_mb)
     session = FuseSession(fs, fd, max_write=conf.fuse.max_write)
     log.info("fuse mounted at %s", conf.fuse.mount_point)
+    runner = None
+    if conf.fuse.metrics_port > 0:
+        runner = await serve_metrics(fs, conf.fuse.metrics_port)
     try:
         await session.run()
     finally:
         session.stop()
+        if runner is not None:
+            await runner.cleanup()
         fusermount_umount(conf.fuse.mount_point)
         await client.close()
+
+
+async def serve_metrics(fs, port: int):
+    """Per-mount metrics plane: /metrics (prometheus text) and /ops
+    (JSON per-op counters + latency quantiles). Parity:
+    curvine-fuse/src/web_server.rs + fuse_metrics.rs."""
+    import json
+
+    from aiohttp import web
+
+    async def metrics(_req):
+        return web.Response(text=fs.metrics.prometheus_text(),
+                            content_type="text/plain")
+
+    async def ops(_req):
+        snap = fs.metrics.snapshot()
+        out = {"counters": snap.get("counters", {}), "ops": {}}
+        for name, h in (snap.get("histograms") or {}).items():
+            out["ops"][name] = h
+        return web.Response(text=json.dumps(out, indent=1),
+                            content_type="application/json")
+
+    app = web.Application()
+    app.router.add_get("/metrics", metrics)
+    app.router.add_get("/ops", ops)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "0.0.0.0", port)
+    await site.start()
+    log.info("fuse metrics at :%d/metrics", port)
+    return runner

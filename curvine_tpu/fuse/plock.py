@@ -1,0 +1,176 @@
+"""POSIX byte-range file locks for the FUSE mount.
+
+Parity: curvine-fuse/src/fs/plock_wait_registry.rs (blocking-wait
+registry with deadlock detection) + curvine_file_system.rs:1752
+(GETLK/SETLK/SETLKW handling). Like the reference's, the table is local
+to the FUSE daemon: one mount's fcntl/flock users (SQLite, pip, data
+loaders) get full POSIX semantics; cross-mount coherence is the master
+path-lock API's job (GET_LOCK/SET_LOCK RPCs).
+
+Semantics implemented:
+- byte ranges with inclusive ends (FUSE wire convention; OFFSET_MAX =
+  "to EOF"), read locks share, write locks exclude, same-owner
+  overlapping set REPLACES the overlapped portion (POSIX split/merge)
+- SETLK: conflicting -> EAGAIN; SETLKW: waits on an asyncio.Event the
+  next unlock wakes, with wait-graph cycle detection -> EDEADLK
+- flock(2) (FUSE_LK_FLOCK) rides the same table as whole-file ranges
+  keyed by the kernel's lock owner
+- release(lock_owner) drops everything that owner held on the node
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, replace
+
+F_RDLCK, F_WRLCK, F_UNLCK = 0, 1, 2
+OFFSET_MAX = 0x7FFF_FFFF_FFFF_FFFF
+
+
+@dataclass(frozen=True)
+class Plock:
+    start: int
+    end: int            # inclusive
+    type: int           # F_RDLCK | F_WRLCK
+    owner: int          # kernel lock_owner cookie
+    pid: int
+
+
+def _overlaps(a_start: int, a_end: int, b_start: int, b_end: int) -> bool:
+    return a_start <= b_end and b_start <= a_end
+
+
+class DeadlockError(Exception):
+    pass
+
+
+class PlockTable:
+    def __init__(self) -> None:
+        self._locks: dict[int, list[Plock]] = {}       # node -> locks
+        self._waiters: dict[int, list[asyncio.Event]] = {}
+        # owner -> owner it currently waits on (one edge per blocked
+        # SETLKW; cycles in this graph are deadlocks)
+        self._waiting_on: dict[int, int] = {}
+        # owner -> blocked SETLKW tasks: a dead process's close
+        # (release_owner) cancels them so the lock is never granted to
+        # a corpse
+        self._wait_tasks: dict[int, set[asyncio.Task]] = {}
+
+    # ---------------- queries ----------------
+
+    def conflicting(self, node: int, start: int, end: int, typ: int,
+                    owner: int) -> Plock | None:
+        """First lock that prevents `owner` taking [start, end] as
+        `typ`. Read locks share; anything conflicts with a write lock."""
+        for lk in self._locks.get(node, ()):
+            if lk.owner == owner:
+                continue
+            if not _overlaps(lk.start, lk.end, start, end):
+                continue
+            if typ == F_WRLCK or lk.type == F_WRLCK:
+                return lk
+        return None
+
+    def holders(self, node: int) -> list[Plock]:
+        return list(self._locks.get(node, ()))
+
+    # ---------------- mutation ----------------
+
+    def apply(self, node: int, start: int, end: int, typ: int,
+              owner: int, pid: int) -> None:
+        """Install (or, for F_UNLCK, remove) the range for `owner`,
+        splitting the owner's overlapped locks POSIX-style. Caller has
+        already checked conflicts."""
+        out: list[Plock] = []
+        for lk in self._locks.get(node, ()):
+            if lk.owner != owner or not _overlaps(lk.start, lk.end,
+                                                  start, end):
+                out.append(lk)
+                continue
+            if lk.start < start:
+                out.append(replace(lk, end=start - 1))
+            if lk.end > end:
+                out.append(replace(lk, start=end + 1))
+        if typ != F_UNLCK:
+            out.append(Plock(start, end, typ, owner, pid))
+        if out:
+            self._locks[node] = out
+        else:
+            self._locks.pop(node, None)
+        self._wake(node)
+
+    def release_owner(self, node: int, owner: int) -> None:
+        """Drop every lock `owner` holds on `node` (fd close), and
+        cancel its blocked waits — the process is gone; granting later
+        would orphan the lock forever."""
+        for t in self._wait_tasks.pop(owner, ()):
+            t.cancel()
+        self._waiting_on.pop(owner, None)
+        locks = self._locks.get(node)
+        if not locks:
+            return
+        kept = [lk for lk in locks if lk.owner != owner]
+        if kept:
+            self._locks[node] = kept
+        elif node in self._locks:
+            del self._locks[node]
+        if len(kept) != len(locks):
+            self._wake(node)
+
+    # ---------------- blocking waits ----------------
+
+    async def wait_and_apply(self, node: int, start: int, end: int,
+                             typ: int, owner: int, pid: int) -> None:
+        """SETLKW: block until the range is grantable, then take it.
+        Raises DeadlockError when the wait graph would cycle.
+        Cancellation (kernel INTERRUPT, or release of a dead owner)
+        cleans its wait-graph edge — no stale edges, no grant to a
+        corpse."""
+        task = asyncio.current_task()
+        if task is not None:
+            self._wait_tasks.setdefault(owner, set()).add(task)
+        try:
+            while True:
+                blocker = self.conflicting(node, start, end, typ, owner)
+                if blocker is None:
+                    self.apply(node, start, end, typ, owner, pid)
+                    return
+                if self._would_deadlock(owner, blocker.owner):
+                    raise DeadlockError(
+                        f"owner {owner:#x} <-> {blocker.owner:#x}")
+                self._waiting_on[owner] = blocker.owner
+                ev = asyncio.Event()
+                self._waiters.setdefault(node, []).append(ev)
+                try:
+                    await ev.wait()
+                finally:
+                    ws = self._waiters.get(node)
+                    if ws and ev in ws:
+                        ws.remove(ev)
+        finally:
+            self._waiting_on.pop(owner, None)
+            if task is not None:
+                ts = self._wait_tasks.get(owner)
+                if ts is not None:
+                    ts.discard(task)
+                    if not ts:
+                        self._wait_tasks.pop(owner, None)
+
+    def _would_deadlock(self, waiter: int, blocked_by: int) -> bool:
+        """Walking the wait graph from `blocked_by` reaches `waiter` →
+        granting would wait forever. Parity:
+        plock_wait_registry.rs would_deadlock."""
+        seen = set()
+        cur = blocked_by
+        while cur in self._waiting_on:
+            if cur in seen:
+                return False          # someone else's cycle
+            seen.add(cur)
+            cur = self._waiting_on[cur]
+            if cur == waiter:
+                return True
+        return False
+
+    def _wake(self, node: int) -> None:
+        for ev in self._waiters.get(node, ()):
+            ev.set()

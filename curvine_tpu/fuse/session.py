@@ -31,9 +31,63 @@ class FuseSession:
         self.ready = asyncio.Event()
 
     async def run(self) -> None:
-        """Serve until unmount (ENODEV on the channel) or stop()."""
-        self._loop = asyncio.get_running_loop()
+        """Serve until unmount (ENODEV on the channel) or stop().
+
+        The channel is read NON-BLOCKING on the event loop itself
+        (loop.add_reader): /dev/fuse is pollable and hands out one whole
+        request per read, so there is no reason to burn a thread and a
+        cross-thread queue handoff per op — on the single-core TPU-VM
+        profile that handoff used to dominate per-op latency."""
+        self._loop = loop = asyncio.get_running_loop()
+        done = asyncio.Event()
+        pending: set[asyncio.Task] = set()
+        os.set_blocking(self.fd, False)
+
+        def on_readable():
+            # drain everything ready: one wakeup can cover many ops
+            while True:
+                try:
+                    buf = os.read(self.fd, self.bufsize)
+                except BlockingIOError:
+                    return
+                except OSError as e:
+                    if e.errno == 19:           # ENODEV: unmounted
+                        log.info("fuse channel closed (unmount)")
+                    elif not self._stop.is_set():
+                        log.warning("fuse read error: %s", e)
+                    try:
+                        loop.remove_reader(self.fd)
+                    except (OSError, ValueError):
+                        pass
+                    done.set()
+                    return
+                if not buf or self.fs.destroyed:
+                    done.set()
+                    return
+                t = asyncio.ensure_future(self._dispatch(buf))
+                pending.add(t)
+                t.add_done_callback(pending.discard)
+
+        try:
+            loop.add_reader(self.fd, on_readable)
+        except NotImplementedError:
+            # exotic loop without fd watching: fall back to a thread
+            return await self._run_threaded()
+        self.ready.set()
+        try:
+            await done.wait()
+        finally:
+            try:
+                loop.remove_reader(self.fd)
+            except (OSError, ValueError):
+                pass
+            for t in pending:
+                t.cancel()
+
+    async def _run_threaded(self) -> None:
+        """Thread-based channel reader (fallback)."""
         queue: asyncio.Queue[bytes | None] = asyncio.Queue(maxsize=64)
+        os.set_blocking(self.fd, True)
 
         def read_loop():
             while not self._stop.is_set():

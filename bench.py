@@ -398,8 +398,8 @@ async def _fuse_bench(c) -> dict:
     def remount_sync():
         # cold phases: a fresh mount = fresh superblock = empty kernel
         # page cache for the file (warm numbers measure the page cache
-        # that FOPEN_KEEP_CACHE + writeback leave behind — fio's own
-        # warm-cache semantics)
+        # that FOPEN_KEEP_CACHE leaves behind — fio's own warm-cache
+        # semantics; writeback is deliberately not negotiated)
         fusermount_umount(mnt)
 
     try:

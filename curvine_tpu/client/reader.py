@@ -165,6 +165,13 @@ class FsReader:
                 try:
                     addr = f"{loc.ip_addr or loc.hostname}:{loc.rpc_port}"
                     conn = await self.pool.get(addr)
+                    # lease clocks start at request SEND, not reply
+                    # arrival: the worker grants after our send, so
+                    # send + lease_ms always undershoots the worker's
+                    # expiry no matter how long the reply took — a
+                    # delayed reply can never extend the window past
+                    # what the worker's quarantine covers
+                    sent_at = time.time()
                     rep = await conn.call(RpcCode.GET_BLOCK_INFO,
                                           data=pack({"block_id": bid}))
                     info = rep.header or unpack(rep.data) or {}
@@ -176,7 +183,7 @@ class FsReader:
                         lease = info.get("lease_ms")
                         if lease:
                             self._local_expiry[bid] = \
-                                time.time() + lease / 1000
+                                sent_at + lease / 1000
                 except err.CurvineError as e:
                     log.debug("short-circuit probe failed for %d: %s", bid, e)
         self._local_paths[bid] = path

@@ -138,6 +138,8 @@ class FuseConf:
     # with per-op latency quantiles); 0 disables.
     # Parity: curvine-fuse/src/web_server.rs + fuse_metrics.rs
     metrics_port: int = 0
+    # loopback by default: op names leak path activity
+    metrics_host: str = "127.0.0.1"
 
 
 @dataclass

@@ -72,7 +72,8 @@ async def mount_and_serve(conf: ClusterConf) -> None:
     log.info("fuse mounted at %s", conf.fuse.mount_point)
     runner = None
     if conf.fuse.metrics_port > 0:
-        runner = await serve_metrics(fs, conf.fuse.metrics_port)
+        runner = await serve_metrics(fs, conf.fuse.metrics_port,
+                                     conf.fuse.metrics_host)
     try:
         await session.run()
     finally:
@@ -83,10 +84,12 @@ async def mount_and_serve(conf: ClusterConf) -> None:
         await client.close()
 
 
-async def serve_metrics(fs, port: int):
+async def serve_metrics(fs, port: int, host: str = "127.0.0.1"):
     """Per-mount metrics plane: /metrics (prometheus text) and /ops
     (JSON per-op counters + latency quantiles). Parity:
-    curvine-fuse/src/web_server.rs + fuse_metrics.rs."""
+    curvine-fuse/src/web_server.rs + fuse_metrics.rs. Binds loopback by
+    default — op names leak path activity; expose deliberately via
+    conf.fuse.metrics_host."""
     import json
 
     from aiohttp import web
@@ -108,7 +111,7 @@ async def serve_metrics(fs, port: int):
     app.router.add_get("/ops", ops)
     runner = web.AppRunner(app)
     await runner.setup()
-    site = web.TCPSite(runner, "0.0.0.0", port)
+    site = web.TCPSite(runner, host, port)
     await site.start()
     log.info("fuse metrics at :%d/metrics", port)
     return runner

@@ -47,14 +47,19 @@ class DeadlockError(Exception):
 class PlockTable:
     def __init__(self) -> None:
         self._locks: dict[int, list[Plock]] = {}       # node -> locks
-        self._waiters: dict[int, list[asyncio.Event]] = {}
-        # owner -> owner it currently waits on (one edge per blocked
-        # SETLKW; cycles in this graph are deadlocks)
-        self._waiting_on: dict[int, int] = {}
-        # owner -> blocked SETLKW tasks: a dead process's close
-        # (release_owner) cancels them so the lock is never granted to
-        # a corpse
-        self._wait_tasks: dict[int, set[asyncio.Task]] = {}
+        # node -> [(event, waiting task)]
+        self._waiters: dict[int, list[tuple[asyncio.Event,
+                                            asyncio.Task | None]]] = {}
+        # one edge per blocked SETLKW, keyed by the waiting task so two
+        # concurrent waits by the same owner never clobber each other:
+        # task -> (waiter owner, blocker owner). Cycles in the induced
+        # owner graph are deadlocks.
+        self._waiting_on: dict[asyncio.Task, tuple[int, int]] = {}
+        # (node, owner) -> blocked SETLKW tasks: a dead process's close
+        # (release_owner) cancels only the waits on the node being
+        # released — flush of an unrelated fd must not EINTR a
+        # multithreaded process's blocked fcntl elsewhere
+        self._wait_tasks: dict[tuple[int, int], set[asyncio.Task]] = {}
 
     # ---------------- queries ----------------
 
@@ -101,11 +106,18 @@ class PlockTable:
 
     def release_owner(self, node: int, owner: int) -> None:
         """Drop every lock `owner` holds on `node` (fd close), and
-        cancel its blocked waits — the process is gone; granting later
-        would orphan the lock forever."""
-        for t in self._wait_tasks.pop(owner, ()):
+        cancel its blocked waits on this node — the process is gone;
+        granting later would orphan the lock forever. Waits the owner
+        has on OTHER nodes are untouched (op_flush fires this on every
+        close(2); a multithreaded process closing one file must not
+        EINTR its blocked fcntl on another)."""
+        for t in self._wait_tasks.pop((node, owner), ()):
+            # drop the wait-graph edge NOW, not when the cancelled
+            # task's finally runs on a later tick — an intervening
+            # deadlock check must not walk an edge from an owner that
+            # is no longer waiting (spurious EDEADLK)
+            self._waiting_on.pop(t, None)
             t.cancel()
-        self._waiting_on.pop(owner, None)
         locks = self._locks.get(node)
         if not locks:
             return
@@ -127,8 +139,9 @@ class PlockTable:
         cleans its wait-graph edge — no stale edges, no grant to a
         corpse."""
         task = asyncio.current_task()
+        key = (node, owner)
         if task is not None:
-            self._wait_tasks.setdefault(owner, set()).add(task)
+            self._wait_tasks.setdefault(key, set()).add(task)
         try:
             while True:
                 blocker = self.conflicting(node, start, end, typ, owner)
@@ -138,39 +151,52 @@ class PlockTable:
                 if self._would_deadlock(owner, blocker.owner):
                     raise DeadlockError(
                         f"owner {owner:#x} <-> {blocker.owner:#x}")
-                self._waiting_on[owner] = blocker.owner
+                if task is not None:
+                    self._waiting_on[task] = (owner, blocker.owner)
                 ev = asyncio.Event()
-                self._waiters.setdefault(node, []).append(ev)
+                entry = (ev, task)
+                self._waiters.setdefault(node, []).append(entry)
                 try:
                     await ev.wait()
                 finally:
                     ws = self._waiters.get(node)
-                    if ws and ev in ws:
-                        ws.remove(ev)
+                    if ws and entry in ws:
+                        ws.remove(entry)
         finally:
-            self._waiting_on.pop(owner, None)
             if task is not None:
-                ts = self._wait_tasks.get(owner)
+                self._waiting_on.pop(task, None)
+                ts = self._wait_tasks.get(key)
                 if ts is not None:
                     ts.discard(task)
                     if not ts:
-                        self._wait_tasks.pop(owner, None)
+                        self._wait_tasks.pop(key, None)
 
     def _would_deadlock(self, waiter: int, blocked_by: int) -> bool:
-        """Walking the wait graph from `blocked_by` reaches `waiter` →
-        granting would wait forever. Parity:
+        """DFS over the owner wait graph from `blocked_by`; reaching
+        `waiter` means granting would wait forever. An owner may have
+        several outgoing edges (one per blocked SETLKW task). Parity:
         plock_wait_registry.rs would_deadlock."""
-        seen = set()
-        cur = blocked_by
-        while cur in self._waiting_on:
-            if cur in seen:
-                return False          # someone else's cycle
-            seen.add(cur)
-            cur = self._waiting_on[cur]
+        adj: dict[int, set[int]] = {}
+        for w, b in self._waiting_on.values():
+            adj.setdefault(w, set()).add(b)
+        seen: set[int] = set()
+        stack = [blocked_by]
+        while stack:
+            cur = stack.pop()
             if cur == waiter:
                 return True
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(adj.get(cur, ()))
         return False
 
     def _wake(self, node: int) -> None:
-        for ev in self._waiters.get(node, ()):
+        for ev, task in self._waiters.get(node, ()):
             ev.set()
+            # a woken waiter is no longer blocked: clear its edge NOW
+            # (it re-records against the current blocker if it loses the
+            # re-check) so a deadlock walk between the wake and the
+            # task's resumption can't see a stale edge
+            if task is not None:
+                self._waiting_on.pop(task, None)

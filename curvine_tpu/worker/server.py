@@ -64,6 +64,15 @@ class WorkerServer:
                 _TIER_NAMES.get(t.storage_type, StorageType.MEM),
                 t.dir, t.capacity)
             for t in wc.tiers]
+        for tier in tiers:
+            if isinstance(tier, BdevTier):
+                # the extent-reuse safety window must cover the slowest
+                # reply a client would still honor (lease clocks start
+                # at reply arrival) — keep it tied to the configured
+                # RPC deadline, never below the class default
+                tier.lease_slack_s = max(
+                    tier.lease_slack_s,
+                    self.conf.client.rpc_timeout_ms / 1000.0)
         self.store = BlockStore(tiers, wc.eviction_high_water,
                                 wc.eviction_low_water)
         self.metrics = MetricsRegistry("worker")

@@ -91,13 +91,23 @@ class BdevTier(TierDir):
     moves, a reused extent inside the shared backing file would hand a
     stale reader another block's bytes. Serving GET_BLOCK_INFO for an
     extent records a lease (quarantine_s / 2, after which the client
-    must re-probe); freeing a still-leased extent parks it in
-    quarantine until the lease expires, while never-leased extents
-    (fresh writes, aborted moves, never-probed victims) return to the
-    free list immediately. The quarantine persists in the allocation
-    index so a restart inside the window can't resurrect the space."""
+    must re-probe); freeing a still-live extent parks it in quarantine
+    until the lease expires PLUS lease_slack_s (the client's lease
+    clock starts at its request send; the slack absorbs any residual
+    client/worker skew), while never-leased extents (fresh writes,
+    aborted moves, never-probed victims) return to the free list
+    immediately. The quarantine persists in the allocation index so a
+    restart inside the window can't resurrect the space."""
 
     quarantine_s: float = 60.0
+    # The client's lease clock starts when the GET_BLOCK_INFO reply
+    # ARRIVES, not when the worker granted it — a reply delayed by load
+    # or retries extends the window the client believes it may preadv
+    # the extent. The slack must therefore cover the whole RPC deadline
+    # (past it the client abandons the call and re-probes), not a fixed
+    # local-clock fudge. Keep ≥ ClientConf.rpc_timeout_ms
+    # (common/conf.py:118, 30s default).
+    lease_slack_s: float = 30.0
 
     def __init__(self, storage_type: StorageType, path: str, capacity: int,
                  dir_id: str = ""):
@@ -148,7 +158,11 @@ class BdevTier(TierDir):
         if self.quarantine_s <= 0:
             return False
         now = time.time() if now is None else now
-        return self._leases.get(block_id, 0.0) > now
+        # the client's lease clock starts at reply ARRIVAL: a lease
+        # expired worker-side may still be live client-side for up to
+        # the RPC deadline, so the liveness guard carries the same
+        # slack as the quarantine duration
+        return self._leases.get(block_id, 0.0) + self.lease_slack_s > now
 
     # ---- extent allocation (first-fit, merge on free) ----
     def reclaim(self, now: float | None = None,
@@ -206,11 +220,14 @@ class BdevTier(TierDir):
         self.used -= size
         lease = self._leases.pop(block_id, 0.0)
         now = time.time()
-        if self.quarantine_s > 0 and lease > now:
+        if self.quarantine_s > 0 and lease + self.lease_slack_s > now:
             # an unexpired short-circuit grant may still read this
             # extent through a cached fd: unusable until the lease
-            # passes (+1s local-clock slack)
-            self._quarantine.append((lease + 1.0, off, size, block_id))
+            # passes PLUS the RPC deadline (the client's lease clock
+            # starts at reply arrival, which can lag the grant by up to
+            # the full RPC timeout)
+            self._quarantine.append(
+                (lease + self.lease_slack_s, off, size, block_id))
             self._quarantined += size
         else:
             self._free.append((off, size))
@@ -228,7 +245,8 @@ class BdevTier(TierDir):
         off, size = ext
         self.used -= size
         lease = self._leases.pop(block_id, 0.0)
-        ready = max(time.time() + max(self.quarantine_s, 1.0), lease + 1.0)
+        ready = max(time.time() + max(self.quarantine_s, 1.0),
+                    lease + self.lease_slack_s)
         self._quarantine.append((ready, off, size, block_id))
         self._quarantined += size
 
@@ -365,13 +383,19 @@ class BlockStore:
         for tier in ordered:
             if tier.available >= size_hint:
                 return tier
-        # under pressure: evict on the preferred tier
-        tier = ordered[0]
-        self._evict_locked(tier, size_hint)
-        if tier.available < size_hint:
-            raise err.CapacityExceeded(
-                f"tier {tier.dir_id}: need {size_hint}, have {tier.available}")
-        return tier
+        # Under pressure: evict on the preferred tier, then fall through
+        # to the others — a bdev tier whose victims are all leased (e.g.
+        # every surviving block right after a restart, load_index grants
+        # synthetic leases) frees nothing until the leases lapse, and
+        # writes must not bounce off the whole worker because one tier
+        # is temporarily unevictable.
+        for tier in ordered:
+            self._evict_locked(tier, size_hint)
+            if tier.available >= size_hint:
+                return tier
+        tried = ", ".join(f"{t.dir_id}={t.available}" for t in ordered)
+        raise err.CapacityExceeded(
+            f"need {size_hint}B, all tiers tried after eviction: {tried}")
 
     def create_temp(self, block_id: int, hint: StorageType | None = None,
                     size_hint: int = 0) -> BlockInfo:
@@ -705,11 +729,12 @@ class BlockStore:
             return True
 
     def _move_candidates_locked(self, tier: TierDir, need: int,
-                                demote: bool) -> tuple[list, int]:
+                                demote: bool) -> tuple[list, int, int]:
         """Under the lock: pick LRU victims on `tier` until `need` (or the
         low-water trim target) fits, deciding drop-vs-demote per victim.
-        Returns (plan, still_needed) where plan is [(block_id, dest|None)]
-        — dest None means drop."""
+        Returns (plan, target_free, projected) where plan is
+        [(block_id, dest|None)] — dest None means drop — and projected
+        is the bytes free on `tier` if the whole plan executes."""
         self._reclaim_locked()
         target_free = max(need, int(tier.capacity * (1 - self.low_water)))
         now = time.time()
@@ -721,8 +746,8 @@ class BlockStore:
              # leased bdev extents entirely: their free lands in
              # quarantine, so dropping destroys data without making
              # room and demoting burns copy IO for zero freed bytes —
-             # the lease lapses within lease_s and the next scan takes
-             # them
+             # the lease lapses within lease_s + lease_slack_s and the
+             # next scan takes them
              and not self._read_pins.get(b.block_id)
              and not (isinstance(tier, BdevTier)
                       and tier.free_would_quarantine(b.block_id, now))),
@@ -735,7 +760,7 @@ class BlockStore:
             dest = self._slower_tier_for(tier, b.len) if demote else None
             plan.append((b.block_id, dest))
             freed += b.len if not isinstance(tier, BdevTier) else b.alloc_len
-        return plan, target_free
+        return plan, target_free, freed
 
     def _slower_tier_for(self, tier: TierDir, size: int) -> TierDir | None:
         """Next tier strictly slower than `tier` with room for `size`."""
@@ -750,9 +775,16 @@ class BlockStore:
         """Drop-only LRU trim, for callers already holding the lock (the
         synchronous create path): when this fires every tier is full, so
         there is no demotion target anyway — dropping is the only move,
-        and it must not stall the write behind multi-MB copies."""
-        plan, _target = self._move_candidates_locked(tier, need,
-                                                     demote=False)
+        and it must not stall the write behind multi-MB copies.
+
+        A plan that cannot reach `need` is NOT executed: destroying
+        cached blocks without unblocking the allocation that asked is
+        pure cache loss (pick_tier falls through to the next tier
+        instead)."""
+        plan, _target, projected = self._move_candidates_locked(
+            tier, need, demote=False)
+        if projected < need:
+            return []
         evicted = []
         for bid, _dest in plan:
             info = self.blocks.get(bid)
@@ -775,7 +807,7 @@ class BlockStore:
         removed, demoted = [], 0
         for _attempt in range(2):      # one retry if planned moves failed
             with self._lock:
-                plan, target = self._move_candidates_locked(
+                plan, target, _projected = self._move_candidates_locked(
                     tier, need, demote)
             if not plan:
                 break

@@ -102,6 +102,61 @@ def test_bdev_freed_extent_quarantined(tmp_path):
     assert tier._quarantine == [] and tier.available == 2 * MB
 
 
+def test_bdev_quarantine_slack_covers_rpc_window(tmp_path):
+    """Regression (round-5 advisor): the quarantine ready time must be
+    lease expiry + the RPC deadline, not a fixed 1s — the client's lease
+    clock starts when the GET_BLOCK_INFO reply ARRIVES, which may lag
+    the worker-side grant by up to the full RPC timeout."""
+    import time
+
+    tier = BdevTier(StorageType.SSD, str(tmp_path / "bdev.img"), 10 * MB)
+    tier.quarantine_s = 60
+    tier.alloc(1, 4 * MB)
+    expiry = time.time() + 30
+    tier.note_lease(1, expiry)
+    tier.free(1)
+    (ready, _off, _ln, _bid), = tier._quarantine
+    assert ready >= expiry + tier.lease_slack_s
+    assert tier.lease_slack_s >= 30.0      # ClientConf.rpc_timeout_ms
+
+
+def test_bdev_restart_leases_dont_wedge_writes(tmp_path):
+    """Regression (round-5 advisor): load_index grants every surviving
+    block a synthetic lease, and eviction skips leased victims — a full
+    bdev tier must fall through to another tier instead of bouncing all
+    writes with CapacityExceeded until the leases lapse."""
+    import curvine_tpu.worker.storage as stmod
+
+    path = str(tmp_path / "bdev.img")
+    tier = BdevTier(StorageType.SSD, path, 8 * MB)
+    store = BlockStore([tier])
+    for bid in (1, 2):
+        info = store.create_temp(bid, StorageType.SSD, size_hint=4 * MB)
+        with open(info.path, "r+b") as f:
+            f.seek(info.offset)
+            f.write(b"a" * MB)
+        store.commit(bid, MB, checksum=None)
+
+    # restart: bdev full, every survivor synthetically leased; mem ALSO
+    # full (with an evictable committed block) so the fall-through has
+    # to run eviction on the second tier, not just find free space
+    tier2 = BdevTier(StorageType.SSD, path, 8 * MB)
+    mem = stmod.TierDir(StorageType.MEM, str(tmp_path / "mem"), 4 * MB)
+    store2 = BlockStore([tier2, mem])
+    info = store2.create_temp(5, StorageType.MEM, size_hint=4 * MB)
+    with open(info.path, "wb") as f:
+        f.write(b"m" * (4 * MB))
+    store2.commit(5, 4 * MB, checksum=None)
+    assert tier2.available == 0 and mem.available == 0
+    info = store2.create_temp(9, StorageType.SSD, size_hint=4 * MB)
+    assert info.tier is mem                # fell through, didn't fail
+    # the leased bdev survivors were NOT destroyed into quarantine for
+    # it (their eviction plan couldn't have satisfied the request);
+    # the mem victim was the one evicted
+    assert store2.contains(1) and store2.contains(2)
+    assert not store2.contains(5)
+
+
 def test_bdev_quarantine_survives_restart(tmp_path):
     """The quarantine rides the allocation index: a worker restart
     inside the window must not hand a leased extent to a new block."""

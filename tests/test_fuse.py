@@ -570,6 +570,56 @@ async def test_plock_wait_and_deadlock():
     await asyncio.wait_for(t3, 5)
 
 
+async def test_plock_release_scoped_to_node():
+    """Regression (round-5 advisor): release_owner(node, owner) must
+    cancel only that node's waits — op_flush fires it on every close(2)
+    with the process-wide lock_owner, so a multithreaded process closing
+    one file must not EINTR its blocked fcntl on another file."""
+    from curvine_tpu.fuse.plock import F_WRLCK, PlockTable
+
+    t = PlockTable()
+    t.apply(7, 0, 9, F_WRLCK, owner=1, pid=1)      # node 7 held by 1
+    got = asyncio.Event()
+
+    async def waiter():
+        await t.wait_and_apply(7, 0, 9, F_WRLCK, owner=2, pid=2)
+        got.set()
+
+    task = asyncio.ensure_future(waiter())
+    await asyncio.sleep(0.05)
+    # owner 2 closes an UNRELATED file (node 8): its wait on node 7
+    # must survive
+    t.release_owner(8, 2)
+    await asyncio.sleep(0.05)
+    assert not task.done()
+    t.release_owner(7, 1)
+    await asyncio.wait_for(got.wait(), 5)
+    task.result()
+    # two concurrent waits by ONE owner keep distinct wait-graph edges:
+    # owner 2 waits on both 1 (node 10) and 3 (node 11); owner 1 trying
+    # to take node 11 must still see the 3->? edges correctly and owner
+    # 3 taking node 10's blocker graph must detect cycles through either
+    t2 = PlockTable()
+    t2.apply(10, 0, 9, F_WRLCK, owner=1, pid=1)
+    t2.apply(11, 0, 9, F_WRLCK, owner=3, pid=3)
+    w_a = asyncio.ensure_future(
+        t2.wait_and_apply(10, 0, 9, F_WRLCK, owner=2, pid=2))
+    w_b = asyncio.ensure_future(
+        t2.wait_and_apply(11, 0, 9, F_WRLCK, owner=2, pid=2))
+    await asyncio.sleep(0.05)
+    # both edges present: owner 1 waiting on anything owner 2 holds
+    # would deadlock through EITHER edge
+    t2.apply(12, 0, 9, F_WRLCK, owner=2, pid=2)
+    from curvine_tpu.fuse.plock import DeadlockError
+    with pytest.raises(DeadlockError):
+        await t2.wait_and_apply(12, 0, 9, F_WRLCK, owner=1, pid=1)
+    with pytest.raises(DeadlockError):
+        await t2.wait_and_apply(12, 0, 9, F_WRLCK, owner=3, pid=3)
+    t2.release_owner(10, 1)
+    t2.release_owner(11, 3)
+    await asyncio.wait_for(asyncio.gather(w_a, w_b), 5)
+
+
 @pytest.mark.skipif(not FUSE_AVAILABLE, reason="no /dev/fuse")
 def test_real_mount_locks_and_sqlite(tmp_path):
     """fcntl + flock through the kernel, then the SQLite smoke the

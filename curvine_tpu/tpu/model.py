@@ -39,6 +39,15 @@ class ModelConfig:
     use_ring_attention: bool = False
     remat: bool = False        # jax.checkpoint each layer (HBM for FLOPs)
     moe_experts: int = 0       # >0: MoE FFN, experts sharded over 'ep'
+    # Fused (Pallas) flash attention on TPU: no [B,H,L,L] score
+    # materialization, O(L) memory. Requires head_dim % 128 == 0 and
+    # seq % 128 == 0; anything else falls back to dense_attention.
+    use_flash_attention: bool = False
+    # Cross-entropy in chunks of this many tokens (0 = one-shot): the
+    # [B·L, vocab] f32 logits never materialize — each chunk's logits
+    # are rematerialized in the backward pass. At vocab 32K, seq 1K the
+    # one-shot path peaks >1 GiB of HBM in pure loss bookkeeping.
+    ce_chunk: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -94,6 +103,25 @@ def _rmsnorm(x, scale):
     return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale
 
 
+def _flash_eligible(cfg: ModelConfig, L: int) -> bool:
+    return (cfg.use_flash_attention
+            and jax.default_backend() == "tpu"
+            and cfg.head_dim % 128 == 0
+            and L % 128 == 0)
+
+
+def _flash_attention(q, k, v):
+    """Pallas TPU fused attention (public jax.experimental kernel):
+    online-softmax tiles in VMEM, never materializing the [B,H,L,L]
+    score matrix — the single biggest activation sink of the dense
+    path at seq 1K+."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention,
+    )
+    return flash_attention(q, k, v, causal=True,
+                           sm_scale=1.0 / float(np.sqrt(q.shape[-1])))
+
+
 def _attention(x, layer, cfg: ModelConfig, mesh: Mesh | None):
     B, L, D = x.shape
     H, hd = cfg.n_heads, cfg.head_dim
@@ -102,6 +130,8 @@ def _attention(x, layer, cfg: ModelConfig, mesh: Mesh | None):
     v = (x @ layer["wv"]).reshape(B, L, H, hd).transpose(0, 2, 1, 3)
     if cfg.use_ring_attention and mesh is not None and "seq" in mesh.axis_names:
         o = ring_attention_sharded(q, k, v, mesh, axis_name="seq", causal=True)
+    elif _flash_eligible(cfg, L):
+        o = _flash_attention(q, k, v)
     else:
         o = dense_attention(q, k, v, causal=True)
     o = o.transpose(0, 2, 1, 3).reshape(B, L, D)
@@ -136,9 +166,9 @@ def _block(x, layer, cfg: ModelConfig, mesh: Mesh | None):
     return x + h
 
 
-def forward(params: dict, tokens, cfg: ModelConfig,
-            mesh: Mesh | None = None):
-    """tokens [B, L] int32 → logits [B, L, V] (dtype f32)."""
+def forward_hidden(params: dict, tokens, cfg: ModelConfig,
+                   mesh: Mesh | None = None):
+    """tokens [B, L] int32 → final hidden states [B, L, D] (model dtype)."""
     B, L = tokens.shape
     x = params["embed"][tokens] + params["pos"][:L]
     block = _block
@@ -146,15 +176,52 @@ def forward(params: dict, tokens, cfg: ModelConfig,
         block = jax.checkpoint(_block, static_argnums=(2,))
     for layer in params["layers"]:
         x = block(x, layer, cfg, mesh)
-    x = _rmsnorm(x, params["ln_f"])
+    return _rmsnorm(x, params["ln_f"])
+
+
+def forward(params: dict, tokens, cfg: ModelConfig,
+            mesh: Mesh | None = None):
+    """tokens [B, L] int32 → logits [B, L, V] (dtype f32)."""
+    x = forward_hidden(params, tokens, cfg, mesh)
     return (x @ params["embed"].T).astype(jnp.float32)
+
+
+def _chunked_ce(x, targets, embed, chunk: int):
+    """Cross entropy over [N, D] hidden states in `chunk`-token slices:
+    each slice's [chunk, V] f32 logits live only inside its (remat'd)
+    scan step, so peak loss memory is one chunk instead of the whole
+    batch. targets < 0 are padding and contribute nothing."""
+    N, D = x.shape
+    pad = (-N) % chunk
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        targets = jnp.pad(targets, (0, pad), constant_values=-1)
+    xc = x.reshape(-1, chunk, D)
+    tc = targets.reshape(-1, chunk)
+    emb_t = embed.T
+
+    def step(total, xt):
+        xs, ts = xt
+        logits = (xs @ emb_t).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, jnp.maximum(ts, 0)[:, None], axis=-1)[:, 0]
+        return total + jnp.sum(jnp.where(ts >= 0, nll, 0.0)), None
+
+    total, _ = jax.lax.scan(jax.checkpoint(step), jnp.float32(0.0), (xc, tc))
+    return total / N
 
 
 def loss_fn(params, tokens, cfg: ModelConfig, mesh: Mesh | None = None):
     """Next-token cross entropy; last position predicts nothing."""
-    logits = forward(params, tokens, cfg, mesh)
+    x = forward_hidden(params, tokens, cfg, mesh)
     targets = tokens[:, 1:]
-    logits = logits[:, :-1]
+    x = x[:, :-1]
+    if cfg.ce_chunk > 0:
+        return _chunked_ce(x.reshape(-1, x.shape[-1]),
+                           targets.reshape(-1),
+                           params["embed"], cfg.ce_chunk)
+    logits = (x @ params["embed"].T).astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
     return jnp.mean(nll)

@@ -13,6 +13,7 @@ import os
 import sys
 import time
 
+from curvine_tpu.common import errors as err
 from curvine_tpu.common.conf import ClusterConf
 from curvine_tpu.common.types import JobState, SetAttrOpts
 
@@ -268,6 +269,22 @@ async def cmd_report(args):
             coords = f" ici={w.ici_coords}" if w.ici_coords else ""
             print(f"  worker {w.address.worker_id} "
                   f"{w.address.hostname}:{w.address.rpc_port} [{tiers}]{coords}")
+        # monitor + watchdog rollup (parity: master_monitor.rs); a
+        # pre-r5 master has no CLUSTER_HEALTH handler — degrade quietly
+        try:
+            h = await c.meta.cluster_health()
+        except err.CurvineError:
+            return
+        line = f"Health: {h['status']} ({h['role']})"
+        if h.get("problems"):
+            line += " — " + "; ".join(h["problems"])
+        print(line)
+        wd = h.get("watchdog") or {}
+        for o in wd.get("stuck_ops", []):
+            print(f"  STUCK op {o['op']}({o['detail']}) for {o['age_s']}s")
+        for l in wd.get("long_held_locks", []):
+            print(f"  LONG-HELD lock {l['path']} by {l['owner']} "
+                  f"for {l['age_s']}s")
     finally:
         await c.close()
 
@@ -504,7 +521,8 @@ async def cmd_gateway(args):
     from curvine_tpu.gateway.webhdfs import WebHdfsGateway
     conf = _conf(args)
     client = CurvineClient(conf)
-    s3 = S3Gateway(client, port=args.s3_port, host="0.0.0.0")
+    s3 = S3Gateway(client, port=args.s3_port, host="0.0.0.0",
+                   credentials=conf.gateway.s3_credentials())
     hdfs = WebHdfsGateway(client, port=args.webhdfs_port, host="0.0.0.0")
     await s3.start()
     await hdfs.start()

@@ -54,6 +54,14 @@ class MasterConf:
     fast_port: int = 0
     # audit/metrics
     audit_log: bool = False
+    # dir watchdog (parity: fs_dir_watchdog.rs): namespace ops / path
+    # locks stuck longer than this are logged + metric-flagged
+    watchdog_stall_ms: int = 10_000
+    # off-box disaster recovery (parity: journal/ufs_loader.rs): upload
+    # the namespace snapshot to this UFS URI periodically; an EMPTY
+    # master dir restores from it on start. "" disables.
+    ufs_backup_uri: str = ""
+    ufs_backup_interval_s: int = 300
     # raft (HA); empty peers → single-node journal mode
     raft_peers: list[str] = field(default_factory=list)
     raft_node_id: int = 1
@@ -143,12 +151,27 @@ class FuseConf:
 
 
 @dataclass
+class GatewayConf:
+    # S3 gateway SigV4 verification: static credential pair. Empty access
+    # key = anonymous mode (explicit opt-in for cluster-internal use);
+    # set both to require signed requests (403 otherwise).
+    s3_access_key: str = ""
+    s3_secret_key: str = ""
+
+    def s3_credentials(self) -> dict | None:
+        if self.s3_access_key:
+            return {self.s3_access_key: self.s3_secret_key}
+        return None
+
+
+@dataclass
 class ClusterConf:
     cluster_name: str = "curvine-tpu"
     master: MasterConf = field(default_factory=MasterConf)
     worker: WorkerConf = field(default_factory=WorkerConf)
     client: ClientConf = field(default_factory=ClientConf)
     fuse: FuseConf = field(default_factory=FuseConf)
+    gateway: GatewayConf = field(default_factory=GatewayConf)
     data_dir: str = "data"
 
     @staticmethod

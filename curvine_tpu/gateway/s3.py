@@ -8,12 +8,15 @@ without code changes. Path-style addressing: ``/<bucket>/<key>`` maps to
 Implemented: GET/PUT/HEAD/DELETE object, ListObjectsV2 (delimiter +
 prefix), ListBuckets, CreateBucket (mkdir), ranged GETs, multipart
 uploads (initiate/UploadPart/complete/abort with validated uploadIds and
-stale-upload GC). Authentication is accepted but not enforced
-(cluster-internal gateway, like the reference's default).
+stale-upload GC). Authentication: SigV4 verification against static
+credentials (``credentials={access: secret}``) — unsigned/forged
+requests get S3-style 403s; ``credentials=None`` is the explicit
+anonymous mode for cluster-internal deployments.
 """
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import re
 import urllib.parse
@@ -23,6 +26,7 @@ import xml.sax.saxutils as sax
 from aiohttp import web
 
 from curvine_tpu.common import errors as cerr
+from curvine_tpu.gateway.sigv4 import SigV4Error, verify_sigv4
 
 log = logging.getLogger(__name__)
 
@@ -30,11 +34,15 @@ _NS = 'xmlns="http://s3.amazonaws.com/doc/2006-03-01/"'
 
 
 class S3Gateway:
-    def __init__(self, client, port: int = 0, host: str = "127.0.0.1"):
+    def __init__(self, client, port: int = 0, host: str = "127.0.0.1",
+                 credentials: dict[str, str] | None = None):
         self.client = client
         self.host = host
         self.port = port
-        self.app = web.Application(client_max_size=1024 ** 3)
+        self.credentials = credentials or None
+        middlewares = [self._auth_middleware] if self.credentials else []
+        self.app = web.Application(client_max_size=1024 ** 3,
+                                   middlewares=middlewares)
         self.app.router.add_route("GET", "/", self._list_buckets)
         self.app.router.add_route("*", "/{bucket}", self._bucket)
         self.app.router.add_route("*", "/{bucket}/{key:.*}", self._object)
@@ -52,6 +60,64 @@ class S3Gateway:
     async def stop(self) -> None:
         if self._runner:
             await self._runner.cleanup()
+
+    @web.middleware
+    async def _auth_middleware(self, req: web.Request, handler):
+        """SigV4-verify every request before it reaches a handler.
+
+        The body is read (and cached by aiohttp, so handlers' later
+        ``req.read()`` is free) to check the declared
+        x-amz-content-sha256 against the actual bytes; UNSIGNED-PAYLOAD
+        skips the hash but the signature itself is still required."""
+        auth = req.headers.get("Authorization", "")
+        if auth.startswith("OSS "):
+            # OSS-dialect clients (ufs/oss.py native signing): verify
+            # the HMAC-SHA1 header scheme against the same credentials
+            if not await self._verify_oss(req, auth):
+                log.info("s3 gateway rejected OSS auth %s %s", req.method,
+                         req.rel_url.raw_path)
+                return self._error(403, "SignatureDoesNotMatch",
+                                   req.rel_url.raw_path)
+            return await handler(req)
+        declared = req.headers.get("x-amz-content-sha256", "")
+        body_hash = None
+        if req.body_exists and declared != "UNSIGNED-PAYLOAD":
+            body_hash = hashlib.sha256(await req.read()).hexdigest()
+        elif not req.body_exists:
+            body_hash = hashlib.sha256(b"").hexdigest()
+        try:
+            verify_sigv4(req.method, req.rel_url.raw_path,
+                         req.rel_url.raw_query_string, req.headers,
+                         body_hash, self.credentials)
+        except SigV4Error as e:
+            log.info("s3 auth rejected %s %s: %s", req.method,
+                     req.rel_url.raw_path, e)
+            return self._error(403, e.code, req.rel_url.raw_path)
+        return await handler(req)
+
+    async def _verify_oss(self, req: web.Request, auth: str) -> bool:
+        import hmac as _hmac
+        from curvine_tpu.gateway.authutil import date_fresh, md5_binds_body
+        from curvine_tpu.ufs.oss import oss_sign, oss_string_to_sign
+        try:
+            access, _, sig = auth[4:].partition(":")
+            secret = self.credentials.get(access.strip())
+            if secret is None:
+                return False
+            headers = {k.lower(): v for k, v in req.headers.items()}
+            # replay window (real OSS enforces 15 min too)
+            if not date_fresh(headers.get("date", "")):
+                return False
+            # payload binding via the signed Content-MD5
+            if req.body_exists and not md5_binds_body(
+                    await req.read(), headers.get("content-md5", "")):
+                return False
+            sts = oss_string_to_sign(
+                req.method, urllib.parse.unquote(req.rel_url.raw_path),
+                req.rel_url.raw_query_string, headers)
+            return _hmac.compare_digest(oss_sign(secret, sts), sig.strip())
+        except Exception:  # noqa: BLE001 — any parse failure = reject
+            return False
 
     # ---------------- bucket ops ----------------
 
@@ -240,6 +306,12 @@ class S3Gateway:
                 return web.Response(status=200, headers={"ETag": '"ok"'})
             if req.method == "HEAD":
                 st = await self.client.meta.file_status(path)
+                if st.is_dir:
+                    # S3 semantics: a directory is only a key PREFIX —
+                    # clients detect it via the trailing-delimiter list
+                    # probe, never via HEAD (adapters' stat() relies on
+                    # the 404 → list fallback)
+                    return self._error(404, "NoSuchKey", key)
                 return web.Response(status=200, headers={
                     "Content-Length": str(st.len),
                     "ETag": '"ok"', "Accept-Ranges": "bytes"})

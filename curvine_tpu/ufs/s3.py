@@ -32,7 +32,11 @@ def sigv4_headers(method: str, url: str, region: str, access_key: str,
     datestamp = now.strftime("%Y%m%d")
     parsed = urllib.parse.urlparse(url)
     host = parsed.netloc
-    canonical_uri = urllib.parse.quote(parsed.path or "/", safe="/")
+    # S3 SigV4 rule: the canonical URI is the path exactly as sent on the
+    # wire, each segment URI-encoded ONCE (object_url already did that) —
+    # re-quoting here would double-encode '%' and break keys with spaces
+    # etc. against real verifiers.
+    canonical_uri = parsed.path or "/"
     # canonical query: sorted, url-encoded
     q = urllib.parse.parse_qsl(parsed.query, keep_blank_values=True)
     canonical_query = "&".join(

@@ -255,3 +255,98 @@ async def test_s3_list_buckets():
                     assert ".s3mpu" not in body
         finally:
             await gw.stop()
+
+
+async def test_s3_gateway_sigv4_auth():
+    """SigV4 verification: correctly-signed requests round-trip, while
+    unsigned, forged-secret, unknown-key and tampered-payload requests
+    all get S3-style 403s (parity: VERDICT r4 task #5 — one static
+    credential pair from conf, anonymous only by explicit opt-in)."""
+    from curvine_tpu.gateway.s3 import S3Gateway
+    from curvine_tpu.ufs.s3 import S3Ufs
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.meta.mkdir("/auth")
+        gw = S3Gateway(c, port=0, host="127.0.0.1",
+                       credentials={"AKIDGOOD": "sekrit"})
+        await gw.start()
+        try:
+            base = f"http://127.0.0.1:{gw.port}"
+
+            def client(access, secret):
+                return S3Ufs(properties={
+                    "s3.endpoint_url": base,
+                    "s3.credentials.access": access,
+                    "s3.credentials.secret": secret,
+                    "s3.path_style": "true"})
+
+            good = client("AKIDGOOD", "sekrit")
+            await good.write_all("s3://auth/a.bin", b"signed!" * 10)
+            assert await good.read_all("s3://auth/a.bin") == b"signed!" * 10
+            assert (await good.stat("s3://auth/a.bin")).len == 70
+            assert {s.path for s in await good.list("s3://auth")} == \
+                {"s3://auth/a.bin"}
+
+            # unsigned request → 403 AccessDenied
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"{base}/auth/a.bin") as r:
+                    assert r.status == 403
+                    assert "AccessDenied" in await r.text()
+                async with s.put(f"{base}/auth/evil.bin", data=b"x") as r:
+                    assert r.status == 403
+            assert not await c.meta.exists("/auth/evil.bin")
+
+            # forged signature (right key id, wrong secret)
+            from curvine_tpu.common import errors as cerr
+            forged = client("AKIDGOOD", "wrong-secret")
+            with pytest.raises(cerr.UfsError, match="403"):
+                await forged.read_all("s3://auth/a.bin")
+
+            # unknown access key
+            unknown = client("AKIDNOPE", "sekrit")
+            with pytest.raises(cerr.UfsError, match="403"):
+                await unknown.read_all("s3://auth/a.bin")
+
+            # tampered payload: declared x-amz-content-sha256 signed for
+            # OTHER bytes than the body actually carried
+            import datetime
+            from curvine_tpu.ufs.s3 import sigv4_headers
+            import hashlib
+            url = f"{base}/auth/tamper.bin"
+            h = sigv4_headers("PUT", url, "us-east-1", "AKIDGOOD", "sekrit",
+                              payload_hash=hashlib.sha256(b"AA").hexdigest())
+            async with aiohttp.ClientSession() as s:
+                async with s.put(url, data=b"BB", headers=h) as r:
+                    assert r.status == 403
+                    assert "XAmzContentSHA256Mismatch" in await r.text()
+            assert not await c.meta.exists("/auth/tamper.bin")
+
+            # stale x-amz-date → RequestTimeTooSkewed
+            old = datetime.datetime.now(
+                datetime.timezone.utc) - datetime.timedelta(hours=2)
+            h = sigv4_headers("GET", f"{base}/auth/a.bin", "us-east-1",
+                              "AKIDGOOD", "sekrit", now=old)
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"{base}/auth/a.bin", headers=h) as r:
+                    assert r.status == 403
+                    assert "RequestTimeTooSkewed" in await r.text()
+        finally:
+            await gw.stop()
+
+
+async def test_s3_gateway_anonymous_optin():
+    """No credentials configured = explicit anonymous mode: unsigned
+    requests keep working (cluster-internal default, unchanged)."""
+    from curvine_tpu.gateway.s3 import S3Gateway
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.write_all("/anon/x.bin", b"open")
+        gw = S3Gateway(c, port=0, host="127.0.0.1")
+        await gw.start()
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                        f"http://127.0.0.1:{gw.port}/anon/x.bin") as r:
+                    assert r.status == 200 and await r.read() == b"open"
+        finally:
+            await gw.stop()

@@ -1,3 +1,4 @@
+from curvine_tpu.vector.serving import AnnServer
 from curvine_tpu.vector.table import VectorTable
 
-__all__ = ["VectorTable"]
+__all__ = ["AnnServer", "VectorTable"]

@@ -63,15 +63,15 @@ def _kmeans_step_fn(n: int, d: int, c: int):
     return fn
 
 
-def _search_fn(metric: str, k: int, nprobe: int):
-    key = (metric, k, nprobe)
+def _search_fn(metric: str, k: int, nprobe: int, qchunk: int = 16):
+    key = (metric, k, nprobe, qchunk)
     fn = _SEARCH_FNS.get(key)
     if fn is None:
         import jax
         import jax.numpy as jnp
 
-        def search(q, centroids, lists, v_pad, ids_pad):
-            """q [Q,D]; centroids [C,D]; lists [C,L] dense-row ids into
+        def one_chunk(q, centroids, lists, v_pad, ids_pad):
+            """q [Qc,D]; centroids [C,D]; lists [C,L] dense-row ids into
             v_pad (-1 pad); v_pad/ids_pad are the table's ONE pinned
             sentinel-padded array pair ([N+1,D] with a zero row at index
             N / [N+1] with -1) — shared with the exact scan, no second
@@ -84,12 +84,12 @@ def _search_fn(metric: str, k: int, nprobe: int):
             else:
                 cs = 2.0 * (q @ centroids.T) \
                     - jnp.sum(centroids * centroids, axis=1)[None, :]
-            _, probe = jax.lax.top_k(cs, nprobe)        # [Q, nprobe]
-            cand = jnp.take(lists, probe, axis=0)       # [Q, nprobe, L]
-            cand = cand.reshape(q.shape[0], -1)         # [Q, nprobe*L]
+            _, probe = jax.lax.top_k(cs, nprobe)        # [Qc, nprobe]
+            cand = jnp.take(lists, probe, axis=0)       # [Qc, nprobe, L]
+            cand = cand.reshape(q.shape[0], -1)         # [Qc, nprobe*L]
             sentinel = v_pad.shape[0] - 1
             slot = jnp.where(cand < 0, sentinel, cand)
-            cv = jnp.take(v_pad, slot, axis=0)          # [Q, M, D]
+            cv = jnp.take(v_pad, slot, axis=0)          # [Qc, M, D]
             # mirror the exact scan's arithmetic EXACTLY (same casts:
             # bf16 q × bf16 table, f32 accumulation; norms in f32) —
             # scores must not shift when the index goes stale and knn
@@ -107,6 +107,25 @@ def _search_fn(metric: str, k: int, nprobe: int):
             s, idx = jax.lax.top_k(scores, kk)
             rows = jnp.take_along_axis(slot, idx, axis=1)
             return s, jnp.take(ids_pad, rows)
+
+        def search(q, centroids, lists, v_pad, ids_pad):
+            """Batched entry: large query batches are processed in
+            `qchunk`-query slices via lax.map INSIDE the one compiled
+            program (one dispatch per batch) — the [Qc, nprobe·L, D]
+            candidate gather is the peak-memory term, so serving batches
+            of 256+ queries must not materialize it for the whole batch
+            at once (500K rows × nprobe 8 would be gigabytes)."""
+            Q = q.shape[0]
+            if Q <= qchunk:
+                return one_chunk(q, centroids, lists, v_pad, ids_pad)
+            pad = (-Q) % qchunk
+            qp = jnp.pad(q, ((0, pad), (0, 0))) if pad else q
+            qs = qp.reshape(-1, qchunk, q.shape[1])
+            s, i = jax.lax.map(
+                lambda qq: one_chunk(qq, centroids, lists, v_pad, ids_pad),
+                qs)
+            return (s.reshape(-1, s.shape[-1])[:Q],
+                    i.reshape(-1, i.shape[-1])[:Q])
 
         fn = _SEARCH_FNS[key] = jax.jit(search)
     return fn

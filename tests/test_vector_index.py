@@ -186,3 +186,93 @@ async def test_bf16_with_ivf_index_scores_match_f32_accumulation():
             assert np.array_equal(e_ids, a_ids), metric
             assert np.allclose(e_s, a_s, atol=1e-3), metric
             assert a_ids[0, 0] == 11
+
+
+async def test_ann_server_microbatch_and_bulk():
+    """AnnServer coalesces concurrent single queries into one device
+    batch and the bulk path pipelines; both return the same neighbors
+    the direct knn call does, and recall@10 over the index stays >=0.9
+    (VERDICT r4 task #2 serving surface)."""
+    import asyncio
+    import numpy as np
+    from curvine_tpu.testing import MiniCluster
+    from curvine_tpu.vector import AnnServer, VectorTable
+
+    rng = np.random.default_rng(7)
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        table = await VectorTable.create(c, "/vec/serve", 32)
+        vecs = rng.normal(size=(2000, 32)).astype(np.float32)
+        await table.append(vecs)
+        await table.create_index(nlist=32, metric="cosine", iters=4)
+
+        srv = await AnnServer(table, k=10, metric="cosine", nprobe=16,
+                              max_batch=64, max_wait_ms=5.0).start()
+        try:
+            # concurrent single queries coalesce into one batch
+            qids = [3, 77, 1500, 42]
+            results = await asyncio.gather(
+                *(srv.query(vecs[i]) for i in qids))
+            for qid, (ids, scores) in zip(qids, results):
+                assert ids.shape == (10,)
+                assert int(ids[0]) == qid          # self is nearest
+                assert scores[0] >= scores[-1]
+
+            # bulk path matches direct knn
+            queries = vecs[100:164]
+            bi, bs = await srv.query_many(queries, batch=16, depth=2)
+            di, ds = await table.knn(queries, k=10, metric="cosine",
+                                     nprobe=16)
+            np.testing.assert_array_equal(bi, di)
+
+            # recall@10 vs the exact scan
+            exact_i, _ = await table.knn(queries, k=10, metric="cosine",
+                                         use_index=False)
+            hits = sum(len(set(map(int, a)) & set(map(int, b)))
+                       for a, b in zip(bi, exact_i))
+            assert hits / (len(queries) * 10) >= 0.9
+        finally:
+            await srv.stop()
+
+
+async def test_ann_server_error_propagates():
+    """A failing batch rejects every waiter instead of hanging them."""
+    import numpy as np
+    from curvine_tpu.testing import MiniCluster
+    from curvine_tpu.vector import AnnServer, VectorTable
+
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        table = await VectorTable.create(c, "/vec/err", 8)
+        await table.append(np.eye(8, dtype=np.float32))
+        srv = await AnnServer(table, k=2, max_batch=4,
+                              use_index=False).start()
+        try:
+            with pytest.raises(Exception):
+                await srv.query(np.zeros(5, dtype=np.float32))  # wrong dim
+            ids, _ = await srv.query(np.eye(8, dtype=np.float32)[1])
+            assert int(ids[0]) == 1                 # server still serves
+        finally:
+            await srv.stop()
+
+
+async def test_ann_server_stop_rejects_waiters():
+    """stop() must reject queued/in-flight waiters, not strand them
+    (round-5 review finding)."""
+    import asyncio
+    import numpy as np
+    from curvine_tpu.testing import MiniCluster
+    from curvine_tpu.vector import AnnServer, VectorTable
+
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        table = await VectorTable.create(c, "/vec/stop", 8)
+        await table.append(np.eye(8, dtype=np.float32))
+        # long coalesce window so the queued query is still pending
+        srv = await AnnServer(table, k=2, max_batch=64,
+                              max_wait_ms=5_000, use_index=False).start()
+        q = asyncio.ensure_future(srv.query(np.ones(8, dtype=np.float32)))
+        await asyncio.sleep(0.05)
+        await srv.stop()
+        with pytest.raises(Exception, match="stopped"):
+            await asyncio.wait_for(q, timeout=2.0)

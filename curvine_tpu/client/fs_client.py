@@ -222,12 +222,14 @@ class FsClient:
     async def add_block(self, path: str,
                         commit_blocks: list[CommitBlock] | None = None,
                         exclude_workers: list[int] | None = None,
-                        ici_coords: list[int] | None = None) -> LocatedBlock:
+                        ici_coords: list[int] | None = None,
+                        abandon_block: int | None = None) -> LocatedBlock:
         rep = await self.call(RpcCode.ADD_BLOCK, {
             "path": path, "client_host": self.client_host,
             "commit_blocks": [c.to_wire() for c in commit_blocks or []],
             "exclude_workers": exclude_workers or [],
-            "ici_coords": ici_coords or []}, mutate=True)
+            "ici_coords": ici_coords or [],
+            "abandon_block": abandon_block}, mutate=True)
         return LocatedBlock.from_wire(rep["block"])
 
     async def complete_file(self, path: str, length: int,
@@ -247,6 +249,12 @@ class FsClient:
     async def master_info(self) -> MasterInfo:
         rep = await self.call(RpcCode.GET_MASTER_INFO, {})
         return MasterInfo.from_wire(rep["info"])
+
+    async def cluster_health(self) -> dict:
+        """Cluster-health rollup: master role, liveness, capacity,
+        replication debt and the dir-watchdog's stuck-op snapshot.
+        Parity: master_monitor.rs + fs_dir_watchdog.rs."""
+        return await self.call(RpcCode.CLUSTER_HEALTH, {})
 
     async def list_options(self, path: str, pattern: str | None = None,
                            dirs_only: bool = False, files_only: bool = False,

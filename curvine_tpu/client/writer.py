@@ -8,6 +8,7 @@ pipeline; with cache-tier replication ≤3 fan-out is equivalent)."""
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import os
 import zlib
@@ -120,9 +121,65 @@ class FsWriter:
         self._block_written += len(chunk)
 
     async def _next_block(self) -> None:
-        self._block = await self.fs.add_block(
-            self.path, commit_blocks=self._take_commits(),
-            ici_coords=self.ici_coords)
+        """Allocate + open the next block. A retryable failure (e.g. the
+        worker's CapacityPending while lease-encumbered bdev space
+        clears after a restart) backs off and re-requests placement —
+        the master may pick another worker, or the same one once its
+        quarantine lapses. The budget is a DEADLINE, not a count: it
+        must outlive the worker's lease_s + slack window (~60s default)
+        that CapacityPending promises will clear. Commits ride only the
+        FIRST add_block; each retry ABANDONS the previous allocation
+        (HDFS abandonBlock — no zero-length ghost blocks on the inode)
+        and aborts any half-opened upload streams."""
+        import random as _random
+        commits = self._take_commits()
+        abandon = None
+        deadline = asyncio.get_running_loop().time() + 90.0
+        delay = 0.4
+        while True:
+            try:
+                self._block = await self.fs.add_block(
+                    self.path, commit_blocks=commits,
+                    ici_coords=self.ici_coords, abandon_block=abandon)
+                commits = []
+                await self._open_block()
+                return
+            except err.CurvineError as e:
+                await self._abort_open_attempt()
+                if self._block is not None:
+                    abandon = self._block.block.id
+                    self._block = None
+                if not e.retryable \
+                        or asyncio.get_running_loop().time() >= deadline:
+                    raise
+                sleep = delay * (0.5 + _random.random() / 2)
+                log.debug("block open retry in %.2fs: %s", sleep, e)
+                await asyncio.sleep(sleep)
+                delay = min(delay * 2, 10.0)
+
+    async def _abort_open_attempt(self) -> None:
+        """Tear down a partially-opened block attempt: half-open upload
+        streams (their pooled conns must not stay mid-protocol) and any
+        short-circuit grant."""
+        if self._sc_file is not None:
+            self._sc_file.close()
+            self._sc_file = None
+        if self._sc_conn is not None and self._block is not None:
+            try:
+                await self._sc_conn.call(
+                    RpcCode.SC_WRITE_ABORT,
+                    data=pack({"block_id": self._block.block.id}))
+            except err.CurvineError:
+                pass
+            self._sc_conn = None
+        for up in self._uploads:
+            try:
+                await up.abort()
+            except (err.CurvineError, OSError):
+                pass
+        self._uploads = []
+
+    async def _open_block(self) -> None:
         if not self._block.locs:
             raise err.NoAvailableWorker(f"no locations for {self.path}")
         self._block_written = 0

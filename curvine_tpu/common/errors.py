@@ -118,6 +118,12 @@ ConnectError = _make("ConnectError", ErrorCode.CONNECT)
 Uncompleted = _make("Uncompleted", ErrorCode.UNCOMPLETED)
 FastMiss = _make("FastMiss", ErrorCode.FAST_MISS)
 FastGated = _make("FastGated", ErrorCode.FAST_GATED)
+# Capacity shortfall that clears by itself (lease-encumbered bdev
+# extents / unexpired quarantine, e.g. the ~lease_s window right after a
+# worker restart when load_index grants synthetic leases): IN_PROGRESS
+# is in the retryable set, so writers back off and re-place instead of
+# hard-failing user writes.
+CapacityPending = _make("CapacityPending", ErrorCode.IN_PROGRESS)
 
 _CODE_TO_CLASS: dict[ErrorCode, type[CurvineError]] = {
     c.code: c
@@ -127,6 +133,6 @@ _CODE_TO_CLASS: dict[ErrorCode, type[CurvineError]] = {
         BlockNotFound, WorkerNotFound, NoAvailableWorker, CapacityExceeded,
         QuotaExceeded, NotLeader, RpcTimeout, Cancelled, Unsupported,
         AbnormalData, UfsError, MountNotFound, PermissionDenied, JobNotFound,
-        ConnectError, Uncompleted, FastMiss, FastGated,
+        ConnectError, Uncompleted, FastMiss, FastGated, CapacityPending,
     ]
 }

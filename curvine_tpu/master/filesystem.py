@@ -657,6 +657,7 @@ class MasterFilesystem:
                   commit_blocks: list[CommitBlock] | None = None,
                   ici_coords: list[int] | None = None,
                   storage_type: StorageType = StorageType.MEM,
+                  abandon_block: int | None = None,
                   ) -> LocatedBlock:
         node = self._file_or_raise(path)
         if node.is_complete:
@@ -666,7 +667,17 @@ class MasterFilesystem:
             self.workers.live_workers(), max(1, node.replicas),
             client_host=client_host, exclude=set(exclude_workers or []),
             needed=node.block_size, ici_coords=ici_coords)
-        block_id = self._log("alloc_block", dict(inode_id=node.id))
+        args = dict(inode_id=node.id)
+        # HDFS abandonBlock semantics: a writer retrying a failed block
+        # open discards its previous allocation in the same journal
+        # entry, so retries never accumulate zero-length ghost blocks on
+        # the inode. Only the trailing, never-committed block qualifies.
+        if abandon_block is not None and node.blocks \
+                and node.blocks[-1] == abandon_block:
+            meta = self.blocks.get(abandon_block)
+            if meta is None or meta.len == 0:
+                args["abandon"] = abandon_block
+        block_id = self._log("alloc_block", args)
         block = ExtendedBlock(id=block_id, len=0, storage_type=storage_type,
                               file_type=node.file_type)
         node = self.tree.get(node.id)
@@ -676,8 +687,13 @@ class MasterFilesystem:
                             locs=[w.address for w in chosen],
                             storage_types=[storage_type] * len(chosen))
 
-    def _apply_alloc_block(self, inode_id: int) -> int:
+    def _apply_alloc_block(self, inode_id: int,
+                           abandon: int | None = None) -> int:
         node = self._inode_or_raise(inode_id)
+        if abandon is not None and node.blocks \
+                and node.blocks[-1] == abandon:
+            node.blocks.pop()
+            self.blocks.remove_block(abandon)
         block_id = self.tree.alloc_block_id()
         node.blocks.append(block_id)
         node.mtime = now_ms()      # writer liveness for lease recovery

@@ -73,7 +73,14 @@ class MasterServer:
                                superuser=mc.superuser,
                                supergroup=mc.supergroup)
         self.retry_cache = RetryCache(mc.retry_cache_size, mc.retry_cache_ttl_ms)
+        from curvine_tpu.master.monitor import DirWatchdog, MasterMonitor
+        self.watchdog = DirWatchdog(self.metrics, self.locks,
+                                    stall_s=mc.watchdog_stall_ms / 1000)
+        self.monitor = MasterMonitor(self)
         self.rpc = RpcServer(mc.hostname, mc.rpc_port, "master")
+        # in-flight requests register at the DISPATCH level so a wedge
+        # anywhere (fault hook, handler, commit barrier) is visible
+        self.rpc.watchdog = self.watchdog
         self.raft = None
         if mc.raft_peers:
             from curvine_tpu.master.ha import RaftLite
@@ -86,6 +93,10 @@ class MasterServer:
         self._bg: list[asyncio.Task] = []
         from curvine_tpu.common.executor import ScheduledExecutor
         self.executor = ScheduledExecutor("master")
+        self.ufs_backup = None
+        if mc.ufs_backup_uri:
+            from curvine_tpu.master.ufs_backup import UfsBackup
+            self.ufs_backup = UfsBackup(self.fs, mc.ufs_backup_uri)
 
     @property
     def addr(self) -> str:
@@ -93,6 +104,14 @@ class MasterServer:
 
     async def start(self) -> None:
         self.fs.recover()
+        if self.ufs_backup is not None:
+            # disaster bootstrap: a wiped/virgin master dir restores the
+            # namespace from the newest UFS snapshot (local truth wins
+            # when any history exists). Parity: ufs_loader.rs.
+            try:
+                await self.ufs_backup.bootstrap_if_empty()
+            except Exception as e:  # noqa: BLE001 — degrade, don't die
+                log.warning("ufs backup bootstrap failed: %s", e)
         self.mounts.load_from_store()
         # durable decommission intents (KV cold starts skip replay, so
         # runtime-only state would otherwise vanish on restart)
@@ -130,6 +149,14 @@ class MasterServer:
                                               self._fast_gate_tick, 1.0)
         self.executor.submit_periodic("lease-recovery",
                                       self._lease_recovery_tick, 30.0)
+        self.executor.submit_periodic("watchdog", self.watchdog.tick, 1.0)
+        if self.ufs_backup is not None:
+            async def backup_tick():
+                if self._is_leader():
+                    await self.ufs_backup.upload_if_advanced()
+            self.executor.submit_periodic(
+                "ufs-backup", backup_tick,
+                self.conf.master.ufs_backup_interval_s)
         self.executor.submit("ttl", self.ttl.run(leader_gate=gate))
         self.executor.submit("replication",
                              self.replication.run(leader_gate=gate))
@@ -225,6 +252,7 @@ class MasterServer:
         r(C.LIST_LOCK, self._h(self._list_lock))
         r(C.ASSIGN_WORKER, self._h(self._assign_worker))
         r(C.METRICS_REPORT, self._h(self._metrics_report))
+        r(C.CLUSTER_HEALTH, self._h(self._cluster_health))
         # worker plane
         r(C.WORKER_HEARTBEAT, self._h(self._worker_heartbeat))
         r(C.WORKER_BLOCK_REPORT, self._h(self._worker_block_report))
@@ -483,7 +511,8 @@ class MasterServer:
             exclude_workers=q.get("exclude_workers"),
             commit_blocks=[CommitBlock.from_wire(c)
                            for c in q.get("commit_blocks", [])],
-            ici_coords=q.get("ici_coords"))
+            ici_coords=q.get("ici_coords"),
+            abandon_block=q.get("abandon_block"))
         return {"block": lb.to_wire()}
 
     def _complete_file(self, q):
@@ -594,6 +623,11 @@ class MasterServer:
         for name, value in (q.get("counters") or {}).items():
             self.metrics.inc(f"client.{name}", value)
         return {}
+
+    def _cluster_health(self, q):
+        """Cluster-health rollup (monitor + watchdog snapshot).
+        Parity: master_monitor.rs state + fs_dir_watchdog.rs sentinel."""
+        return self.monitor.health()
 
     @staticmethod
     def _with_identity(q: dict, r: dict) -> dict:

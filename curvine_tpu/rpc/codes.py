@@ -63,6 +63,10 @@ class RpcCode(enum.IntEnum):
     DECOMMISSION_WORKER = 46
 
     METRICS_REPORT = 60
+    # cluster-health rollup (master monitor + dir watchdog snapshot)
+    # Parity: curvine-server/src/master/master_monitor.rs +
+    # fs_dir_watchdog.rs — state, capacity, liveness, stuck-op sentinel
+    CLUSTER_HEALTH = 61
 
     # block interface (worker)
     WRITE_BLOCK = 80

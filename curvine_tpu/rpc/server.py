@@ -140,6 +140,10 @@ class RpcServer:
         # optional fault-injection hook (curvine_tpu.fault): called per
         # request, may sleep, raise, or ask for the request to be dropped
         self.fault_hook = None
+        # optional DirWatchdog: every in-flight request registers so a
+        # wedged dispatch (including one stalled in the fault hook) is
+        # visible to the stuck-op sentinel (master/monitor.py)
+        self.watchdog = None
 
     def register(self, code: int, handler: Handler) -> None:
         self._handlers[int(code)] = handler
@@ -297,6 +301,9 @@ class RpcServer:
 
     async def _dispatch(self, msg: Message, conn: ServerConn) -> None:
         handler = self._handlers.get(msg.code)
+        token = None
+        if self.watchdog is not None:
+            token = self.watchdog.op_enter(_code_name(msg.code))
         try:
             if self.fault_hook is not None:
                 if not await self.fault_hook(self.name, msg):
@@ -323,3 +330,14 @@ class RpcServer:
                 await conn.send(error_for(msg, e))
             except Exception:
                 pass
+        finally:
+            if token is not None:
+                self.watchdog.op_exit(token)
+
+
+def _code_name(code: int) -> str:
+    from curvine_tpu.rpc.codes import RpcCode
+    try:
+        return RpcCode(code).name.lower()
+    except ValueError:
+        return f"code_{code}"

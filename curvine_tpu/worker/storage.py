@@ -394,6 +394,27 @@ class BlockStore:
             if tier.available >= size_hint:
                 return tier
         tried = ", ".join(f"{t.dir_id}={t.available}" for t in ordered)
+        # Transient shortfall: a bdev tier whose room is merely parked in
+        # unexpired quarantine or behind lease-encumbered victims (the
+        # whole tier right after a restart — load_index grants synthetic
+        # leases) WILL clear within lease_s + slack. Surface that as the
+        # retryable CapacityPending so writers back off and re-place
+        # instead of hard-failing for the window.
+        now = time.time()
+        for tier in ordered:
+            if not isinstance(tier, BdevTier):
+                continue
+            pending = tier._quarantined + sum(
+                b.alloc_len for b in self.blocks.values()
+                if b.tier is tier and b.state == BlockState.COMMITTED
+                and b.block_id not in self._moving
+                and not self._read_pins.get(b.block_id)
+                and tier.free_would_quarantine(b.block_id, now))
+            if tier.available + pending >= size_hint:
+                raise err.CapacityPending(
+                    f"need {size_hint}B on {tier.dir_id}: {pending}B "
+                    f"lease-encumbered/quarantined, clears within "
+                    f"~{tier.lease_s + tier.lease_slack_s:.0f}s")
         raise err.CapacityExceeded(
             f"need {size_hint}B, all tiers tried after eviction: {tried}")
 

@@ -259,3 +259,34 @@ async def test_bdev_cluster_roundtrip(tmp_path):
         await c.meta.delete("/bdev/blob.bin")
         await asyncio.sleep(0.6)               # heartbeat delivers deletes
         assert w.store.tiers[0].used == 0
+
+
+def test_bdev_restart_single_tier_capacity_pending(tmp_path):
+    """Single-bdev-tier worker right after a restart: every survivor is
+    synthetically leased, so there is NO immediate room — but the
+    shortfall is transient, and the failure must be the RETRYABLE
+    CapacityPending (writers back off through the ~lease_s window)
+    rather than a hard CapacityExceeded; once the leases lapse, the
+    same allocation succeeds via normal eviction."""
+    path = str(tmp_path / "bdev.img")
+    tier = BdevTier(StorageType.SSD, path, 8 * MB)
+    store = BlockStore([tier])
+    for bid in (1, 2):
+        info = store.create_temp(bid, StorageType.SSD, size_hint=4 * MB)
+        with open(info.path, "r+b") as f:
+            f.seek(info.offset)
+            f.write(b"a" * MB)
+        store.commit(bid, MB, checksum=None)
+
+    tier2 = BdevTier(StorageType.SSD, path, 8 * MB)
+    store2 = BlockStore([tier2])
+    assert tier2.available == 0
+    with pytest.raises(err.CapacityPending) as ei:
+        store2.create_temp(9, StorageType.SSD, size_hint=4 * MB)
+    assert ei.value.retryable          # writers back off, not fail
+    assert store2.contains(1) and store2.contains(2)   # nothing destroyed
+
+    # leases lapse → the very same allocation succeeds via eviction
+    tier2._leases = {b: 0.0 for b in tier2._leases}
+    info = store2.create_temp(9, StorageType.SSD, size_hint=4 * MB)
+    assert info.tier is tier2

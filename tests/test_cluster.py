@@ -166,3 +166,30 @@ async def test_free_releases_cache():
         assert st.len == 1 * MB       # metadata kept
         fb = await c.meta.get_block_locations("/fr")
         assert fb.block_locs == []    # cache dropped
+
+
+async def test_add_block_abandon_no_ghost_blocks():
+    """A writer retry abandons its previous failed allocation (HDFS
+    abandonBlock): the inode must not accumulate zero-length ghost
+    blocks (round-5 review finding)."""
+    from curvine_tpu.common.types import CommitBlock
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        w = await c.create("/gb/f.bin")     # open-for-write lease
+        fs = mc.master.fs
+        wid = fs.workers.live_workers()[0].address.worker_id
+
+        b1 = fs.add_block("/gb/f.bin").block.id
+        # retry path: abandon b1, allocate b2
+        b2 = fs.add_block("/gb/f.bin", abandon_block=b1).block.id
+        node = fs.tree.resolve("/gb/f.bin")
+        assert node.blocks == [b2]
+        assert fs.blocks.get(b1) is None        # block map pruned too
+
+        # a COMMITTED (len>0) block is never abandonable
+        fs.add_block("/gb/f.bin", commit_blocks=[CommitBlock(
+            block_id=b2, block_len=7, worker_ids=[wid])],
+            abandon_block=b2)
+        node = fs.tree.resolve("/gb/f.bin")
+        assert b2 in node.blocks and len(node.blocks) == 2
+        await w.abort()

@@ -1,0 +1,180 @@
+"""Master monitor + dir watchdog.
+
+Parity: curvine-server/src/master/master_monitor.rs (cluster/master state
+rollup) and curvine-server/src/master/fs/fs_dir_watchdog.rs (the stuck-
+metadata-op sentinel). The reference probes its single global fs_dir
+RwLock with try_read(); this master is asyncio, so the equivalent wedge
+modes are different and the watchdog covers all three:
+
+* an in-flight namespace RPC stuck past the threshold (awaiting a
+  commit barrier / KV fsync / UFS call that never returns),
+* a path lock held far beyond the stall threshold (a client that took
+  an exclusive lease and wedged — writers queue behind it),
+* event-loop stall (a synchronous call starving every handler).
+
+A stall is surfaced, never acted on: it logs once per incident, flips
+the ``watchdog.*`` gauges that /metrics and the health rollup expose,
+and clears itself on recovery — recovery decisions stay with the
+operator, exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import time
+
+from curvine_tpu.common.types import now_ms
+
+log = logging.getLogger(__name__)
+
+
+class DirWatchdog:
+    def __init__(self, metrics, locks, stall_s: float = 10.0):
+        self.metrics = metrics
+        self.locks = locks
+        self.stall_s = stall_s
+        self._inflight: dict[int, tuple[str, str, float]] = {}
+        self._ids = itertools.count(1)
+        self._reported: set[int] = set()
+        self._reported_locks: set[tuple[str, str]] = set()
+        self._last_tick = time.monotonic()
+        self._loop_lag_s = 0.0
+        self._tick_interval = 1.0
+
+    # ---- in-flight op registry (server._h hooks these) ----
+
+    def op_enter(self, op: str, detail: str = "") -> int:
+        token = next(self._ids)
+        self._inflight[token] = (op, detail, time.monotonic())
+        return token
+
+    def op_exit(self, token: int) -> None:
+        self._inflight.pop(token, None)
+        self._reported.discard(token)
+
+    # ---- periodic probe (rides the scheduled executor) ----
+
+    def tick(self) -> None:
+        now = time.monotonic()
+        # event-loop lag: how late this tick fired vs the schedule. A
+        # synchronous stall shows up here even with zero in-flight ops.
+        self._loop_lag_s = max(0.0, now - self._last_tick
+                               - self._tick_interval)
+        self._last_tick = now
+
+        stuck = [(tok, op, detail, now - t0)
+                 for tok, (op, detail, t0) in self._inflight.items()
+                 if now - t0 > self.stall_s]
+        for tok, op, detail, age in stuck:
+            if tok not in self._reported:
+                self._reported.add(tok)
+                log.warning("watchdog: op %s(%s) stuck for %.1fs "
+                            "(threshold %.1fs)", op, detail, age,
+                            self.stall_s)
+        # recovered incidents log once too (parity: fs_dir_watchdog's
+        # recovery message)
+        gone = self._reported - set(self._inflight)
+        for tok in gone:
+            self._reported.discard(tok)
+
+        long_locks = []
+        stall_ms = self.stall_s * 1000
+        for l in self.locks.list_locks():
+            age_ms = now_ms() - l.create_ms
+            if age_ms > stall_ms:
+                long_locks.append(l)
+                key = (l.path, l.owner)
+                if key not in self._reported_locks:
+                    self._reported_locks.add(key)
+                    log.warning(
+                        "watchdog: path lock %s held by %s for %.1fs "
+                        "(ttl %.1fs)", l.path, l.owner, age_ms / 1000,
+                        l.ttl_ms / 1000)
+        held = {(l.path, l.owner) for l in long_locks}
+        for key in self._reported_locks - held:
+            log.info("watchdog: path lock %s released by %s after stall",
+                     *key)
+        self._reported_locks &= held
+
+        self.metrics.gauge("watchdog.stuck_ops", len(stuck))
+        self.metrics.gauge("watchdog.long_held_locks", len(long_locks))
+        self.metrics.gauge("watchdog.loop_lag_ms",
+                           self._loop_lag_s * 1000)
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        return {
+            "stall_threshold_s": self.stall_s,
+            "loop_lag_ms": round(self._loop_lag_s * 1000, 1),
+            "stuck_ops": [
+                {"op": op, "detail": detail,
+                 "age_s": round(now - t0, 1)}
+                for op, detail, t0 in self._inflight.values()
+                if now - t0 > self.stall_s],
+            "long_held_locks": [
+                {"path": l.path, "owner": l.owner,
+                 "age_s": round((now_ms() - l.create_ms) / 1000, 1)}
+                for l in self.locks.list_locks()
+                if now_ms() - l.create_ms > self.stall_s * 1000],
+        }
+
+
+class MasterMonitor:
+    """Cluster-health rollup: one structured snapshot of master role,
+    journal position, worker liveness/capacity, replication debt, jobs
+    and the watchdog — served over CLUSTER_HEALTH and /api/health."""
+
+    def __init__(self, server):
+        self.server = server
+
+    def health(self) -> dict:
+        s = self.server
+        fs = s.fs
+        role = "leader" if s._is_leader() else "follower"
+        live = fs.workers.live_workers()
+        lost = fs.workers.lost_workers()
+        deco = [w for w in fs.workers.workers.values()
+                if w.address.worker_id in fs.workers.deco_ids]
+        cap, avail = fs.workers.capacity()
+        under = len(list(fs.blocks.under_replicated()))
+        jobs = getattr(s.jobs, "jobs", {})
+        running_jobs = sum(1 for j in jobs.values()
+                           if str(getattr(j, "state", "")).lower()
+                           in ("running", "pending"))
+        wd = s.watchdog.snapshot() if s.watchdog else {}
+
+        problems = []
+        if not live:
+            problems.append("no live workers")
+        if lost:
+            problems.append(f"{len(lost)} lost worker(s)")
+        if under:
+            problems.append(f"{under} under-replicated block(s)")
+        if cap and avail / cap < 0.05:
+            problems.append("cluster >95% full")
+        if wd.get("stuck_ops") or wd.get("long_held_locks"):
+            problems.append("watchdog: stuck namespace ops")
+        status = "healthy"
+        if problems:
+            status = "degraded"
+        if not live or wd.get("stuck_ops"):
+            status = "critical"
+
+        return {
+            "status": status,
+            "problems": problems,
+            "role": role,
+            "inodes": fs.tree.count(),
+            "blocks": fs.blocks.count(),
+            "journal_seq": fs.journal.seq if fs.journal else 0,
+            "workers": {
+                "live": len(live), "lost": len(lost),
+                "decommissioning": len(deco),
+            },
+            "capacity": cap,
+            "available": avail,
+            "under_replicated": under,
+            "jobs_active": running_jobs,
+            "watchdog": wd,
+        }

@@ -65,6 +65,7 @@ class WebServer:
         r.add_get("/api/jobs/{job_id}", self._job)
         r.add_get("/api/workers", self._workers)
         r.add_get("/api/metrics.json", self._metrics_json)
+        r.add_get("/api/health", self._health)
         import os
         static_dir = os.path.join(os.path.dirname(__file__), "static")
         if os.path.isdir(static_dir):
@@ -133,6 +134,13 @@ class WebServer:
             return self._json({"error": "not a master"})
         return self._json(self.master.fs.master_info(
             self.master.addr).to_wire())
+
+    async def _health(self, req):
+        """Monitor + watchdog rollup (SPA health panel; parity
+        master_monitor.rs)."""
+        if self.master is None:
+            return self._json({"error": "not a master"})
+        return self._json(self.master.monitor.health())
 
     async def _browse(self, req):
         if self.master is None:

@@ -73,10 +73,21 @@ function sparkline(canvas, data, color, tipFmt) {
 /* ---------- views ---------- */
 async function overview() {
   const d = await api("/api/info");
+  const h = await api("/api/health");
   const used = d.capacity - d.available;
   const pct = d.capacity ? used / d.capacity : 0;
+  const hcls = h.status === "healthy" ? "live" : "lost";
+  const problems = (h.problems || []).map(esc).join(" · ");
+  const wd = h.watchdog || {};
+  const stuck = (wd.stuck_ops || []).map(o =>
+    `<li>op <b>${esc(o.op)}</b>(${esc(o.detail || "")}) stuck ${o.age_s}s</li>`);
+  const locks = (wd.long_held_locks || []).map(l =>
+    `<li>lock <b>${esc(l.path)}</b> held by ${esc(l.owner)} for ${l.age_s}s</li>`);
   view.innerHTML = `
     <div class="tiles">
+      <div class="tile"><div class="v"><span class="status ${hcls}">
+        <span class="dot"></span>${esc(h.status || "?")}</span></div>
+        <div class="l">health${h.role ? " (" + esc(h.role) + ")" : ""}</div></div>
       <div class="tile"><div class="v">${d.inode_num}</div><div class="l">inodes</div></div>
       <div class="tile"><div class="v">${d.block_num}</div><div class="l">blocks</div></div>
       <div class="tile"><div class="v">${d.live_workers.length}</div><div class="l">live workers</div></div>
@@ -84,6 +95,9 @@ async function overview() {
       <div class="tile"><div class="v">${gib(d.capacity)}</div><div class="l">capacity</div></div>
       <div class="tile"><div class="v">${(pct * 100).toFixed(1)}%</div><div class="l">used</div></div>
     </div>
+    ${problems ? `<div class="empty">⚠ ${problems}</div>` : ""}
+    ${stuck.length || locks.length
+      ? `<h2>Watchdog</h2><ul>${stuck.join("")}${locks.join("")}</ul>` : ""}
     <h2>Cache usage</h2>
     <div class="meter ${pct > 0.92 ? "crit" : pct > 0.8 ? "warn" : ""}" style="max-width:420px">
       <div style="width:${(pct * 100).toFixed(1)}%"></div>

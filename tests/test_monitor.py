@@ -1,0 +1,107 @@
+"""Master monitor + dir watchdog.
+
+Parity: curvine-server/src/master/master_monitor.rs (health rollup) and
+fs_dir_watchdog.rs (stuck-namespace-op sentinel). The watchdog must FIRE
+when a path lock wedges or an RPC stalls, and clear on recovery."""
+
+import asyncio
+
+import pytest
+
+from curvine_tpu.common.conf import ClusterConf
+from curvine_tpu.fault.runtime import FaultInjector, FaultSpec
+from curvine_tpu.rpc.codes import RpcCode
+from curvine_tpu.testing import MiniCluster
+
+
+async def test_health_rollup_healthy_cluster():
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.write_all("/h/a.bin", b"x" * 100)
+        h = await c.meta.cluster_health()
+        assert h["status"] == "healthy"
+        assert h["role"] == "leader"
+        assert h["workers"]["live"] == 1 and h["workers"]["lost"] == 0
+        assert h["inodes"] >= 2 and h["blocks"] >= 1
+        assert h["capacity"] > 0 and h["available"] > 0
+        assert h["watchdog"]["stuck_ops"] == []
+        assert h["watchdog"]["long_held_locks"] == []
+
+
+async def test_watchdog_fires_on_wedged_path_lock():
+    """A client takes an exclusive path lock and wedges (never releases,
+    long TTL): the watchdog flags it past the stall threshold, health
+    degrades, metrics expose it — and it clears on release."""
+    conf = ClusterConf()
+    conf.master.watchdog_stall_ms = 300
+    async with MiniCluster(workers=1, conf=conf) as mc:
+        c = mc.client()
+        await c.meta.set_lock("/wedged/dir", kind="exclusive",
+                              ttl_ms=3_600_000)
+        await asyncio.sleep(0.4)               # cross the stall threshold
+        mc.master.watchdog.tick()              # (periodic tick is 1s)
+        h = await c.meta.cluster_health()
+        held = h["watchdog"]["long_held_locks"]
+        assert [l["path"] for l in held] == ["/wedged/dir"]
+        assert held[0]["owner"] == c.meta.client_id
+        assert h["status"] == "degraded"
+        assert "stuck" in " ".join(h["problems"])
+        assert mc.master.metrics.as_dict()[
+            "watchdog.long_held_locks"] == 1.0
+
+        await c.meta.release_lock("/wedged/dir")
+        mc.master.watchdog.tick()
+        h = await c.meta.cluster_health()
+        assert h["watchdog"]["long_held_locks"] == []
+        assert h["status"] == "healthy"
+
+
+async def test_watchdog_fires_on_stalled_rpc():
+    """Fault injection wedges a namespace RPC in flight; the watchdog's
+    in-flight registry flags it while it is stuck and clears after."""
+    conf = ClusterConf()
+    conf.master.watchdog_stall_ms = 200
+    async with MiniCluster(workers=1, conf=conf) as mc:
+        c = mc.client()
+        inj = FaultInjector().install(mc.master.rpc)
+        try:
+            inj.add(FaultSpec(kind="delay", target="master",
+                              codes=[int(RpcCode.MKDIR)], delay_ms=900))
+            task = asyncio.ensure_future(c.meta.mkdir("/slow/dir", True))
+            await asyncio.sleep(0.5)           # in flight, past threshold
+            mc.master.watchdog.tick()
+            h = await c.meta.cluster_health()
+            stuck = h["watchdog"]["stuck_ops"]
+            assert any(o["op"] == "mkdir" for o in stuck)
+            assert h["status"] == "critical"
+            await task                          # completes after the delay
+            mc.master.watchdog.tick()
+            h = await c.meta.cluster_health()
+            assert h["watchdog"]["stuck_ops"] == []
+        finally:
+            inj.uninstall(mc.master.rpc)
+
+
+async def test_health_flags_lost_worker_and_web_endpoint():
+    import aiohttp
+    from curvine_tpu.web.server import WebServer
+    async with MiniCluster(workers=2, lost_timeout_ms=800) as mc:
+        c = mc.client()
+        await mc.kill_worker(0)
+        await asyncio.sleep(1.2)               # heartbeat expiry
+        h = await c.meta.cluster_health()
+        assert h["workers"]["lost"] == 1
+        assert h["status"] in ("degraded", "critical")
+        assert any("lost" in p for p in h["problems"])
+
+        web = WebServer(0, master=mc.master, host="127.0.0.1")
+        await web.start()
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                        f"http://127.0.0.1:{web.port}/api/health") as r:
+                    assert r.status == 200
+                    j = await r.json()
+                    assert j["workers"]["lost"] == 1
+        finally:
+            await web.stop()

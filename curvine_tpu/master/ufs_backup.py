@@ -1,0 +1,157 @@
+"""Master snapshot backup to UFS + disaster-recovery bootstrap.
+
+Parity: curvine-server/src/master/journal/ufs_loader.rs — the reference
+lets a fresh master recover namespace state through the UFS; here the
+master periodically uploads its full-state snapshot (the same dict the
+HA snapshot transfer ships, filesystem._snapshot_state) to any mounted
+or direct UFS URI, and an EMPTY master dir restores from the newest one
+on start. Local journal/KV remain the source of truth; the UFS copy is
+the off-box disaster story (lose the disk, keep the namespace).
+
+Layout under the configured URI:
+  snapshot-<seq 20d>.bin   msgpack {"__snap__": state, "__last_term__"}
+                            + trailing crc32 (le u32) over the payload
+  LATEST                   json manifest {file, seq, last_term, ts_ms}
+
+Upload is atomic-enough for object stores: the snapshot object is
+written first, the manifest swings last, and the previous snapshot is
+kept until a newer one lands (2-deep retention).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import zlib
+
+import msgpack
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.common.types import now_ms
+from curvine_tpu.ufs.base import create_ufs
+
+log = logging.getLogger(__name__)
+
+KEEP = 2
+
+
+class UfsBackup:
+    def __init__(self, fs, uri: str, properties: dict | None = None):
+        self.fs = fs
+        self.uri = uri.rstrip("/")
+        self.properties = properties or {}
+        self._last_seq = -1
+        # a FAILED bootstrap (UFS unreachable ≠ manifest absent) blocks
+        # uploads: an empty master must never swing LATEST over a DR
+        # copy it could not read
+        self._upload_blocked = False
+
+    def _ufs(self):
+        return create_ufs(self.uri, self.properties)
+
+    # ---------------- upload ----------------
+
+    async def upload_if_advanced(self) -> str | None:
+        """Periodic duty: upload a snapshot when the journal advanced
+        since the last upload (leader-gated by the caller)."""
+        if self._upload_blocked:
+            log.warning("ufs backup: uploads blocked — bootstrap could "
+                        "not read %s (fix connectivity and restart)",
+                        self.uri)
+            return None
+        seq = self.fs.journal.seq if self.fs.journal else 0
+        if seq <= self._last_seq:
+            return None
+        return await self.upload()
+
+    async def upload(self) -> str:
+        # never clobber a NEWER remote copy: a wiped master that somehow
+        # skipped bootstrap (or a stale ex-leader) must not swing LATEST
+        # backwards over state it never restored
+        local_seq = self.fs.journal.seq if self.fs.journal else 0
+        if self._last_seq < 0:
+            try:
+                manifest = json.loads((await self._ufs().read_all(
+                    f"{self.uri}/LATEST")).decode())
+                if int(manifest.get("seq", 0)) > local_seq:
+                    raise err.AbnormalData(
+                        f"ufs backup at {self.uri} has seq "
+                        f"{manifest['seq']} > local {local_seq}; refusing "
+                        "to overwrite a newer DR copy")
+            except err.AbnormalData:
+                raise
+            except err.CurvineError:
+                pass                  # absent/unreadable manifest: proceed
+        state = self.fs._snapshot_state()
+        seq = self.fs.journal.seq if self.fs.journal else 0
+        last_term = self.fs.journal.last_term if self.fs.journal else 0
+        payload = msgpack.packb({"__snap__": state,
+                                 "__last_term__": last_term},
+                                use_bin_type=True)
+        blob = payload + zlib.crc32(payload).to_bytes(4, "little")
+        name = f"snapshot-{seq:020d}.bin"
+        ufs = self._ufs()
+        await ufs.write_all(f"{self.uri}/{name}", blob)
+        manifest = json.dumps({"file": name, "seq": seq,
+                               "last_term": last_term, "ts_ms": now_ms()})
+        await ufs.write_all(f"{self.uri}/LATEST", manifest.encode())
+        self._last_seq = seq
+        await self._prune(ufs, keep_to=name)
+        log.info("ufs backup: snapshot seq=%d (%d bytes) → %s/%s",
+                 seq, len(blob), self.uri, name)
+        return name
+
+    async def _prune(self, ufs, keep_to: str) -> None:
+        try:
+            snaps = sorted(
+                s.path.rsplit("/", 1)[-1] for s in await ufs.list(self.uri)
+                if s.path.rsplit("/", 1)[-1].startswith("snapshot-"))
+        except err.CurvineError:
+            return
+        for old in snaps[:-KEEP]:
+            if old == keep_to:
+                continue
+            try:
+                await ufs.delete(f"{self.uri}/{old}")
+            except err.CurvineError:
+                pass
+
+    # ---------------- bootstrap ----------------
+
+    async def bootstrap_if_empty(self) -> bool:
+        """Restore the namespace from the newest UFS snapshot when the
+        local state is virgin (fresh/wiped master dir). Never touches a
+        master that already has history — local truth wins."""
+        fs = self.fs
+        local_seq = fs.journal.seq if fs.journal else 0
+        if local_seq > 0 or fs.tree.count() > 1:
+            return False
+        try:
+            manifest = json.loads(
+                (await self._ufs().read_all(f"{self.uri}/LATEST")).decode())
+        except err.FileNotFound:
+            log.info("ufs backup: no manifest at %s, starting empty",
+                     self.uri)
+            return False
+        except err.CurvineError as e:
+            # unreachable ≠ absent: starting empty now and uploading
+            # later would DESTROY the DR copy — block uploads and
+            # surface the failure
+            self._upload_blocked = True
+            raise err.UfsError(
+                f"ufs backup manifest at {self.uri} unreadable ({e}); "
+                "refusing to start-empty-and-overwrite") from e
+        blob = await self._ufs().read_all(
+            f"{self.uri}/{manifest['file']}")
+        payload, crc = blob[:-4], int.from_bytes(blob[-4:], "little")
+        if zlib.crc32(payload) != crc:
+            raise err.AbnormalData(
+                f"ufs backup {manifest['file']}: crc mismatch")
+        env = msgpack.unpackb(payload, raw=False, strict_map_key=False)
+        fs.install_snapshot(env["__snap__"], int(manifest["seq"]),
+                            int(env.get("__last_term__", 0)))
+        self._last_seq = int(manifest["seq"])
+        log.info("ufs backup: restored namespace seq=%d (%d inodes) "
+                 "from %s/%s", manifest["seq"], fs.tree.count(),
+                 self.uri, manifest["file"])
+        return True

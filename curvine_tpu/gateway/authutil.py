@@ -1,0 +1,36 @@
+"""Shared request-auth checks for the protocol gateways.
+
+One implementation of the replay-window and payload-binding rules so
+the S3 (OSS-dialect) and Azure middlewares cannot drift apart."""
+
+from __future__ import annotations
+
+import base64
+import datetime
+import hashlib
+
+MAX_SKEW_S = 15 * 60
+HTTP_DATE = "%a, %d %b %Y %H:%M:%S GMT"
+
+
+def date_fresh(value: str, fmt: str = HTTP_DATE,
+               max_skew_s: int = MAX_SKEW_S) -> bool:
+    """True when the signed date header is within the replay window."""
+    try:
+        sent = datetime.datetime.strptime(value, fmt).replace(
+            tzinfo=datetime.timezone.utc)
+    except (ValueError, TypeError):
+        return False
+    now = datetime.datetime.now(datetime.timezone.utc)
+    return abs((now - sent).total_seconds()) <= max_skew_s
+
+
+def md5_binds_body(body: bytes, content_md5: str) -> bool:
+    """True when the signed Content-MD5 matches the received bytes; an
+    empty body needs no binding, a non-empty one without (or with a
+    wrong) Content-MD5 is refused — nothing else ties the signature to
+    the payload in the date-based auth schemes."""
+    if not body:
+        return True
+    return base64.b64encode(
+        hashlib.md5(body).digest()).decode() == content_md5

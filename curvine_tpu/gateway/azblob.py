@@ -1,0 +1,201 @@
+"""Azure Blob protocol gateway: serve the cache namespace over the Blob
+service REST API.
+
+The Azure-wire sibling of gateway/s3.py — any Azure Blob client (and the
+in-tree azblob:// adapter, which this gateway round-trip tests) can
+read/write cached data. Containers map to top-level dirs, blobs to
+files. Implemented surface: Put Blob (BlockBlob), Get Blob (ranged),
+Get Blob Properties, Delete Blob, List Blobs (prefix + delimiter),
+Create Container.
+
+Auth: SharedKey verification against the configured account/key
+(the exact canonicalization the adapter signs with — forged or unsigned
+requests get 403); account=None is the anonymous opt-in.
+"""
+
+from __future__ import annotations
+
+import logging
+import posixpath
+import urllib.parse
+import xml.sax.saxutils as sax
+
+from aiohttp import web
+
+from curvine_tpu.common import errors as cerr
+from curvine_tpu.ufs.azblob import sharedkey_auth
+
+log = logging.getLogger(__name__)
+
+
+class AzBlobGateway:
+    def __init__(self, client, port: int = 0, host: str = "127.0.0.1",
+                 account: str | None = None, key: str = ""):
+        self.client = client
+        self.host = host
+        self.port = port
+        self.account = account
+        self.key = key
+        middlewares = [self._auth_middleware] if account else []
+        self.app = web.Application(client_max_size=1024 ** 3,
+                                   middlewares=middlewares)
+        self.app.router.add_route("*", "/{container}", self._container)
+        self.app.router.add_route("*", "/{container}/{key:.*}", self._blob)
+        self._runner: web.AppRunner | None = None
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for s in self._runner.sites:
+            self.port = s._server.sockets[0].getsockname()[1]
+        log.info("azblob gateway on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    @web.middleware
+    async def _auth_middleware(self, req: web.Request, handler):
+        import hmac as _hmac
+        from curvine_tpu.gateway.authutil import date_fresh, md5_binds_body
+        auth = req.headers.get("Authorization", "")
+        expect_prefix = f"SharedKey {self.account}:"
+        ok = False
+        if auth.startswith(expect_prefix):
+            headers = {k.lower(): v for k, v in req.headers.items()}
+            headers["content-length"] = str(req.content_length or 0)
+            # replay window on the signed x-ms-date + payload binding
+            # via the signed Content-MD5 (shared rules: authutil)
+            fresh = date_fresh(headers.get("x-ms-date", ""))
+            body_ok = not req.body_exists or md5_binds_body(
+                await req.read(), headers.get("content-md5", ""))
+            url = f"http://host{req.rel_url.raw_path}"
+            if req.rel_url.raw_query_string:
+                url += "?" + req.rel_url.raw_query_string
+            want = sharedkey_auth(req.method, url, self.account, self.key,
+                                  headers)
+            ok = fresh and body_ok and _hmac.compare_digest(want, auth)
+        if not ok:
+            log.info("azblob auth rejected %s %s", req.method,
+                     req.rel_url.raw_path)
+            return web.Response(
+                status=403, content_type="application/xml",
+                text=('<?xml version="1.0"?><Error>'
+                      "<Code>AuthenticationFailed</Code></Error>"))
+        return await handler(req)
+
+    # ---------------- container ops ----------------
+
+    async def _container(self, req: web.Request) -> web.Response:
+        name = req.match_info["container"]
+        if req.method == "PUT" and req.query.get("restype") == "container":
+            await self.client.meta.mkdir(f"/{name}")
+            return web.Response(status=201)
+        if req.method == "GET" and req.query.get("comp") == "list":
+            return await self._list(req, name)
+        return web.Response(status=400)
+
+    async def _list(self, req: web.Request, container: str) -> web.Response:
+        prefix = req.query.get("prefix", "")
+        delimiter = req.query.get("delimiter", "")
+        base = f"/{container}"
+        if not await self.client.meta.exists(base):
+            return web.Response(status=404)
+        blobs: list[tuple[str, int]] = []
+        prefixes: set[str] = set()
+
+        async def walk(path: str) -> None:
+            for st in await self.client.meta.list_status(path):
+                key = st.path[len(base) + 1:]
+                if not key.startswith(prefix) and not prefix.startswith(key):
+                    continue
+                if st.is_dir:
+                    if delimiter == "/" and key.startswith(prefix) \
+                            and "/" not in key[len(prefix):]:
+                        prefixes.add(key + "/")
+                        continue
+                    await walk(st.path)
+                elif key.startswith(prefix):
+                    blobs.append((key, st.len))
+
+        await walk(base)
+        blobs.sort()
+        items = "".join(
+            f"<Blob><Name>{sax.escape(k)}</Name><Properties>"
+            f"<Content-Length>{n}</Content-Length></Properties></Blob>"
+            for k, n in blobs)
+        commons = "".join(
+            f"<BlobPrefix><Name>{sax.escape(p)}</Name></BlobPrefix>"
+            for p in sorted(prefixes))
+        return web.Response(content_type="application/xml", text=(
+            f'<?xml version="1.0"?><EnumerationResults>'
+            f"<Prefix>{sax.escape(prefix)}</Prefix>"
+            f"<Blobs>{items}{commons}</Blobs></EnumerationResults>"))
+
+    # ---------------- blob ops ----------------
+
+    async def _blob(self, req: web.Request) -> web.StreamResponse:
+        container = req.match_info["container"]
+        key = urllib.parse.unquote(req.match_info["key"])
+        path = f"/{container}/{key}"
+        if not posixpath.normpath(path).startswith(f"/{container}/"):
+            return web.Response(status=400)
+        try:
+            if req.method == "PUT":
+                if req.headers.get("x-ms-blob-type", "BlockBlob") \
+                        != "BlockBlob":
+                    return web.Response(status=400)
+                data = await req.read()
+                await self.client.write_all(path, data)
+                return web.Response(status=201)
+            if req.method == "HEAD":
+                st = await self.client.meta.file_status(path)
+                if st.is_dir:
+                    # blob semantics: a "directory" is only a name
+                    # prefix (adapters' stat() relies on 404 → list)
+                    return web.Response(status=404)
+                return web.Response(status=200, headers={
+                    "Content-Length": str(st.len),
+                    "x-ms-blob-type": "BlockBlob"})
+            if req.method == "GET":
+                return await self._get(req, path)
+            if req.method == "DELETE":
+                try:
+                    await self.client.meta.delete(path, recursive=False)
+                except cerr.FileNotFound:
+                    return web.Response(status=404)
+                return web.Response(status=202)
+        except cerr.FileNotFound:
+            return web.Response(status=404)
+        except cerr.CurvineError as e:
+            return web.Response(status=500, text=str(e))
+        return web.Response(status=405)
+
+    async def _get(self, req: web.Request, path: str) -> web.StreamResponse:
+        reader = await self.client.unified_open(path)
+        length = reader.len
+        status, offset, n = 200, 0, length
+        rng = req.headers.get("Range") or req.headers.get("x-ms-range")
+        if rng and rng.startswith("bytes="):
+            lo, _, hi = rng[6:].partition("-")
+            offset = int(lo or 0)
+            end = int(hi) if hi else length - 1
+            n = min(end, length - 1) - offset + 1
+            status = 206
+        resp = web.StreamResponse(status=status, headers={
+            "Content-Length": str(max(0, n)),
+            "x-ms-blob-type": "BlockBlob"})
+        await resp.prepare(req)
+        sent = 0
+        while sent < n:
+            chunk = await reader.pread(offset + sent,
+                                       min(4 * 1024 * 1024, n - sent))
+            if not chunk:
+                break
+            await resp.write(chunk)
+            sent += len(chunk)
+        await resp.write_eof()
+        await reader.close()
+        return resp

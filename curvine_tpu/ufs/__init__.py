@@ -6,6 +6,8 @@ import curvine_tpu.ufs.memory  # noqa: F401  (mem://)
 import curvine_tpu.ufs.s3      # noqa: F401  (s3://, env-gated)
 import curvine_tpu.ufs.hdfs    # noqa: F401  (hdfs:// via WebHDFS REST)
 import curvine_tpu.ufs.gcs     # noqa: F401  (gs://gcs:// via XML interop)
-import curvine_tpu.ufs.stubs   # noqa: F401  (oss/cos/azblob, env-gated)
+import curvine_tpu.ufs.oss     # noqa: F401  (oss:// native OSS signing)
+import curvine_tpu.ufs.azblob  # noqa: F401  (azblob:// SharedKey)
+import curvine_tpu.ufs.stubs   # noqa: F401  (cos, env-gated)
 
 __all__ = ["Ufs", "UfsStatus", "create_ufs", "register_scheme"]

@@ -29,11 +29,8 @@ def _gated(scheme: str, hint: str):
     return GatedUfs
 
 
-register_scheme("oss", _gated(
-    "oss", "set s3.endpoint_url to the OSS S3-compatible endpoint"))
 register_scheme("cos", _gated(
     "cos", "set s3.endpoint_url to the COS S3-compatible endpoint"))
-register_scheme("azblob", _gated(
-    "azblob", "Azure Blob needs an azblob backend (not bundled)"))
-# gcs:// and hdfs:// have real backends now (ufs/gcs.py via the XML
-# interop API, ufs/hdfs.py via WebHDFS REST) — no longer stubbed.
+# gcs://, hdfs://, oss:// and azblob:// have real backends now
+# (ufs/gcs.py XML interop, ufs/hdfs.py WebHDFS REST, ufs/oss.py native
+# OSS signing, ufs/azblob.py SharedKey) — no longer stubbed.

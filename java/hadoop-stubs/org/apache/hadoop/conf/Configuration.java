@@ -1,0 +1,21 @@
+package org.apache.hadoop.conf;
+
+import java.util.HashMap;
+import java.util.Map;
+
+public class Configuration {
+    private final Map<String, String> props = new HashMap<>();
+
+    public String get(String name) { return props.get(name); }
+
+    public String get(String name, String defaultValue) {
+        return props.getOrDefault(name, defaultValue);
+    }
+
+    public int getInt(String name, int defaultValue) {
+        String v = props.get(name);
+        return v == null ? defaultValue : Integer.parseInt(v);
+    }
+
+    public void set(String name, String value) { props.put(name, value); }
+}

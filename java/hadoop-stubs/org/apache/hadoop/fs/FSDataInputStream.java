@@ -1,0 +1,47 @@
+package org.apache.hadoop.fs;
+
+import java.io.DataInputStream;
+import java.io.IOException;
+import java.io.InputStream;
+
+public class FSDataInputStream extends DataInputStream
+        implements Seekable, PositionedReadable {
+
+    public FSDataInputStream(InputStream in) { super(in); }
+
+    public InputStream getWrappedStream() { return in; }
+
+    @Override
+    public void seek(long pos) throws IOException {
+        ((Seekable) in).seek(pos);
+    }
+
+    @Override
+    public long getPos() throws IOException {
+        return ((Seekable) in).getPos();
+    }
+
+    @Override
+    public boolean seekToNewSource(long targetPos) throws IOException {
+        return ((Seekable) in).seekToNewSource(targetPos);
+    }
+
+    @Override
+    public int read(long position, byte[] buffer, int offset, int length)
+            throws IOException {
+        return ((PositionedReadable) in).read(position, buffer, offset,
+                length);
+    }
+
+    @Override
+    public void readFully(long position, byte[] buffer, int offset,
+            int length) throws IOException {
+        ((PositionedReadable) in).readFully(position, buffer, offset,
+                length);
+    }
+
+    @Override
+    public void readFully(long position, byte[] buffer) throws IOException {
+        ((PositionedReadable) in).readFully(position, buffer);
+    }
+}

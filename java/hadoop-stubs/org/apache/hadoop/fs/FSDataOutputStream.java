@@ -1,0 +1,15 @@
+package org.apache.hadoop.fs;
+
+import java.io.DataOutputStream;
+import java.io.IOException;
+import java.io.OutputStream;
+
+public class FSDataOutputStream extends DataOutputStream {
+
+    public FSDataOutputStream(OutputStream out, Object stats)
+            throws IOException {
+        super(out);
+    }
+
+    public long getPos() { return written; }
+}

@@ -1,0 +1,39 @@
+package org.apache.hadoop.fs;
+
+import java.io.IOException;
+import java.io.InputStream;
+
+public abstract class FSInputStream extends InputStream
+        implements Seekable, PositionedReadable {
+
+    @Override
+    public int read(long position, byte[] buffer, int offset, int length)
+            throws IOException {
+        long oldPos = getPos();
+        try {
+            seek(position);
+            return read(buffer, offset, length);
+        } finally {
+            seek(oldPos);
+        }
+    }
+
+    @Override
+    public void readFully(long position, byte[] buffer, int offset,
+            int length) throws IOException {
+        int done = 0;
+        while (done < length) {
+            int n = read(position + done, buffer, offset + done,
+                    length - done);
+            if (n < 0) {
+                throw new IOException("end of stream");
+            }
+            done += n;
+        }
+    }
+
+    @Override
+    public void readFully(long position, byte[] buffer) throws IOException {
+        readFully(position, buffer, 0, buffer.length);
+    }
+}

@@ -1,0 +1,43 @@
+package org.apache.hadoop.fs;
+
+import org.apache.hadoop.fs.permission.FsPermission;
+
+public class FileStatus {
+    private final long length;
+    private final boolean isdir;
+    private final int replication;
+    private final long blocksize;
+    private final long mtime;
+    private final long atime;
+    private final FsPermission permission;
+    private final String owner;
+    private final String group;
+    private final Path path;
+
+    public FileStatus(long length, boolean isdir, int replication,
+            long blocksize, long mtime, long atime, FsPermission permission,
+            String owner, String group, Path path) {
+        this.length = length;
+        this.isdir = isdir;
+        this.replication = replication;
+        this.blocksize = blocksize;
+        this.mtime = mtime;
+        this.atime = atime;
+        this.permission = permission;
+        this.owner = owner;
+        this.group = group;
+        this.path = path;
+    }
+
+    public long getLen() { return length; }
+    public boolean isDirectory() { return isdir; }
+    public boolean isFile() { return !isdir; }
+    public int getReplication() { return replication; }
+    public long getBlockSize() { return blocksize; }
+    public long getModificationTime() { return mtime; }
+    public long getAccessTime() { return atime; }
+    public FsPermission getPermission() { return permission; }
+    public String getOwner() { return owner; }
+    public String getGroup() { return group; }
+    public Path getPath() { return path; }
+}

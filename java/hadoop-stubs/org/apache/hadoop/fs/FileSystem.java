@@ -1,0 +1,70 @@
+package org.apache.hadoop.fs;
+
+import java.io.IOException;
+import java.net.URI;
+
+import org.apache.hadoop.conf.Configuration;
+import org.apache.hadoop.fs.permission.FsPermission;
+import org.apache.hadoop.util.Progressable;
+
+public abstract class FileSystem {
+    private Configuration conf;
+
+    public void initialize(URI name, Configuration conf) throws IOException {
+        this.conf = conf;
+    }
+
+    public Configuration getConf() { return conf; }
+
+    public String getScheme() {
+        throw new UnsupportedOperationException("no scheme");
+    }
+
+    public abstract URI getUri();
+
+    public abstract FSDataInputStream open(Path f, int bufferSize)
+            throws IOException;
+
+    public abstract FSDataOutputStream create(Path f,
+            FsPermission permission, boolean overwrite, int bufferSize,
+            short replication, long blockSize, Progressable progress)
+            throws IOException;
+
+    public abstract FSDataOutputStream append(Path f, int bufferSize,
+            Progressable progress) throws IOException;
+
+    public abstract boolean rename(Path src, Path dst) throws IOException;
+
+    public abstract boolean delete(Path f, boolean recursive)
+            throws IOException;
+
+    public abstract FileStatus[] listStatus(Path f) throws IOException;
+
+    public abstract void setWorkingDirectory(Path new_dir);
+
+    public abstract Path getWorkingDirectory();
+
+    public abstract boolean mkdirs(Path f, FsPermission permission)
+            throws IOException;
+
+    public abstract FileStatus getFileStatus(Path f) throws IOException;
+
+    public boolean exists(Path f) throws IOException {
+        try {
+            getFileStatus(f);
+            return true;
+        } catch (IOException e) {
+            return false;
+        }
+    }
+
+    public boolean mkdirs(Path f) throws IOException {
+        return mkdirs(f, FsPermission.getDefault());
+    }
+
+    public FsStatus getStatus(Path p) throws IOException {
+        return new FsStatus(Long.MAX_VALUE, 0, Long.MAX_VALUE);
+    }
+
+    public void close() throws IOException {}
+}

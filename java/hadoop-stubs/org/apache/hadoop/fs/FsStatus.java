@@ -1,0 +1,17 @@
+package org.apache.hadoop.fs;
+
+public class FsStatus {
+    private final long capacity;
+    private final long used;
+    private final long remaining;
+
+    public FsStatus(long capacity, long used, long remaining) {
+        this.capacity = capacity;
+        this.used = used;
+        this.remaining = remaining;
+    }
+
+    public long getCapacity() { return capacity; }
+    public long getUsed() { return used; }
+    public long getRemaining() { return remaining; }
+}

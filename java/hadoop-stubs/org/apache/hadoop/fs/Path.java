@@ -1,0 +1,28 @@
+package org.apache.hadoop.fs;
+
+import java.net.URI;
+
+public class Path {
+    private final URI uri;
+
+    public Path(String pathString) { this.uri = URI.create(pathString); }
+
+    public Path(URI aUri) { this.uri = aUri; }
+
+    public Path(Path parent, String child) {
+        String base = parent.uri.toString();
+        this.uri = URI.create(
+            base.endsWith("/") ? base + child : base + "/" + child);
+    }
+
+    public URI toUri() { return uri; }
+
+    public String getName() {
+        String p = uri.getPath();
+        int i = p.lastIndexOf('/');
+        return i < 0 ? p : p.substring(i + 1);
+    }
+
+    @Override
+    public String toString() { return uri.toString(); }
+}

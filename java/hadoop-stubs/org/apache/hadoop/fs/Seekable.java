@@ -1,0 +1,9 @@
+package org.apache.hadoop.fs;
+
+import java.io.IOException;
+
+public interface Seekable {
+    void seek(long pos) throws IOException;
+    long getPos() throws IOException;
+    boolean seekToNewSource(long targetPos) throws IOException;
+}

@@ -1,0 +1,13 @@
+package org.apache.hadoop.fs.permission;
+
+public class FsPermission {
+    private final short mode;
+
+    public FsPermission(short mode) { this.mode = mode; }
+
+    public short toShort() { return mode; }
+
+    public static FsPermission getDefault() {
+        return new FsPermission((short) 0755);
+    }
+}

@@ -1,0 +1,73 @@
+package org.apache.hadoop.fs.s3a;
+
+import java.io.IOException;
+import java.net.URI;
+
+import org.apache.hadoop.fs.FSDataInputStream;
+import org.apache.hadoop.fs.FSDataOutputStream;
+import org.apache.hadoop.fs.FileStatus;
+import org.apache.hadoop.fs.FileSystem;
+import org.apache.hadoop.fs.Path;
+import org.apache.hadoop.fs.permission.FsPermission;
+import org.apache.hadoop.util.Progressable;
+
+/** Compile stub of hadoop-aws's S3AFileSystem (public surface only). */
+public class S3AFileSystem extends FileSystem {
+
+    @Override
+    public String getScheme() { return "s3a"; }
+
+    @Override
+    public URI getUri() { return URI.create("s3a:///"); }
+
+    @Override
+    public FSDataInputStream open(Path f, int bufferSize)
+            throws IOException {
+        throw new IOException("stub");
+    }
+
+    @Override
+    public FSDataOutputStream create(Path f, FsPermission permission,
+            boolean overwrite, int bufferSize, short replication,
+            long blockSize, Progressable progress) throws IOException {
+        throw new IOException("stub");
+    }
+
+    @Override
+    public FSDataOutputStream append(Path f, int bufferSize,
+            Progressable progress) throws IOException {
+        throw new IOException("stub");
+    }
+
+    @Override
+    public boolean rename(Path src, Path dst) throws IOException {
+        throw new IOException("stub");
+    }
+
+    @Override
+    public boolean delete(Path f, boolean recursive) throws IOException {
+        throw new IOException("stub");
+    }
+
+    @Override
+    public FileStatus[] listStatus(Path f) throws IOException {
+        throw new IOException("stub");
+    }
+
+    @Override
+    public void setWorkingDirectory(Path new_dir) {}
+
+    @Override
+    public Path getWorkingDirectory() { return new Path("/"); }
+
+    @Override
+    public boolean mkdirs(Path f, FsPermission permission)
+            throws IOException {
+        throw new IOException("stub");
+    }
+
+    @Override
+    public FileStatus getFileStatus(Path f) throws IOException {
+        throw new IOException("stub");
+    }
+}

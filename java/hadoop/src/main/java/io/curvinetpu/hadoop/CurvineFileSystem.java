@@ -1,0 +1,219 @@
+package io.curvinetpu.hadoop;
+
+import java.io.IOException;
+import java.io.OutputStream;
+import java.net.URI;
+import java.util.List;
+
+import org.apache.hadoop.conf.Configuration;
+import org.apache.hadoop.fs.FSDataInputStream;
+import org.apache.hadoop.fs.FSDataOutputStream;
+import org.apache.hadoop.fs.FSInputStream;
+import org.apache.hadoop.fs.FileStatus;
+import org.apache.hadoop.fs.FileSystem;
+import org.apache.hadoop.fs.Path;
+import org.apache.hadoop.fs.permission.FsPermission;
+import org.apache.hadoop.util.Progressable;
+
+import io.curvinetpu.CurvineFileStatus;
+import io.curvinetpu.CurvineInputStream;
+import io.curvinetpu.CurvineTpuFileSystem;
+
+/**
+ * Hadoop-compatible FileSystem over the curvine-tpu native SDK — the
+ * ecosystem entry point for Spark/Flink/Hive (parity:
+ * curvine-libsdk/java .../CurvineFileSystem.java, which extends
+ * org.apache.hadoop.fs.FileSystem for exactly this purpose).
+ *
+ * <p>Registration (core-site.xml):
+ * <pre>
+ *   fs.cv.impl = io.curvinetpu.hadoop.CurvineFileSystem
+ * </pre>
+ * URIs look like {@code cv://master-host:8995/path}; the authority
+ * names the master (conf keys {@code fs.cv.master.host/port} override).
+ *
+ * <p>Compiled against java/hadoop-stubs/ in CI (no Hadoop tree in the
+ * image) and against real hadoop-common wherever it exists — the stub
+ * signatures mirror Hadoop's public API.
+ */
+public class CurvineFileSystem extends FileSystem {
+
+    public static final String SCHEME = "cv";
+
+    private URI uri;
+    private CurvineTpuFileSystem fs;
+    private Path workingDir = new Path("/");
+
+    @Override
+    public String getScheme() {
+        return SCHEME;
+    }
+
+    @Override
+    public void initialize(URI name, Configuration conf) throws IOException {
+        super.initialize(name, conf);
+        String host = conf.get("fs.cv.master.host",
+                name.getHost() == null ? "127.0.0.1" : name.getHost());
+        int port = conf.getInt("fs.cv.master.port",
+                name.getPort() > 0 ? name.getPort() : 8995);
+        String user = conf.get("fs.cv.user", "");
+        this.uri = URI.create(SCHEME + "://" + host + ":" + port);
+        this.fs = CurvineTpuFileSystem.connect(host, port, user);
+    }
+
+    @Override
+    public URI getUri() {
+        return uri;
+    }
+
+    @Override
+    public void setWorkingDirectory(Path newDir) {
+        workingDir = newDir;
+    }
+
+    @Override
+    public Path getWorkingDirectory() {
+        return workingDir;
+    }
+
+    /** cv://host:port/a/b (or relative) → namespace path /a/b. */
+    String toCvPath(Path path) {
+        String p = path.toUri().getPath();
+        if (p == null || p.isEmpty()) {
+            return "/";
+        }
+        if (!p.startsWith("/")) {
+            String base = workingDir.toUri().getPath();
+            p = (base.endsWith("/") ? base : base + "/") + p;
+        }
+        return p;
+    }
+
+    private CurvineTpuFileSystem fs() throws IOException {
+        if (fs == null) {
+            throw new IOException("filesystem not initialized");
+        }
+        return fs;
+    }
+
+    @Override
+    public FSDataInputStream open(Path path, int bufferSize)
+            throws IOException {
+        CurvineInputStream in = fs().open(toCvPath(path));
+        return new FSDataInputStream(new CurvineFsInputStream(in));
+    }
+
+    @Override
+    public FSDataOutputStream create(Path path, FsPermission permission,
+            boolean overwrite, int bufferSize, short replication,
+            long blockSize, Progressable progress) throws IOException {
+        OutputStream out = fs().create(toCvPath(path), overwrite);
+        return new FSDataOutputStream(out, null);
+    }
+
+    @Override
+    public FSDataOutputStream append(Path path, int bufferSize,
+            Progressable progress) throws IOException {
+        throw new IOException(
+                "append is not supported by the cv Hadoop adapter yet; "
+                + "write-once or use the WebHDFS gateway");
+    }
+
+    @Override
+    public boolean rename(Path src, Path dst) throws IOException {
+        try {
+            fs().rename(toCvPath(src), toCvPath(dst));
+            return true;
+        } catch (IOException e) {
+            return false;          // Hadoop contract: false, not throw
+        }
+    }
+
+    @Override
+    public boolean delete(Path path, boolean recursive) throws IOException {
+        try {
+            fs().delete(toCvPath(path), recursive);
+            return true;
+        } catch (IOException e) {
+            return false;
+        }
+    }
+
+    @Override
+    public boolean mkdirs(Path path, FsPermission permission)
+            throws IOException {
+        fs().mkdir(toCvPath(path));
+        return true;
+    }
+
+    @Override
+    public FileStatus getFileStatus(Path path) throws IOException {
+        return toHadoop(fs().getFileStatus(toCvPath(path)), path);
+    }
+
+    @Override
+    public FileStatus[] listStatus(Path path) throws IOException {
+        List<CurvineFileStatus> sts = fs().listStatus(toCvPath(path));
+        FileStatus[] out = new FileStatus[sts.size()];
+        for (int i = 0; i < sts.size(); i++) {
+            CurvineFileStatus st = sts.get(i);
+            out[i] = toHadoop(st, new Path(path, st.name));
+        }
+        return out;
+    }
+
+    FileStatus toHadoop(CurvineFileStatus st, Path path) {
+        return new FileStatus(st.len, st.isDir, st.replicas, st.blockSize,
+                st.mtime, st.atime, new FsPermission((short) st.mode),
+                st.owner, st.group, path);
+    }
+
+    @Override
+    public void close() throws IOException {
+        super.close();
+        if (fs != null) {
+            fs.close();
+            fs = null;
+        }
+    }
+
+    /** Hadoop FSInputStream (seek + positioned read) over the SDK's
+     *  seekable stream. */
+    static final class CurvineFsInputStream extends FSInputStream {
+        private final CurvineInputStream in;
+
+        CurvineFsInputStream(CurvineInputStream in) {
+            this.in = in;
+        }
+
+        @Override
+        public int read() throws IOException {
+            return in.read();
+        }
+
+        @Override
+        public int read(byte[] b, int off, int len) throws IOException {
+            return in.read(b, off, len);
+        }
+
+        @Override
+        public void seek(long pos) throws IOException {
+            in.seek(pos);
+        }
+
+        @Override
+        public long getPos() throws IOException {
+            return in.getPos();
+        }
+
+        @Override
+        public boolean seekToNewSource(long targetPos) throws IOException {
+            return false;      // replica choice lives in the native SDK
+        }
+
+        @Override
+        public void close() throws IOException {
+            in.close();
+        }
+    }
+}

@@ -1,0 +1,89 @@
+package io.curvinetpu.hadoop;
+
+import java.io.IOException;
+import java.net.URI;
+
+import org.apache.hadoop.conf.Configuration;
+import org.apache.hadoop.fs.FSDataInputStream;
+import org.apache.hadoop.fs.Path;
+import org.apache.hadoop.fs.s3a.S3AFileSystem;
+
+/**
+ * Drop-in S3A replacement that routes reads through the curvine-tpu
+ * cache when the object is cached, falling back to real S3 otherwise
+ * (parity: curvine-libsdk/java .../S3aProxyFileSystem.java — 96 LoC
+ * that let existing {@code s3a://} jobs hit the cache with ONE conf
+ * change):
+ *
+ * <pre>
+ *   fs.s3a.impl       = io.curvinetpu.hadoop.S3aProxyFileSystem
+ *   fs.cv.master.host = master-host
+ *   fs.cv.master.port = 8995
+ * </pre>
+ *
+ * Mapping mirrors the in-tree S3 gateway: {@code s3a://bucket/key} ↔
+ * namespace path {@code /bucket/key} (override the prefix per bucket
+ * with {@code fs.cv.s3a.prefix.<bucket> = /mnt/something}). Writes and
+ * everything else stay on the real S3AFileSystem.
+ */
+public class S3aProxyFileSystem extends S3AFileSystem {
+
+    private CurvineFileSystem cache;
+
+    @Override
+    public void initialize(URI name, Configuration conf) throws IOException {
+        super.initialize(name, conf);
+        if (conf.get("fs.cv.master.host") != null) {
+            cache = new CurvineFileSystem();
+            cache.initialize(URI.create(
+                    "cv://" + conf.get("fs.cv.master.host") + ":"
+                    + conf.get("fs.cv.master.port", "8995")), conf);
+        }
+    }
+
+    /** s3a://bucket/key → cached namespace path, or null if unmapped. */
+    Path toCvPath(Path path) {
+        URI u = path.toUri();
+        String bucket = u.getHost();
+        if (bucket == null) {
+            return null;
+        }
+        String prefix = getConf() == null ? null
+                : getConf().get("fs.cv.s3a.prefix." + bucket);
+        if (prefix == null) {
+            prefix = "/" + bucket;
+        }
+        return new Path(prefix + u.getPath());
+    }
+
+    FSDataInputStream openCached(Path path, int bufferSize) {
+        if (cache == null) {
+            return null;
+        }
+        try {
+            Path cv = toCvPath(path);
+            if (cv == null || !cache.exists(cv)) {
+                return null;           // not cached → real S3
+            }
+            return cache.open(cv, bufferSize);
+        } catch (IOException e) {
+            return null;               // cache trouble must never fail s3a
+        }
+    }
+
+    @Override
+    public FSDataInputStream open(Path path, int bufferSize)
+            throws IOException {
+        FSDataInputStream cached = openCached(path, bufferSize);
+        return cached != null ? cached : super.open(path, bufferSize);
+    }
+
+    @Override
+    public void close() throws IOException {
+        super.close();
+        if (cache != null) {
+            cache.close();
+            cache = null;
+        }
+    }
+}

@@ -63,7 +63,16 @@ class FsWriter:
             if self._block is None:
                 await self._next_block()
             room = self.block_size - self._block_written - len(self._buf)
-            if self._buf:
+            if self._sc_file is not None and not self._buf:
+                # short-circuit: crc + pwrite are streaming, so there is
+                # nothing to assemble into chunk_size units — write the
+                # caller's buffer straight through (the FUSE path hands
+                # 1 MB ops; buffering them to 4 MB costs two extra
+                # copies of every byte)
+                take = min(room, len(view))
+                await self._send_chunk(view[:take])
+                view = view[take:]
+            elif self._buf:
                 # top up the partial buffer to one chunk, flush it
                 take = min(room, len(view), self.chunk_size - len(self._buf))
                 self._buf += view[:take]
@@ -110,14 +119,23 @@ class FsWriter:
                 None, zlib.crc32, chunk, self._block_crc)
         else:
             self._block_crc = zlib.crc32(chunk, self._block_crc)
-        if len(self._uploads) == 1:
-            await self._uploads[0].send_chunk(chunk)
-        else:
-            # replica fan-out in parallel, not serially
-            await asyncio.gather(*(up.send_chunk(chunk)
-                                   for up in self._uploads))
-        if crc_task is not None:
-            self._block_crc = await crc_task
+        try:
+            if len(self._uploads) == 1:
+                await self._uploads[0].send_chunk(chunk)
+            else:
+                # replica fan-out in parallel, not serially
+                await asyncio.gather(*(up.send_chunk(chunk)
+                                       for up in self._uploads))
+        finally:
+            # settle the executor crc even when a send FAILS: the caller
+            # (_flush_chunk) releases its memoryview of `chunk` right
+            # after — a still-running crc holding the buffer export
+            # would turn the real (retryable) error into BufferError
+            if crc_task is not None:
+                try:
+                    self._block_crc = await crc_task
+                except Exception:  # noqa: BLE001 — send error wins
+                    pass
         self._block_written += len(chunk)
 
     async def _next_block(self) -> None:
@@ -231,9 +249,16 @@ class FsWriter:
         n = len(self._buf) if n is None else min(n, len(self._buf))
         if n == 0:
             return
-        chunk = bytes(self._buf[:n])
+        # send straight out of the accumulation buffer (consumers crc +
+        # write/send before returning); the del (memmove) afterwards
+        # needs the view released first — bytearray resize refuses while
+        # a buffer export lives
+        chunk = memoryview(self._buf)[:n]
+        try:
+            await self._send_chunk(chunk)
+        finally:
+            chunk.release()
         del self._buf[:n]
-        await self._send_chunk(chunk)
 
     async def _seal_block(self) -> None:
         if self._block is None:

@@ -7,6 +7,7 @@ handshake, so no root is needed."""
 from __future__ import annotations
 
 import array
+import asyncio
 import logging
 import os
 import socket
@@ -54,6 +55,47 @@ def fusermount_umount(mountpoint: str, lazy: bool = True) -> None:
     subprocess.run(cmd + ["--", mountpoint], capture_output=True)
 
 
+def tune_readahead(mountpoint: str, read_ahead_kb: int) -> bool:
+    """Raise the mount's bdi readahead window so sequential reads reach
+    the daemon as max_write-sized requests instead of the kernel-default
+    128 KiB — per-op cost (request copy + dispatch + reply writev +
+    waker) dominates the FUSE read path, and 8x fewer ops is the single
+    biggest seq-read lever (measured: 256 -> 32 READ ops per 32 MiB).
+
+    The device number comes from /proc/self/mountinfo, NOT os.stat(mnt):
+    a stat would issue a FUSE GETATTR back into the daemon — deadlock
+    when called from the serving loop. Best-effort: needs a writable
+    /sys (root/privileged container); False means kernel default stays."""
+    try:
+        with open("/proc/self/mountinfo") as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) > 4 and parts[4] == mountpoint:
+                    path = f"/sys/class/bdi/{parts[2]}/read_ahead_kb"
+                    with open(path, "w") as bdi:
+                        bdi.write(str(read_ahead_kb))
+                    log.info("fuse readahead %s -> %d KiB", mountpoint,
+                             read_ahead_kb)
+                    return True
+    except OSError as e:
+        log.debug("fuse readahead tuning unavailable: %s", e)
+    return False
+
+
+async def tune_readahead_retry(mountpoint: str, read_ahead_kb: int,
+                               attempts: int = 10,
+                               delay_s: float = 0.3) -> bool:
+    """tune_readahead with retries: the bdi sysfs node can appear a
+    beat AFTER fusermount returns. One shared loop for the daemon and
+    bench — what ships is what gets measured."""
+    for _ in range(attempts):
+        if await asyncio.to_thread(tune_readahead, mountpoint,
+                                   read_ahead_kb):
+            return True
+        await asyncio.sleep(delay_s)
+    return False
+
+
 async def mount_and_serve(conf: ClusterConf) -> None:
     """cv fuse: mount the namespace and serve until unmounted."""
     from curvine_tpu.client import CurvineClient
@@ -70,6 +112,11 @@ async def mount_and_serve(conf: ClusterConf) -> None:
                        inplace_max_mb=conf.fuse.inplace_max_mb)
     session = FuseSession(fs, fd, max_write=conf.fuse.max_write)
     log.info("fuse mounted at %s", conf.fuse.mount_point)
+    tune_task = None
+    if conf.fuse.read_ahead_kb > 0:
+        # runs in the background while the session starts serving
+        tune_task = asyncio.ensure_future(tune_readahead_retry(
+            conf.fuse.mount_point, conf.fuse.read_ahead_kb))
     runner = None
     if conf.fuse.metrics_port > 0:
         runner = await serve_metrics(fs, conf.fuse.metrics_port,
@@ -78,6 +125,8 @@ async def mount_and_serve(conf: ClusterConf) -> None:
         await session.run()
     finally:
         session.stop()
+        if tune_task is not None:
+            tune_task.cancel()
         if runner is not None:
             await runner.cleanup()
         fusermount_umount(conf.fuse.mount_point)

@@ -29,6 +29,18 @@ class FuseSession:
         self._reader: threading.Thread | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
         self.ready = asyncio.Event()
+        # request-buffer pool: os.read allocates a fresh bufsize (1 MB+)
+        # bytes object per op — pure allocator churn for 40-byte GETATTRs.
+        # readv into pooled bytearrays instead; a buffer is returned when
+        # its request's dispatch completes.
+        self._pool: list[bytearray] = []
+
+    def _borrow(self) -> bytearray:
+        return self._pool.pop() if self._pool else bytearray(self.bufsize)
+
+    def _give_back(self, buf: bytearray) -> None:
+        if len(self._pool) < 16:
+            self._pool.append(buf)
 
     async def run(self) -> None:
         """Serve until unmount (ENODEV on the channel) or stop().
@@ -46,11 +58,14 @@ class FuseSession:
         def on_readable():
             # drain everything ready: one wakeup can cover many ops
             while True:
+                buf = self._borrow()
                 try:
-                    buf = os.read(self.fd, self.bufsize)
+                    n = os.readv(self.fd, [buf])
                 except BlockingIOError:
+                    self._give_back(buf)
                     return
                 except OSError as e:
+                    self._give_back(buf)
                     if e.errno == 19:           # ENODEV: unmounted
                         log.info("fuse channel closed (unmount)")
                     elif not self._stop.is_set():
@@ -61,10 +76,11 @@ class FuseSession:
                         pass
                     done.set()
                     return
-                if not buf or self.fs.destroyed:
+                if n <= 0 or self.fs.destroyed:
+                    self._give_back(buf)
                     done.set()
                     return
-                t = asyncio.ensure_future(self._dispatch(buf))
+                t = asyncio.ensure_future(self._dispatch(buf, n))
                 pending.add(t)
                 t.add_done_callback(pending.discard)
 
@@ -126,29 +142,40 @@ class FuseSession:
             for t in pending:
                 t.cancel()
 
-    async def _dispatch(self, buf: bytes) -> None:
-        view = memoryview(buf)
-        hdr = abi.InHeader.parse(view)
-        payload = view[abi.IN_HEADER.size:hdr.length]
-        bufs: list | None = None
+    async def _dispatch(self, buf: bytes | bytearray,
+                        n: int | None = None) -> None:
+        pooled = n is not None
+        view = memoryview(buf)[:n] if pooled else memoryview(buf)
         try:
-            result = await self.fs.handle(hdr, payload)
-            if result is None:        # FORGET-class: no reply at all
+            hdr = abi.InHeader.parse(view)
+            payload = view[abi.IN_HEADER.size:hdr.length]
+            bufs: list | None = None
+            try:
+                result = await self.fs.handle(hdr, payload)
+                if result is None:    # FORGET-class: no reply at all
+                    return
+                if isinstance(result, (bytes, bytearray)):
+                    bufs = [abi.pack_reply_header(hdr.unique, len(result)),
+                            result]
+                else:                 # buffer view (numpy): avoid the copy
+                    rview = memoryview(result)
+                    bufs = [abi.pack_reply_header(hdr.unique, rview.nbytes),
+                            rview]
+            except FuseError as e:
+                bufs = [abi.pack_reply(hdr.unique, error=e.errno)]
+            except asyncio.CancelledError:
                 return
-            if isinstance(result, (bytes, bytearray)):
-                bufs = [abi.pack_reply_header(hdr.unique, len(result)), result]
-            else:                     # buffer view (numpy): avoid the copy
-                view = memoryview(result)
-                bufs = [abi.pack_reply_header(hdr.unique, view.nbytes), view]
-        except FuseError as e:
-            bufs = [abi.pack_reply(hdr.unique, error=e.errno)]
-        except asyncio.CancelledError:
-            return
-        try:
-            os.writev(self.fd, bufs)
-        except OSError as e:
-            if e.errno not in (2, 19):        # ENOENT: interrupted request
-                log.warning("fuse reply write failed: %s", e)
+            try:
+                os.writev(self.fd, bufs)
+            except OSError as e:
+                if e.errno not in (2, 19):    # ENOENT: interrupted request
+                    log.warning("fuse reply write failed: %s", e)
+        finally:
+            # pooled bytearrays are REUSED: every handler either copies
+            # what it keeps (audited: pending writes, staged pwrite,
+            # name parses) or finishes consuming before returning
+            if pooled:
+                self._give_back(buf)  # type: ignore[arg-type]
 
     def stop(self) -> None:
         self._stop.set()

@@ -36,7 +36,7 @@ class AnnServer:
     def __init__(self, table, k: int = 10, metric: str = "cosine",
                  nprobe: int = 8, device=None, max_batch: int = 256,
                  max_wait_ms: float = 2.0, use_index: bool = True,
-                 dtype: str = "f32"):
+                 dtype: str = "f32", warm_all: bool = True):
         self.table = table
         self.k = k
         self.metric = metric
@@ -46,6 +46,10 @@ class AnnServer:
         self.max_wait_ms = max_wait_ms
         self.use_index = use_index
         self.dtype = dtype
+        # warm_all=False: only the 1 and max_batch shapes pre-compile —
+        # for bulk-only callers (query_many at a fixed batch) the other
+        # pow2 shapes would be compile time spent on nothing
+        self.warm_all = warm_all
         self._queue: asyncio.Queue = asyncio.Queue()
         self._collector: asyncio.Task | None = None
         self._closed = False
@@ -57,14 +61,16 @@ class AnnServer:
         dev = self.device if self.device is not None else jax.devices()[0]
         self.device = dev
         # _run_batch pads to powers of two — warm EVERY shape it can
-        # emit, or the first 3-query batch eats a JIT trace as latency
+        # emit (warm_all), or the first 3-query batch eats a JIT trace
+        # as latency; bulk-only callers warm just 1 and max_batch
         warm = np.zeros((1, self.table.dim), dtype=np.float32)
         q = 1
         while True:
-            await self.table.knn(np.repeat(warm, q, axis=0), k=self.k,
-                                 metric=self.metric, device=dev,
-                                 use_index=self.use_index,
-                                 nprobe=self.nprobe, dtype=self.dtype)
+            if self.warm_all or q in (1, self.max_batch):
+                await self.table.knn(np.repeat(warm, q, axis=0), k=self.k,
+                                     metric=self.metric, device=dev,
+                                     use_index=self.use_index,
+                                     nprobe=self.nprobe, dtype=self.dtype)
             if q >= self.max_batch:
                 break
             q = min(q * 2, self.max_batch)

@@ -66,6 +66,8 @@ class WebServer:
         r.add_get("/api/workers", self._workers)
         r.add_get("/api/metrics.json", self._metrics_json)
         r.add_get("/api/health", self._health)
+        r.add_get("/api/config", self._config)
+        r.add_get("/api/blocks", self._blocks)
         import os
         static_dir = os.path.join(os.path.dirname(__file__), "static")
         if os.path.isdir(static_dir):
@@ -141,6 +143,59 @@ class WebServer:
         if self.master is None:
             return self._json({"error": "not a master"})
         return self._json(self.master.monitor.health())
+
+    _SECRET_MARKERS = ("secret", "key", "password", "token")
+
+    async def _config(self, req):
+        """Effective cluster conf as nested JSON, secrets redacted
+        (parity: curvine-web/webui/src/views/Config.vue)."""
+        src = self.master or self.worker
+        if src is None or not hasattr(src, "conf"):
+            return self._json({"error": "no conf"})
+        import dataclasses
+
+        def dump(obj):
+            if dataclasses.is_dataclass(obj):
+                out = {}
+                for f in dataclasses.fields(obj):
+                    v = getattr(obj, f.name)
+                    if isinstance(v, str) and v and any(
+                            m in f.name.lower()
+                            for m in self._SECRET_MARKERS):
+                        v = "<redacted>"
+                    out[f.name] = dump(v)
+                return out
+            if isinstance(v := obj, list):
+                return [dump(x) for x in v]
+            return obj
+
+        return self._json(dump(src.conf))
+
+    async def _blocks(self, req):
+        """File → its blocks with lengths, replicas and live locations
+        (parity: curvine-web/webui/src/views/Blocks.vue)."""
+        if self.master is None:
+            return self._json({"error": "not a master"})
+        path = req.query.get("path", "")
+        if not path:
+            return self._json({"error": "path required"})
+        try:
+            fb = self.master.fs.get_block_locations(path)
+            return self._json({
+                "path": path,
+                "len": fb.status.len if fb.status else 0,
+                "blocks": [{
+                    "id": lb.block.id,
+                    "len": lb.block.len,
+                    "offset": lb.offset,
+                    "storage_types": [int(st) for st in lb.storage_types],
+                    "locations": [{
+                        "worker_id": a.worker_id,
+                        "addr": f"{a.hostname}:{a.rpc_port}",
+                    } for a in lb.locs],
+                } for lb in fb.block_locs]})
+        except Exception as e:  # noqa: BLE001 — http boundary
+            return self._json({"error": str(e)})
 
     async def _browse(self, req):
         if self.master is None:

@@ -154,7 +154,7 @@ async function browse(path) {
   const rows = sts.map(s => `<tr>
       <td>${s.is_dir
         ? `<a href="#/browse${encodeURI(s.path)}">${esc(s.name)}/</a>`
-        : esc(s.name)}</td>
+        : `<a href="#/blocks${encodeURI(s.path)}">${esc(s.name)}</a>`}</td>
       <td>${s.is_dir ? "—" : bytesFmt(s.len)}</td>
       <td>${fmtMode(s)}</td>
       <td>${esc(s.owner)}:${esc(s.group)}</td>
@@ -194,8 +194,41 @@ async function jobs() {
     ${rows || `<tr><td colspan="5" class="empty">no jobs</td></tr>`}</table>`;
 }
 
+/* blocks view: file → block map with locations
+   (parity: curvine-web/webui/src/views/Blocks.vue) */
+async function blocksView(path) {
+  const d = await api("/api/blocks?path=" + encodeURIComponent(path));
+  if (d.error) { view.innerHTML = `<div class="empty">${esc(d.error)}</div>`; return; }
+  const rows = d.blocks.map(b => `<tr>
+      <td>${b.id}</td><td>${bytesFmt(b.offset)}</td><td>${bytesFmt(b.len)}</td>
+      <td>${b.storage_types.map(t => TIERS[t] ?? t).join(", ")}</td>
+      <td>${b.locations.map(l => `${l.worker_id} (${esc(l.addr)})`).join("<br>") ||
+          '<span class="empty">no live locations</span>'}</td>
+    </tr>`).join("");
+  const parent = path.replace(/\/[^/]+$/, "") || "/";
+  view.innerHTML = `<h2>Blocks</h2>
+    <div class="crumbs"><a href="#/browse${encodeURI(parent)}">← ${esc(parent)}</a>
+      &nbsp; ${esc(path)} · ${bytesFmt(d.len)}</div>
+    <table><tr><th>block id</th><th>offset</th><th>len</th>
+    <th>tiers</th><th>locations</th></tr>${rows ||
+    `<tr><td colspan="5" class="empty">no blocks</td></tr>`}</table>`;
+}
+
+/* config view: effective cluster conf, secrets redacted
+   (parity: curvine-web/webui/src/views/Config.vue) */
+async function config() {
+  const d = await api("/api/config");
+  const render = (obj, prefix) => Object.entries(obj).flatMap(([k, v]) =>
+    (v !== null && typeof v === "object" && !Array.isArray(v))
+      ? render(v, prefix ? `${prefix}.${k}` : k)
+      : [`<tr><td>${esc(prefix ? `${prefix}.${k}` : k)}</td>
+          <td>${esc(JSON.stringify(v))}</td></tr>`]);
+  view.innerHTML = `<h2>Configuration</h2><table>
+    <tr><th>key</th><th>value</th></tr>${render(d, "").join("")}</table>`;
+}
+
 /* ---------- router ---------- */
-const routes = { overview, workers, mounts, jobs };
+const routes = { overview, workers, mounts, jobs, config };
 async function route() {
   const hash = location.hash || "#/overview";
   const m = hash.match(/^#\/([a-z]+)(\/.*)?$/);
@@ -203,7 +236,10 @@ async function route() {
   document.querySelectorAll("#nav a").forEach(a =>
     a.classList.toggle("active", a.getAttribute("href") === "#/" + name));
   try {
-    if (name === "browse") await browse(m[2] || "/");
+    // hash segments carry encodeURI'd paths: decode before reuse or a
+    // name with spaces double-encodes into the API query
+    if (name === "browse") await browse(decodeURIComponent(m[2] || "/"));
+    else if (name === "blocks") await blocksView(decodeURIComponent(m[2] || "/"));
     else await (routes[name] || overview)();
   } catch (e) {
     view.innerHTML = `<div class="empty">error: ${esc(e)}</div>`;

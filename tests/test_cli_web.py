@@ -183,3 +183,41 @@ async def test_client_sc_counters_reach_master():
         await c.flush_metrics()
         assert mc.master.metrics.as_dict()["client.sc.bytes.read"] == \
             m["client.sc.bytes.read"]
+
+
+async def test_web_config_and_blocks_views():
+    """/api/config (secrets redacted) + /api/blocks (file → block map)
+    — parity: curvine-web/webui/src/views/Config.vue + Blocks.vue."""
+    import aiohttp
+    from curvine_tpu.common.conf import ClusterConf
+    from curvine_tpu.web.server import WebServer
+
+    conf = ClusterConf()
+    conf.gateway.s3_access_key = "AKID"
+    conf.gateway.s3_secret_key = "super-secret"
+    async with MiniCluster(workers=1, conf=conf) as mc:
+        c = mc.client()
+        await c.write_all("/bv/data.bin", b"z" * (5 * 1024 * 1024))
+        web = WebServer(0, master=mc.master, host="127.0.0.1")
+        await web.start()
+        try:
+            base = f"http://127.0.0.1:{web.port}"
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"{base}/api/config") as r:
+                    j = await r.json()
+                    assert j["master"]["rpc_port"] == mc.master.rpc.port
+                    assert j["gateway"]["s3_secret_key"] == "<redacted>"
+                    assert j["gateway"]["s3_access_key"] == "<redacted>"
+                    assert "block_size" in j["client"]
+                async with s.get(f"{base}/api/blocks",
+                                 params={"path": "/bv/data.bin"}) as r:
+                    j = await r.json()
+                    assert j["len"] == 5 * 1024 * 1024
+                    assert len(j["blocks"]) >= 2        # 4 MiB blocks
+                    b0 = j["blocks"][0]
+                    assert b0["locations"] and b0["len"] > 0
+                async with s.get(f"{base}/api/blocks",
+                                 params={"path": "/nope"}) as r:
+                    assert "error" in await r.json()
+        finally:
+            await web.stop()

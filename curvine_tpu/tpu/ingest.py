@@ -8,6 +8,7 @@ batch's host fetch and device transfer overlap the current step's compute.
 
 from __future__ import annotations
 
+import asyncio
 import collections
 from typing import AsyncIterator, Iterator
 
@@ -63,7 +64,15 @@ class DevicePrefetcher:
 
 
 class AsyncDevicePrefetcher:
-    """Async variant for cache-backed sources (CurvineClient readers)."""
+    """Async variant for cache-backed sources (CurvineClient readers).
+
+    A background PRODUCER task keeps `depth` batches in flight on
+    device: the host fetch + host→HBM transfer of batch k+1 overlap the
+    consumer's compute on batch k without the consumer doing anything —
+    jax dispatch is async, so the consumer's step call returns while the
+    producer's next `device_put` streams. (The round-4 version filled
+    its window inside __anext__, i.e. only while the consumer was
+    ASKING — fetches never overlapped a running step.)"""
 
     def __init__(self, host_batches: AsyncIterator[np.ndarray],
                  mesh: Mesh | None, spec: P | None = None, depth: int = 2,
@@ -73,25 +82,63 @@ class AsyncDevicePrefetcher:
         self.spec = spec
         self.depth = max(1, depth)
         self.device = device
-        self._queue: collections.deque[jax.Array] = collections.deque()
-        self._done = False
+        # maxsize bounds device memory: at most depth+1 batches resident
+        # (depth queued, plus the one the blocked producer transferred
+        # before put()) — size depth with that +1 in the HBM budget
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=self.depth)
+        self._producer: asyncio.Task | None = None
+        self._error: BaseException | None = None
+        self._finished = False
 
     def _transfer(self, batch: np.ndarray) -> jax.Array:
         if self.mesh is not None:
             return put_sharded(batch, self.mesh, self.spec)
         return jax.device_put(batch, self.device)
 
+    async def _produce(self) -> None:
+        try:
+            async for batch in self.src:
+                await self._queue.put(self._transfer(batch))
+        except asyncio.CancelledError:
+            # aclose() initiated this — nobody is waiting for a
+            # notification, and putting into a possibly-FULL queue here
+            # would deadlock the cancellation
+            raise
+        except Exception as e:
+            await self._queue.put(e)     # surface at the consumer
+            return
+        await self._queue.put(_DONE)
+
     def __aiter__(self):
         return self
 
     async def __anext__(self) -> jax.Array:
-        while not self._done and len(self._queue) < self.depth:
-            try:
-                batch = await self.src.__anext__()
-            except StopAsyncIteration:
-                self._done = True
-                break
-            self._queue.append(self._transfer(batch))
-        if not self._queue:
+        if self._error is not None:
+            # sticky: restarting the producer on the dead generator
+            # would report a clean StopAsyncIteration and mask the
+            # mid-stream failure as successful exhaustion
+            raise self._error
+        if self._finished:
             raise StopAsyncIteration
-        return self._queue.popleft()
+        if self._producer is None:
+            self._producer = asyncio.ensure_future(self._produce())
+        item = await self._queue.get()
+        if item is _DONE:
+            self._finished = True
+            raise StopAsyncIteration
+        if isinstance(item, BaseException):
+            self._error = item
+            raise item
+        return item
+
+    async def aclose(self) -> None:
+        if self._producer is not None:
+            self._producer.cancel()
+            try:
+                await self._producer
+            except (Exception, asyncio.CancelledError):
+                pass
+            self._producer = None
+
+
+_DONE = object()

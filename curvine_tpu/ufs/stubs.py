@@ -31,6 +31,15 @@ def _gated(scheme: str, hint: str):
 
 register_scheme("cos", _gated(
     "cos", "set s3.endpoint_url to the COS S3-compatible endpoint"))
+# OSS-HDFS (Alibaba JindoFS service): the reference ships a 1,099-LoC
+# native FFI filesystem for its proprietary wire protocol
+# (curvine-ufs/src/oss_hdfs/oss_hdfs_filesystem.rs). Zero-egress here:
+# the scheme registers so mounts type-check, and endpoints exposing the
+# S3-compatible or WebHDFS-compatible surface route through oss:// /
+# hdfs:// today; the native protocol stays env-gated.
+register_scheme("oss-hdfs", _gated(
+    "oss-hdfs", "route via oss:// (S3-compatible) or hdfs:// (WebHDFS) "
+    "endpoints; the native JindoFS wire protocol needs the vendor SDK"))
 # gcs://, hdfs://, oss:// and azblob:// have real backends now
 # (ufs/gcs.py XML interop, ufs/hdfs.py WebHDFS REST, ufs/oss.py native
 # OSS signing, ufs/azblob.py SharedKey) — no longer stubbed.

@@ -504,3 +504,49 @@ def test_multihost_two_process_distributed(tmp_path):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-2000:]}"
         assert f"proc {i} ok" in out
+
+
+async def test_async_prefetcher_background_producer():
+    """The prefetcher's producer task fills the device window WHILE the
+    consumer computes (round-5: the old version only fetched inside
+    __anext__); errors surface at the consumer, cancellation is clean."""
+    import asyncio
+    from curvine_tpu.tpu.ingest import AsyncDevicePrefetcher
+
+    fetched = []
+
+    async def source():
+        for i in range(5):
+            fetched.append(i)
+            yield np.full((2, 2), i, dtype=np.int32)
+
+    pf = AsyncDevicePrefetcher(source(), mesh=None, depth=2)
+    first = await pf.__anext__()
+    assert int(np.asarray(first)[0, 0]) == 0
+    # consumer "computes" — the producer keeps fetching into the window
+    await asyncio.sleep(0.05)
+    assert len(fetched) >= 3          # 1 consumed + up to depth in flight
+    got = [int(np.asarray(b)[0, 0]) async for b in pf]
+    assert got == [1, 2, 3, 4]
+    with pytest.raises(StopAsyncIteration):
+        await pf.__anext__()
+
+    # a failing source surfaces its error at the consumer, not silently
+    async def bad():
+        yield np.zeros((1,), np.int32)
+        raise RuntimeError("shard gone")
+
+    pf2 = AsyncDevicePrefetcher(bad(), mesh=None, depth=2)
+    await pf2.__anext__()
+    with pytest.raises(RuntimeError, match="shard gone"):
+        await pf2.__anext__()
+
+    # aclose cancels an in-flight producer without noise
+    async def slow():
+        yield np.zeros((1,), np.int32)
+        await asyncio.sleep(60)
+        yield np.zeros((1,), np.int32)
+
+    pf3 = AsyncDevicePrefetcher(slow(), mesh=None, depth=2)
+    await pf3.__anext__()
+    await pf3.aclose()

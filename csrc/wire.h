@@ -144,10 +144,17 @@ inline void pack_value(std::string& out, const Value& v) {
       size_t n = v.arr.size();
       if (n < 16) {
         out.push_back(static_cast<char>(0x90 | n));
-      } else {
+      } else if (n <= 0xFFFF) {
         out.push_back('\xdc');
         uint16_t x = htons(static_cast<uint16_t>(n));
         out.append(reinterpret_cast<char*>(&x), 2);
+      } else {
+        // array32: a truncated array16 count would silently corrupt
+        // big payloads (e.g. a compacted segment's sparse index past
+        // 65,535 entries ≈ 4.2M keys — the whole namespace)
+        out.push_back('\xdd');
+        uint32_t x = htonl(static_cast<uint32_t>(n));
+        out.append(reinterpret_cast<char*>(&x), 4);
       }
       for (auto& e : v.arr) pack_value(out, e);
       break;
@@ -156,10 +163,14 @@ inline void pack_value(std::string& out, const Value& v) {
       size_t n = v.map.size();
       if (n < 16) {
         out.push_back(static_cast<char>(0x80 | n));
-      } else {
+      } else if (n <= 0xFFFF) {
         out.push_back('\xde');
         uint16_t x = htons(static_cast<uint16_t>(n));
         out.append(reinterpret_cast<char*>(&x), 2);
+      } else {
+        out.push_back('\xdf');
+        uint32_t x = htonl(static_cast<uint32_t>(n));
+        out.append(reinterpret_cast<char*>(&x), 4);
       }
       for (auto& kv : v.map) {
         pack_str(out, kv.first);

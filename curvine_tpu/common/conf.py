@@ -26,6 +26,10 @@ class MasterConf:
     # metadata store: "kv" (log-structured KV; namespace can exceed RAM,
     # O(journal-tail) restarts) or "mem" (dicts + snapshot replay)
     meta_store: str = "kv"
+    # kv engine: "auto" (native C++ LSM when built — csrc/kv_engine.cc,
+    # the RocksDB role), "native" (require it) or "python"; identical
+    # on-disk format, switchable per restart
+    meta_engine: str = "auto"
     meta_cache_inodes: int = 65_536
     # journal
     journal_dir: str = "data/journal"

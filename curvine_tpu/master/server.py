@@ -39,7 +39,8 @@ class MasterServer:
             from curvine_tpu.master.store import KvMetaStore
             meta_dir = mc.meta_dir or mc.journal_dir.rstrip("/") + "-meta"
             store = KvMetaStore(meta_dir, fsync=mc.journal_fsync,
-                                cache_inodes=mc.meta_cache_inodes)
+                                cache_inodes=mc.meta_cache_inodes,
+                                engine=mc.meta_engine)
         # native metadata read plane: mirror every committed namespace
         # mutation into C++ and serve stat/exists from native threads
         self.fastmeta = None

@@ -201,9 +201,25 @@ class KvMetaStore:
     kind = "kv"
 
     def __init__(self, kv_dir: str, cache_inodes: int = 65_536,
-                 fsync: bool = False, memtable_max_bytes: int = 8 << 20):
-        self.kv = KvStore(kv_dir, fsync=fsync,
-                          memtable_max_bytes=memtable_max_bytes)
+                 fsync: bool = False, memtable_max_bytes: int = 8 << 20,
+                 engine: str = "auto"):
+        # engine: "native" (csrc/kv_engine.cc — the RocksDB role served
+        # by C++ like the reference), "python", or "auto" (native when
+        # the .so loads; SAME on-disk format either way, so the choice
+        # can change between restarts)
+        self.kv = None
+        if engine in ("auto", "native"):
+            from curvine_tpu.common import kvnative
+            if kvnative.available():
+                self.kv = kvnative.NativeKvStore(
+                    kv_dir, fsync=fsync,
+                    memtable_max_bytes=memtable_max_bytes)
+            elif engine == "native":
+                raise RuntimeError("native kv engine requested but "
+                                   "libcurvine_kv.so is unavailable")
+        if self.kv is None:
+            self.kv = KvStore(kv_dir, fsync=fsync,
+                              memtable_max_bytes=memtable_max_bytes)
         self.cache_max = cache_inodes
         self._cache: OrderedDict[int, object] = OrderedDict()
         # (parent_id, name) -> child id | None (negative entries cached:

@@ -211,6 +211,31 @@ async def run_bench(total_mb: int = 256, block_mb: int = 64,
         await asyncio.gather(*(stat_worker(k) for k in range(conc)))
         results["meta_qps"] = total_calls / (time.perf_counter() - t0)
 
+        # ---- metadata WRITE plane: batched file creates through the
+        # RPC + inode-tree + KV-batch path (native C++ LSM engine by
+        # default — conf master.meta_engine). This perf cluster runs
+        # journal=False like every other bench phase, so the figure is
+        # the non-WAL write plane; 4 batches stay in flight so it
+        # measures server throughput, not client round trips.
+        from curvine_tpu.rpc import RpcCode
+        t0 = time.perf_counter()
+        n_create = 20_000
+        bs = 500
+
+        async def create_batch(lo: int):
+            await c.meta.call(RpcCode.CREATE_FILES_BATCH, {"requests": [
+                {"path": f"/bench/crt/f{j:07d}", "overwrite": True,
+                 "block_size": 4 * MB, "replicas": 1,
+                 "client_name": c.meta.client_id}
+                for j in range(lo, lo + bs)]}, mutate=True)
+
+        offs = list(range(0, n_create, bs))
+        for group in range(0, len(offs), 4):
+            await asyncio.gather(*(create_batch(lo)
+                                   for lo in offs[group:group + 4]))
+        results["meta_create_qps"] = n_create / (time.perf_counter() - t0)
+        await c.meta.delete("/bench/crt", recursive=True)
+
         # ---- native metadata read plane (C++ mirror, fast port) ----
         # the C++ load generator pipelines stats at the C++ server so
         # neither side is bounded by Python (this is the path that meets
@@ -629,6 +654,7 @@ def main():
         "link_gibs": round(results["link_gibs"], 3),
         "pipeline_vs_link": round(results.get("pipeline_vs_link", 0), 3),
         "meta_qps": round(results.get("meta_qps", 0), 1),
+        "meta_create_qps": round(results.get("meta_create_qps", 0), 1),
         "meta_qps_native": round(results.get("meta_qps_native", 0), 1),
         "p99_block_fetch_ms": round(results["p99_block_fetch_ms"], 3),
         "p50_block_fetch_ms": round(results["p50_block_fetch_ms"], 3),

@@ -566,18 +566,55 @@ async def _fuse_bench(c) -> dict:
         await asyncio.sleep(0.3)
         session, sess_task = await mount()
 
-        def cold_rand():
+        def rand_job(seed: int, iters: int = 512) -> None:
+            # ONE read-loop shape for both the serial and j4 figures
             import random
-            rng = random.Random(0)
+            rng = random.Random(seed)
             fd2 = os.open(f"{mnt}/fio.bin", os.O_RDONLY)
+            try:
+                for _ in range(iters):
+                    os.pread(fd2, 4096, rng.randrange(0, total - 4096))
+            finally:
+                os.close(fd2)
+
+        def cold_rand():
             iters = 512
             t0 = time.perf_counter()
-            for _ in range(iters):
-                os.pread(fd2, 4096, rng.randrange(0, total - 4096))
-            os.close(fd2)
+            rand_job(0, iters)
             return {"fuse_rand4k_iops": iters / (time.perf_counter() - t0)}
 
         out.update(await asyncio.to_thread(cold_rand))
+
+        def cold_rand_j4():
+            # fio numjobs=4 shape: 4 reader threads against the same
+            # mount — the session dispatches concurrently, so this is
+            # the daemon's rand-read THROUGHPUT (iodepth-1 per job);
+            # plain fuse_rand4k_iops stays the serial-latency figure.
+            # Seeds 101.. so no job replays cold_rand's seed-0 offsets
+            # (those are in the page cache now — KEEP_CACHE hits would
+            # inflate the figure).
+            import threading
+            iters, jobs = 512, 4
+            done: list[int] = []
+
+            def job(seed):
+                rand_job(seed, iters)
+                done.append(1)
+
+            ts = [threading.Thread(target=job, args=(101 + s,))
+                  for s in range(jobs)]
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            dt = time.perf_counter() - t0
+            if len(done) != jobs:       # a job died: no silent inflation
+                raise RuntimeError(
+                    f"rand4k j4: only {len(done)}/{jobs} jobs finished")
+            return {"fuse_rand4k_iops_j4": jobs * iters / dt}
+
+        out.update(await asyncio.to_thread(cold_rand_j4))
 
         sess_task.cancel()
         await asyncio.to_thread(remount_sync)
@@ -672,6 +709,8 @@ def main():
         "fuse_seq_read_gibs": round(results.get("fuse_seq_read_gibs", 0), 3),
         "fuse_seq_write_gibs": round(results.get("fuse_seq_write_gibs", 0), 3),
         "fuse_rand4k_iops": round(results.get("fuse_rand4k_iops", 0), 1),
+        "fuse_rand4k_iops_j4": round(
+            results.get("fuse_rand4k_iops_j4", 0), 1),
         "fuse_warm_read_gibs": round(results.get("fuse_warm_read_gibs", 0), 3),
         "fuse_warm_rand4k_iops": round(
             results.get("fuse_warm_rand4k_iops", 0), 1),

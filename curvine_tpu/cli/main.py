@@ -514,6 +514,23 @@ async def cmd_worker(args):
     await asyncio.Event().wait()
 
 
+async def cmd_health(args):
+    """Machine-readable cluster-health rollup (monitor + watchdog);
+    exit code 0 healthy / 1 degraded / 2 critical-or-unreachable so
+    scripts and liveness probes can gate on it (an unreachable or
+    pre-r5 master is the WORST case, never 'degraded')."""
+    c = await _client(args)
+    try:
+        h = await c.meta.cluster_health()
+    except err.CurvineError as e:
+        print(json.dumps({"status": "unreachable", "error": str(e)}))
+        return 2
+    finally:
+        await c.close()
+    print(json.dumps(h, indent=None if args.compact else 1))
+    return {"healthy": 0, "degraded": 1}.get(h.get("status"), 2)
+
+
 async def cmd_gateway(args):
     """Serve the S3 and WebHDFS protocol gateways over the namespace."""
     from curvine_tpu.client import CurvineClient
@@ -573,6 +590,8 @@ def build_parser() -> argparse.ArgumentParser:
         A("-r", "--recursive", action="store_true"))
     add("blocks", cmd_blocks, A("path"))
     add("report", cmd_report)
+    add("health", cmd_health,
+        A("--compact", action="store_true"))
     add("node", cmd_node,
         A("action", nargs="?", default="list",
           choices=["list", "decommission", "recommission"]),
@@ -610,8 +629,8 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     try:
-        asyncio.run(args.fn(args))
-        return 0
+        rc = asyncio.run(args.fn(args))
+        return rc if isinstance(rc, int) else 0
     except KeyboardInterrupt:
         return 130
     except Exception as e:  # noqa: BLE001 — CLI boundary

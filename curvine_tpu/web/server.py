@@ -165,8 +165,8 @@ class WebServer:
                         v = "<redacted>"
                     out[f.name] = dump(v)
                 return out
-            if isinstance(v := obj, list):
-                return [dump(x) for x in v]
+            if isinstance(obj, list):
+                return [dump(x) for x in obj]
             return obj
 
         return self._json(dump(src.conf))

@@ -105,3 +105,34 @@ async def test_health_flags_lost_worker_and_web_endpoint():
                     assert j["workers"]["lost"] == 1
         finally:
             await web.stop()
+
+
+async def test_cv_health_cli_exit_codes():
+    """`cv health`: JSON rollup + exit code 0/1/2 by status — scripts
+    and k8s probes gate on it."""
+    import io
+    import json as _json
+    from contextlib import redirect_stdout
+    from curvine_tpu.cli import main as cli
+
+    conf = ClusterConf()
+    conf.master.watchdog_stall_ms = 300
+    async with MiniCluster(workers=1, conf=conf) as mc:
+        argv = ["--master", mc.master.addr, "health", "--compact"]
+        args = cli.build_parser().parse_args(argv)
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = await args.fn(args)
+        assert rc == 0
+        h = _json.loads(buf.getvalue())
+        assert h["status"] == "healthy" and h["role"] == "leader"
+
+        # wedge a lock → degraded → exit 1
+        c = mc.client()
+        await c.meta.set_lock("/stuck", kind="exclusive", ttl_ms=600_000)
+        await asyncio.sleep(0.4)
+        mc.master.watchdog.tick()
+        args = cli.build_parser().parse_args(argv)
+        with redirect_stdout(io.StringIO()):
+            rc = await args.fn(args)
+        assert rc == 1

@@ -350,3 +350,36 @@ async def test_s3_gateway_anonymous_optin():
                     assert r.status == 200 and await r.read() == b"open"
         finally:
             await gw.stop()
+
+
+async def test_s3_gateway_unsigned_payload_mode():
+    """AWS streaming clients sign with x-amz-content-sha256:
+    UNSIGNED-PAYLOAD — the signature still covers method/path/headers
+    and must verify; a FORGED signature with UNSIGNED-PAYLOAD still
+    403s."""
+    import datetime
+    import hashlib
+    from curvine_tpu.gateway.s3 import S3Gateway
+    from curvine_tpu.ufs.s3 import sigv4_headers
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.meta.mkdir("/up")
+        gw = S3Gateway(c, port=0, host="127.0.0.1",
+                       credentials={"AK": "SK"})
+        await gw.start()
+        try:
+            url = f"http://127.0.0.1:{gw.port}/up/s.bin"
+            h = sigv4_headers("PUT", url, "us-east-1", "AK", "SK",
+                              payload_hash="UNSIGNED-PAYLOAD")
+            async with aiohttp.ClientSession() as s:
+                async with s.put(url, data=b"streamed!", headers=h) as r:
+                    assert r.status == 200
+            assert await c.read_all("/up/s.bin") == b"streamed!"
+
+            bad = sigv4_headers("PUT", url, "us-east-1", "AK", "WRONG",
+                                payload_hash="UNSIGNED-PAYLOAD")
+            async with aiohttp.ClientSession() as s:
+                async with s.put(url, data=b"x", headers=bad) as r:
+                    assert r.status == 403
+        finally:
+            await gw.stop()

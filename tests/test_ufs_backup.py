@@ -90,3 +90,36 @@ async def test_backup_crc_guard():
         bk = UfsBackup(fresh, "mem://dr/master")
         with pytest.raises(err.AbnormalData):
             await bk.bootstrap_if_empty()
+
+
+async def test_periodic_backup_tick_uploads_on_advance():
+    """The scheduled leader-gated tick uploads when the journal
+    advanced and skips when it hasn't (upload_if_advanced contract)."""
+    import asyncio
+    memufs.reset()
+    conf = _conf()
+    conf.master.ufs_backup_interval_s = 1
+    async with MiniCluster(workers=1, conf=conf) as mc:
+        c = mc.client()
+        await c.meta.mkdir("/tick")
+        await asyncio.sleep(1.4)            # first interval fires
+        from curvine_tpu.ufs.base import create_ufs
+        ufs = create_ufs("mem://dr/master")
+        names = {s.path.rsplit("/", 1)[-1]
+                 for s in await ufs.list("mem://dr/master")}
+        assert "LATEST" in names
+        snaps = {n for n in names if n.startswith("snapshot-")}
+        assert snaps
+
+        # no journal advance → no new snapshot object
+        await asyncio.sleep(1.2)
+        names2 = {s.path.rsplit("/", 1)[-1]
+                  for s in await ufs.list("mem://dr/master")}
+        assert {n for n in names2 if n.startswith("snapshot-")} == snaps
+
+        # advance → next tick uploads a newer one
+        await c.meta.mkdir("/tick2")
+        await asyncio.sleep(1.4)
+        names3 = {s.path.rsplit("/", 1)[-1]
+                  for s in await ufs.list("mem://dr/master")}
+        assert {n for n in names3 if n.startswith("snapshot-")} != snaps

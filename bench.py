@@ -74,6 +74,113 @@ def _is_tunneled() -> bool:
         return False
 
 
+def _fs_type(path: str) -> str:
+    """Filesystem type of the mount holding `path` (best-effort)."""
+    try:
+        best, fstype = "", "?"
+        with open("/proc/mounts") as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) >= 3 and path.startswith(parts[1]) \
+                        and len(parts[1]) > len(best):
+                    best, fstype = parts[1], parts[2]
+        return fstype
+    except OSError:
+        return "?"
+
+
+def _direct_io_dir() -> str:
+    """A writable dir on a REAL filesystem for the direct-IO microbench
+    (tmpfs has no device to bypass the page cache for)."""
+    cands = [os.environ.get("BENCH_DIRECT_DIR", ""), os.getcwd(),
+             "/var/tmp", "/tmp"]
+    for d in cands:
+        if d and os.path.isdir(d) and os.access(d, os.W_OK) \
+                and _fs_type(d) not in ("tmpfs", "ramfs"):
+            return d
+    return next(d for d in cands[1:] if d and os.access(d, os.W_OK))
+
+
+def _direct_io_bench(size_mb: int = 256) -> dict:
+    """Cold sequential read through the O_DIRECT ring engine vs the
+    buffered pread path, on a real (non-tmpfs) filesystem when one is
+    writable. The direct figure bypasses the page cache by construction;
+    the buffered figure gets a best-effort drop_caches first and is
+    marked `cold:false` when that isn't possible (page-cache numbers
+    must never masquerade as device numbers — same honesty rule as the
+    CPU-fallback stamp)."""
+    import shutil
+    import tempfile
+    from curvine_tpu.worker.io_engine import DirectIOEngine
+
+    base = tempfile.mkdtemp(prefix="curvine-directio-",
+                            dir=_direct_io_dir())
+    out = {"direct_io_fs": _fs_type(base)}
+    path = os.path.join(base, "cold.bin")
+    chunk = 4 * MB
+    try:
+        buf = os.urandom(chunk)
+        with open(path, "wb") as f:
+            for _ in range(size_mb * MB // chunk):
+                f.write(buf)
+            f.flush()
+            os.fsync(f.fileno())
+
+        def drop_caches() -> bool:
+            try:
+                with open("/proc/sys/vm/drop_caches", "w") as f:
+                    f.write("1")
+                return True
+            except OSError:
+                return False
+
+        engine = DirectIOEngine(queue_depth=32)
+        try:
+            dropped = drop_caches()
+            seg = engine.segment_bytes
+            total = size_mb * MB
+            t0 = time.perf_counter()
+            # windowed submission at full ring depth — the engine's
+            # point is batched in-flight IO, not serialized preads
+            window: list = []
+            pos = got = 0
+            while pos < total or window:
+                while pos < total and len(window) < engine.queue_depth:
+                    n = min(seg, total - pos)
+                    buf = engine.pool.acquire(n)
+                    window.append((buf, engine.submit(path, pos, n, buf)))
+                    pos += n
+                buf, fut = window.pop(0)
+                got += fut.result()
+                engine.pool.release(buf)
+            out["direct_read_gibs"] = round(
+                got / (1024 ** 3) / (time.perf_counter() - t0), 3)
+            stats = engine.stats()
+            out["direct_io_mode"] = stats["mode"]
+            if stats["fallbacks"]:
+                # the engine ran buffered: stamp WHY, so this artifact
+                # can't be mistaken for a page-cache-bypassing result
+                out["direct_io_fallback"] = "; ".join(
+                    sorted(stats["fallbacks"]))
+        finally:
+            engine.shutdown()
+
+        dropped = drop_caches()
+        out["direct_buffered_cold"] = dropped
+        t0 = time.perf_counter()
+        n = 0
+        with open(path, "rb", buffering=0) as f:
+            while c := f.read(chunk):
+                n += len(c)
+        out["direct_buffered_gibs"] = round(
+            n / (1024 ** 3) / (time.perf_counter() - t0), 3)
+    except OSError as e:
+        out["direct_io_error"] = str(e)
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    return out
+
+
 def _tmpfs_raw_gibs(base: str) -> float:
     """Raw sequential write rate to the cache tier's backing dir (the
     hardware ceiling for the write path on this host)."""
@@ -124,6 +231,12 @@ async def run_bench(total_mb: int = 256, block_mb: int = 64,
         c = mc.client()
         rng = np.random.default_rng(0)
         results["tmpfs_raw_gibs"] = _tmpfs_raw_gibs(base)
+
+        # ---- direct-IO cold read (O_DIRECT ring engine, SSD-tier
+        # data plane) vs buffered — device-speed path, page-cache
+        # bypassed by construction ----
+        results.update(await asyncio.to_thread(
+            _direct_io_bench, int(os.environ.get("BENCH_DIRECT_MB", "256"))))
 
         # ---- write path (short-circuit local write) ----
         payload = rng.integers(0, 255, total_mb * MB, dtype=np.uint8).tobytes()
@@ -665,16 +778,33 @@ def _device_backend_alive(timeout_s: float = 120.0) -> bool:
         return False
 
 
-def main():
+def main(argv: list[str] | None = None):
+    import argparse
+    ap = argparse.ArgumentParser(description="curvine-tpu bench")
+    ap.add_argument("--require-device", action="store_true",
+                    help="exit non-zero if the device backend is "
+                         "unreachable instead of re-running on CPU "
+                         "(CPU artifacts must never masquerade as "
+                         "device results)")
+    args = ap.parse_args(argv)
     total_mb = int(os.environ.get("BENCH_TOTAL_MB", "256"))
     if (os.environ.get("_CURVINE_BENCH_CHILD") != "1"
             and not _device_backend_alive()):
+        reason = ("device backend unreachable (probe subprocess "
+                  "failed or timed out)")
+        if args.require_device or os.environ.get("BENCH_REQUIRE_DEVICE"):
+            print(f"bench: {reason}; --require-device set, refusing the "
+                  "CPU fallback", file=sys.stderr)
+            return 2
         print("bench: device backend unreachable; re-running on CPU",
               file=sys.stderr)
         env = {k: v for k, v in os.environ.items()
                if not k.startswith(("TPU_", "PJRT_", "AXON_", "PALLAS_AXON",
                                     "LIBTPU", "MEGASCALE"))}
         env["_CURVINE_BENCH_CHILD"] = "1"
+        # the artifact must carry WHY it is a CPU run (VERDICT Weak #1:
+        # CPU numbers masquerading as device results)
+        env["_CURVINE_BENCH_FALLBACK_REASON"] = reason
         env["JAX_PLATFORMS"] = "cpu"
         env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
         import subprocess
@@ -698,6 +828,11 @@ def main():
         "read_gibs_host": round(results["read_gibs_host"], 3),
         "write_gibs": round(results["write_gibs"], 3),
         "tmpfs_raw_gibs": round(results["tmpfs_raw_gibs"], 3),
+        "direct_read_gibs": results.get("direct_read_gibs", 0),
+        "direct_buffered_gibs": results.get("direct_buffered_gibs", 0),
+        "direct_buffered_cold": results.get("direct_buffered_cold", False),
+        "direct_io_mode": results.get("direct_io_mode", "off"),
+        "direct_io_fs": results.get("direct_io_fs", "?"),
         "hbm_tier_read_gibs": round(results.get("hbm_tier_read_gibs", 0), 3),
         "ckpt_broadcast_gibs": round(results.get("ckpt_broadcast_gibs", 0), 3),
         "vector_scan_mrows_s": round(results.get("vector_scan_mrows_s", 0), 3),
@@ -727,6 +862,11 @@ def main():
         # co-located chips only — absent (not 0) under tunnel:true, so
         # consumers can tell "omitted by design" from "measured 0"
         out["dram_to_hbm_gibs"] = round(results["dram_to_hbm_gibs"], 3)
+    if "direct_io_fallback" in results:
+        out["direct_io_fallback"] = results["direct_io_fallback"]
+    reason = os.environ.get("_CURVINE_BENCH_FALLBACK_REASON")
+    if reason:
+        out["cpu_fallback_reason"] = reason
     print(json.dumps(out))
 
 

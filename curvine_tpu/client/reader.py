@@ -100,6 +100,10 @@ class FsReader:
         # 2x this); past expiry the cached (path, offset) must be
         # re-probed before the next fd read
         self._local_expiry: dict[int, float] = {}
+        # direct-IO capability advertised by GET_BLOCK_INFO: the serving
+        # tier reads O_DIRECT through a submission ring of this depth —
+        # read_range sizes its slice fan-out to it (0 = not advertised)
+        self.direct_queue_depth = 0
         # short-circuit reads bypass the worker, so heat is reported
         # back: per-block read counts, flushed periodically + on close
         self._sc_reads: dict[int, int] = {}
@@ -175,6 +179,10 @@ class FsReader:
                     rep = await conn.call(RpcCode.GET_BLOCK_INFO,
                                           data=pack({"block_id": bid}))
                     info = rep.header or unpack(rep.data) or {}
+                    if info.get("direct_io"):
+                        self.direct_queue_depth = max(
+                            self.direct_queue_depth,
+                            int(info.get("queue_depth", 0)))
                     p = info.get("path")
                     if p and os.path.exists(p):
                         path = p
@@ -338,6 +346,16 @@ class FsReader:
         out = np.empty(n, dtype=np.uint8)
         if n == 0:
             return out
+        qd = self.direct_queue_depth
+        if qd > 0:
+            if parallel <= 1 and n >= 4 * self.chunk_size:
+                # direct-IO worker: fan out to keep its submission ring
+                # full even when the caller didn't ask for parallelism
+                parallel = min(qd, max(1, n // (4 * self.chunk_size)))
+            else:
+                # never oversubscribe the ring — excess slices would
+                # just queue behind each other at the engine
+                parallel = min(parallel, qd) if parallel > 1 else parallel
         if parallel <= 1 or n < 4 * self.chunk_size:
             got = await self._read_into(offset, out, use_prefetch=True)
             return out[:got]

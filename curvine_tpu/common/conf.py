@@ -8,7 +8,11 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:          # Python < 3.11
+    import tomli as tomllib
 from dataclasses import dataclass, field
 
 MB = 1024 * 1024
@@ -79,6 +83,10 @@ class TierConf:
     # "file": one file per block in hashed subdirs; "bdev": blocks as
     # extents inside ONE preallocated backing file / raw device
     layout: str = "file"
+    # direct-IO submission depth for THIS tier (0 → the worker-wide
+    # direct_io_queue_depth); advertised to clients via GET_BLOCK_INFO
+    # so parallel readers size their slice count to it
+    queue_depth: int = 0
 
 
 @dataclass
@@ -102,6 +110,16 @@ class WorkerConf:
     # hbm tier (bytes reserved on device for cache; 0 disables)
     hbm_capacity: int = 0
     task_parallelism: int = 4
+    # direct-IO data plane for SSD/HDD tiers (worker/io_engine.py —
+    # the SPDK-role page-cache bypass): cold block reads and tier-move
+    # copies go through an O_DIRECT submission/completion ring.
+    # Filesystems rejecting O_DIRECT fall back per-request.
+    direct_io: bool = True
+    direct_io_engine: str = "auto"     # auto|uring|threads|off
+    direct_io_queue_depth: int = 32
+    direct_io_alignment: int = 4096
+    direct_io_threads: int = 2
+    direct_io_segment: int = 1 * MB    # split size for batched reads
 
 
 @dataclass

@@ -13,6 +13,17 @@ import os
 import socket
 import subprocess
 
+# An in-process FUSE daemon makes subprocess's vfork fast path a
+# deadlock machine: vfork suspends the calling thread WITH THE GIL HELD
+# until the child execs, but the child's fd-closing can send a FUSE
+# FLUSH that only a (GIL-needing) Python daemon thread can answer —
+# child never execs, GIL never releases. Plain fork returns immediately
+# and waitpid drops the GIL, so the daemon can serve the child. Any
+# process importing this module may mount FUSE in-process, so the knob
+# is flipped here, once, for the whole process.
+if hasattr(subprocess, "_USE_VFORK"):
+    subprocess._USE_VFORK = False
+
 from curvine_tpu.common.conf import ClusterConf
 
 log = logging.getLogger(__name__)

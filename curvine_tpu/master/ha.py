@@ -121,9 +121,23 @@ class RaftLite:
         self._bg.append(asyncio.ensure_future(self._election_loop()))
 
     async def stop(self) -> None:
-        for t in self._bg:
-            t.cancel()
+        # Demote BEFORE cancelling: asyncio.wait_for swallows a
+        # cancellation that races its inner future completing
+        # (bpo-37658), and a replicate loop whose queue is hot mid-storm
+        # hits that race routinely — the cancel is lost and a ZOMBIE
+        # leader keeps heartbeating, suppressing every election on the
+        # survivors. The role flip ends the `while self.role == LEADER`
+        # loops regardless, and awaiting the tasks proves they exited.
+        self.role = FOLLOWER
+        tasks = list(self._bg)
         self._bg.clear()
+        for t in tasks:
+            t.cancel()
+        for t in tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
         self._fail_waiters(err.NotLeader("shutting down"))
         await self.pool.close()
 

@@ -96,6 +96,7 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = "seq",
     L over `axis_name`; output has the same sharding."""
     spec = P(None, None, axis_name, None)
     fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal)
-    return jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)(q, k, v)
+    from curvine_tpu.tpu.mesh import shard_map_compat
+    return shard_map_compat(
+        fn, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec)(q, k, v)

@@ -68,6 +68,10 @@ class WebServer:
         r.add_get("/api/health", self._health)
         r.add_get("/api/config", self._config)
         r.add_get("/api/blocks", self._blocks)
+        # mutation plane (parity: curvine-web/src/router/load_handler.rs
+        # submit_loading_task): REST load-job submission + cancel
+        r.add_post("/api/load", self._submit_load)
+        r.add_post("/api/jobs/{job_id}/cancel", self._cancel_job)
         import os
         static_dir = os.path.join(os.path.dirname(__file__), "static")
         if os.path.isdir(static_dir):
@@ -224,3 +228,41 @@ class WebServer:
             return self._json(self.master.jobs.status(job_id).to_wire())
         except Exception as e:  # noqa: BLE001
             return self._json({"error": str(e)})
+
+    async def _submit_load(self, req):
+        """POST /api/load {"path": "/mnt/s3/data", "kind"?: "load"|
+        "export", "recursive"?: bool, "replicas"?: int} → {"job_id"}.
+        The REST face of the CLI's `cv load` (same JobManager path)."""
+        if self.master is None:
+            return self._json({"error": "not a master"})
+        try:
+            body = await req.json()
+        except Exception:  # noqa: BLE001 — malformed body is a 400
+            return web.Response(status=400, text=json.dumps(
+                {"error": "invalid JSON body"}),
+                content_type="application/json")
+        path = body.get("path")
+        if not path:
+            return web.Response(status=400, text=json.dumps(
+                {"error": "path required"}),
+                content_type="application/json")
+        try:
+            job = self.master.jobs.submit(
+                body.get("kind", "load"), path,
+                recursive=bool(body.get("recursive", True)),
+                replicas=int(body.get("replicas", 1)))
+            return self._json({"job_id": job.job_id,
+                               "state": int(job.state)})
+        except Exception as e:  # noqa: BLE001 — http boundary
+            return web.Response(status=400, text=json.dumps(
+                {"error": str(e)}), content_type="application/json")
+
+    async def _cancel_job(self, req):
+        if self.master is None:
+            return self._json({"error": "not a master"})
+        try:
+            self.master.jobs.cancel(req.match_info["job_id"])
+            return self._json({"cancelled": True})
+        except Exception as e:  # noqa: BLE001 — http boundary
+            return web.Response(status=404, text=json.dumps(
+                {"error": str(e)}), content_type="application/json")

@@ -9,6 +9,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import time
 import zlib
 
 from curvine_tpu.common import errors as err
@@ -64,6 +65,19 @@ class WorkerServer:
                 _TIER_NAMES.get(t.storage_type, StorageType.MEM),
                 t.dir, t.capacity)
             for t in wc.tiers]
+        # direct-IO data plane: SSD/HDD tiers read O_DIRECT through one
+        # shared submission ring (worker/io_engine.py); MEM tiers stay
+        # on the page cache by design — that IS their storage medium
+        from curvine_tpu.worker.io_engine import create_engine
+        self.io_engine = None
+        if any(t.storage_type >= StorageType.SSD for t in tiers):
+            self.io_engine = create_engine(wc)
+        if self.io_engine is not None:
+            for tier, tc in zip(tiers, wc.tiers):
+                if tier.storage_type >= StorageType.SSD:
+                    tier.io_engine = self.io_engine
+                    tier.io_queue_depth = (getattr(tc, "queue_depth", 0)
+                                           or self.io_engine.queue_depth)
         for tier in tiers:
             if isinstance(tier, BdevTier):
                 # the extent-reuse safety window must cover the slowest
@@ -96,6 +110,9 @@ class WorkerServer:
         self.executor = ScheduledExecutor("worker")
         self._task_sem = asyncio.Semaphore(wc.task_parallelism)
         self._leader_idx = 0
+        # heartbeat failure dedup/backoff state
+        self._hb_fails = 0
+        self._hb_backoff_until = 0.0
         self._register_handlers()
 
     @property
@@ -144,6 +161,9 @@ class WorkerServer:
         await self.rpc.stop()
         await self.master_pool.close()
         await self.peer_pool.close()
+        if self.io_engine is not None:
+            await asyncio.to_thread(self.io_engine.shutdown)
+            self.io_engine = None
 
     # ---------------- master plane ----------------
 
@@ -215,7 +235,14 @@ class WorkerServer:
     async def heartbeat_once(self) -> None:
         """Heartbeat EVERY master: followers serve reads and need live
         worker state + replica locations too (runtime locs never ride the
-        journal). Delete commands from any master are idempotent."""
+        journal). Delete commands from any master are idempotent.
+
+        An unreachable cluster (shutdown ordering, master restart, net
+        partition) must not traceback-spam every tick: one deduped
+        warning, then exponential backoff — the tick returns immediately
+        until the backoff lapses, and recovery logs once."""
+        if time.monotonic() < self._hb_backoff_until:
+            return
         payload = pack({"info": self._info().to_wire(),
                         "metrics": {
             "bytes.read": self.metrics.counters.get("bytes.read", 0),
@@ -240,7 +267,24 @@ class WorkerServer:
         oks = await asyncio.gather(*(beat(a)
                                      for a in self.conf.client.master_addrs))
         if not any(oks):
-            raise err.ConnectError("no master reachable for heartbeat")
+            self._hb_fails += 1
+            base = self.conf.worker.heartbeat_ms / 1000.0
+            delay = min(base * (2 ** min(self._hb_fails, 6)), 60.0)
+            self._hb_backoff_until = time.monotonic() + delay
+            if self._hb_fails == 1:
+                log.warning(
+                    "no master reachable for heartbeat (%s); backing off "
+                    "exponentially up to 60s, further failures logged at "
+                    "debug", ", ".join(self.conf.client.master_addrs))
+            else:
+                log.debug("heartbeat still failing (%d consecutive); "
+                          "next attempt in %.1fs", self._hb_fails, delay)
+            return
+        if self._hb_fails:
+            log.info("master reachable again after %d failed heartbeats",
+                     self._hb_fails)
+        self._hb_fails = 0
+        self._hb_backoff_until = 0.0
         for bid in deletes:
             self.store.delete(bid)
             if self.hbm is not None:
@@ -523,6 +567,36 @@ class WorkerServer:
             want_crc = bool(q.get("verify", False))
 
             base = info.offset              # bdev extents start mid-file
+            engine = info.tier.io_engine
+            if engine is not None:
+                # direct-IO tier: chunks come off the submission ring
+                # O_DIRECT (batched at the engine's queue depth), so a
+                # cold SSD/HDD read never evicts MEM-tier/FUSE pages.
+                # One reusable buffer; send completes before reuse.
+                buf = np.empty(min(chunk_size, max(1, end - offset)),
+                               dtype=np.uint8)
+                crc = 0
+                pos = offset
+                while pos < end:
+                    n = min(chunk_size, end - pos)
+                    view = memoryview(buf[:n])
+                    got = await engine.read_into(info.path, base + pos, view)
+                    if got <= 0:
+                        break
+                    view = view[:got]
+                    if want_crc:
+                        crc = zlib.crc32(view, crc)
+                    pos += got
+                    await conn.send(response_for(
+                        msg, data=view, flags=Flags.RESPONSE | Flags.CHUNK))
+                header = {"len": pos - offset, "direct_io": True}
+                if want_crc:
+                    header["crc32"] = crc
+                await conn.send(response_for(
+                    msg, header=header, flags=Flags.RESPONSE | Flags.EOF))
+                self.metrics.inc("bytes.read", pos - offset)
+                self.metrics.inc("bytes.read.direct", pos - offset)
+                return None
             if not want_crc:
                 # zero-copy: chunk payloads leave via kernel sendfile, data
                 # never enters userspace (TCP checksums the wire; at-rest
@@ -621,6 +695,13 @@ class WorkerServer:
                "storage_type": int(info.tier.storage_type),
                "path": os.path.abspath(info.path),
                "offset": info.offset}
+        if info.tier.io_engine is not None:
+            # capability plumb-through: parallel readers size their
+            # slice fan-out to the tier's submission depth instead of
+            # guessing (client/reader.py read_range)
+            rep["direct_io"] = True
+            rep["queue_depth"] = (info.tier.io_queue_depth
+                                  or info.tier.io_engine.queue_depth)
         if lease_ms:
             # extent grants expire: the client must re-probe before the
             # tier's quarantine can return the freed extent to reuse

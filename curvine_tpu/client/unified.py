@@ -32,6 +32,17 @@ class CurvineClient:
         self.meta = FsClient(self.conf)
         self.pool = ConnectionPool(size=self.conf.client.conn_pool_size,
                                    timeout_ms=self.conf.client.rpc_timeout_ms)
+        # per-worker circuit breakers, SHARED by every reader/writer this
+        # client opens: a wedged worker is learned once, then skipped in
+        # replica choice and excluded from placement until it heals
+        cc = self.conf.client
+        self.health = None
+        if cc.breaker_enabled:
+            from curvine_tpu.client.health import WorkerHealth
+            self.health = WorkerHealth(
+                fail_threshold=cc.breaker_fail_threshold,
+                open_s=cc.breaker_open_ms / 1000.0,
+                decay_s=cc.breaker_decay_ms / 1000.0)
         self._mount_cache: dict[str, object] = {}
         # client-side IO counters: short-circuit reads/writes bypass the
         # worker entirely, so their bytes are invisible to worker metrics
@@ -102,7 +113,7 @@ class CurvineClient:
                         chunk_size=cc.write_chunk_size, storage_type=st,
                         ici_coords=list(self.conf.worker.ici_coords) or None,
                         short_circuit=cc.short_circuit,
-                        counters=self.counters)
+                        counters=self.counters, health=self.health)
 
     async def append(self, path: str) -> FsWriter:
         fb = await self.meta.append_file(path)
@@ -112,7 +123,7 @@ class CurvineClient:
                      chunk_size=cc.write_chunk_size,
                      storage_type=_TIERS.get(cc.storage_type, StorageType.MEM),
                      short_circuit=cc.short_circuit,
-                     counters=self.counters)
+                     counters=self.counters, health=self.health)
         w.pos = fb.status.len
         return w
 
@@ -126,7 +137,9 @@ class CurvineClient:
                         read_ahead=cc.read_ahead_chunks,
                         counters=self.counters,
                         smart_prefetch=cc.enable_smart_prefetch,
-                        seq_threshold=cc.sequential_read_threshold)
+                        seq_threshold=cc.sequential_read_threshold,
+                        health=self.health,
+                        op_deadline_ms=cc.op_deadline_ms)
 
     async def write_all(self, path: str, data: bytes, **kw) -> None:
         async with await self.create(path, overwrite=True, **kw) as w:

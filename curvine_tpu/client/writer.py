@@ -31,10 +31,15 @@ class FsWriter:
                  storage_type: StorageType = StorageType.MEM,
                  ici_coords: list[int] | None = None,
                  short_circuit: bool = True,
-                 counters: dict | None = None):
+                 counters: dict | None = None,
+                 health=None):
         self.fs = fs_client
         self.path = path
         self.pool = pool
+        # shared per-client WorkerHealth scoreboard: open-circuit workers
+        # are excluded from add_block placement retries, and every
+        # upload-open outcome feeds back into it
+        self.health = health
         self.block_size = block_size
         self.chunk_size = chunk_size
         self.storage_type = storage_type
@@ -154,10 +159,17 @@ class FsWriter:
         abandon = None
         deadline = asyncio.get_running_loop().time() + 90.0
         delay = 0.4
+        use_exclude = self.health is not None
         while True:
             try:
+                # placement steers around workers the client just watched
+                # fail: open-circuit worker ids are excluded up front so a
+                # retry isn't handed the same wedged worker back
+                exclude = (sorted(self.health.open_worker_ids())
+                           if use_exclude else None)
                 self._block = await self.fs.add_block(
                     self.path, commit_blocks=commits,
+                    exclude_workers=exclude,
                     ici_coords=self.ici_coords, abandon_block=abandon)
                 commits = []
                 await self._open_block()
@@ -167,6 +179,12 @@ class FsWriter:
                 if self._block is not None:
                     abandon = self._block.block.id
                     self._block = None
+                if exclude and e.code == err.ErrorCode.NO_AVAILABLE_WORKER:
+                    # the breaker exclusions left no candidates: an
+                    # open-circuit worker beats no worker — retry with
+                    # exclusions relaxed instead of hard-failing
+                    use_exclude = False
+                    continue
                 if not e.retryable \
                         or asyncio.get_running_loop().time() >= deadline:
                     raise
@@ -210,12 +228,21 @@ class FsWriter:
             if await self._try_short_circuit(self._block.locs[0]):
                 return
         for loc in self._block.locs:
-            conn = await self.pool.get(
-                f"{loc.ip_addr or loc.hostname}:{loc.rpc_port}")
-            up = await conn.open_upload(RpcCode.WRITE_BLOCK, header={
-                "block_id": self._block.block.id,
-                "storage_type": int(self.storage_type),
-                "len_hint": self.block_size})
+            addr = f"{loc.ip_addr or loc.hostname}:{loc.rpc_port}"
+            try:
+                conn = await self.pool.get(addr)
+                up = await conn.open_upload(RpcCode.WRITE_BLOCK, header={
+                    "block_id": self._block.block.id,
+                    "storage_type": int(self.storage_type),
+                    "len_hint": self.block_size})
+            except err.CurvineError:
+                # feeds the breaker so the add_block retry can exclude
+                # this worker from the next placement
+                if self.health is not None:
+                    self.health.fail(addr, worker_id=loc.worker_id)
+                raise
+            if self.health is not None:
+                self.health.ok(addr)
             self._uploads.append(up)
 
     async def _try_short_circuit(self, loc) -> bool:

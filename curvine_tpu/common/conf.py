@@ -73,6 +73,10 @@ class MasterConf:
     # raft (HA); empty peers → single-node journal mode
     raft_peers: list[str] = field(default_factory=list)
     raft_node_id: int = 1
+    # time budget for one master-dispatched replication pull (submit RPC
+    # + the destination's pull from the source), propagated in the RPC
+    # header so the worker's peer stream is bounded by the same budget
+    replication_pull_budget_ms: int = 20_000
 
 
 @dataclass
@@ -149,6 +153,22 @@ class ClientConf:
     conn_retry_max: int = 3
     conn_retry_base_ms: int = 100
     conn_pool_size: int = 4
+    # end-to-end deadline budget per read operation (rpc/deadline.py):
+    # propagated in RPC headers and decremented across hops; per-hop
+    # timeouts become min(rpc_timeout, remaining/replicas_left) so a
+    # wedged worker costs a fraction of the budget, not a full RPC
+    # timeout, before replica failover. 0 disables (legacy behavior).
+    op_deadline_ms: int = 0
+    # per-worker circuit breakers (client/health.py): after
+    # breaker_fail_threshold consecutive failures/timeouts against one
+    # worker address the breaker opens for breaker_open_ms (replica
+    # choice deprioritizes it; placement retries exclude it), then
+    # half-opens for a single probe. Counts decay after breaker_decay_ms
+    # without failures.
+    breaker_enabled: bool = True
+    breaker_fail_threshold: int = 3
+    breaker_open_ms: int = 5_000
+    breaker_decay_ms: int = 30_000
     # route stat/exists to the master's native fast port when advertised
     fast_meta: bool = True
 

@@ -61,6 +61,22 @@ class FaultInjector:
         for s in servers:
             s.fault_hook = None
 
+    def install_client(self, *pools) -> "FaultInjector":
+        """Client-side twin of install(): hook a ConnectionPool so every
+        OUTGOING request runs the same fault catalogue before it leaves
+        the client. The `target` glob matches the destination address
+        (e.g. "127.0.0.1:9996" or "*:9996"), so one worker can be faulted
+        from the client side without server cooperation — a dropped send
+        looks exactly like a request lost on the wire (the caller times
+        out). Mirrors RpcServer.fault_hook."""
+        for p in pools:
+            p.set_fault_hook(self.hook)
+        return self
+
+    def uninstall_client(self, *pools) -> None:
+        for p in pools:
+            p.set_fault_hook(None)
+
     def add(self, spec: FaultSpec) -> int:
         if spec.kind not in KINDS:
             raise ValueError(f"unknown fault kind {spec.kind!r}")

@@ -782,10 +782,21 @@ class MasterFilesystem:
 
     def worker_heartbeat(self, info_wire: dict) -> dict:
         info = WorkerInfo.from_wire(info_wire)
-        self.workers.heartbeat(info.address, info.storages, info.ici_coords)
+        w = self.workers.heartbeat(info.address, info.storages,
+                                   info.ici_coords)
         wid = info.address.worker_id
         deletes = list(self.pending_deletes.pop(wid, set()))
-        return {"delete_blocks": deletes}
+        cmds = {"delete_blocks": deletes}
+        if w.state in (WorkerState.LIVE, WorkerState.DECOMMISSIONING) \
+                and not self.workers.has_current_report(wid):
+            # no full block report since this worker (re)registered — the
+            # worker just started, returned from LOST, or THIS MASTER
+            # restarted and lost its runtime location map. Ask for a
+            # report now: reads need locations, and waiting out the
+            # periodic report interval leaves every pre-restart block
+            # location-less for up to that long.
+            cmds["report_now"] = True
+        return cmds
 
     def worker_block_report(self, worker_id: int, held: dict,
                             storage_types: dict,

@@ -71,6 +71,7 @@ class RaftLite:
             "raft_hard_state")
         self._load_hard_state()
         rpc.register(RpcCode.RAFT_VOTE, self._h_vote)
+        rpc.register(RpcCode.RAFT_PREVOTE, self._h_prevote)
         rpc.register(RpcCode.RAFT_APPEND, self._h_append)
         rpc.register(RpcCode.RAFT_SNAPSHOT, self._h_snapshot)
 
@@ -157,7 +158,46 @@ class RaftLite:
                 continue
             await self._run_election()
 
+    async def _run_prevote(self) -> bool:
+        """Pre-vote round (raft §9.6): ask peers whether they WOULD grant
+        a vote for term+1, without bumping our term or persisting
+        anything. Peers that heard from a live leader recently refuse, so
+        a partitioned node retrying elections forever keeps its term
+        frozen — when the partition heals it rejoins as a follower
+        instead of deposing the healthy leader with an inflated term."""
+        term = self.term + 1
+
+        async def ask(addr: str) -> bool:
+            try:
+                conn = await self.pool.get(addr)
+                rep = await conn.call(RpcCode.RAFT_PREVOTE, data=pack({
+                    "term": term, "candidate": self.node_id,
+                    "last_seq": self.last_seq(),
+                    "last_term": self.last_term()}), timeout=1.0)
+                body = unpack(rep.data) or {}
+                return bool(body.get("granted"))
+            except Exception:
+                return False
+
+        votes = 1                         # our own
+        tasks = [asyncio.ensure_future(ask(addr))
+                 for addr in self.peers.values()]
+        try:
+            for fut in asyncio.as_completed(tasks):
+                if await fut:
+                    votes += 1
+                if votes >= self.quorum:
+                    return True
+        finally:
+            for t in tasks:
+                t.cancel()
+        return votes >= self.quorum
+
     async def _run_election(self) -> None:
+        if self.peers and not await self._run_prevote():
+            log.debug("node %d: pre-vote failed (term %d stays)",
+                      self.node_id, self.term)
+            return
         self.role = CANDIDATE
         self.term += 1
         self.voted_for = self.node_id
@@ -268,10 +308,12 @@ class RaftLite:
                 fut.set_exception(exc)
         self._commit_waiters = []
 
-    async def wait_committed(self, seq: int | None = None) -> None:
+    async def wait_committed(self, seq: int | None = None,
+                             deadline=None) -> None:
         """Block until ``seq`` (default: the journal head) is replicated
         on a quorum. This is what makes a client ack mean 'durable on a
-        majority' (raft commit rule)."""
+        majority' (raft commit rule). A caller-propagated deadline caps
+        the wait below the configured commit timeout."""
         if not self.peers:
             return
         if self.role != LEADER:
@@ -281,12 +323,15 @@ class RaftLite:
             return
         fut = asyncio.get_event_loop().create_future()
         self._commit_waiters.append((seq, fut))
+        wait_s = self.commit_timeout_s
+        if deadline is not None:
+            wait_s = deadline.cap(wait_s)
         try:
-            await asyncio.wait_for(fut, self.commit_timeout_s)
+            await asyncio.wait_for(fut, wait_s)
         except asyncio.TimeoutError:
             raise err.RpcTimeout(
                 f"seq {seq} not committed on a quorum within "
-                f"{self.commit_timeout_s}s") from None
+                f"{wait_s:.1f}s") from None
 
     # ---------------- replication (leader) ----------------
 
@@ -384,6 +429,23 @@ class RaftLite:
             self.voted_for = candidate
             self._save_hard_state()       # fsync BEFORE the vote leaves
             self._touch()
+        return {}, pack({"granted": granted, "term": self.term})
+
+    async def _h_prevote(self, msg: Message, conn: ServerConn):
+        """Grant iff we would plausibly vote for the candidate in a real
+        election at that term AND we have NOT heard from a live leader
+        within the minimum election timeout. Grants are stateless: no
+        term bump, no voted_for persistence, no timer reset — a pre-vote
+        round can never disturb a healthy cluster."""
+        q = unpack(msg.data) or {}
+        cand_log = (q.get("last_term", 0), q.get("last_seq", 0))
+        now = asyncio.get_event_loop().time()
+        heard_recently = (now - self._last_heard) < \
+            (self.election_timeout[0] / 1000)
+        granted = (self.role != LEADER          # a live leader never grants
+                   and not heard_recently
+                   and q.get("term", 0) > self.term
+                   and cand_log >= (self.last_term(), self.last_seq()))
         return {}, pack({"granted": granted, "term": self.term})
 
     async def _h_append(self, msg: Message, conn: ServerConn):

@@ -15,20 +15,30 @@ from curvine_tpu.common import errors as err
 from curvine_tpu.common.types import WorkerInfo
 from curvine_tpu.rpc import RpcCode
 from curvine_tpu.rpc.client import ConnectionPool
+from curvine_tpu.rpc.deadline import Deadline
 from curvine_tpu.rpc.frame import pack
 
 log = logging.getLogger(__name__)
 
 
 class ReplicationManager:
-    def __init__(self, fs, scan_interval_s: float = 5.0):
+    def __init__(self, fs, scan_interval_s: float = 5.0,
+                 pull_budget_ms: int = 20_000):
         self._leader_gate = None
         self.fs = fs
         self.scan_interval_s = scan_interval_s
+        # end-to-end budget for one dispatched pull (submit RPC + the
+        # destination's stream from the source), propagated in the RPC
+        # header — a dead source fails the job inside this budget, not
+        # after a full client RPC timeout
+        self.pull_budget_ms = pull_budget_ms
         self.pool = ConnectionPool(size=1)
         self.queue: asyncio.Queue[int] = asyncio.Queue()
         self._inflight: set[int] = set()
         self._queued: set[int] = set()
+        # per-block re-enqueue backoff (ms): doubles on each failed /
+        # unplaceable dispatch, resets when the dispatch succeeds
+        self._backoff_ms: dict[int, int] = {}
 
     def enqueue(self, block_ids: list[int]) -> None:
         for bid in block_ids:
@@ -61,11 +71,25 @@ class ReplicationManager:
                     continue    # RPC-fed work (scrub reports, requeues)
                                 # must not dispatch from a follower either
                 try:
-                    await self._replicate(bid)
+                    ok = await self._replicate(bid)
                 except Exception as e:
                     log.warning("replication of block %d failed: %s", bid, e)
+                    ok = False
+                if ok:
+                    self._backoff_ms.pop(bid, None)
+                else:
+                    self._requeue_later(bid)
         finally:
             scan.cancel()
+
+    def _requeue_later(self, bid: int) -> None:
+        """A dispatch that couldn't run (dead source, no target, submit
+        failure) re-enqueues after an exponential per-block backoff
+        instead of hot-looping against a cluster that hasn't changed."""
+        delay = self._backoff_ms.get(bid, 500)
+        self._backoff_ms[bid] = min(delay * 2, 30_000)
+        asyncio.get_event_loop().call_later(
+            delay / 1000, lambda: self.enqueue([bid]))
 
     async def _scan_loop(self) -> None:
         while True:
@@ -140,13 +164,20 @@ class ReplicationManager:
                 else:
                     log.info("worker %d fully drained: DECOMMISSIONED", wid)
 
-    async def _replicate(self, block_id: int) -> None:
+    async def _replicate(self, block_id: int) -> bool:
+        """Dispatch one pull job. Returns True when the block needs no
+        further action from this dispatch (done, satisfied, or deleted);
+        False when the caller should re-enqueue with backoff (no usable
+        source/target right now, or the submit itself failed)."""
         from curvine_tpu.common.types import WorkerState
         meta = self.fs.blocks.get(block_id)
         if meta is None or not meta.locs:
-            return
-        # only LIVE replicas count toward the goal (a draining worker's
-        # copy is leaving); both LIVE and draining copies can be sources
+            return True                  # deleted or no holders to copy
+        # Only LIVE replicas count toward the goal, and only LIVE or
+        # DECOMMISSIONING holders can SERVE a pull: a LOST worker's
+        # address would make the destination burn its whole pull budget
+        # against a dead socket. LIVE sources are preferred — a draining
+        # worker may disappear mid-pull.
         serving = []
         live = 0
         for wid in meta.locs:
@@ -155,17 +186,25 @@ class ReplicationManager:
                 continue
             if w.state == WorkerState.LIVE:
                 live += 1
-                serving.append(w)
+                serving.insert(0, w)
             elif w.state == WorkerState.DECOMMISSIONING:
-                serving.insert(0, w)   # prefer draining the leaver
-        if live >= self.fs.blocks.desired_of(block_id) or not serving:
-            return
+                serving.append(w)      # fallback source only
+        if live >= self.fs.blocks.desired_of(block_id):
+            return True
+        if not serving:
+            # every holder is LOST/retired: nothing can serve the pull
+            # right now — back off and retry (the holder may come back)
+            log.debug("block %d has no servable source (holders lost)",
+                      block_id)
+            return False
         src = serving[0]
         try:
+            # replacement_worker chooses among LIVE workers only: a LOST
+            # or draining destination is never handed a pull job
             dst = self.replacement_worker(block_id, exclude=set())
         except err.CurvineError as e:
             log.debug("no replication target for block %d: %s", block_id, e)
-            return
+            return False
         self._inflight.add(block_id)
         try:
             conn = await self.pool.get(
@@ -174,9 +213,14 @@ class ReplicationManager:
                 "block_id": block_id,
                 "block_len": meta.len,
                 "source": src.address.to_wire(),
-            }))
+            }), deadline=Deadline.after_ms(self.pull_budget_ms))
+        except err.CurvineError as e:
+            log.warning("replication submit for block %d to worker %d "
+                        "failed: %s", block_id, dst.address.worker_id, e)
+            return False
         finally:
             self._inflight.discard(block_id)
+        return True
 
     def on_result(self, block_id: int, worker_id: int, success: bool,
                   message: str) -> None:

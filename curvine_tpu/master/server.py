@@ -63,7 +63,8 @@ class MasterServer:
         self.fs.mounts = self.mounts
         self.metrics = MetricsRegistry("master")
         self.jobs = JobManager(self.fs, self.mounts)
-        self.replication = ReplicationManager(self.fs)
+        self.replication = ReplicationManager(
+            self.fs, pull_budget_ms=mc.replication_pull_budget_ms)
         self.fs.on_worker_lost = self.replication.on_worker_lost
         self.ttl = TtlManager(self.fs, check_ms=mc.ttl_check_ms)
         from curvine_tpu.master.quota import QuotaManager
@@ -312,22 +313,26 @@ class MasterServer:
                         if cached is not None:
                             return {}, cached
                         rep = await call(req)
-                        await self._commit_barrier()
+                        await self._commit_barrier(msg.deadline)
                         data = pack(rep)
                         self.retry_cache.put(key, data)
                         return {}, data
                 rep = await call(req)
                 if mutate:
-                    await self._commit_barrier()
+                    await self._commit_barrier(msg.deadline)
             return {}, pack(rep)
         return handler
 
-    async def _commit_barrier(self) -> None:
+    async def _commit_barrier(self, deadline=None) -> None:
         """Raft commit rule: a mutation is acked to the client only after
         its journal entry is replicated on a quorum (closes the acked-
-        write-loss window of the round-1 design)."""
+        write-loss window of the round-1 design). A caller deadline caps
+        the wait: past it the client is gone, so holding the dispatch
+        slot longer is dead work (the entry still commits in the
+        background — only the ack is abandoned)."""
         if self.raft is not None:
-            await self.raft.wait_committed(self.fs.journal.seq)
+            await self.raft.wait_committed(self.fs.journal.seq,
+                                           deadline=deadline)
 
     # --- fs ---
     def _mkdir(self, q):

@@ -21,6 +21,7 @@ from dataclasses import dataclass
 from typing import Any, AsyncIterator
 
 from curvine_tpu.common.errors import ConnectError, CurvineError, RpcTimeout
+from curvine_tpu.rpc.deadline import DEADLINE_KEY, Deadline
 from curvine_tpu.rpc.frame import (
     FIXED_LEN, LEN_PREFIX, MAX_FRAME, Flags, Message, pack, unpack,
 )
@@ -53,6 +54,11 @@ class Connection:
         self._reader_task: asyncio.Task | None = None
         self._wlock = asyncio.Lock()
         self.closed = False
+        # client-side fault hook mirroring RpcServer.fault_hook: called
+        # with (addr, msg) before each request leaves; may sleep (delay),
+        # raise (error), or return False to swallow the send — the caller
+        # then times out exactly as if the request was lost on the wire.
+        self.fault_hook = None
 
     async def connect(self) -> "Connection":
         host, port = self.addr.rsplit(":", 1)
@@ -160,6 +166,22 @@ class Connection:
                 assert self._loop is not None
                 for b in bufs:
                     await self._loop.sock_sendall(self._sock, b)
+            except asyncio.CancelledError:
+                # cancelled mid-send (teardown of a prefetch/stream
+                # task): a PARTIAL frame may be on the wire, so the
+                # stream is unrecoverable mid-protocol. Poison the
+                # connection NOW — the pool must never hand it to
+                # another request, whose frames would queue behind
+                # garbage the peer can't parse (the peer would sit in
+                # recv forever and the next sender would wedge in an
+                # unbounded sendall once the socket buffer filled).
+                self.closed = True
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                raise
             except (OSError, RuntimeError) as e:
                 self.closed = True
                 raise ConnectError(f"send to {self.addr}: {e}") from e
@@ -175,17 +197,41 @@ class Connection:
 
     # ---------------- request patterns ----------------
 
+    async def _launch(self, msg: Message,
+                      deadline: "Deadline | None") -> None:
+        """Stamp the remaining budget into the header, run the client
+        fault hook, then send. A hook returning False swallows the send:
+        the caller's response wait times out exactly as if the request
+        was lost on the wire."""
+        if deadline is not None:
+            deadline.check(f"rpc {msg.code} to {self.addr}")
+            deadline.stamp(msg.header)
+        if self.fault_hook is not None:
+            if not await self.fault_hook(self.addr, msg):
+                return
+        await self.send(msg)
+
+    def _wait_s(self, timeout: float | None,
+                deadline: "Deadline | None") -> float:
+        """Per-wait timeout: min(conf/explicit timeout, remaining budget).
+        Recomputed per wait so stream reads never outlive the budget."""
+        t = timeout or self.timeout
+        return deadline.cap(t) if deadline is not None else t
+
     async def call(self, code: int, header: dict | None = None,
                    data: bytes | memoryview = b"",
-                   timeout: float | None = None) -> Message:
+                   timeout: float | None = None,
+                   deadline: "Deadline | None" = None) -> Message:
         """Unary request → single response."""
         req_id = next(_req_ids)
         q = self.register(req_id)
         try:
-            await self.send(Message(code=int(code), req_id=req_id,
-                                    header=header or {}, data=data))
+            await self._launch(Message(code=int(code), req_id=req_id,
+                                       header=dict(header or {}), data=data),
+                               deadline)
             try:
-                rep: Message = await asyncio.wait_for(q.get(), timeout or self.timeout)
+                rep: Message = await asyncio.wait_for(
+                    q.get(), self._wait_s(timeout, deadline))
             except asyncio.TimeoutError as e:
                 raise RpcTimeout(f"rpc {code} to {self.addr} timed out") from e
             return rep.check()
@@ -194,16 +240,18 @@ class Connection:
 
     async def call_stream(self, code: int, header: dict | None = None,
                           timeout: float | None = None,
+                          deadline: "Deadline | None" = None,
                           ) -> AsyncIterator[Message]:
         """Unary request → stream of chunk frames ending with EOF."""
         req_id = next(_req_ids)
         q = self.register(req_id)
         try:
-            await self.send(Message(code=int(code), req_id=req_id,
-                                    header=header or {}))
+            await self._launch(Message(code=int(code), req_id=req_id,
+                                       header=dict(header or {})), deadline)
             while True:
                 try:
-                    rep: Message = await asyncio.wait_for(q.get(), timeout or self.timeout)
+                    rep: Message = await asyncio.wait_for(
+                        q.get(), self._wait_s(timeout, deadline))
                 except asyncio.TimeoutError as e:
                     raise RpcTimeout(f"stream rpc {code} to {self.addr} timed out") from e
                 rep.check()
@@ -215,7 +263,8 @@ class Connection:
 
     async def call_readinto(self, code: int, sink: memoryview,
                             header: dict | None = None,
-                            timeout: float | None = None) -> int:
+                            timeout: float | None = None,
+                            deadline: "Deadline | None" = None) -> int:
         """Streaming read whose chunk payloads are scattered straight into
         `sink`; returns bytes filled (the zero-copy remote-read path)."""
         req_id = next(_req_ids)
@@ -223,12 +272,12 @@ class Connection:
         state = _Sink(view=sink)
         self._sinks[req_id] = state
         try:
-            await self.send(Message(code=int(code), req_id=req_id,
-                                    header=header or {}))
+            await self._launch(Message(code=int(code), req_id=req_id,
+                                       header=dict(header or {})), deadline)
             while True:
                 try:
                     rep: Message = await asyncio.wait_for(
-                        q.get(), timeout or self.timeout)
+                        q.get(), self._wait_s(timeout, deadline))
                 except asyncio.TimeoutError as e:
                     raise RpcTimeout(
                         f"readinto rpc {code} to {self.addr} timed out") from e
@@ -271,13 +320,16 @@ class Connection:
             self.conn.unregister(self.req_id)
 
     async def open_upload(self, code: int, header: dict | None = None,
-                          timeout: float | None = None) -> "Connection._UploadStream":
+                          timeout: float | None = None,
+                          deadline: "Deadline | None" = None,
+                          ) -> "Connection._UploadStream":
         """Start a chunked upload: request frame, then CHUNK*, EOF → ack."""
         req_id = next(_req_ids)
         q = self.register(req_id)
-        await self.send(Message(code=int(code), req_id=req_id, header=header or {}))
+        await self._launch(Message(code=int(code), req_id=req_id,
+                                   header=dict(header or {})), deadline)
         return Connection._UploadStream(self, int(code), req_id, q,
-                                        timeout or self.timeout)
+                                        self._wait_s(timeout, deadline))
 
 
 class ConnectionPool:
@@ -289,6 +341,17 @@ class ConnectionPool:
         self._conns: dict[str, list[Connection]] = {}
         self._rr: dict[str, int] = {}
         self._lock = asyncio.Lock()
+        # client-side fault hook, inherited by every dialed Connection
+        # (FaultInjector.install_client); see Connection.fault_hook
+        self.fault_hook = None
+
+    def set_fault_hook(self, hook) -> None:
+        """Install/remove the client fault hook on this pool AND every
+        already-dialed connection (new dials inherit it)."""
+        self.fault_hook = hook
+        for conns in self._conns.values():
+            for c in conns:
+                c.fault_hook = hook
 
     async def get(self, addr: str) -> Connection:
         async with self._lock:
@@ -319,7 +382,9 @@ class ConnectionPool:
         last: Exception | None = None
         for i in range(attempts):
             try:
-                return await Connection(addr, self.timeout_ms).connect()
+                conn = Connection(addr, self.timeout_ms)
+                conn.fault_hook = self.fault_hook
+                return await conn.connect()
             except ConnectError as e:
                 last = e
                 await asyncio.sleep(0.05 * (2 ** i))
@@ -335,7 +400,12 @@ class ConnectionPool:
 
 
 class RetryPolicy:
-    """Exponential backoff with jitter on retryable errors."""
+    """Exponential backoff with jitter on retryable errors.
+
+    With a `deadline`, the policy never sleeps past the budget: if the
+    next backoff would cross the expiry (or the budget is already gone),
+    the last error propagates immediately — the caller's deadline wins
+    over retry persistence."""
 
     def __init__(self, max_retries: int = 3, base_ms: int = 100,
                  max_ms: int = 5_000):
@@ -343,7 +413,8 @@ class RetryPolicy:
         self.base_ms = base_ms
         self.max_ms = max_ms
 
-    async def run(self, fn, *args, **kwargs) -> Any:
+    async def run(self, fn, *args, deadline: Deadline | None = None,
+                  **kwargs) -> Any:
         attempt = 0
         while True:
             try:
@@ -353,6 +424,9 @@ class RetryPolicy:
                     raise
                 delay = min(self.max_ms, self.base_ms * (2 ** attempt))
                 delay = delay * (0.5 + random.random() / 2) / 1000
+                if deadline is not None and \
+                        delay >= deadline.remaining():
+                    raise            # sleeping would outlive the budget
                 log.debug("retry %d after %.3fs: %s", attempt + 1, delay, e)
                 await asyncio.sleep(delay)
                 attempt += 1

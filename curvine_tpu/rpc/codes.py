@@ -88,6 +88,10 @@ class RpcCode(enum.IntEnum):
     RAFT_VOTE = 90
     RAFT_APPEND = 91
     RAFT_SNAPSHOT = 92
+    # pre-vote (raft §9.6 / role_monitor.rs parity): a would-be candidate
+    # probes for electability WITHOUT bumping its term, so a partitioned
+    # node rejoining cannot depose a healthy leader with inflated terms
+    RAFT_PREVOTE = 93
 
     # TPU extensions
     HBM_PIN = 100        # pin a cached block into the HBM tier

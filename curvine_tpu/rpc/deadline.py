@@ -1,0 +1,84 @@
+"""Deadline budgets propagated across RPC hops.
+
+Parity in spirit with gRPC deadline propagation and "The Tail at Scale"
+(Dean & Barroso): every client operation may carry a time budget; the
+remaining budget rides the RPC header (`DEADLINE_KEY`, milliseconds) and
+is decremented across hops (client → master → worker, master → worker
+replication pulls). Per-hop timeouts become ``min(conf_timeout,
+remaining)`` — or ``remaining / hops_left`` when the caller still has
+alternative replicas to try — and servers fast-fail requests whose
+budget is already exhausted instead of doing dead work the caller can no
+longer use."""
+
+from __future__ import annotations
+
+import time
+
+from curvine_tpu.common.errors import RpcTimeout
+
+# header key carrying the REMAINING budget in ms (restamped per hop)
+DEADLINE_KEY = "deadline_ms"
+
+# floor for a capped wait: a sub-millisecond wait_for would time out
+# before the event loop even schedules the recv
+MIN_WAIT_S = 0.001
+
+
+class Deadline:
+    """A monotonic expiry point. Cheap to pass around; hops derive their
+    own sub-budgets from ``remaining()``."""
+
+    __slots__ = ("expiry",)
+
+    def __init__(self, budget_s: float):
+        self.expiry = time.monotonic() + max(0.0, budget_s)
+
+    @classmethod
+    def after_ms(cls, ms: float) -> "Deadline":
+        return cls(ms / 1000.0)
+
+    @classmethod
+    def from_header(cls, header: dict | None) -> "Deadline | None":
+        """Rebuild the budget a peer stamped into a request header.
+        Clock skew is irrelevant: the header carries a *duration*, and the
+        receiver restarts it on its own monotonic clock (wire latency
+        eats silently into the budget, which is the conservative side)."""
+        if not header:
+            return None
+        ms = header.get(DEADLINE_KEY)
+        if ms is None:
+            return None
+        return cls.after_ms(float(ms))
+
+    def remaining(self) -> float:
+        return max(0.0, self.expiry - time.monotonic())
+
+    def remaining_ms(self) -> int:
+        return int(self.remaining() * 1000)
+
+    @property
+    def expired(self) -> bool:
+        return time.monotonic() >= self.expiry
+
+    def cap(self, timeout_s: float | None) -> float:
+        """Effective per-hop timeout: min(conf timeout, remaining)."""
+        r = max(self.remaining(), MIN_WAIT_S)
+        return r if timeout_s is None else min(timeout_s, r)
+
+    def sub(self, hops_left: int) -> "Deadline":
+        """Split the remaining budget evenly over `hops_left` sequential
+        attempts — the failover-aware hop budget: with N replicas left,
+        a wedged first replica can only burn 1/N of what remains, so the
+        caller still reaches a healthy one inside the budget."""
+        return Deadline(self.remaining() / max(1, hops_left))
+
+    def check(self, what: str = "operation") -> None:
+        if self.expired:
+            raise RpcTimeout(f"{what}: deadline budget exhausted")
+
+    def stamp(self, header: dict) -> dict:
+        header[DEADLINE_KEY] = self.remaining_ms()
+        return header
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Deadline(remaining={self.remaining():.3f}s)"

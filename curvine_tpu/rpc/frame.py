@@ -15,6 +15,9 @@ from typing import Any
 import msgpack
 
 from curvine_tpu.common.errors import CurvineError, ErrorCode
+from curvine_tpu.rpc.deadline import DEADLINE_KEY, Deadline  # noqa: F401
+# DEADLINE_KEY: reserved header field carrying the request's remaining
+# time budget in ms (rpc/deadline.py); restamped (decremented) per hop.
 
 VERSION = 1
 # fixed metadata after the u32 frame length:
@@ -43,6 +46,9 @@ class Message:
     flags: int = Flags.REQUEST
     header: dict = field(default_factory=dict)
     data: bytes | bytearray | memoryview = b""
+    # server-side: the parsed deadline budget (set once at dispatch from
+    # the DEADLINE_KEY header field; never serialized)
+    deadline: "Deadline | None" = None
 
     @property
     def is_response(self) -> bool:
@@ -55,6 +61,13 @@ class Message:
     @property
     def is_eof(self) -> bool:
         return bool(self.flags & Flags.EOF)
+
+    def budget(self) -> "Deadline | None":
+        """The caller-propagated deadline budget, restarted on this
+        process's monotonic clock; None when the request carries none.
+        Server dispatch calls this once and caches it on the message
+        (``msg.deadline``) so handlers share one expiry point."""
+        return Deadline.from_header(self.header)
 
     def check(self) -> "Message":
         """Raise the carried remote error, if any."""

@@ -169,8 +169,9 @@ class RpcServer:
                  self.port)
 
     async def stop(self) -> None:
-        if self._accept_task:
-            self._accept_task.cancel()
+        accept = self._accept_task
+        if accept is not None:
+            accept.cancel()
             self._accept_task = None
         if self._lsock is not None:
             self._lsock.close()
@@ -181,8 +182,20 @@ class RpcServer:
                 conn.sock.close()
             except OSError:
                 pass
-        for t in list(self._conn_tasks):
+        tasks = list(self._conn_tasks)
+        for t in tasks:
             t.cancel()
+        # AWAIT the teardown, don't just request it: the caller closes
+        # backing resources (the native KV store, io engines) right after
+        # stop() returns, and a dispatch task resuming past that point
+        # would touch freed state — a real use-after-free segfault under
+        # master-restart storms. Each conn loop awaits its own pending
+        # dispatches out the same way.
+        for t in ([accept] if accept is not None else []) + tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
         self._conns.clear()
 
     @property
@@ -284,7 +297,24 @@ class RpcServer:
                               flags=flags, header=header,
                               data=bytes(view) if data_len else b"")
                 if is_chunk:
-                    await conn.open_stream(req_id).put(msg)
+                    # NEVER block the receive loop on a stream queue: if
+                    # the request frame was dropped (fault injection) or
+                    # its handler died, nothing will ever consume these
+                    # chunks — an `await put` on the full queue would
+                    # wedge this connection (and, through a filling
+                    # socket buffer, the sender) permanently. Shed the
+                    # oldest chunk instead: a legit-but-raced upload
+                    # surfaces the loss at EOF (crc/length mismatch) as
+                    # a clean error the client can retry.
+                    q = conn.open_stream(req_id)
+                    if q.full():
+                        try:
+                            q.get_nowait()
+                        except asyncio.QueueEmpty:
+                            pass
+                        log.debug("%s: shed chunk for unconsumed stream "
+                                  "req_id=%d", self.name, req_id)
+                    q.put_nowait(msg)
                     continue
                 t = asyncio.ensure_future(self._dispatch(msg, conn))
                 pending.add(t)
@@ -294,6 +324,13 @@ class RpcServer:
             self._conns.discard(conn)
             for t in pending:
                 t.cancel()
+            # prove the dispatches exited (see RpcServer.stop): a
+            # handler mid-flight must not outlive the server teardown
+            for t in list(pending):
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
             try:
                 conn.sock.close()
             except OSError:
@@ -305,9 +342,21 @@ class RpcServer:
         if self.watchdog is not None:
             token = self.watchdog.op_enter(_code_name(msg.code))
         try:
+            # deadline propagation: restart the caller's remaining budget
+            # on our clock once; handlers that make downstream calls
+            # (replication pulls, peer streams) read msg.deadline
+            msg.deadline = msg.budget()
+            if msg.deadline is not None:
+                msg.deadline.check(f"{self.name} {_code_name(msg.code)}")
             if self.fault_hook is not None:
                 if not await self.fault_hook(self.name, msg):
                     return          # fault: drop the request silently
+            if msg.deadline is not None:
+                # fast-fail dead work: the budget may have died while the
+                # request sat behind the fault hook / dispatch queue —
+                # the caller already gave up, so doing the work (or
+                # applying the mutation) only burns server time
+                msg.deadline.check(f"{self.name} {_code_name(msg.code)}")
             if handler is None:
                 raise CurvineError(f"no handler for code {msg.code}")
             result = await handler(msg, conn)

@@ -1,3 +1,4 @@
 from curvine_tpu.testing.cluster import MiniCluster
+from curvine_tpu.testing.storm import ChaosStorm, StormReport, run_storm
 
-__all__ = ["MiniCluster"]
+__all__ = ["MiniCluster", "ChaosStorm", "StormReport", "run_storm"]

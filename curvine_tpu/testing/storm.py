@@ -1,0 +1,516 @@
+"""Chaos-storm harness: seeded randomized fault schedules over a
+MiniCluster with concurrent reader/writer workloads, plus post-quiesce
+invariant checks.
+
+Jepsen-in-miniature for the Python port: a seeded ``random.Random``
+drives BOTH the workload (file contents are derived from the seed, so
+every acked file has a recomputable checksum) and the chaos schedule
+(worker kill/restart, master restart, injected delay/drop/error faults
+via curvine_tpu.fault). After the storm quiesces the harness asserts:
+
+* **integrity** — every file whose write was ACKED reads back with its
+  exact checksum (failover/replica churn may delay reads, never corrupt
+  them);
+* **replication convergence** — no block stays under-replicated once the
+  cluster is healthy again (bounded wait);
+* **no task leaks** — the asyncio task set returns to its pre-storm
+  baseline after shutdown (zombie read loops / replicate loops were real
+  bugs this style of test caught);
+* **bounded degraded reads** (optional probe) — with one replica wedged
+  by a drop fault, a deadline-budgeted read completes via failover
+  within budget + slack instead of a full RPC timeout.
+
+Deterministic short storms run in tier-1 (tests/test_storm.py,
+scripts/storm_smoke.sh); longer randomized storms are marked `slow`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import random
+import time
+from dataclasses import dataclass, field
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.fault.runtime import FaultInjector, FaultSpec
+from curvine_tpu.rpc import RpcCode
+from curvine_tpu.testing.cluster import MiniCluster
+
+log = logging.getLogger(__name__)
+
+MB = 1024 * 1024
+
+# workload errors that chaos legitimately causes (counted, not fatal);
+# anything else (KeyError, assertion, ...) is a harness/product bug and
+# propagates
+_EXPECTED = (err.CurvineError, OSError, asyncio.TimeoutError)
+
+
+def _dump_task_stacks(limit: int = 12) -> str:
+    """Human-readable stacks of every live asyncio task — attached to
+    watchdog assertions so a wedged storm names its culprit."""
+    out = []
+    for t in asyncio.all_tasks():
+        if t is asyncio.current_task() or t.done():
+            continue
+        out.append(f"-- {t.get_name()}: {t.get_coro()!r}")
+        for f in t.get_stack(limit=limit):
+            out.append(f"     {f.f_code.co_filename}:{f.f_lineno} "
+                       f"{f.f_code.co_name}")
+    return "\n".join(out) or "(no tasks)"
+
+
+def storm_bytes(seed: int, tag: str, size: int) -> bytes:
+    """Deterministic file content for (seed, tag): recomputable at
+    verification time without storing the data."""
+    out = bytearray()
+    counter = 0
+    while len(out) < size:
+        out += hashlib.sha256(f"{seed}:{tag}:{counter}".encode()).digest()
+        counter += 1
+    return bytes(out[:size])
+
+
+@dataclass
+class StormReport:
+    seed: int
+    events: list[dict] = field(default_factory=list)
+    ops: dict = field(default_factory=dict)          # op -> count
+    acked_files: int = 0
+    integrity_errors: list[str] = field(default_factory=list)
+    replication_converged: bool = True
+    unconverged_blocks: list[int] = field(default_factory=list)
+    leaked_tasks: list[str] = field(default_factory=list)
+    degraded_read_s: float | None = None
+    degraded_read_bound_s: float | None = None
+    elapsed_s: float = 0.0
+
+    @property
+    def integrity_ok(self) -> bool:
+        return not self.integrity_errors
+
+    @property
+    def degraded_read_bounded(self) -> bool:
+        if self.degraded_read_s is None:
+            return True
+        return self.degraded_read_s < self.degraded_read_bound_s
+
+    def assert_invariants(self) -> None:
+        problems = []
+        if self.integrity_errors:
+            problems.append(f"integrity: {self.integrity_errors}")
+        if not self.replication_converged:
+            problems.append(
+                f"replication did not converge: {self.unconverged_blocks}")
+        if self.leaked_tasks:
+            problems.append(f"leaked asyncio tasks: {self.leaked_tasks}")
+        if not self.degraded_read_bounded:
+            problems.append(
+                f"degraded read took {self.degraded_read_s:.2f}s "
+                f">= bound {self.degraded_read_bound_s:.2f}s")
+        assert not problems, (
+            f"storm seed={self.seed} invariants violated: "
+            + "; ".join(problems) + f" (events={self.events})")
+
+
+class ChaosStorm:
+    """One seeded storm run. Construct, then ``await run()``."""
+
+    EVENTS = ("kill_worker", "restart_worker", "restart_master",
+              "fault_delay", "fault_drop", "fault_error", "clear_faults")
+
+    def __init__(self, seed: int, workers: int = 3, replicas: int = 2,
+                 duration_s: float = 2.5, event_interval_s: float = 0.25,
+                 writer_tasks: int = 2, reader_tasks: int = 2,
+                 file_size: int = 96 * 1024, deadline_ms: int = 2_000,
+                 deadline_slack_ms: int = 500,
+                 converge_timeout_s: float = 25.0,
+                 master_restarts: bool = True,
+                 degraded_probe: bool = True,
+                 base_dir: str | None = None,
+                 overall_timeout_s: float | None = None):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.n_workers = workers
+        self.replicas = min(replicas, workers)
+        self.duration_s = duration_s
+        self.event_interval_s = event_interval_s
+        self.writer_tasks = writer_tasks
+        self.reader_tasks = reader_tasks
+        self.file_size = file_size
+        self.deadline_ms = deadline_ms
+        self.deadline_slack_ms = deadline_slack_ms
+        self.converge_timeout_s = converge_timeout_s
+        self.master_restarts = master_restarts
+        self.degraded_probe = degraded_probe
+        self.base_dir = base_dir
+        # self-watchdog: a wedged storm must FAIL with task stacks, not
+        # hang the suite — any unbounded wait the chaos uncovers becomes
+        # a diagnosable assertion instead of a CI timeout
+        self.overall_timeout_s = overall_timeout_s if overall_timeout_s \
+            else duration_s + converge_timeout_s + 60.0
+        self.report = StormReport(seed=seed)
+        self.acked: dict[str, str] = {}       # path -> sha256 hexdigest
+        self._stop = False
+        self._alive: set[int] = set()         # indexes into mc.workers
+        self._minj = FaultInjector()          # master-side faults
+        self._winj: dict[int, FaultInjector] = {}
+
+    def _count(self, op: str, n: int = 1) -> None:
+        self.report.ops[op] = self.report.ops.get(op, 0) + n
+
+    # ---------------- cluster plumbing ----------------
+
+    def _configure(self, mc: MiniCluster) -> None:
+        cc = mc.conf.client
+        # remote paths only: short-circuit reads/writes would bypass the
+        # worker RPC plane the storm is trying to stress
+        cc.short_circuit = False
+        cc.rpc_timeout_ms = 4_000
+        cc.conn_retry_max = 6
+        cc.conn_retry_base_ms = 50
+        cc.op_deadline_ms = self.deadline_ms
+        cc.breaker_fail_threshold = 2
+        cc.breaker_open_ms = 1_000
+        cc.replicas = self.replicas
+        cc.block_size = 1 * MB
+
+    def _tune_master(self, mc: MiniCluster) -> None:
+        mc.master.replication.scan_interval_s = 0.3
+        # the repair queue dispatches serially: one pull wedged by an
+        # injected fault must stall it for a bounded slice, not the
+        # full default pull budget
+        mc.master.replication.pull_budget_ms = 2_000
+
+    def _install_worker(self, idx: int, worker) -> None:
+        inj = self._winj.get(idx)
+        if inj is None:
+            inj = self._winj[idx] = FaultInjector()
+        inj.install(worker.rpc)
+        self._alive.add(idx)
+
+    # ---------------- workloads ----------------
+
+    async def _writer(self, mc: MiniCluster, wid: int) -> None:
+        c = mc.client()
+        k = 0
+        while not self._stop:
+            tag = f"w{wid}/f{k}"
+            path = f"/storm/{tag}"
+            data = storm_bytes(self.seed, tag, self.file_size)
+            try:
+                await c.write_all(path, data, replicas=self.replicas)
+                self.acked[path] = hashlib.sha256(data).hexdigest()
+                self._count("write_ok")
+            except _EXPECTED as e:
+                self._count("write_err")
+                log.debug("storm write %s failed: %s", path, e)
+            k += 1
+            # throttle: the point is concurrent load during faults, not
+            # maximizing file count — an unthrottled writer acks
+            # hundreds of files and turns the post-kill heal into the
+            # long pole of every storm
+            await asyncio.sleep(0.01)
+
+    async def _reader(self, mc: MiniCluster, rid: int) -> None:
+        c = mc.client()
+        rng = random.Random((self.seed << 8) ^ rid)
+        while not self._stop:
+            if not self.acked:
+                await asyncio.sleep(0.05)
+                continue
+            path = rng.choice(sorted(self.acked))
+            want = self.acked[path]
+            try:
+                r = await c.open(path)
+                try:
+                    data = await r.read_all(deadline_ms=self.deadline_ms)
+                finally:
+                    await r.close()
+            except _EXPECTED as e:
+                self._count("read_err")
+                log.debug("storm read %s failed: %s", path, e)
+                await asyncio.sleep(0.01)
+                continue
+            self._count("read_ok")
+            got = hashlib.sha256(data).hexdigest()
+            if got != want:
+                self.report.integrity_errors.append(
+                    f"mid-storm read of {path}: {len(data)}B, "
+                    f"digest {got[:12]} != acked {want[:12]}")
+            await asyncio.sleep(0.005)
+
+    # ---------------- chaos schedule ----------------
+
+    def _pick_event(self, mc: MiniCluster) -> str:
+        weights = {
+            "kill_worker": 3, "restart_worker": 4, "restart_master": 1,
+            "fault_delay": 3, "fault_drop": 3, "fault_error": 2,
+            "clear_faults": 3,
+        }
+        if not self.master_restarts:
+            weights["restart_master"] = 0
+        names = list(weights)
+        return self.rng.choices(names, [weights[n] for n in names])[0]
+
+    def _safe_to_kill(self, mc: MiniCluster) -> bool:
+        """True when every located block keeps >= desired replicas on
+        workers that are REALLY alive right now (self._alive is ground
+        truth; the master's worker states lag kills by the lost
+        timeout). A kill taken under this predicate removes at most one
+        copy of any fully-replicated block — acked data always keeps a
+        live replica."""
+        if self._unhealed_blocks(mc):
+            # a committed block with zero known locations means the
+            # master has (temporarily) lost track of a holder that is
+            # still alive — killing anything now could destroy the last
+            # real copy without the guard seeing it
+            return False
+        alive_ids = {mc.workers[i].worker_id for i in self._alive}
+        blocks = mc.master.fs.blocks
+        for bid, locs in blocks.locs.items():
+            if not locs:
+                continue                     # in-flight: not acked yet
+            want = min(blocks.desired_of(bid), len(alive_ids))
+            if len(set(locs) & alive_ids) < want:
+                return False
+        return True
+
+    async def _apply_event(self, mc: MiniCluster, ev: str) -> None:
+        rng = self.rng
+        rec = {"t": round(time.monotonic(), 3), "event": ev}
+        if ev == "kill_worker":
+            # never kill the last replica of anything: strike only while
+            # every committed block has its full replica count on
+            # CURRENTLY-alive workers (the master's LOST detection lags
+            # a kill by lost_timeout_ms, so its under-replication view
+            # cannot be trusted in that window), and keep at most one
+            # worker down at a time
+            if (len(self._alive) < self.n_workers
+                    or not self._safe_to_kill(mc)):
+                rec["skipped"] = True
+            else:
+                idx = rng.choice(sorted(self._alive))
+                self._alive.discard(idx)
+                self._winj.pop(idx, None)
+                await mc.kill_worker(idx)
+                rec["worker"] = idx
+        elif ev == "restart_worker":
+            if len(self._alive) >= self.n_workers:
+                rec["skipped"] = True
+            else:
+                w = await mc.add_worker()
+                idx = len(mc.workers) - 1
+                self._install_worker(idx, w)
+                rec["worker"] = idx
+        elif ev == "restart_master":
+            await mc.restart_master()
+            self._minj.install(mc.master.rpc)
+            self._tune_master(mc)
+        elif ev in ("fault_delay", "fault_drop", "fault_error"):
+            kind = ev.split("_", 1)[1]
+            spec = FaultSpec(
+                kind=kind,
+                probability=rng.choice([0.3, 0.6, 1.0]),
+                delay_ms=rng.choice([50, 150, 400]),
+                error_code=int(err.ErrorCode.IO),
+                error_msg=f"storm seed={self.seed}",
+                max_hits=rng.randint(3, 25),
+                codes=rng.choice([
+                    [], [int(RpcCode.READ_BLOCK)],
+                    [int(RpcCode.WRITE_BLOCK), int(RpcCode.READ_BLOCK)],
+                ]))
+            if rng.random() < 0.3:
+                self._minj.add(spec)
+                rec["target"] = "master"
+            elif self._alive:
+                idx = rng.choice(sorted(self._alive))
+                self._winj[idx].add(spec)
+                rec["target"] = f"worker{idx}"
+            rec["kind"] = kind
+        elif ev == "clear_faults":
+            self._minj.clear()
+            for inj in self._winj.values():
+                inj.clear()
+        self.report.events.append(rec)
+
+    # ---------------- invariants ----------------
+
+    def _unhealed_blocks(self, mc: MiniCluster) -> list[int]:
+        blocks = mc.master.fs.blocks
+        under = [m.block_id for m in blocks.under_replicated()]
+        # under_replicated() skips blocks with ZERO locations — exactly
+        # the state a committed block is in after its holder was marked
+        # LOST (heartbeats dropped by a fault) until the holder returns
+        # and re-reports. Those must heal too before the storm is over.
+        for bid, locs in blocks.locs.items():
+            meta = blocks.get(bid)
+            if not locs and meta is not None and meta.len > 0:
+                under.append(bid)
+        return under
+
+    async def _await_convergence(self, mc: MiniCluster) -> None:
+        deadline = time.monotonic() + self.converge_timeout_s
+        while time.monotonic() < deadline:
+            under = self._unhealed_blocks(mc)
+            if not under:
+                return
+            await asyncio.sleep(0.2)
+        self.report.replication_converged = False
+        self.report.unconverged_blocks = under[:32]
+
+    async def _verify_integrity(self, mc: MiniCluster) -> None:
+        c = mc.client()
+        for path in sorted(self.acked):
+            want = self.acked[path]
+            try:
+                r = await c.open(path)
+                try:
+                    data = await r.read_all()
+                finally:
+                    await r.close()
+            except _EXPECTED as e:
+                self.report.integrity_errors.append(
+                    f"post-quiesce read of {path} failed: {e!r}")
+                continue
+            got = hashlib.sha256(data).hexdigest()
+            if got != want:
+                self.report.integrity_errors.append(
+                    f"post-quiesce {path}: {len(data)}B, digest "
+                    f"{got[:12]} != acked {want[:12]}")
+        self.report.acked_files = len(self.acked)
+
+    async def _probe_degraded_read(self, mc: MiniCluster) -> None:
+        """With one replica's worker wedged by a drop fault, a deadline-
+        budgeted read must finish via failover within budget + slack —
+        the headline number of the deadline plane (vs a full RPC
+        timeout without it)."""
+        if self.replicas < 2 or len(self._alive) < 2 or not self.acked:
+            return
+        path = sorted(self.acked)[0]
+        c = mc.client()                   # fresh client: cold breakers
+        fb = await c.meta.get_block_locations(path)
+        if not fb.block_locs or len(fb.block_locs[0].locs) < 2:
+            return
+        first = fb.block_locs[0].locs[0]
+        victim = next((i for i in self._alive
+                       if mc.workers[i].rpc.port == first.rpc_port), None)
+        if victim is None:
+            return
+        inj = self._winj[victim]
+        fid = inj.add(FaultSpec(kind="drop",
+                                codes=[int(RpcCode.READ_BLOCK),
+                                       int(RpcCode.GET_BLOCK_INFO)]))
+        try:
+            t0 = time.monotonic()
+            r = await c.open(path)
+            try:
+                data = await r.read_all(deadline_ms=self.deadline_ms)
+            finally:
+                await r.close()
+            self.report.degraded_read_s = time.monotonic() - t0
+            self.report.degraded_read_bound_s = \
+                (self.deadline_ms + self.deadline_slack_ms) / 1000
+            got = hashlib.sha256(data).hexdigest()
+            if got != self.acked[path]:
+                self.report.integrity_errors.append(
+                    f"degraded read of {path}: wrong digest")
+        finally:
+            inj.remove(fid)
+
+    # ---------------- driver ----------------
+
+    async def _drive(self, mc: MiniCluster, workers: list,
+                     t_start: float) -> None:
+        """The storm proper: warm-up, chaos schedule, quiesce, and the
+        post-quiesce invariant sweep (bounded by run()'s watchdog)."""
+        # let the first writes land before the first hammer falls
+        while not self.acked and time.monotonic() - t_start < 5.0:
+            await asyncio.sleep(0.05)
+        t_end = time.monotonic() + self.duration_s
+        while time.monotonic() < t_end:
+            await self._apply_event(mc, self._pick_event(mc))
+            await asyncio.sleep(self.event_interval_s)
+
+        # ---- quiesce ----
+        self._minj.clear()
+        for inj in self._winj.values():
+            inj.clear()
+        while len(self._alive) < self.n_workers:
+            w = await mc.add_worker()
+            self._install_worker(len(mc.workers) - 1, w)
+        for i in self._alive:
+            # dropped heartbeats during the storm put workers into
+            # exponential backoff; the quiesce must not wait it out
+            mc.workers[i]._hb_fails = 0
+            mc.workers[i]._hb_backoff_until = 0.0
+        self._stop = True
+        await asyncio.gather(*workers, return_exceptions=False)
+        del workers[:]
+        await mc.await_workers(self.n_workers, timeout=15.0)
+        await self._await_convergence(mc)
+        await self._verify_integrity(mc)
+        if self.degraded_probe:
+            await self._probe_degraded_read(mc)
+
+    async def run(self) -> StormReport:
+        t_start = time.monotonic()
+        baseline = {t for t in asyncio.all_tasks() if not t.done()}
+        mc = MiniCluster(workers=self.n_workers, base_dir=self.base_dir)
+        self._configure(mc)
+        await mc.start()
+        self._tune_master(mc)
+        self._minj.install(mc.master.rpc)
+        for i, w in enumerate(mc.workers):
+            self._install_worker(i, w)
+
+        workers = [asyncio.ensure_future(self._writer(mc, i))
+                   for i in range(self.writer_tasks)]
+        workers += [asyncio.ensure_future(self._reader(mc, i))
+                    for i in range(self.reader_tasks)]
+        try:
+            try:
+                await asyncio.wait_for(self._drive(mc, workers, t_start),
+                                       self.overall_timeout_s)
+            except asyncio.TimeoutError:
+                raise AssertionError(
+                    f"storm seed={self.seed} WEDGED: exceeded its "
+                    f"{self.overall_timeout_s:.0f}s overall budget "
+                    f"(events={self.report.events}); task stacks:\n"
+                    + _dump_task_stacks()) from None
+        finally:
+            self._stop = True
+            for t in workers:
+                t.cancel()
+            self._minj.uninstall(mc.master.rpc)
+            for idx, inj in self._winj.items():
+                if idx < len(mc.workers):
+                    inj.uninstall(mc.workers[idx].rpc)
+            try:
+                await asyncio.wait_for(mc.stop(), 30.0)
+            except asyncio.TimeoutError:
+                raise AssertionError(
+                    f"storm seed={self.seed}: cluster stop WEDGED; "
+                    "task stacks:\n" + _dump_task_stacks()) from None
+
+        # ---- task-leak sweep: everything the storm started must be
+        # gone once the cluster is stopped (zombie replicate/read loops
+        # were real bugs this catches) ----
+        for _ in range(10):
+            leaked = [t for t in asyncio.all_tasks()
+                      if not t.done() and t not in baseline
+                      and t is not asyncio.current_task()]
+            if not leaked:
+                break
+            await asyncio.sleep(0.05)
+        self.report.leaked_tasks = [repr(t) for t in leaked]
+        self.report.elapsed_s = time.monotonic() - t_start
+        return self.report
+
+
+async def run_storm(seed: int, **kw) -> StormReport:
+    """One-call entry point: run a seeded storm and return its report
+    (call ``report.assert_invariants()`` to gate on it)."""
+    return await ChaosStorm(seed, **kw).run()

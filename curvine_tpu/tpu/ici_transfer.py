@@ -64,7 +64,7 @@ def ring_shift(sharded: jax.Array, mesh: Mesh, axis: str | None = None,
     (replicas spread to adjacent chips at link speed, no host hop, no
     full all-gather). Numerics: shard k of the result equals shard
     (k-steps) % N of the input."""
-    from jax.experimental.shard_map import shard_map
+    from curvine_tpu.tpu.mesh import shard_map_compat
 
     axis = axis or mesh.axis_names[0]
     key = (mesh, axis, steps, sharded.ndim)
@@ -78,7 +78,7 @@ def ring_shift(sharded: jax.Array, mesh: Mesh, axis: str | None = None,
             return jax.lax.ppermute(x, axis, perm)
 
         fn = _SHIFT_FNS[key] = jax.jit(
-            shard_map(shift, mesh=mesh, in_specs=spec, out_specs=spec))
+            shard_map_compat(shift, mesh, spec, spec))
     return fn(sharded)
 
 
@@ -108,7 +108,7 @@ def verify_scattered(sharded: jax.Array, mesh: Mesh,
     uint32 wrap-around is deliberate (x64 is disabled under jit on TPU
     and a truncated int64 would wrap SILENTLY; mod-2^32 is the defined
     checksum). Returns [N] uint32 sums, one per shard."""
-    from jax.experimental.shard_map import shard_map
+    from curvine_tpu.tpu.mesh import shard_map_compat
 
     axis = axis or mesh.axis_names[0]
     key = (mesh, axis, sharded.ndim)
@@ -121,6 +121,5 @@ def verify_scattered(sharded: jax.Array, mesh: Mesh,
             return jnp.sum(x.astype(jnp.uint32)).reshape(1)
 
         fn = _SUM_FNS[key] = jax.jit(
-            shard_map(shard_sum, mesh=mesh, in_specs=spec,
-                      out_specs=P(axis)))
+            shard_map_compat(shard_sum, mesh, spec, P(axis)))
     return np.asarray(fn(sharded)).astype(np.uint32)

@@ -113,6 +113,8 @@ class WorkerServer:
         # heartbeat failure dedup/backoff state
         self._hb_fails = 0
         self._hb_backoff_until = 0.0
+        # rate limit for master-requested full block reports (report_now)
+        self._forced_report_at = 0.0
         self._register_handlers()
 
     @property
@@ -249,14 +251,19 @@ class WorkerServer:
             "bytes.written": self.metrics.counters.get("bytes.written", 0),
         }})
         deletes: set[int] = set()
+        report_now = False
 
         async def beat(addr: str) -> bool:
+            nonlocal report_now
             try:
                 rep = await self._bounded_master_call(
                     addr, RpcCode.WORKER_HEARTBEAT, payload,
                     connect_s=3.0, call_s=5.0)
-                for bid in (unpack(rep.data) or {}).get("delete_blocks", []):
+                body = unpack(rep.data) or {}
+                for bid in body.get("delete_blocks", []):
                     deletes.add(bid)
+                if body.get("report_now"):
+                    report_now = True
                 return True
             except Exception as e:  # noqa: BLE001 — peer down is routine
                 log.debug("heartbeat to %s failed: %s", addr, e)
@@ -289,6 +296,15 @@ class WorkerServer:
             self.store.delete(bid)
             if self.hbm is not None:
                 self.hbm.drop(bid)
+        if report_now and time.monotonic() - self._forced_report_at >= 1.0:
+            # a master lost track of our holdings (it restarted, or we
+            # returned from LOST): push a full report immediately instead
+            # of leaving our blocks location-less until the periodic one.
+            # In the BACKGROUND — a slow report awaited here would starve
+            # the heartbeat tick and get us marked LOST all over again.
+            self._forced_report_at = time.monotonic()
+            self._bg = [t for t in self._bg if not t.done()]
+            self._bg.append(asyncio.ensure_future(self.block_report_once()))
 
     async def block_report_once(self) -> None:
         held, types = self.store.report()
@@ -578,6 +594,11 @@ class WorkerServer:
                 crc = 0
                 pos = offset
                 while pos < end:
+                    if msg.deadline is not None:
+                        # the client stopped listening at its budget:
+                        # abandon the stream instead of shoveling chunks
+                        # into a dead socket buffer
+                        msg.deadline.check(f"read block {q['block_id']}")
                     n = min(chunk_size, end - pos)
                     view = memoryview(buf[:n])
                     got = await engine.read_into(info.path, base + pos, view)
@@ -605,6 +626,9 @@ class WorkerServer:
                 try:
                     pos = offset
                     while pos < end:
+                        if msg.deadline is not None:
+                            msg.deadline.check(
+                                f"read block {q['block_id']}")
                         n = min(chunk_size, end - pos)
                         sent = await conn.send_chunk_from_file(
                             msg.code, msg.req_id, f, base + pos, n)
@@ -629,6 +653,8 @@ class WorkerServer:
                 crc = 0
                 pos = offset
                 while pos < end:
+                    if msg.deadline is not None:
+                        msg.deadline.check(f"read block {q['block_id']}")
                     n = min(chunk_size, end - pos)
                     view = memoryview(buf[:n])
                     if inline_io:
@@ -736,8 +762,12 @@ class WorkerServer:
                 cap = info.alloc_len if info.is_extent else None
                 f = await asyncio.to_thread(_open_block_writer, info)
                 try:
+                    # the master's pull budget rides the submit header:
+                    # a dead/wedged source fails this stream inside the
+                    # remaining budget instead of the full RPC timeout
                     async for m in peer.call_stream(
-                            RpcCode.READ_BLOCK, header={"block_id": block_id}):
+                            RpcCode.READ_BLOCK, header={"block_id": block_id},
+                            deadline=msg.deadline):
                         if len(m.data):
                             total += len(m.data)
                             if cap is not None and total > cap:

@@ -1,0 +1,45 @@
+#!/bin/bash
+# Chaos-storm smoke gate (<90s): run the deterministic-seed storms plus
+# the deadline/breaker acceptance tests from tests/test_storm.py and
+# fail on any invariant violation. Mirrors scripts/perf_smoke.sh.
+#
+# Usage: scripts/storm_smoke.sh [project_root]
+#   STORM_RAFT_REPEAT=N   additionally run the raft election/storm tests
+#                         N times each (--repeat; flaky-election hunter)
+# Exit: 0 = all invariants held, 1 = violation/failure, 2 = harness error.
+
+set -u
+
+ROOT="${1:-$(cd "$(dirname "$0")/.." && pwd)}"
+cd "$ROOT" || exit 2
+
+run_pytest() {
+    timeout -k 10 85 env JAX_PLATFORMS=cpu python -m pytest -q \
+        -p no:cacheprovider -p no:xdist -p no:randomly "$@"
+}
+
+echo "storm_smoke: deterministic-seed storms + deadline/breaker gates"
+run_pytest tests/test_storm.py -m 'not slow'
+rc=$?
+if [ $rc -eq 124 ] || [ $rc -eq 137 ]; then
+    echo "storm_smoke: TIMEOUT — storm gate exceeded 85s" >&2
+    exit 2
+elif [ $rc -ne 0 ]; then
+    echo "storm_smoke: FAIL — storm invariants violated (rc=$rc)" >&2
+    exit 1
+fi
+
+if [ "${STORM_RAFT_REPEAT:-0}" -gt 1 ]; then
+    echo "storm_smoke: raft storm x${STORM_RAFT_REPEAT} (flaky-election hunt)"
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest -q \
+        -p no:cacheprovider -p no:xdist -p no:randomly \
+        --repeat "$STORM_RAFT_REPEAT" \
+        tests/test_raft.py -k "storm or prevote or failover"
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "storm_smoke: FAIL — raft storm repeat found a flake (rc=$rc)" >&2
+        exit 1
+    fi
+fi
+
+echo "storm_smoke: PASS"

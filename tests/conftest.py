@@ -28,9 +28,28 @@ import inspect
 import pytest
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--repeat", type=int, default=1, metavar="N",
+        help="run each selected test N times (flaky-election hunting; "
+             "used by scripts/storm_smoke.sh on the raft storm tests)")
+
+
 def pytest_configure(config):
     config.addinivalue_line("markers",
                             "asyncio_plain: async test run via asyncio.run")
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from tier-1 "
+                   "(run explicitly or without -m 'not slow')")
+
+
+def pytest_generate_tests(metafunc):
+    """--repeat N: parametrize every test N times (distinct node ids, so
+    one flaky failure out of N is reported precisely)."""
+    count = metafunc.config.getoption("--repeat")
+    if count > 1:
+        metafunc.fixturenames.append("__repeat")
+        metafunc.parametrize("__repeat", range(count))
 
 
 def pytest_collection_modifyitems(items):

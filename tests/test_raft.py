@@ -210,6 +210,70 @@ async def test_kill_leader_mid_write_storm_no_acked_loss(tmp_path):
                 await m.stop()
 
 
+async def test_prevote_partitioned_node_cannot_depose_leader(tmp_path):
+    """Raft pre-vote (§9.6, parity: role_monitor.rs): a node cut off
+    from the quorum keeps failing PRE-vote rounds, so its term never
+    inflates — when the partition heals it rejoins as a follower and
+    the healthy leader is NOT deposed. Without pre-vote the victim
+    would bump its term every election timeout and depose the leader
+    on rejoin with a wave of vote requests."""
+    from curvine_tpu.fault.runtime import FaultInjector, FaultSpec
+
+    masters, addrs = await _make_ha_cluster(tmp_path)
+    try:
+        leader = await _wait_leader(masters)
+        victim = next(m for m in masters if m is not leader)
+        term_before = leader.raft.term
+
+        # partition the victim both ways: its server drops everything
+        # inbound, its raft client pool drops everything outbound
+        inj = FaultInjector()
+        inj.install(victim.rpc)
+        inj.install_client(victim.raft.pool)
+        inj.add(FaultSpec(kind="drop", target="*"))
+
+        # many election timeouts pass (150-300ms each) while isolated
+        await asyncio.sleep(2.0)
+        assert victim.raft.term == term_before, \
+            f"partitioned node inflated its term " \
+            f"{term_before} -> {victim.raft.term} despite pre-vote"
+        assert victim.raft.role != LEADER
+        assert leader.raft.role == LEADER
+
+        # heal the partition: the victim must rejoin as a follower and
+        # the healthy leader must keep both its role and its term
+        inj.clear()
+        inj.uninstall(victim.rpc)
+        inj.uninstall_client(victim.raft.pool)
+        await asyncio.sleep(1.0)
+        assert leader.raft.role == LEADER, "healthy leader was deposed"
+        assert leader.raft.term == term_before
+        assert victim.raft.role != LEADER
+        assert victim.raft.leader_id == leader.raft.node_id
+    finally:
+        for m in masters:
+            if m.rpc._server is not None:
+                await m.stop()
+
+
+async def test_prevote_does_not_block_legitimate_elections(tmp_path):
+    """Pre-vote must not stop a REAL failover: when the leader dies,
+    survivors' pre-vote rounds succeed (nobody has heard from a leader)
+    and a new leader emerges normally."""
+    masters, addrs = await _make_ha_cluster(tmp_path)
+    try:
+        leader = await _wait_leader(masters)
+        await leader.stop()
+        survivors = [m for m in masters if m is not leader]
+        new_leader = await _wait_leader(survivors)
+        assert new_leader.raft.role == LEADER
+        assert new_leader.raft.term > 0
+    finally:
+        for m in masters:
+            if m.rpc._server is not None:
+                await m.stop()
+
+
 async def test_hard_state_survives_restart(tmp_path):
     """term/voted_for are fsync'd: a restarted node must not double-vote
     in the same term (raft_node.rs persisted HardState parity)."""

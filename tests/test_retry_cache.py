@@ -1,0 +1,121 @@
+"""Retry cache: duplicate-mutation suppression.
+
+Parity: curvine-server/src/master/fs/fs_retry_cache.rs. Covers the unit
+behavior (TTL, capacity, LRU refresh) and the end-to-end property it
+exists for: a client retransmitting a non-idempotent mutation — e.g.
+after its connection to the master died mid-ack and it reconnected —
+gets the SAME serialized response back instead of a second application."""
+
+import time
+
+from curvine_tpu.master.retry_cache import RetryCache
+from curvine_tpu.rpc import RpcCode
+from curvine_tpu.rpc.frame import pack, unpack
+from curvine_tpu.testing import MiniCluster
+
+MB = 1024 * 1024
+
+
+# ---------------------------------------------------------------------
+# unit
+# ---------------------------------------------------------------------
+
+def test_put_get_roundtrip():
+    rc = RetryCache(capacity=10, ttl_ms=60_000)
+    rc.put(("c1", 1), b"resp-1")
+    assert rc.get(("c1", 1)) == b"resp-1"
+    assert rc.get(("c1", 2)) is None
+    assert rc.get(("c2", 1)) is None
+
+
+def test_ttl_expiry(monkeypatch):
+    rc = RetryCache(capacity=10, ttl_ms=500)
+    t = [1000.0]
+    monkeypatch.setattr(time, "time", lambda: t[0])
+    rc.put(("c1", 1), b"resp")
+    t[0] += 0.4
+    assert rc.get(("c1", 1)) == b"resp"
+    t[0] += 0.2                       # 600ms total: past the TTL
+    assert rc.get(("c1", 1)) is None
+    # the expired entry was evicted, not left to rot
+    assert ("c1", 1) not in rc._entries
+
+
+def test_capacity_eviction_is_lru():
+    rc = RetryCache(capacity=3, ttl_ms=60_000)
+    for i in range(3):
+        rc.put(("c", i), i)
+    assert rc.get(("c", 0)) == 0      # refresh 0 → 1 is now oldest
+    rc.put(("c", 3), 3)
+    assert rc.get(("c", 1)) is None   # evicted
+    assert rc.get(("c", 0)) == 0
+    assert rc.get(("c", 3)) == 3
+
+
+# ---------------------------------------------------------------------
+# end-to-end: duplicate ADD_BLOCK retransmit is suppressed
+# ---------------------------------------------------------------------
+
+async def test_duplicate_add_block_applied_once(tmp_path):
+    """Two wire-identical ADD_BLOCK requests with the same
+    (client_id, call_id) — the retransmit a client sends when the first
+    ack was lost — must allocate ONE block and replay the same
+    response, not grow the file by a ghost block."""
+    async with MiniCluster(workers=1, base_dir=str(tmp_path)) as mc:
+        c = mc.client()
+        await c.meta.create_file("/rc.bin", block_size=MB)
+        req = {"path": "/rc.bin", "client_host": c.meta.client_host,
+               "commit_blocks": [], "exclude_workers": [],
+               "ici_coords": [], "abandon_block": None,
+               "client_id": "client-A", "call_id": 7,
+               "client_name": c.meta.client_id,
+               "user": c.meta.user, "groups": c.meta.groups}
+        conn = await c.meta._conn()
+        rep1 = unpack((await conn.call(RpcCode.ADD_BLOCK,
+                                       data=pack(req))).data)
+        rep2 = unpack((await conn.call(RpcCode.ADD_BLOCK,
+                                       data=pack(req))).data)
+        assert rep1 == rep2, "retransmit got a different response"
+        node = mc.master.fs.tree.resolve("/rc.bin")
+        assert len(node.blocks) == 1, \
+            f"duplicate mutation applied: {node.blocks}"
+
+
+async def test_retransmit_on_new_connection_after_reconnect(tmp_path):
+    """The cache keys on (client_id, call_id), not the connection: a
+    client that lost its socket (master failover of its conn, LB
+    reconnect) and retries over a FRESH connection still deduplicates."""
+    async with MiniCluster(workers=1, base_dir=str(tmp_path)) as mc:
+        c = mc.client()
+        await c.meta.create_file("/rc2.bin", block_size=MB)
+        req = {"path": "/rc2.bin", "client_host": c.meta.client_host,
+               "commit_blocks": [], "exclude_workers": [],
+               "ici_coords": [], "abandon_block": None,
+               "client_id": "client-B", "call_id": 1,
+               "client_name": c.meta.client_id,
+               "user": c.meta.user, "groups": c.meta.groups}
+        conn1 = await c.meta._conn()
+        rep1 = unpack((await conn1.call(RpcCode.ADD_BLOCK,
+                                        data=pack(req))).data)
+        # simulate the connection dying before the client saw the ack
+        await conn1.close()
+        from curvine_tpu.rpc.client import Connection
+        conn2 = await Connection(mc.master.addr).connect()
+        try:
+            rep2 = unpack((await conn2.call(RpcCode.ADD_BLOCK,
+                                            data=pack(req))).data)
+        finally:
+            await conn2.close()
+        assert rep1 == rep2
+        node = mc.master.fs.tree.resolve("/rc2.bin")
+        assert len(node.blocks) == 1
+
+
+async def test_distinct_call_ids_are_not_deduped(tmp_path):
+    """Sanity: the cache must not swallow REAL successive mutations."""
+    async with MiniCluster(workers=1, base_dir=str(tmp_path)) as mc:
+        c = mc.client()
+        await c.meta.mkdir("/d1")        # call_id auto-increments
+        await c.meta.mkdir("/d2")
+        assert await c.meta.exists("/d1")
+        assert await c.meta.exists("/d2")

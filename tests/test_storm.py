@@ -1,0 +1,328 @@
+"""Chaos-storm harness + deadline propagation + circuit breakers.
+
+The resilience layer end-to-end: seeded randomized storms over a
+MiniCluster (worker kill/restart, master restart, injected faults) with
+invariants asserted after quiesce; deadline budgets that bound degraded
+reads to budget + slack instead of a full RPC timeout; and the
+client-side per-worker circuit breakers that skip wedged replicas."""
+
+import asyncio
+import os
+import time
+
+import pytest
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.fault.runtime import FaultInjector, FaultSpec
+from curvine_tpu.rpc import RpcCode
+from curvine_tpu.rpc.client import RetryPolicy
+from curvine_tpu.rpc.deadline import DEADLINE_KEY, Deadline
+from curvine_tpu.rpc.frame import pack, unpack
+from curvine_tpu.testing import MiniCluster
+from curvine_tpu.testing.storm import ChaosStorm, storm_bytes
+
+MB = 1024 * 1024
+
+# ---------------------------------------------------------------------
+# deterministic-seed storms (the tier-1 gate; scripts/storm_smoke.sh)
+# ---------------------------------------------------------------------
+
+STORM_SEEDS = [1, 2, 3, 5, 8]
+
+
+@pytest.mark.parametrize("seed", STORM_SEEDS)
+async def test_storm_deterministic_seed(seed, tmp_path):
+    storm = ChaosStorm(seed, workers=3, replicas=2, duration_s=1.5,
+                       event_interval_s=0.2, writer_tasks=2,
+                       reader_tasks=2, file_size=64 * 1024,
+                       base_dir=str(tmp_path))
+    report = await storm.run()
+    report.assert_invariants()
+    # a storm that never acked a write or never injected anything
+    # exercised nothing — the schedule must have real content
+    assert report.acked_files > 0
+    assert report.events, "no chaos events fired"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [11, 23, 42])
+async def test_storm_long_randomized(seed, tmp_path):
+    storm = ChaosStorm(seed, workers=4, replicas=2, duration_s=8.0,
+                       event_interval_s=0.3, writer_tasks=3,
+                       reader_tasks=3, file_size=256 * 1024,
+                       base_dir=str(tmp_path))
+    report = await storm.run()
+    report.assert_invariants()
+    assert report.acked_files > 3
+
+
+def test_storm_bytes_deterministic():
+    a = storm_bytes(7, "w0/f1", 1000)
+    assert a == storm_bytes(7, "w0/f1", 1000)
+    assert a != storm_bytes(8, "w0/f1", 1000)
+    assert len(storm_bytes(7, "x", 12345)) == 12345
+
+
+# ---------------------------------------------------------------------
+# deadline propagation
+# ---------------------------------------------------------------------
+
+def test_deadline_primitives():
+    dl = Deadline(1.0)
+    assert not dl.expired
+    assert 0.9 < dl.remaining() <= 1.0
+    assert dl.cap(30.0) <= 1.0
+    assert dl.cap(0.5) == 0.5
+    # hop split: 2 replicas left → half the budget each
+    hop = dl.sub(2)
+    assert hop.remaining() <= dl.remaining() / 2 + 0.01
+    # wire round trip
+    hdr = dl.stamp({})
+    back = Deadline.from_header(hdr)
+    assert back is not None and abs(back.remaining() - dl.remaining()) < 0.05
+    assert Deadline.from_header({}) is None
+    assert Deadline.from_header(None) is None
+    expired = Deadline(0.0)
+    assert expired.expired
+    with pytest.raises(err.RpcTimeout):
+        expired.check("op")
+
+
+async def test_degraded_read_bounded_by_deadline(tmp_path):
+    """Acceptance headline: with one replica's worker wedged by a drop
+    fault, a read with a 2s deadline budget completes via replica
+    failover in < budget + 500ms slack — not the 30s RPC timeout."""
+    async with MiniCluster(workers=2, base_dir=str(tmp_path)) as mc:
+        mc.conf.client.short_circuit = False   # force the RPC read path
+        c = mc.client()
+        data = os.urandom(1 * MB)
+        await c.write_all("/deg.bin", data, replicas=2)
+
+        fb = await c.meta.get_block_locations("/deg.bin")
+        first = fb.block_locs[0].locs[0]       # the reader's first pick
+        victim = next(w for w in mc.workers
+                      if w.rpc.port == first.rpc_port)
+        inj = FaultInjector().install(victim.rpc)
+        inj.add(FaultSpec(kind="drop",
+                          codes=[int(RpcCode.READ_BLOCK),
+                                 int(RpcCode.GET_BLOCK_INFO)]))
+
+        c2 = mc.client()                       # cold breakers: pays the hop
+        t0 = time.monotonic()
+        r = await c2.open("/deg.bin")
+        try:
+            got = await r.read_all(deadline_ms=2_000)
+        finally:
+            await r.close()
+        elapsed = time.monotonic() - t0
+        assert bytes(got) == data
+        assert elapsed < 2.5, \
+            f"degraded read took {elapsed:.2f}s (budget 2s + 0.5s slack)"
+        # it really paid a wedged hop before failing over (hop budget =
+        # remaining / replicas-left ≈ 1s), not a lucky first pick
+        assert elapsed > 0.3, \
+            f"read took {elapsed:.3f}s — fault never engaged?"
+
+
+async def test_server_fast_fails_exhausted_budget(tmp_path):
+    """A mutation whose budget dies in transit is refused, not applied:
+    the server checks the propagated deadline after the (faulted) delay
+    and skips the handler — no dead work, no surprise side effect."""
+    async with MiniCluster(workers=1, base_dir=str(tmp_path)) as mc:
+        c = mc.client()
+        inj = FaultInjector().install(mc.master.rpc)
+        inj.add(FaultSpec(kind="delay", delay_ms=400,
+                          codes=[int(RpcCode.MKDIR)]))
+        with pytest.raises(err.RpcTimeout):
+            await c.meta.call(RpcCode.MKDIR, {"path": "/dead"},
+                              mutate=True,
+                              deadline=Deadline.after_ms(150))
+        # past the injected delay: the handler must NOT have run late
+        await asyncio.sleep(0.6)
+        inj.clear()
+        assert not await c.meta.exists("/dead")
+
+
+async def test_deadline_header_rides_the_wire(tmp_path):
+    async with MiniCluster(workers=1, base_dir=str(tmp_path)) as mc:
+        seen = {}
+        orig_hook = None
+
+        async def spy(server_name, msg):
+            if msg.code == int(RpcCode.EXISTS):
+                seen["budget"] = msg.header.get(DEADLINE_KEY)
+            return True
+
+        mc.master.rpc.fault_hook = spy
+        c = mc.client()
+        await c.meta.call(RpcCode.EXISTS, {"path": "/"},
+                          deadline=Deadline.after_ms(5_000))
+        mc.master.rpc.fault_hook = orig_hook
+        assert seen.get("budget") is not None
+        assert 0 < seen["budget"] <= 5_000
+
+
+async def test_retry_policy_never_sleeps_past_budget():
+    policy = RetryPolicy(max_retries=10, base_ms=400, max_ms=400)
+    calls = []
+
+    async def flaky():
+        calls.append(1)
+        raise err.RpcTimeout("nope")
+
+    t0 = time.monotonic()
+    with pytest.raises(err.RpcTimeout):
+        await policy.run(flaky, deadline=Deadline(0.25))
+    elapsed = time.monotonic() - t0
+    # one or two attempts, but the policy must refuse the backoff sleep
+    # that would cross the 250ms budget (bare policy would sleep ~4s)
+    assert elapsed < 0.7
+    assert len(calls) <= 3
+
+
+# ---------------------------------------------------------------------
+# circuit breakers
+# ---------------------------------------------------------------------
+
+def test_breaker_state_machine():
+    from curvine_tpu.client.health import (
+        CLOSED, HALF_OPEN, OPEN, WorkerHealth,
+    )
+    now = [0.0]
+    h = WorkerHealth(fail_threshold=3, open_s=5.0, decay_s=30.0,
+                     clock=lambda: now[0])
+    a = "w1:9000"
+    assert h.state(a) == CLOSED and h.allow(a)
+    h.fail(a, worker_id=11)
+    h.fail(a, worker_id=11)
+    assert h.state(a) == CLOSED            # under threshold
+    h.fail(a, worker_id=11)
+    assert h.state(a) == OPEN
+    assert not h.allow(a)
+    assert h.open_worker_ids() == {11}
+    # open window lapses → half-open admits exactly one probe
+    now[0] += 5.0
+    assert h.state(a) == HALF_OPEN
+    assert h.allow(a)
+    assert not h.allow(a)                  # second probe denied
+    # probe failure re-opens immediately
+    h.fail(a)
+    assert h.state(a) == OPEN
+    now[0] += 5.0
+    assert h.allow(a)                      # next probe window
+    h.ok(a)                                # probe success closes
+    assert h.state(a) == CLOSED
+    assert h.open_worker_ids() == set()
+
+
+def test_breaker_decay_and_order():
+    from curvine_tpu.client.health import OPEN, WorkerHealth
+    now = [0.0]
+    h = WorkerHealth(fail_threshold=2, open_s=5.0, decay_s=10.0,
+                     clock=lambda: now[0])
+    h.fail("a")
+    now[0] += 11.0                         # quiet period forgives
+    h.fail("a")
+    assert h.state("a") != OPEN
+    h.fail("a")
+    assert h.state("a") == OPEN
+    # order: open-circuit sinks last, nothing is dropped
+    assert h.order(["a", "b", "c"]) == ["b", "c", "a"]
+    # a stale half-open probe permit can't wedge the breaker forever
+    now[0] += 5.0
+    assert h.allow("a")                    # probe permit issued
+    now[0] += 5.0
+    assert h.allow("a")                    # permit expired → reissued
+    snap = h.snapshot()
+    assert snap["a"]["trips"] == 1
+
+
+async def test_reader_skips_open_circuit_worker(tmp_path):
+    """After the breaker opens for a wedged worker, the next read tries
+    the healthy replica FIRST — no repeated per-read timeout tax."""
+    async with MiniCluster(workers=2, base_dir=str(tmp_path)) as mc:
+        mc.conf.client.short_circuit = False
+        mc.conf.client.breaker_fail_threshold = 1
+        mc.conf.client.breaker_open_ms = 60_000
+        c = mc.client()
+        data = os.urandom(256 * 1024)
+        await c.write_all("/cb.bin", data, replicas=2)
+
+        fb = await c.meta.get_block_locations("/cb.bin")
+        first = fb.block_locs[0].locs[0]
+        victim = next(w for w in mc.workers
+                      if w.rpc.port == first.rpc_port)
+        inj = FaultInjector().install(victim.rpc)
+        inj.add(FaultSpec(kind="drop", codes=[int(RpcCode.READ_BLOCK)]))
+
+        # read 1: pays one wedged hop (~1s of a 2s budget), opens breaker
+        r = await c.open("/cb.bin")
+        try:
+            assert bytes(await r.read_all(deadline_ms=2_000)) == data
+        finally:
+            await r.close()
+        assert c.health.open_worker_ids(), "breaker did not open"
+
+        # read 2: breaker reorders — healthy replica first, near-instant
+        t0 = time.monotonic()
+        r = await c.open("/cb.bin")
+        try:
+            assert bytes(await r.read_all(deadline_ms=2_000)) == data
+        finally:
+            await r.close()
+        assert time.monotonic() - t0 < 0.5, \
+            "open-circuit worker was still tried first"
+
+
+async def test_writer_placement_excludes_open_breakers(tmp_path):
+    """add_block placement retries steer around open-circuit workers via
+    exclude_workers — and relax the exclusion rather than hard-failing
+    when every worker is open."""
+    async with MiniCluster(workers=2, base_dir=str(tmp_path)) as mc:
+        mc.conf.client.short_circuit = False
+        c = mc.client()
+        # trip the breaker for worker 0 by hand
+        w0 = mc.workers[0]
+        addr = f"127.0.0.1:{w0.rpc.port}"
+        for _ in range(3):
+            c.health.fail(addr, worker_id=w0.worker_id)
+        assert c.health.open_worker_ids() == {w0.worker_id}
+
+        await c.write_all("/place.bin", b"x" * 1024, replicas=1)
+        fb = await c.meta.get_block_locations("/place.bin")
+        placed = {l.worker_id for lb in fb.block_locs for l in lb.locs}
+        assert w0.worker_id not in placed, \
+            "placement landed on the open-circuit worker"
+
+        # every breaker open → exclusion must relax, not fail the write
+        w1 = mc.workers[1]
+        c.health.fail(f"127.0.0.1:{w1.rpc.port}", worker_id=w1.worker_id)
+        for _ in range(2):
+            c.health.fail(f"127.0.0.1:{w1.rpc.port}",
+                          worker_id=w1.worker_id)
+        assert len(c.health.open_worker_ids()) == 2
+        await c.write_all("/place2.bin", b"y" * 1024, replicas=1)
+        assert await c.read_all("/place2.bin") == b"y" * 1024
+
+
+# ---------------------------------------------------------------------
+# client-side fault hook (fault/runtime.py mirror of RpcServer hook)
+# ---------------------------------------------------------------------
+
+async def test_client_side_fault_hook_drop(tmp_path):
+    async with MiniCluster(workers=1, base_dir=str(tmp_path)) as mc:
+        c = mc.client()
+        await c.meta.mkdir("/cf")
+        inj = FaultInjector()
+        inj.install_client(c.meta.pool)
+        fid = inj.add(FaultSpec(kind="drop",
+                                codes=[int(RpcCode.EXISTS)], max_hits=1))
+        t0 = time.monotonic()
+        with pytest.raises(err.RpcTimeout):
+            await c.meta.call(RpcCode.EXISTS, {"path": "/cf"},
+                              deadline=Deadline.after_ms(300))
+        assert time.monotonic() - t0 < 1.0   # budget, not rpc_timeout
+        inj.remove(fid)
+        inj.uninstall_client(c.meta.pool)
+        assert (await c.meta.call(RpcCode.EXISTS,
+                                  {"path": "/cf"}))["exists"]

@@ -3,7 +3,12 @@
 block_checksum: integrity hash of an HBM-resident cached block computed
 on-device (VPU tile reduction) — verifying a block after an ICI/DCN
 transfer without ever copying it back to the host. Falls back to pallas
-interpret mode off-TPU so tests run on CPU."""
+interpret mode off-TPU so tests run on CPU.
+
+pq_lut_scan: the IVF-PQ ADC inner loop (vector/index.py) — score W
+candidates by summing M one-byte codeword lookups against a per-query
+LUT, fused over candidate tiles so codes stream HBM→VMEM once and the
+score accumulation never leaves the chip."""
 
 from __future__ import annotations
 
@@ -96,3 +101,68 @@ def block_checksum_host(data: bytes | np.ndarray) -> int:
     mixed = np.bitwise_xor(w, (cols + tile_of) & np.uint64(0xFFFFFFFF))
     m = np.uint64(mixed.sum()) & np.uint64(0xFFFFFFFF)
     return int((s ^ ((m << np.uint64(1)) & np.uint64(0xFFFFFFFF))))
+
+
+# ---------------------------------------------------------------- PQ ADC
+
+PQ_TILE = 128      # candidates scored per grid step
+
+
+def _pq_scan_kernel(lut_ref, codes_ref, out_ref, *, pre_offset: bool):
+    # ADC without a hardware gather: codes are compared against a lane
+    # iota and the matching LUT entry selected per subspace — an
+    # [TILE, ksub] VPU select+reduce per subspace, all in VMEM. The
+    # subspace count M is small (8-64) so the python loop unrolls.
+    # pre_offset: codes carry the m·ksub flat-LUT offset already (the
+    # device-pinned layout the IVF-PQ search uses).
+    m, ksub = lut_ref.shape
+    codes = codes_ref[:]                         # [PQ_TILE, M] int32
+    col = jax.lax.broadcasted_iota(jnp.int32, (codes.shape[0], ksub), 1)
+    acc = jnp.zeros((codes.shape[0], 1), jnp.float32)
+    for mi in range(m):
+        want = col + mi * ksub if pre_offset else col
+        eq = codes[:, mi:mi + 1] == want
+        acc = acc + jnp.sum(
+            jnp.where(eq, lut_ref[mi:mi + 1, :], 0.0),
+            axis=1, keepdims=True)
+    out_ref[:] = acc
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "pre_offset"))
+def _pq_scan_padded(lut: jax.Array, codes: jax.Array,
+                    interpret: bool = False,
+                    pre_offset: bool = False) -> jax.Array:
+    w, m = codes.shape
+    ksub = lut.shape[1]
+    out = pl.pallas_call(
+        functools.partial(_pq_scan_kernel, pre_offset=pre_offset),
+        grid=(w // PQ_TILE,),
+        in_specs=[pl.BlockSpec((m, ksub), lambda i: (0, 0)),
+                  pl.BlockSpec((PQ_TILE, m), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((PQ_TILE, 1), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((w, 1), jnp.float32),
+        interpret=interpret,
+    )(lut, codes)
+    return out[:, 0]
+
+
+def pq_lut_scan(lut: jax.Array, codes: jax.Array,
+                interpret: bool | None = None,
+                pre_offset: bool = False) -> jax.Array:
+    """ADC scores out[w] = sum_m lut[m, codes[w, m]].
+
+    lut [M, ksub] f32 (one query's per-codeword contributions), codes
+    [W, M] int — W is padded to the candidate tile internally.
+    pre_offset=True means codes already hold code + m·ksub (the pinned
+    flat-LUT layout). Traceable (used inside the jitted IVF-PQ search);
+    interpret=None picks interpret mode off-TPU like block_checksum."""
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    w = codes.shape[0]
+    pad = (-w) % PQ_TILE
+    if pad:
+        codes = jnp.pad(codes, ((0, pad), (0, 0)))
+    return _pq_scan_padded(lut.astype(jnp.float32),
+                           codes.astype(jnp.int32),
+                           interpret=interpret,
+                           pre_offset=pre_offset)[:w]

@@ -1,4 +1,5 @@
+from curvine_tpu.vector.index import IvfIndex, PqCodebook
 from curvine_tpu.vector.serving import AnnServer
 from curvine_tpu.vector.table import VectorTable
 
-__all__ = ["AnnServer", "VectorTable"]
+__all__ = ["AnnServer", "IvfIndex", "PqCodebook", "VectorTable"]

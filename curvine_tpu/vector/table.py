@@ -14,11 +14,14 @@ Layout under `<path>/`:
 from __future__ import annotations
 
 import json
+import logging
 
 import numpy as np
 
 from curvine_tpu.client import CurvineClient
 from curvine_tpu.common import errors as err
+
+log = logging.getLogger(__name__)
 
 _DTYPES = {"f32": np.float32, "i32": np.int32, "i64": np.int64}
 
@@ -81,6 +84,11 @@ class VectorTable:
         # lazily-loaded IVF index (vector/index.py); None = not probed
         self._index = None
         self._index_missing = False
+        # knn calls that wanted the index but fell back to the exact
+        # brute-force scan because it was stale — a silent ~100x serving
+        # slowdown otherwise; logged once, counted always
+        self.stale_fallbacks = 0
+        self._stale_warned = False
 
     # ---------------- lifecycle ----------------
 
@@ -353,17 +361,28 @@ class VectorTable:
 
     async def create_index(self, nlist: int | None = None,
                            metric: str = "cosine", iters: int = 10,
-                           device=None) -> "IvfIndex":
-        """Build (or rebuild) the IVF-flat ANN index on device and
-        persist it as a cached file. Follows the Lance model: the index
-        is a snapshot — table mutations leave it stale, and knn falls
-        back to the exact scan until the next create_index. See
-        vector/index.py for the TPU-first design."""
+                           device=None, cap_pct: float = 95.0,
+                           pq_m: int | None = None, pq_ksub: int = 256,
+                           pq_iters: int = 8,
+                           pq_sample: int = 65536) -> "IvfIndex":
+        """Build (or rebuild) the IVF ANN index on device and persist it
+        as a cached file. Follows the Lance model: the index is a
+        snapshot — table mutations leave it stale, and knn falls back to
+        the exact scan until the next create_index. `cap_pct` clips the
+        inverted-list padding at that percentile of list lengths (spill
+        lists absorb the overflow); `pq_m` additionally trains product-
+        quantization codebooks with pq_m subspaces × pq_ksub codewords
+        and packs uint8 codes, enabling the two-stage ADC + exact-rerank
+        search (the Lance IVF_PQ analog). See vector/index.py for the
+        TPU-first design."""
         import jax
         from curvine_tpu.vector.index import IvfIndex, table_snapshot
 
         if metric not in ("cosine", "l2"):
             raise err.InvalidArgument(f"metric {metric!r}")
+        if pq_m and self.dim % pq_m:
+            raise err.InvalidArgument(
+                f"pq_m {pq_m} must divide dim {self.dim}")
         host, live = await self._host_live()
         if metric == "cosine":
             host = host / np.linalg.norm(
@@ -375,7 +394,9 @@ class VectorTable:
         snap["metric"] = metric
         dev = device if device is not None else jax.devices()[0]
         idx = IvfIndex.build(host, live, nlist, snap, iters=iters,
-                             device=dev)
+                             device=dev, cap_pct=cap_pct, pq_m=pq_m,
+                             pq_ksub=pq_ksub, pq_iters=pq_iters,
+                             pq_sample=pq_sample)
         await self.client.write_all(f"{self.path}/index.ivf",
                                     idx.to_bytes())
         self._index = idx
@@ -412,17 +433,25 @@ class VectorTable:
     async def knn(self, query: np.ndarray, k: int = 10,
                   metric: str = "cosine", device=None,
                   materialize: bool = True, use_index: bool = True,
-                  nprobe: int = 8, dtype: str = "f32"):
+                  nprobe: int = 8, dtype: str = "f32",
+                  use_pq: bool | str = "auto", rerank: int | None = None,
+                  pallas: bool | str = "auto"):
         """Top-k nearest rows to `query` [D] or [Q, D].
 
         With a FRESH IVF index (create_index since the last mutation) and
-        use_index=True, the scan is two chained device stages — queries ×
-        centroids, then a gather+dot over only the probed lists (see
+        use_index=True, the scan is chained device stages — queries ×
+        centroids, then a gather+dot over only the probed lists; with PQ
+        codes (create_index(pq_m=...)) and use_pq, the probed lists are
+        scored by the 8-bit ADC scan first and only the top-`rerank`
+        survivors are gathered for the exact re-rank (see
         vector/index.py); results are approximate with recall set by
-        `nprobe`. Otherwise it is ONE exact [Q, D]×[D, N] matmul + top_k
-        over the pinned table — no per-group host loop, no re-streaming
-        (the round-2 per-group await+device_put pattern benched at Python
-        speed, not MXU speed).
+        `nprobe` (and `rerank` on the PQ path). Otherwise it is ONE
+        exact [Q, D]×[D, N] matmul + top_k over the pinned table — no
+        per-group host loop, no re-streaming (the round-2 per-group
+        await+device_put pattern benched at Python speed, not MXU
+        speed). A STALE index (mutations since create_index) silently
+        degrading to the brute-force scan is a ~100x serving regression,
+        so it is warned once and counted in `stale_fallbacks`.
 
         materialize=False returns device arrays without forcing a
         device→host sync — callers issuing a stream of scans can pipeline
@@ -439,8 +468,18 @@ class VectorTable:
         dev = device if device is not None else jax.devices()[0]
         v, ids = await self._device_vectors(metric, dev, dtype=dtype)
         idx = await self._fresh_index(metric) if use_index else None
+        if use_index and idx is None and self._index is not None:
+            self.stale_fallbacks += 1
+            if not self._stale_warned:
+                self._stale_warned = True
+                log.warning(
+                    "table %s: IVF index is stale (or built for another "
+                    "metric) — knn falling back to the exact brute-force "
+                    "scan until create_index() rebuilds it (warned once; "
+                    "see the stale_fallbacks counter)", self.path)
         if idx is not None:
-            s, i = idx.search(query, v, ids, k, metric, nprobe, dev)
+            s, i = idx.search(query, v, ids, k, metric, nprobe, dev,
+                              use_pq=use_pq, rerank=rerank, pallas=pallas)
         else:
             q = jax.device_put(query, dev)
             s, i = _scan_fn(metric, k)(q, v, ids)

@@ -1,10 +1,13 @@
 #!/bin/bash
-# Direct-IO perf smoke gate (<60s): run the bench's cold-read microbench
-# on a loopback store and fail if direct_read_gibs regresses more than
-# 30% below the floor checked into scripts/perf_floor.json.
+# Perf smoke gate (~2min): run the bench's cold-read microbench and the
+# IVF-PQ ANN serving microbench on a loopback store and fail if either
+# regresses below the floors checked into scripts/perf_floor.json
+# (throughput floors get 30% slack; the recall floor is absolute — a
+# recall regression is a correctness bug, not noise).
 #
 # Usage: scripts/perf_smoke.sh [project_root]
-# Exit: 0 = at/above the regression gate, 1 = regression, 2 = harness error.
+#   BENCH_ANN=0 skips the ANN gate (direct-IO only).
+# Exit: 0 = at/above the regression gates, 1 = regression, 2 = harness error.
 
 set -u
 
@@ -22,7 +25,7 @@ EOF
 )
 rc=$?
 if [ $rc -ne 0 ] || [ -z "$OUT" ]; then
-    echo "perf_smoke: microbench failed to run (rc=$rc)" >&2
+    echo "perf_smoke: direct-io microbench failed to run (rc=$rc)" >&2
     exit 2
 fi
 echo "$OUT"
@@ -47,6 +50,50 @@ if "direct_io_error" in result:
 if got < gate:
     print(f"perf_smoke: FAIL — direct_read_gibs {got} < {gate:.3f} "
           f"(floor {floor} - 30%)", file=sys.stderr)
+    sys.exit(1)
+print("perf_smoke: PASS")
+EOF
+rc=$?
+[ $rc -ne 0 ] && exit $rc
+
+if [ "${BENCH_ANN:-1}" = "0" ]; then
+    echo "perf_smoke: ANN gate skipped (BENCH_ANN=0)"
+    exit 0
+fi
+
+ANN_OUT=$(JAX_PLATFORMS=cpu timeout 150 python - <<'EOF'
+import asyncio, json, os, sys
+sys.path.insert(0, os.getcwd())
+from bench import _ann_smoke
+print(json.dumps(asyncio.run(_ann_smoke())))
+EOF
+)
+rc=$?
+if [ $rc -ne 0 ] || [ -z "$ANN_OUT" ]; then
+    echo "perf_smoke: ANN microbench failed to run (rc=$rc)" >&2
+    exit 2
+fi
+echo "$ANN_OUT"
+
+python - "$FLOOR_FILE" <<'EOF' "$ANN_OUT"
+import json, sys
+floor_file, result = sys.argv[1], json.loads(sys.argv[2])
+floors = json.load(open(floor_file))
+qps_floor = floors["vector_ann_qps"]
+rec_floor = floors["vector_ann_recall10"]
+qps = result.get("vector_ann_qps", 0.0)
+rec = result.get("vector_ann_recall10", 0.0)
+qps_gate = qps_floor * 0.7              # >30% regression fails
+print(f"perf_smoke: vector_ann_qps={qps} floor={qps_floor} "
+      f"gate={qps_gate:.1f} recall10={rec} recall_floor={rec_floor}")
+if qps < qps_gate:
+    print(f"perf_smoke: FAIL — vector_ann_qps {qps} < {qps_gate:.1f} "
+          f"(floor {qps_floor} - 30%)", file=sys.stderr)
+    sys.exit(1)
+if rec < rec_floor:
+    print(f"perf_smoke: FAIL — vector_ann_recall10 {rec} < {rec_floor} "
+          "(absolute floor; recall regressions are correctness bugs)",
+          file=sys.stderr)
     sys.exit(1)
 print("perf_smoke: PASS")
 EOF

@@ -181,6 +181,69 @@ def _direct_io_bench(size_mb: int = 256) -> dict:
     return out
 
 
+async def _trace_overhead_bench(file_kb: int = 4096, read_kb: int = 64,
+                                ops: int = 600, rounds: int = 3) -> dict:
+    """Tracing-overhead gate: hot-path read QPS against a loopback
+    MiniCluster with `obs.trace_sample_rate=0.01` (production default)
+    must stay within 5% of tracing-off. Remote (RPC) preads so every op
+    crosses the instrumented dispatch path; short-circuit would hide
+    the cost being measured. Rounds alternate off/on and the BEST of
+    each side is compared — noise shows up as slow outliers, and taking
+    the max per side filters it without biasing either way.
+    Returns {trace_read_qps_off, trace_read_qps_on, trace_overhead_pct}.
+    """
+    import copy
+    import shutil
+    import tempfile
+    from curvine_tpu.client import CurvineClient
+    from curvine_tpu.testing.cluster import MiniCluster
+
+    base = tempfile.mkdtemp(prefix="curvine-traceov-")
+    mc = MiniCluster(workers=1, base_dir=base)
+    mc.conf.client.short_circuit = False
+    mc.conf.obs.trace_sample_rate = 0.01
+    out: dict = {}
+    try:
+        await mc.start()
+        c_on = mc.client()
+        conf_off = copy.deepcopy(mc.conf)
+        conf_off.obs.enabled = False
+        c_off = CurvineClient(conf_off)
+        path = "/traceov/hot.bin"
+        size = file_kb * 1024
+        await c_on.write_all(path, os.urandom(size))
+        n = read_kb * 1024
+
+        async def qps(client) -> float:
+            r = await client.open(path)
+            try:
+                # warm connections + block-location cache
+                for i in range(8):
+                    await r.pread((i * n) % (size - n), n)
+                t0 = time.perf_counter()
+                for i in range(ops):
+                    await r.pread((i * n) % (size - n), n)
+                return ops / (time.perf_counter() - t0)
+            finally:
+                await r.close()
+
+        best_off = best_on = 0.0
+        for _ in range(rounds):
+            best_off = max(best_off, await qps(c_off))
+            best_on = max(best_on, await qps(c_on))
+        await c_off.close()
+        out["trace_read_qps_off"] = round(best_off, 1)
+        out["trace_read_qps_on"] = round(best_on, 1)
+        out["trace_overhead_pct"] = round(
+            max(0.0, (best_off - best_on) / best_off * 100), 2)
+    finally:
+        try:
+            await mc.stop()
+        finally:
+            shutil.rmtree(base, ignore_errors=True)
+    return out
+
+
 def _tmpfs_raw_gibs(base: str) -> float:
     """Raw sequential write rate to the cache tier's backing dir (the
     hardware ceiling for the write path on this host)."""

@@ -531,6 +531,27 @@ async def cmd_health(args):
     return {"healthy": 0, "degraded": 1}.get(h.get("status"), 2)
 
 
+async def cmd_trace(args):
+    """Fetch one trace's spans (master + workers via GET_SPANS collect)
+    and render the assembled tree. Trace ids come from slow-op log
+    lines, `/api/trace`, or Tracer.last_trace_id."""
+    from curvine_tpu.obs.trace import assemble_tree, render_tree
+    c = await _client(args)
+    try:
+        spans = await c.get_trace(args.trace_id)
+        if not spans:
+            print(f"no spans collected for trace {args.trace_id} "
+                  "(unsampled, expired from the ring, or wrong id)",
+                  file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(assemble_tree(spans), indent=1, default=str))
+        else:
+            print(render_tree(assemble_tree(spans), args.trace_id))
+    finally:
+        await c.close()
+
+
 async def cmd_gateway(args):
     """Serve the S3 and WebHDFS protocol gateways over the namespace."""
     from curvine_tpu.client import CurvineClient
@@ -590,6 +611,8 @@ def build_parser() -> argparse.ArgumentParser:
         A("-r", "--recursive", action="store_true"))
     add("blocks", cmd_blocks, A("path"))
     add("report", cmd_report)
+    add("trace", cmd_trace, A("trace_id"),
+        A("--json", action="store_true"))
     add("health", cmd_health,
         A("--compact", action="store_true"))
     add("node", cmd_node,

@@ -53,9 +53,17 @@ def _os_groups(user: str) -> list[str]:
         return []
 
 
+# meta codes whose tracing would only be telemetry-about-telemetry
+_UNTRACED = frozenset({RpcCode.METRICS_REPORT, RpcCode.GET_SPANS})
+
+
 class FsClient:
     def __init__(self, conf: ClusterConf | None = None):
         self.conf = conf or ClusterConf()
+        # optional Tracer (set by CurvineClient): each meta RPC becomes
+        # a client span; the context is stamped into the RPC header by
+        # the connection layer so the master's span links to it
+        self.tracer = None
         cc = self.conf.client
         self.masters = list(cc.master_addrs)
         self._active = 0
@@ -103,7 +111,10 @@ class FsClient:
                     self._fast_probe_after = 0.0
                 raise
 
-        # the retry policy never sleeps past the caller's budget
+        if self.tracer is not None and code not in _UNTRACED:
+            with self.tracer.span(f"meta.{RpcCode(code).name.lower()}"):
+                # the retry policy never sleeps past the caller's budget
+                return await self.retry.run(once, deadline=deadline)
         return await self.retry.run(once, deadline=deadline)
 
     # ---------------- native metadata fast path ----------------
@@ -299,8 +310,12 @@ class FsClient:
             "ici_coords": ici_coords or []})
         return WorkerAddress.from_wire(rep["worker"])
 
-    async def report_metrics(self, counters: dict) -> None:
-        await self.call(RpcCode.METRICS_REPORT, {"counters": counters})
+    async def report_metrics(self, counters: dict,
+                             spans: list[dict] | None = None) -> None:
+        req: dict = {"counters": counters}
+        if spans:
+            req["spans"] = spans
+        await self.call(RpcCode.METRICS_REPORT, req)
 
     async def decommission_worker(self, worker_id: int,
                                   on: bool = True) -> int:

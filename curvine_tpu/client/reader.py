@@ -11,6 +11,7 @@ import asyncio
 import logging
 import os
 import time
+from contextlib import nullcontext
 
 from curvine_tpu.common import errors as err  # noqa: F401
 from curvine_tpu.common.types import FileBlocks, LocatedBlock
@@ -68,11 +69,15 @@ class FsReader:
                  short_circuit: bool = True, read_ahead: int = 2,
                  counters: dict | None = None,
                  smart_prefetch: bool = True, seq_threshold: int = 3,
-                 health=None, op_deadline_ms: int = 0):
+                 health=None, op_deadline_ms: int = 0, tracer=None):
         # shared per-client WorkerHealth scoreboard (client/health.py):
         # replica choice deprioritizes open-circuit workers and every
         # remote outcome feeds back into it
         self.health = health
+        # shared per-client Tracer (obs/trace.py): each public read op
+        # is a span, and every remote replica ATTEMPT gets its own child
+        # span — a failover shows as an error span, never as a gap
+        self.tracer = tracer
         # default end-to-end budget per read op (0 = none); explicit
         # deadline_ms args on read methods override per call
         self.op_deadline_ms = op_deadline_ms
@@ -176,6 +181,26 @@ class FsReader:
         if self.health is not None:
             locs = self.health.order(locs, key=self._addr)
         return locs
+
+    def _span(self, op: str, **attrs):
+        """Tracer span (or a no-op when untraced)."""
+        if self.tracer is None:
+            return nullcontext()
+        return self.tracer.span(op, attrs=attrs or None)
+
+    # ---------------- hole regions ----------------
+
+    def _hole_len(self, offset: int) -> int:
+        """Bytes of HOLE at `offset`: no block covers it but it is
+        inside the file (resize-extended past the last written block).
+        Served as zeros through the cached read path instead of a short
+        read (parity: reference block_reader_hole.rs)."""
+        if offset >= self.len:
+            return 0
+        for lb in self.blocks.block_locs:
+            if lb.offset > offset:
+                return lb.offset - offset
+        return self.len - offset
 
     def _deadline(self, deadline_ms) -> Deadline | None:
         """Per-op budget: the explicit per-call override, else the
@@ -288,18 +313,20 @@ class FsReader:
         if n <= 0:
             return b""
         dl = self._deadline(deadline_ms)
-        first = await self._read_some(self.pos, n, deadline=dl)
-        self.pos += len(first)
-        if len(first) == n or not first:
-            return first          # common case: one block segment, no copy
-        out = bytearray(first)
-        while len(out) < n:
-            got = await self._read_some(self.pos, n - len(out), deadline=dl)
-            if not got:
-                break
-            out += got
-            self.pos += len(got)
-        return bytes(out)
+        with self._span("read", path=self.path, n=n):
+            first = await self._read_some(self.pos, n, deadline=dl)
+            self.pos += len(first)
+            if len(first) == n or not first:
+                return first      # common case: one block segment, no copy
+            out = bytearray(first)
+            while len(out) < n:
+                got = await self._read_some(self.pos, n - len(out),
+                                            deadline=dl)
+                if not got:
+                    break
+                out += got
+                self.pos += len(got)
+            return bytes(out)
 
     async def read_all(self, deadline_ms=None) -> bytes:
         self.seek(0)
@@ -308,14 +335,15 @@ class FsReader:
     async def pread(self, offset: int, n: int, deadline_ms=None) -> bytes:
         """Positional read without moving the cursor."""
         dl = self._deadline(deadline_ms)
-        out = bytearray()
-        while len(out) < n and offset + len(out) < self.len:
-            got = await self._read_some(offset + len(out), n - len(out),
-                                        deadline=dl)
-            if not got:
-                break
-            out += got
-        return bytes(out)
+        with self._span("pread", path=self.path, offset=offset, n=n):
+            out = bytearray()
+            while len(out) < n and offset + len(out) < self.len:
+                got = await self._read_some(offset + len(out), n - len(out),
+                                            deadline=dl)
+                if not got:
+                    break
+                out += got
+            return bytes(out)
 
     async def pread_view(self, offset: int, n: int, deadline_ms=None):
         """Positional read returning a numpy uint8 buffer — the fast path:
@@ -327,8 +355,10 @@ class FsReader:
         import numpy as np
         n = max(0, min(n, self.len - offset))
         out = np.empty(n, dtype=np.uint8)
-        filled = await self._read_into(offset, out, use_prefetch=True,
-                                       deadline=self._deadline(deadline_ms))
+        with self._span("pread_view", path=self.path, offset=offset, n=n):
+            filled = await self._read_into(
+                offset, out, use_prefetch=True,
+                deadline=self._deadline(deadline_ms))
         self.detector.record_read(offset, offset + filled)
         self._prefetch_topup(offset + filled)
         return out[:filled]
@@ -350,7 +380,15 @@ class FsReader:
                     continue
             located = self._locate(pos)
             if located is None:
-                break
+                # hole region (resized past the written blocks): zeros
+                nh = min(self._hole_len(pos), n - filled)
+                if nh <= 0:
+                    break
+                out[filled:filled + nh] = 0
+                self.counters["hole.bytes.read"] = \
+                    self.counters.get("hole.bytes.read", 0) + nh
+                filled += nh
+                continue
             lb, block_off = located
             seg = min(n - filled, lb.block.len - block_off)
             fd = await self._local_fd(lb)
@@ -387,6 +425,12 @@ class FsReader:
         if n == 0:
             return out
         dl = self._deadline(deadline_ms)
+        with self._span("read_range", path=self.path, offset=offset,
+                        n=n, parallel=parallel):
+            return await self._read_range(offset, n, parallel, out, dl)
+
+    async def _read_range(self, offset: int, n: int, parallel: int,
+                          out, dl):
         qd = self.direct_queue_depth
         if qd > 0:
             if parallel <= 1 and n >= 4 * self.chunk_size:
@@ -519,12 +563,16 @@ class FsReader:
                 deadline.check(f"read block {lb.block.id}")
                 hop = deadline.sub(len(locs) - i)
             try:
-                conn = await self.pool.get(addr)
-                got = await conn.call_readinto(
-                    RpcCode.READ_BLOCK, sink, header={
-                        "block_id": lb.block.id, "offset": block_off,
-                        "len": len(sink), "chunk_size": self.chunk_size},
-                    deadline=hop)
+                # one span per replica ATTEMPT: a failed first replica
+                # leaves a status=error span in the trace, not a gap
+                with self._span("read_block", addr=addr,
+                                block=lb.block.id):
+                    conn = await self.pool.get(addr)
+                    got = await conn.call_readinto(
+                        RpcCode.READ_BLOCK, sink, header={
+                            "block_id": lb.block.id, "offset": block_off,
+                            "len": len(sink), "chunk_size": self.chunk_size},
+                        deadline=hop)
                 if self.health is not None:
                     self.health.ok(addr)
                 return got
@@ -602,7 +650,13 @@ class FsReader:
                          deadline: Deadline | None = None) -> bytes:
         located = self._locate(offset)
         if located is None:
-            return b""
+            # hole region (resized past the written blocks): zeros
+            nh = min(self._hole_len(offset), n)
+            if nh <= 0:
+                return b""
+            self.counters["hole.bytes.read"] = \
+                self.counters.get("hole.bytes.read", 0) + nh
+            return b"\x00" * nh
         lb, block_off = located
         n = min(n, lb.block.len - block_off)
         fd = await self._local_fd(lb)
@@ -620,8 +674,10 @@ class FsReader:
                 deadline.check(f"read block {lb.block.id}")
                 hop = deadline.sub(len(locs) - i)
             try:
-                return await self._read_from(loc, lb.block.id, block_off, n,
-                                             deadline=hop)
+                with self._span("read_block", addr=self._addr(loc),
+                                block=lb.block.id):
+                    return await self._read_from(loc, lb.block.id,
+                                                 block_off, n, deadline=hop)
             except err.CurvineError as e:
                 log.warning("read block %d from %s:%d failed (%s), "
                             "trying next replica", lb.block.id,

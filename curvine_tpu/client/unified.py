@@ -16,6 +16,7 @@ from curvine_tpu.common.types import StorageType
 from curvine_tpu.client.fs_client import FsClient
 from curvine_tpu.client.reader import FsReader
 from curvine_tpu.client.writer import FsWriter
+from curvine_tpu.obs.trace import Tracer
 from curvine_tpu.rpc.client import ConnectionPool
 
 log = logging.getLogger(__name__)
@@ -43,6 +44,11 @@ class CurvineClient:
                 fail_threshold=cc.breaker_fail_threshold,
                 open_s=cc.breaker_open_ms / 1000.0,
                 decay_s=cc.breaker_decay_ms / 1000.0)
+        # tracing front end (docs/observability.md): ops stamp a trace
+        # context at start; finished spans ship to the master alongside
+        # the periodic metrics flush so /api/trace sees the client side
+        self.tracer = Tracer.from_conf("client", self.conf.obs)
+        self.meta.tracer = self.tracer
         self._mount_cache: dict[str, object] = {}
         # client-side IO counters: short-circuit reads/writes bypass the
         # worker entirely, so their bytes are invisible to worker metrics
@@ -82,16 +88,37 @@ class CurvineClient:
         self._metrics_task = asyncio.ensure_future(loop())
 
     async def flush_metrics(self) -> None:
-        """Push counter DELTAS since the last flush to the master."""
+        """Push counter DELTAS since the last flush — and any finished
+        trace spans — to the master."""
         # deltas come from a SNAPSHOT: increments landing during the RPC
         # await must stay unreported until the next flush
         snap = dict(self.counters)
         delta = {k: v - self._reported.get(k, 0)
                  for k, v in snap.items()
                  if v != self._reported.get(k, 0)}
-        if delta:
-            await self.meta.report_metrics(delta)
+        spans = self.tracer.drain()
+        if delta or spans:
+            try:
+                await self.meta.report_metrics(delta, spans=spans)
+            except BaseException:
+                # master away: spans go back in the ring (order is
+                # cosmetic) so the next flush retries them
+                self.tracer.ingest(spans)
+                raise
             self._reported = snap
+
+    async def get_trace(self, trace_id: str) -> list[dict]:
+        """All collected spans of one trace: flushes this client's
+        finished spans to the master, then asks it to merge its own
+        store with every worker's (GET_SPANS collect)."""
+        try:
+            await self.flush_metrics()
+        except err.CurvineError:
+            pass                       # collect may still answer
+        from curvine_tpu.rpc import RpcCode
+        rep = await self.meta.call(RpcCode.GET_SPANS,
+                                   {"trace_id": trace_id, "collect": True})
+        return rep.get("spans", [])
 
     # ---------------- plain cache paths ----------------
 
@@ -113,7 +140,8 @@ class CurvineClient:
                         chunk_size=cc.write_chunk_size, storage_type=st,
                         ici_coords=list(self.conf.worker.ici_coords) or None,
                         short_circuit=cc.short_circuit,
-                        counters=self.counters, health=self.health)
+                        counters=self.counters, health=self.health,
+                        tracer=self.tracer)
 
     async def append(self, path: str) -> FsWriter:
         fb = await self.meta.append_file(path)
@@ -123,13 +151,15 @@ class CurvineClient:
                      chunk_size=cc.write_chunk_size,
                      storage_type=_TIERS.get(cc.storage_type, StorageType.MEM),
                      short_circuit=cc.short_circuit,
-                     counters=self.counters, health=self.health)
+                     counters=self.counters, health=self.health,
+                     tracer=self.tracer)
         w.pos = fb.status.len
         return w
 
     async def open(self, path: str) -> FsReader:
         self._ensure_metrics_task()
-        fb = await self.meta.get_block_locations(path)
+        with self.tracer.span("open", attrs={"path": path}):
+            fb = await self.meta.get_block_locations(path)
         cc = self.conf.client
         return FsReader(self.meta, path, fb, self.pool,
                         chunk_size=cc.read_chunk_size,
@@ -139,11 +169,17 @@ class CurvineClient:
                         smart_prefetch=cc.enable_smart_prefetch,
                         seq_threshold=cc.sequential_read_threshold,
                         health=self.health,
-                        op_deadline_ms=cc.op_deadline_ms)
+                        op_deadline_ms=cc.op_deadline_ms,
+                        tracer=self.tracer)
 
     async def write_all(self, path: str, data: bytes, **kw) -> None:
-        async with await self.create(path, overwrite=True, **kw) as w:
-            await w.write(data)
+        # one root span covers create + uploads + complete; every RPC
+        # under it (meta calls, WRITE_BLOCK streams) inherits the trace
+        # through the ambient context
+        with self.tracer.span("write", attrs={"path": path,
+                                              "bytes": len(data)}):
+            async with await self.create(path, overwrite=True, **kw) as w:
+                await w.write(data)
 
     async def read_all(self, path: str) -> bytes:
         return await self.unified_read(path)
@@ -210,27 +246,41 @@ class CurvineClient:
 
     async def unified_read(self, path: str) -> bytes:
         """Cache first; fall back to UFS through the mount table."""
-        try:
-            st = await self.meta.file_status(path)
-            if st.is_complete and (st.len == 0 or
-                                   await self._has_cached_blocks(path, st)):
-                r = await self.open(path)
-                return await r.read_all()
-        except err.FileNotFound:
-            pass
-        mount, ufs, uri = await self._ufs_for(path)
-        data = await ufs.read_all(uri)
-        if mount.auto_cache:
+        with self.tracer.span("read", attrs={"path": path}) as sp:
             try:
-                await self.write_all(path, data)
-            except err.CurvineError as e:
-                log.debug("auto-cache of %s failed: %s", path, e)
-        return data
+                st = await self.meta.file_status(path)
+                if st.is_complete and (st.len == 0 or
+                                       await self._has_cached_blocks(path,
+                                                                     st)):
+                    r = await self.open(path)
+                    return await r.read_all()
+            except err.FileNotFound:
+                pass
+            # cache miss: the UFS leg gets its own child span so a trace
+            # of a miss shows where the fallback time went
+            with self.tracer.span("ufs_read", attrs={"path": path}):
+                mount, ufs, uri = await self._ufs_for(path)
+                data = await ufs.read_all(uri)
+            sp.set_attr("ufs_fallback", True)
+            if mount.auto_cache:
+                try:
+                    await self.write_all(path, data)
+                except err.CurvineError as e:
+                    log.debug("auto-cache of %s failed: %s", path, e)
+            return data
 
     async def _has_cached_blocks(self, path: str, st) -> bool:
+        """Every EXISTING block has a live location. Hole regions (a
+        file resized past its written blocks) have no block at all and
+        are served as zeros by the read path, so they don't count
+        against cachedness — but a FREED file (TTL free / `cv free`:
+        blocks dropped, storage state flipped to UFS) is not a hole
+        file; its bytes live only in the under-store now."""
+        from curvine_tpu.common.types import StorageState
+        if st.storage_policy.state == StorageState.UFS:
+            return False
         fb = await self.meta.get_block_locations(path)
-        covered = sum(lb.block.len for lb in fb.block_locs if lb.locs)
-        return covered >= st.len
+        return all(lb.locs for lb in fb.block_locs)
 
     async def unified_open(self, path: str):
         """Open preferring cache; uncached files under a mount stream
@@ -284,6 +334,11 @@ class CurvineClient:
         reference state::StoragePolicy parity). Per-mount caching policy
         applies: the mount's ttl/storage/replica/block-size defaults
         govern the cached copy (reference state/mount.rs MountInfo)."""
+        with self.tracer.span("ufs_load", attrs={"path": path}):
+            return await self._load_from_ufs(path, replicas)
+
+    async def _load_from_ufs(self, path: str,
+                             replicas: int | None = None) -> int:
         from curvine_tpu.common.types import TtlAction
         mount, ufs, uri = await self._ufs_for(path)
         st = await ufs.stat(uri)
@@ -410,6 +465,11 @@ class FallbackReader:
             await self._r.close()
         except Exception:            # noqa: BLE001 — old stream is dead
             pass
+        # the lost-replica event is an error span (always recorded, even
+        # unsampled) so a trace of the degraded read names its cause
+        self._client.tracer.span(
+            "ufs_fallback", attrs={"path": self._path, "resume": resume}
+        ).error(cause).finish()
         log.warning("read fallback to UFS for %s at offset %d (%s)",
                     self._path, resume, cause)
         self._r = UfsReader(ufs, uri, ust.len,

@@ -32,7 +32,10 @@ class FsWriter:
                  ici_coords: list[int] | None = None,
                  short_circuit: bool = True,
                  counters: dict | None = None,
-                 health=None):
+                 health=None, tracer=None):
+        # shared per-client Tracer: the close/commit leg gets a span (the
+        # upload RPCs inherit whatever trace the caller's op opened)
+        self.tracer = tracer
         self.fs = fs_client
         self.path = path
         self.pool = pool
@@ -333,9 +336,15 @@ class FsWriter:
     async def close(self) -> None:
         if self._closed:
             return
-        await self._seal_block()
-        await self.fs.complete_file(self.path, self.pos,
-                                    commit_blocks=self._take_commits())
+        from contextlib import nullcontext
+        span = self.tracer.span("write_commit",
+                                attrs={"path": self.path,
+                                       "bytes": self.pos}) \
+            if self.tracer is not None else nullcontext()
+        with span:
+            await self._seal_block()
+            await self.fs.complete_file(self.path, self.pos,
+                                        commit_blocks=self._take_commits())
         self._closed = True
 
     async def abort(self) -> None:

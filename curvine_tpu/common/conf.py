@@ -197,6 +197,24 @@ class FuseConf:
 
 
 @dataclass
+class ObsConf:
+    """Observability plane (curvine_tpu/obs): tracing + profiler knobs."""
+    # master switch: False skips span creation entirely (no-op spans)
+    enabled: bool = True
+    # head-based sampling rate for NEW traces; error and slow spans are
+    # always recorded regardless
+    trace_sample_rate: float = 0.01
+    # ops slower than this emit a structured slow-op log line and keep
+    # their span even when unsampled
+    slow_op_ms: int = 1_000
+    # per-process span ring-buffer capacity
+    span_store_size: int = 8192
+    # budget for the master's GET_SPANS fan-out to workers when
+    # assembling /api/trace/<id> / `cv trace`
+    trace_collect_timeout_ms: int = 2_000
+
+
+@dataclass
 class GatewayConf:
     # S3 gateway SigV4 verification: static credential pair. Empty access
     # key = anonymous mode (explicit opt-in for cluster-internal use);
@@ -218,6 +236,7 @@ class ClusterConf:
     client: ClientConf = field(default_factory=ClientConf)
     fuse: FuseConf = field(default_factory=FuseConf)
     gateway: GatewayConf = field(default_factory=GatewayConf)
+    obs: ObsConf = field(default_factory=ObsConf)
     data_dir: str = "data"
 
     @staticmethod
@@ -277,7 +296,8 @@ def _coerce(cur, raw: str, annotation: str = ""):
 
 def _apply_env(conf: "ClusterConf", env: dict) -> None:
     sections = {"master": conf.master, "worker": conf.worker,
-                "client": conf.client, "fuse": conf.fuse}
+                "client": conf.client, "fuse": conf.fuse,
+                "obs": conf.obs}
     for key, raw in env.items():
         if not key.startswith("CURVINE_") or key == "CURVINE_CONF":
             continue

@@ -18,23 +18,43 @@ class Histogram:
         self.buckets = [0] * (len(_BUCKETS) + 1)
         self.count = 0
         self.sum = 0.0
+        # observations past the last bucket bound (10s): tracked
+        # explicitly so slow-op tails are visible instead of silently
+        # clamped, with the max observed value anchoring the estimate
+        self.overflow = 0
+        self.max = 0.0
 
     def observe(self, v: float) -> None:
-        self.buckets[bisect.bisect_left(_BUCKETS, v)] += 1
+        i = bisect.bisect_left(_BUCKETS, v)
+        self.buckets[i] += 1
+        if i == len(_BUCKETS):
+            self.overflow += 1
         self.count += 1
         self.sum += v
+        if v > self.max:
+            self.max = v
 
     def quantile(self, q: float) -> float:
-        """Approximate quantile from bucket upper bounds."""
+        """Approximate quantile, linearly interpolated WITHIN the
+        containing bucket (bucket upper bounds alone bias every estimate
+        high by up to a full bucket width). The overflow bucket (>10s)
+        interpolates toward the max observed value instead of clamping
+        to 10.0, so a p99 of genuinely slow ops is not silently capped."""
         if self.count == 0:
             return 0.0
-        target = q * self.count
+        target = min(max(q, 0.0), 1.0) * self.count
         acc = 0
         for i, c in enumerate(self.buckets):
+            if c == 0:
+                continue
+            if acc + c >= target:
+                lo = 0.0 if i == 0 else _BUCKETS[i - 1]
+                hi = _BUCKETS[i] if i < len(_BUCKETS) \
+                    else max(self.max, _BUCKETS[-1])
+                frac = (target - acc) / c
+                return lo + (hi - lo) * frac
             acc += c
-            if acc >= target:
-                return _BUCKETS[i] if i < len(_BUCKETS) else _BUCKETS[-1]
-        return _BUCKETS[-1]
+        return self.max or _BUCKETS[-1]
 
 
 class MetricsRegistry:
@@ -97,6 +117,7 @@ class MetricsRegistry:
             "counters": dict(self.counters),
             "gauges": dict(self.gauges),
             "histograms": {n: {"count": h.count, "sum": h.sum,
-                               "p50": h.quantile(0.5), "p99": h.quantile(0.99)}
+                               "p50": h.quantile(0.5), "p99": h.quantile(0.99),
+                               "overflow": h.overflow, "max": h.max}
                            for n, h in self.histograms.items()},
         }

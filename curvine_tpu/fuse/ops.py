@@ -9,6 +9,7 @@ from __future__ import annotations
 import logging
 import os
 import time
+from contextlib import nullcontext
 
 from curvine_tpu.common import errors as cerr
 from curvine_tpu.common.types import FileStatus, SetAttrOpts
@@ -245,9 +246,16 @@ class CurvineFuseFs:
             raise FuseError(Errno.ENOSYS)
         name = fn.__name__[3:]
         self.metrics.inc(f"ops.{name}")
+        # each kernel op is a (head-sampled) trace root on the client's
+        # tracer: a slow/errored FUSE read shows its full path down to
+        # the serving worker in one trace
+        tracer = getattr(self.client, "tracer", None)
+        span = tracer.span(f"fuse.{name}") if tracer is not None \
+            else nullcontext()
         try:
-            with self.metrics.timer(f"lat.{name}"):
-                result = await fn(self, hdr, payload)
+            with span:
+                with self.metrics.timer(f"lat.{name}"):
+                    result = await fn(self, hdr, payload)
             if hdr.opcode == abi.Op.READ and result is not None:
                 self.metrics.inc("bytes.read", len(result))
             elif hdr.opcode == abi.Op.WRITE:

@@ -624,16 +624,26 @@ class MasterFilesystem:
         return node.to_status(dst)
 
     def resize_file(self, path: str, new_len: int) -> None:
+        """Shrink OR extend. Extending past the last written block
+        creates a HOLE — a region with no backing block — which the
+        client read path serves as zeros (parity: reference
+        block_reader_hole.rs; sparse-file semantics)."""
         self._mount_write_guard(path)
         node = self._file_or_raise(path)
-        if new_len > node.len:
-            raise err.InvalidArgument("resize can only shrink")
+        if new_len < 0:
+            raise err.InvalidArgument(f"resize to negative length {new_len}")
         self._log("resize", dict(path=path, new_len=new_len))
 
     def _apply_resize(self, path: str, new_len: int) -> None:
         node = self._file_or_raise(path)
+        grow = new_len >= node.len
         node.len = new_len
         node.mtime = now_ms()
+        if grow:
+            # extend: existing blocks keep their data, the tail becomes
+            # a hole (no block allocation — readers zero-fill)
+            self.tree.save(node)
+            return
         # drop whole blocks past the new length
         keep, off = [], 0
         for bid in node.blocks:

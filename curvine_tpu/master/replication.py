@@ -33,6 +33,10 @@ class ReplicationManager:
         # after a full client RPC timeout
         self.pull_budget_ms = pull_budget_ms
         self.pool = ConnectionPool(size=1)
+        # optional Tracer (set by MasterServer): each dispatched pull
+        # opens a master-rooted trace that propagates through the submit
+        # header to the destination worker and on to its source stream
+        self.tracer = None
         self.queue: asyncio.Queue[int] = asyncio.Queue()
         self._inflight: set[int] = set()
         self._queued: set[int] = set()
@@ -206,14 +210,24 @@ class ReplicationManager:
             log.debug("no replication target for block %d: %s", block_id, e)
             return False
         self._inflight.add(block_id)
+        # master fan-out tracing: root the trace here so the whole chain
+        # (submit → destination's pull stream → source's read) links up
+        # under one trace id; the context rides the submit header
+        from contextlib import nullcontext
+        span = self.tracer.start_trace(
+            "replicate_block", attrs={"block_id": block_id,
+                                      "dst": dst.address.worker_id}) \
+            if self.tracer is not None else nullcontext()
         try:
-            conn = await self.pool.get(
-                f"{dst.address.ip_addr or dst.address.hostname}:{dst.address.rpc_port}")
-            await conn.call(RpcCode.SUBMIT_BLOCK_REPLICATION_JOB, data=pack({
-                "block_id": block_id,
-                "block_len": meta.len,
-                "source": src.address.to_wire(),
-            }), deadline=Deadline.after_ms(self.pull_budget_ms))
+            with span:
+                conn = await self.pool.get(
+                    f"{dst.address.ip_addr or dst.address.hostname}:{dst.address.rpc_port}")
+                await conn.call(
+                    RpcCode.SUBMIT_BLOCK_REPLICATION_JOB, data=pack({
+                        "block_id": block_id,
+                        "block_len": meta.len,
+                        "source": src.address.to_wire(),
+                    }), deadline=Deadline.after_ms(self.pull_budget_ms))
         except err.CurvineError as e:
             log.warning("replication submit for block %d to worker %d "
                         "failed: %s", block_id, dst.address.worker_id, e)

@@ -22,6 +22,7 @@ from curvine_tpu.master.mount import MountManager
 from curvine_tpu.master.replication import ReplicationManager
 from curvine_tpu.master.retry_cache import RetryCache
 from curvine_tpu.master.ttl import TtlManager
+from curvine_tpu.obs.trace import Tracer
 from curvine_tpu.rpc import Message, RpcCode, RpcServer, ServerConn
 from curvine_tpu.rpc.frame import pack, unpack
 
@@ -83,6 +84,18 @@ class MasterServer:
         # in-flight requests register at the DISPATCH level so a wedge
         # anywhere (fault hook, handler, commit barrier) is visible
         self.rpc.watchdog = self.watchdog
+        # observability plane: server spans per dispatch (trace context
+        # picked off the header) + per-code rpc.<name> histograms; the
+        # store additionally holds spans the CLIENTS push via
+        # METRICS_REPORT, so one GET_SPANS collect sees both
+        self.tracer = Tracer.from_conf("master", self.conf.obs,
+                                       metrics=self.metrics)
+        self.rpc.obs = self.tracer
+        self.rpc.metrics = self.metrics
+        self.replication.tracer = self.tracer
+        # pool for the GET_SPANS fan-out to workers (trace assembly)
+        from curvine_tpu.rpc.client import ConnectionPool
+        self._obs_pool = ConnectionPool(size=1)
         self.raft = None
         if mc.raft_peers:
             from curvine_tpu.master.ha import RaftLite
@@ -215,6 +228,7 @@ class MasterServer:
             t.cancel()
         self._bg.clear()
         await self.rpc.stop()
+        await self._obs_pool.close()
         if self.fs.journal:
             self.fs.journal.close()
         if self.fastmeta is not None:
@@ -255,6 +269,7 @@ class MasterServer:
         r(C.ASSIGN_WORKER, self._h(self._assign_worker))
         r(C.METRICS_REPORT, self._h(self._metrics_report))
         r(C.CLUSTER_HEALTH, self._h(self._cluster_health))
+        r(C.GET_SPANS, self._h(self._get_spans))
         # worker plane
         r(C.WORKER_HEARTBEAT, self._h(self._worker_heartbeat))
         r(C.WORKER_BLOCK_REPORT, self._h(self._worker_block_report))
@@ -292,7 +307,6 @@ class MasterServer:
         return req
 
     def _h(self, fn, mutate: bool = False):
-        metrics = self.metrics
         import inspect
 
         async def call(req):
@@ -302,24 +316,26 @@ class MasterServer:
             return rep
 
         async def handler(msg: Message, conn: ServerConn):
+            # per-code latency histograms moved to the dispatch level
+            # (RpcServer.metrics → rpc.<code_name>), uniform with the
+            # worker; this wrapper only keeps the mutation discipline
             req = self._norm_req(unpack(msg.data) or {})
-            with metrics.timer(f"rpc.{fn.__name__.lstrip('_')}"):
-                if mutate and self.raft is not None:
-                    self.raft.check_leader()
-                if mutate:
-                    key = (req.get("client_id"), req.get("call_id"))
-                    if key[0] is not None and key[1] is not None:
-                        cached = self.retry_cache.get(key)
-                        if cached is not None:
-                            return {}, cached
-                        rep = await call(req)
-                        await self._commit_barrier(msg.deadline)
-                        data = pack(rep)
-                        self.retry_cache.put(key, data)
-                        return {}, data
-                rep = await call(req)
-                if mutate:
+            if mutate and self.raft is not None:
+                self.raft.check_leader()
+            if mutate:
+                key = (req.get("client_id"), req.get("call_id"))
+                if key[0] is not None and key[1] is not None:
+                    cached = self.retry_cache.get(key)
+                    if cached is not None:
+                        return {}, cached
+                    rep = await call(req)
                     await self._commit_barrier(msg.deadline)
+                    data = pack(rep)
+                    self.retry_cache.put(key, data)
+                    return {}, data
+            rep = await call(req)
+            if mutate:
+                await self._commit_barrier(msg.deadline)
             return {}, pack(rep)
         return handler
 
@@ -624,11 +640,55 @@ class MasterServer:
         return {"worker": chosen[0].address.to_wire()}
 
     def _metrics_report(self, q):
-        """Clients push counters; aggregated into master metrics.
+        """Clients push counters (aggregated into master metrics) and
+        their finished trace spans (ingested into the master's span
+        store so trace assembly sees the client side of every request).
         Parity: RpcCode::MetricsReport."""
         for name, value in (q.get("counters") or {}).items():
             self.metrics.inc(f"client.{name}", value)
+        spans = q.get("spans")
+        if spans:
+            self.tracer.ingest(spans)
         return {}
+
+    def _get_spans(self, q):
+        """One trace's spans from this master's store; with
+        ``collect=True`` the request fans out to the workers too and
+        returns the merged set (web /api/trace and `cv trace` use
+        this)."""
+        tid = str(q.get("trace_id", ""))
+        if q.get("collect"):
+            return self.collect_trace(tid)        # awaited by _h
+        return {"spans": self.tracer.spans_for(tid)}
+
+    async def collect_trace(self, trace_id: str) -> dict:
+        """Merge this master's spans (incl. client-pushed ones) with
+        every serving worker's over GET_SPANS; a slow/dead worker costs
+        the collect timeout, never the assembly."""
+        spans = list(self.tracer.spans_for(trace_id))
+        timeout = self.conf.obs.trace_collect_timeout_ms / 1000.0
+        payload = pack({"trace_id": trace_id})
+
+        async def fetch(w):
+            a = w.address
+            conn = await self._obs_pool.get(
+                f"{a.ip_addr or a.hostname}:{a.rpc_port}")
+            rep = await conn.call(RpcCode.GET_SPANS, data=payload,
+                                  timeout=timeout)
+            return (unpack(rep.data) or {}).get("spans", [])
+
+        workers = self.fs.workers.serving_workers()
+        if workers:
+            results = await asyncio.wait_for(
+                asyncio.gather(*(fetch(w) for w in workers),
+                               return_exceptions=True),
+                timeout + 1.0)
+            for r in results:
+                if isinstance(r, list):
+                    spans.extend(r)
+                else:
+                    log.debug("span collect from a worker failed: %s", r)
+        return {"spans": spans}
 
     def _cluster_health(self, q):
         """Cluster-health rollup (monitor + watchdog snapshot).

@@ -21,6 +21,7 @@ from dataclasses import dataclass
 from typing import Any, AsyncIterator
 
 from curvine_tpu.common.errors import ConnectError, CurvineError, RpcTimeout
+from curvine_tpu.obs.trace import TRACE_KEY, current_ctx
 from curvine_tpu.rpc.deadline import DEADLINE_KEY, Deadline
 from curvine_tpu.rpc.frame import (
     FIXED_LEN, LEN_PREFIX, MAX_FRAME, Flags, Message, pack, unpack,
@@ -206,6 +207,12 @@ class Connection:
         if deadline is not None:
             deadline.check(f"rpc {msg.code} to {self.addr}")
             deadline.stamp(msg.header)
+        # trace propagation: the ambient span context (obs/trace.py)
+        # rides the header so the receiving server's span links to the
+        # span this request was made under — no per-call-site plumbing
+        ctx = current_ctx()
+        if ctx is not None and TRACE_KEY not in msg.header:
+            ctx.stamp(msg.header)
         if self.fault_hook is not None:
             if not await self.fault_hook(self.addr, msg):
                 return

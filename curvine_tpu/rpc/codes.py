@@ -67,6 +67,10 @@ class RpcCode(enum.IntEnum):
     # Parity: curvine-server/src/master/master_monitor.rs +
     # fs_dir_watchdog.rs — state, capacity, liveness, stuck-op sentinel
     CLUSTER_HEALTH = 61
+    # span collection (curvine_tpu/obs): fetch one trace's spans from a
+    # process's ring buffer; the master additionally fans the request
+    # out to workers when asked to collect (web /api/trace, `cv trace`)
+    GET_SPANS = 62
 
     # block interface (worker)
     WRITE_BLOCK = 80

@@ -18,6 +18,10 @@ from curvine_tpu.common.errors import CurvineError, ErrorCode
 from curvine_tpu.rpc.deadline import DEADLINE_KEY, Deadline  # noqa: F401
 # DEADLINE_KEY: reserved header field carrying the request's remaining
 # time budget in ms (rpc/deadline.py); restamped (decremented) per hop.
+from curvine_tpu.obs.trace import TRACE_KEY, SpanCtx  # noqa: F401
+# TRACE_KEY: reserved header field carrying the caller's trace context
+# [trace_id, span_id, sampled] (obs/trace.py); rides the same rail as
+# the deadline and is re-stamped with the local span id per hop.
 
 VERSION = 1
 # fixed metadata after the u32 frame length:
@@ -49,6 +53,9 @@ class Message:
     # server-side: the parsed deadline budget (set once at dispatch from
     # the DEADLINE_KEY header field; never serialized)
     deadline: "Deadline | None" = None
+    # server-side: the caller's trace context (set once at dispatch from
+    # the TRACE_KEY header field; never serialized)
+    trace: "SpanCtx | None" = None
 
     @property
     def is_response(self) -> bool:
@@ -68,6 +75,10 @@ class Message:
         Server dispatch calls this once and caches it on the message
         (``msg.deadline``) so handlers share one expiry point."""
         return Deadline.from_header(self.header)
+
+    def trace_ctx(self) -> "SpanCtx | None":
+        """The caller's trace context, if the request carries one."""
+        return SpanCtx.from_header(self.header)
 
     def check(self) -> "Message":
         """Raise the carried remote error, if any."""

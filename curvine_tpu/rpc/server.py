@@ -19,6 +19,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import socket
+import time
 from typing import Awaitable, Callable
 
 from curvine_tpu.common.errors import CurvineError
@@ -144,6 +145,13 @@ class RpcServer:
         # wedged dispatch (including one stalled in the fault hook) is
         # visible to the stuck-op sentinel (master/monitor.py)
         self.watchdog = None
+        # optional Tracer (curvine_tpu/obs): dispatch picks the caller's
+        # trace context off the header (msg.trace, same rail as the
+        # deadline) and records a server span per request
+        self.obs = None
+        # optional MetricsRegistry: per-code dispatch latency histograms
+        # (rpc.<code_name>), uniform across master and worker
+        self.metrics = None
 
     def register(self, code: int, handler: Handler) -> None:
         self._handlers[int(code)] = handler
@@ -338,9 +346,20 @@ class RpcServer:
 
     async def _dispatch(self, msg: Message, conn: ServerConn) -> None:
         handler = self._handlers.get(msg.code)
+        name = _code_name(msg.code)
         token = None
         if self.watchdog is not None:
-            token = self.watchdog.op_enter(_code_name(msg.code))
+            token = self.watchdog.op_enter(name)
+        # trace propagation: the caller's span context rides the header
+        # the same way the deadline does; this dispatch becomes a child
+        # span and sets the ambient context so the handler's own
+        # downstream calls (replication pulls, peer streams) carry it on
+        msg.trace = msg.trace_ctx()
+        span = None
+        if self.obs is not None:
+            span = self.obs.span(name, parent=msg.trace)
+            span.__enter__()
+        t0 = time.perf_counter()
         try:
             # deadline propagation: restart the caller's remaining budget
             # on our clock once; handlers that make downstream calls
@@ -371,8 +390,12 @@ class RpcServer:
             await conn.send(response_for(
                 msg, header=header, data=data, flags=Flags.RESPONSE | Flags.EOF))
         except asyncio.CancelledError:
+            if span is not None:
+                span.error("cancelled")
             raise
         except Exception as e:  # noqa: BLE001 — all errors cross the wire
+            if span is not None:
+                span.error(e)
             if not isinstance(e, CurvineError):
                 log.exception("%s handler error code=%s", self.name, msg.code)
             try:
@@ -380,6 +403,11 @@ class RpcServer:
             except Exception:
                 pass
         finally:
+            if span is not None:
+                span.__exit__(None, None, None)
+            if self.metrics is not None:
+                self.metrics.observe(f"rpc.{name}",
+                                     time.perf_counter() - t0)
             if token is not None:
                 self.watchdog.op_exit(token)
 

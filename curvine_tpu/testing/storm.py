@@ -85,6 +85,10 @@ class StormReport:
     leaked_tasks: list[str] = field(default_factory=list)
     degraded_read_s: float | None = None
     degraded_read_bound_s: float | None = None
+    # observability probe (trace_probe=True): violations collected here
+    trace_problems: list[str] = field(default_factory=list)
+    trace_span_count: int = 0
+    trace_error_spans: int = 0
     elapsed_s: float = 0.0
 
     @property
@@ -110,6 +114,8 @@ class StormReport:
             problems.append(
                 f"degraded read took {self.degraded_read_s:.2f}s "
                 f">= bound {self.degraded_read_bound_s:.2f}s")
+        if self.trace_problems:
+            problems.append(f"trace: {self.trace_problems}")
         assert not problems, (
             f"storm seed={self.seed} invariants violated: "
             + "; ".join(problems) + f" (events={self.events})")
@@ -129,6 +135,7 @@ class ChaosStorm:
                  converge_timeout_s: float = 25.0,
                  master_restarts: bool = True,
                  degraded_probe: bool = True,
+                 trace_probe: bool = False,
                  base_dir: str | None = None,
                  overall_timeout_s: float | None = None):
         self.seed = seed
@@ -145,6 +152,7 @@ class ChaosStorm:
         self.converge_timeout_s = converge_timeout_s
         self.master_restarts = master_restarts
         self.degraded_probe = degraded_probe
+        self.trace_probe = trace_probe
         self.base_dir = base_dir
         # self-watchdog: a wedged storm must FAIL with task stacks, not
         # hang the suite — any unbounded wait the chaos uncovers becomes
@@ -176,6 +184,9 @@ class ChaosStorm:
         cc.breaker_open_ms = 1_000
         cc.replicas = self.replicas
         cc.block_size = 1 * MB
+        if self.trace_probe:
+            # sample EVERY trace so failover paths are fully recorded
+            mc.conf.obs.trace_sample_rate = 1.0
 
     def _tune_master(self, mc: MiniCluster) -> None:
         mc.master.replication.scan_interval_s = 0.3
@@ -420,6 +431,76 @@ class ChaosStorm:
         finally:
             inj.remove(fid)
 
+    async def _probe_traced_failover(self, mc: MiniCluster) -> None:
+        """Observability invariants under chaos (docs/observability.md):
+
+        1. a sampled traced read against a replica wedged by a drop
+           fault completes via failover AND its trace records the
+           failed attempt as a ``status=error`` span — never a gap;
+        2. the master's span store does not leak across a master
+           restart: a fresh master starts with an EMPTY store (spans
+           are runtime telemetry, not journaled state)."""
+        if self.replicas < 2 or len(self._alive) < 2 or not self.acked:
+            return
+        path = sorted(self.acked)[0]
+        c = mc.client()                   # fresh client: cold breakers
+        fb = await c.meta.get_block_locations(path)
+        if not fb.block_locs or len(fb.block_locs[0].locs) < 2:
+            return
+        first = fb.block_locs[0].locs[0]
+        victim = next((i for i in self._alive
+                       if mc.workers[i].rpc.port == first.rpc_port), None)
+        if victim is None:
+            return
+        inj = self._winj[victim]
+        fid = inj.add(FaultSpec(kind="drop",
+                                codes=[int(RpcCode.READ_BLOCK),
+                                       int(RpcCode.GET_BLOCK_INFO)]))
+        data = None
+        root = c.tracer.start_trace("storm_traced_read", sampled=True)
+        try:
+            with root:
+                r = await c.open(path)
+                try:
+                    data = await r.read_all(deadline_ms=self.deadline_ms)
+                finally:
+                    await r.close()
+        except _EXPECTED as e:
+            self.report.trace_problems.append(
+                f"traced failover read of {path} failed: {e!r}")
+        finally:
+            inj.remove(fid)
+        tid = root.ctx.trace_id
+        if data is not None and \
+                hashlib.sha256(data).hexdigest() != self.acked[path]:
+            self.report.trace_problems.append(
+                f"traced failover read of {path}: wrong digest")
+        await c.flush_metrics()
+        spans = (await mc.master.collect_trace(tid))["spans"]
+        self.report.trace_span_count = len(spans)
+        errors = [s for s in spans if s.get("status") == "error"]
+        self.report.trace_error_spans = len(errors)
+        comps = {s.get("component") for s in spans}
+        if len(spans) < 3:
+            self.report.trace_problems.append(
+                f"traced failover read yielded only {len(spans)} spans")
+        if not errors:
+            self.report.trace_problems.append(
+                "wedged replica left NO error span (gap in the trace)")
+        if not {"client", "worker"} <= comps:
+            self.report.trace_problems.append(
+                f"trace missing components: got {comps}")
+        # ---- master-restart leak check ----
+        await mc.restart_master()
+        self._minj.install(mc.master.rpc)
+        self._tune_master(mc)
+        leaked = len(mc.master.tracer.store)
+        if leaked or mc.master.tracer.spans_for(tid):
+            self.report.trace_problems.append(
+                f"span store leaked across master restart "
+                f"({leaked} spans survived)")
+        await mc.await_workers(self.n_workers, timeout=15.0)
+
     # ---------------- driver ----------------
 
     async def _drive(self, mc: MiniCluster, workers: list,
@@ -454,6 +535,8 @@ class ChaosStorm:
         await self._verify_integrity(mc)
         if self.degraded_probe:
             await self._probe_degraded_read(mc)
+        if self.trace_probe:
+            await self._probe_traced_failover(mc)
 
     async def run(self) -> StormReport:
         t_start = time.monotonic()

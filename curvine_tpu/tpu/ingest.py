@@ -36,18 +36,28 @@ class DevicePrefetcher:
     device so the consumer never waits on the host→HBM copy."""
 
     def __init__(self, host_batches: Iterator[np.ndarray], mesh: Mesh | None,
-                 spec: P | None = None, depth: int = 2, device=None):
+                 spec: P | None = None, depth: int = 2, device=None,
+                 profiler=None):
         self.src = iter(host_batches)
         self.mesh = mesh
         self.spec = spec
         self.depth = max(1, depth)
         self.device = device
+        # optional StepProfiler (obs/profiler.py): host→HBM dispatch time
+        self.profiler = profiler
         self._queue: collections.deque[jax.Array] = collections.deque()
 
     def _transfer(self, batch: np.ndarray) -> jax.Array:
+        import time as _time
+        t0 = _time.perf_counter()
         if self.mesh is not None:
-            return put_sharded(batch, self.mesh, self.spec)
-        return jax.device_put(batch, self.device)
+            out = put_sharded(batch, self.mesh, self.spec)
+        else:
+            out = jax.device_put(batch, self.device)
+        if self.profiler is not None:
+            self.profiler.record("host_to_hbm",
+                                 _time.perf_counter() - t0, batch.nbytes)
+        return out
 
     def __iter__(self):
         return self
@@ -76,12 +86,17 @@ class AsyncDevicePrefetcher:
 
     def __init__(self, host_batches: AsyncIterator[np.ndarray],
                  mesh: Mesh | None, spec: P | None = None, depth: int = 2,
-                 device=None):
+                 device=None, profiler=None):
         self.src = host_batches
         self.mesh = mesh
         self.spec = spec
         self.depth = max(1, depth)
         self.device = device
+        # optional StepProfiler (obs/profiler.py): attributes each step
+        # to host→HBM transfer, compute_wait (producer blocked on a full
+        # queue — the MODEL is the bottleneck) and input_wait (consumer
+        # blocked on an empty queue — the DATA PIPELINE is)
+        self.profiler = profiler
         # maxsize bounds device memory: at most depth+1 batches resident
         # (depth queued, plus the one the blocked producer transferred
         # before put()) — size depth with that +1 in the HBM budget
@@ -91,14 +106,29 @@ class AsyncDevicePrefetcher:
         self._finished = False
 
     def _transfer(self, batch: np.ndarray) -> jax.Array:
+        import time as _time
+        t0 = _time.perf_counter()
         if self.mesh is not None:
-            return put_sharded(batch, self.mesh, self.spec)
-        return jax.device_put(batch, self.device)
+            out = put_sharded(batch, self.mesh, self.spec)
+        else:
+            out = jax.device_put(batch, self.device)
+        if self.profiler is not None:
+            self.profiler.record("host_to_hbm",
+                                 _time.perf_counter() - t0, batch.nbytes)
+        return out
 
     async def _produce(self) -> None:
+        import time as _time
         try:
             async for batch in self.src:
-                await self._queue.put(self._transfer(batch))
+                arr = self._transfer(batch)
+                t0 = _time.perf_counter()
+                await self._queue.put(arr)
+                if self.profiler is not None:
+                    # blocked put = the device queue is full = the step
+                    # function is the pipeline's long pole
+                    self.profiler.record("compute_wait",
+                                         _time.perf_counter() - t0)
         except asyncio.CancelledError:
             # aclose() initiated this — nobody is waiting for a
             # notification, and putting into a possibly-FULL queue here
@@ -122,13 +152,23 @@ class AsyncDevicePrefetcher:
             raise StopAsyncIteration
         if self._producer is None:
             self._producer = asyncio.ensure_future(self._produce())
-        item = await self._queue.get()
+        if self.profiler is not None:
+            import time as _time
+            t0 = _time.perf_counter()
+            item = await self._queue.get()
+            # blocked get = the queue ran dry = the data pipeline (cache
+            # fetch / decode / transfer) is the pipeline's long pole
+            self.profiler.record("input_wait", _time.perf_counter() - t0)
+        else:
+            item = await self._queue.get()
         if item is _DONE:
             self._finished = True
             raise StopAsyncIteration
         if isinstance(item, BaseException):
             self._error = item
             raise item
+        if self.profiler is not None:
+            self.profiler.step_done()
         return item
 
     async def aclose(self) -> None:

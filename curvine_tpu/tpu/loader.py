@@ -25,7 +25,7 @@ class CacheShardSource:
 
     def __init__(self, client: CurvineClient, path: str, batch: int,
                  seq_len: int, dtype=np.int32, shuffle_seed: int | None = None,
-                 drop_remainder: bool = True):
+                 drop_remainder: bool = True, profiler=None):
         self.client = client
         self.path = path
         self.batch = batch
@@ -33,6 +33,9 @@ class CacheShardSource:
         self.dtype = np.dtype(dtype)
         self.shuffle_seed = shuffle_seed
         self.drop_remainder = drop_remainder
+        # optional StepProfiler (obs/profiler.py): cache_fetch + decode
+        # stage timings per shard
+        self.profiler = profiler
 
     async def shards(self) -> list[str]:
         statuses = await self.client.meta.list_status(self.path)
@@ -43,9 +46,11 @@ class CacheShardSource:
         return files
 
     async def batches(self) -> AsyncIterator[np.ndarray]:
+        import time as _time
         tokens_per_batch = self.batch * self.seq_len
         carry = np.empty(0, dtype=self.dtype)
         for shard in await self.shards():
+            t0 = _time.perf_counter()
             reader = await self.client.open(shard)
             n_tokens = reader.len // self.dtype.itemsize
             view = await reader.mmap_view(0, n_tokens * self.dtype.itemsize)
@@ -54,9 +59,16 @@ class CacheShardSource:
             else:
                 raw = await reader.read_all()
                 data = np.frombuffer(raw, dtype=self.dtype)
+            if self.profiler is not None:
+                self.profiler.record("cache_fetch",
+                                     _time.perf_counter() - t0,
+                                     reader.len)
+            t0 = _time.perf_counter()
             if carry.size:
                 data = np.concatenate([carry, data])
                 carry = np.empty(0, dtype=self.dtype)
+            if self.profiler is not None:
+                self.profiler.record("decode", _time.perf_counter() - t0)
             usable = (data.size // tokens_per_batch) * tokens_per_batch
             for off in range(0, usable, tokens_per_batch):
                 yield data[off:off + tokens_per_batch].reshape(
@@ -89,16 +101,25 @@ class TpuTrainFeed:
     mesh 'data' (and 'seq') axes — the full cache→HBM→step pipeline."""
 
     def __init__(self, client: CurvineClient, path: str, batch: int,
-                 seq_len: int, mesh=None, depth: int = 2, dtype=np.int32):
+                 seq_len: int, mesh=None, depth: int = 2, dtype=np.int32,
+                 profiler=None):
         from jax.sharding import PartitionSpec as P
+        from curvine_tpu.obs.profiler import StepProfiler
         from curvine_tpu.tpu.ingest import AsyncDevicePrefetcher
-        self.source = CacheShardSource(client, path, batch, seq_len, dtype)
+        # one StepProfiler threads the whole pipeline: cache_fetch +
+        # decode from the shard source, host_to_hbm + compute_wait +
+        # input_wait from the device prefetcher. `feed.profiler.summary()`
+        # answers "where did the step go".
+        self.profiler = profiler if profiler is not None else StepProfiler()
+        self.source = CacheShardSource(client, path, batch, seq_len, dtype,
+                                       profiler=self.profiler)
         spec = None
         if mesh is not None:
             seq = "seq" if "seq" in mesh.axis_names else None
             spec = P("data", seq)
         self.prefetcher = AsyncDevicePrefetcher(
-            self.source.batches(), mesh, spec, depth=depth)
+            self.source.batches(), mesh, spec, depth=depth,
+            profiler=self.profiler)
 
     def __aiter__(self):
         return self.prefetcher

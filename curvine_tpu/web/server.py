@@ -72,6 +72,13 @@ class WebServer:
         # submit_loading_task): REST load-job submission + cancel
         r.add_post("/api/load", self._submit_load)
         r.add_post("/api/jobs/{job_id}/cancel", self._cancel_job)
+        # mount mutation plane: REST mount/umount delegating to the
+        # master's mount manager (parity: curvine-web mount handlers)
+        r.add_post("/api/mount", self._mount_create)
+        r.add_delete("/api/mount", self._mount_delete)
+        # observability: assembled span tree of one trace, collected
+        # from master + workers (docs/observability.md)
+        r.add_get("/api/trace/{trace_id}", self._trace)
         import os
         static_dir = os.path.join(os.path.dirname(__file__), "static")
         if os.path.isdir(static_dir):
@@ -123,6 +130,10 @@ class WebServer:
 
     async def _metrics(self, req):
         src = self.master or self.worker
+        tracer = getattr(src, "tracer", None)
+        if tracer is not None:
+            # span-store occupancy rides the same scrape
+            src.metrics.gauge("trace.spans_stored", len(tracer.store))
         if (self.master is not None
                 and getattr(self.master, "fastmeta", None) is not None):
             # native read plane counters ride the same scrape
@@ -266,3 +277,74 @@ class WebServer:
         except Exception as e:  # noqa: BLE001 — http boundary
             return web.Response(status=404, text=json.dumps(
                 {"error": str(e)}), content_type="application/json")
+
+    async def _mount_create(self, req):
+        """POST /api/mount {"cv_path", "ufs_path", "properties"?,
+        "auto_cache"?, "ttl_ms"?, "ttl_action"?, "storage_type"?,
+        "block_size"?, "replicas"?, "access_mode"?} → the MountInfo.
+        The REST face of `cv mount` (same MountManager path)."""
+        if self.master is None:
+            return self._json({"error": "not a master"})
+        try:
+            body = await req.json()
+        except Exception:  # noqa: BLE001 — malformed body is a 400
+            return web.Response(status=400, text=json.dumps(
+                {"error": "invalid JSON body"}),
+                content_type="application/json")
+        cv_path, ufs_path = body.get("cv_path"), body.get("ufs_path")
+        if not cv_path or not ufs_path:
+            return web.Response(status=400, text=json.dumps(
+                {"error": "cv_path and ufs_path required"}),
+                content_type="application/json")
+        try:
+            info = self.master.mounts.mount(
+                cv_path, ufs_path,
+                properties=body.get("properties") or {},
+                auto_cache=bool(body.get("auto_cache", False)),
+                write_type=int(body.get("write_type", 0)),
+                ttl_ms=int(body.get("ttl_ms", 0)),
+                ttl_action=int(body.get("ttl_action", 0)),
+                storage_type=body.get("storage_type", ""),
+                block_size=int(body.get("block_size", 0)),
+                replicas=int(body.get("replicas", 0)),
+                access_mode=body.get("access_mode", "rw"))
+            return self._json(info.to_wire())
+        except Exception as e:  # noqa: BLE001 — http boundary
+            return web.Response(status=400, text=json.dumps(
+                {"error": str(e)}), content_type="application/json")
+
+    async def _mount_delete(self, req):
+        """DELETE /api/mount?cv_path=/m (or JSON body {"cv_path"})."""
+        if self.master is None:
+            return self._json({"error": "not a master"})
+        cv_path = req.query.get("cv_path")
+        if not cv_path:
+            try:
+                cv_path = (await req.json()).get("cv_path")
+            except Exception:  # noqa: BLE001
+                cv_path = None
+        if not cv_path:
+            return web.Response(status=400, text=json.dumps(
+                {"error": "cv_path required"}),
+                content_type="application/json")
+        try:
+            self.master.mounts.umount(cv_path)
+            return self._json({"unmounted": cv_path})
+        except Exception as e:  # noqa: BLE001 — http boundary
+            return web.Response(status=404, text=json.dumps(
+                {"error": str(e)}), content_type="application/json")
+
+    async def _trace(self, req):
+        """GET /api/trace/<id>: spans collected from the master's store
+        (incl. client-pushed spans) + every worker over GET_SPANS,
+        assembled into a parent/child tree."""
+        if self.master is None:
+            return self._json({"error": "not a master"})
+        from curvine_tpu.obs.trace import assemble_tree
+        tid = req.match_info["trace_id"]
+        try:
+            spans = (await self.master.collect_trace(tid))["spans"]
+        except Exception as e:  # noqa: BLE001 — http boundary
+            return self._json({"error": str(e)})
+        return self._json({"trace_id": tid, "span_count": len(spans),
+                           "roots": assemble_tree(spans)})

@@ -44,6 +44,7 @@ import mmap
 import os
 import queue
 import threading
+import time
 from concurrent.futures import Future
 
 log = logging.getLogger(__name__)
@@ -304,7 +305,8 @@ class UringRing:
 # --------------------------------------------------------------------------
 
 class _Request:
-    __slots__ = ("fd", "offset", "length", "buf_addr", "future", "buffered")
+    __slots__ = ("fd", "offset", "length", "buf_addr", "future", "buffered",
+                 "t0")
 
     def __init__(self, fd: int, offset: int, length: int, buf_addr: int,
                  buffered: bool):
@@ -314,6 +316,9 @@ class _Request:
         self.buf_addr = buf_addr
         self.buffered = buffered
         self.future: Future = Future()
+        # submit timestamp: completion observes submit→complete latency
+        # into the worker's io.submit_to_complete histogram
+        self.t0 = time.perf_counter()
 
 
 class EngineShutdown(RuntimeError):
@@ -347,6 +352,11 @@ class DirectIOEngine:
         self.pool = BufferPool(min_size=max(64 * 1024, alignment),
                                per_class=self.queue_depth + 4)
         self._q: queue.Queue[_Request | None] = queue.Queue()
+        # optional MetricsRegistry (set by WorkerServer): completions
+        # observe submit→complete latency (io.submit_to_complete).
+        # Histogram mutation is dict arithmetic under the GIL — safe
+        # enough from the engine threads for metrics purposes.
+        self.metrics = None
         self._fds: dict[str, tuple[int, bool]] = {}   # path -> (fd, direct)
         self._fd_lock = threading.Lock()
         self._closed = False
@@ -578,6 +588,10 @@ class DirectIOEngine:
         req.future.set_result(got)
 
     def _complete(self, req: _Request, res: int) -> None:
+        m = self.metrics
+        if m is not None:
+            m.observe("io.submit_to_complete",
+                      time.perf_counter() - req.t0)
         if res < 0:
             with self.stats_lock:
                 self.counters["errors"] += 1

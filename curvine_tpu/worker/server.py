@@ -19,6 +19,7 @@ from curvine_tpu.common.types import (
     BlockState, JobState, StorageType, TaskInfo, WorkerAddress, WorkerInfo,
     now_ms,
 )
+from curvine_tpu.obs.trace import Tracer
 from curvine_tpu.rpc import Message, RpcCode, RpcServer, ServerConn
 from curvine_tpu.rpc.client import Connection, ConnectionPool
 from curvine_tpu.rpc.frame import Flags, pack, response_for, unpack
@@ -90,6 +91,15 @@ class WorkerServer:
         self.store = BlockStore(tiers, wc.eviction_high_water,
                                 wc.eviction_low_water)
         self.metrics = MetricsRegistry("worker")
+        # observability plane: server spans per dispatch + per-code
+        # rpc.<name> histograms; the io engine reports submit→complete
+        # latency into the same registry
+        self.tracer = Tracer.from_conf("worker", self.conf.obs,
+                                       metrics=self.metrics)
+        self.rpc.obs = self.tracer
+        self.rpc.metrics = self.metrics
+        if self.io_engine is not None:
+            self.io_engine.metrics = self.metrics
         self.master_pool = ConnectionPool(size=2)
         self.peer_pool = ConnectionPool(size=2)
         self.worker_id = worker_id if worker_id is not None else 0
@@ -445,6 +455,13 @@ class WorkerServer:
         r(RpcCode.HBM_UNPIN, self._hbm_unpin)
         r(RpcCode.SUBMIT_BLOCK_REPLICATION_JOB, self._replicate_block)
         r(RpcCode.SUBMIT_TASK, self._submit_task)
+        r(RpcCode.GET_SPANS, self._get_spans)
+
+    async def _get_spans(self, msg: Message, conn: ServerConn):
+        """This worker's recorded spans for one trace (master collect)."""
+        q = unpack(msg.data) or {}
+        return {}, pack({"spans":
+                         self.tracer.spans_for(str(q.get("trace_id", "")))})
 
     async def _write_block(self, msg: Message, conn: ServerConn):
         """Chunked upload: request header {block_id, storage_type, len_hint},
@@ -454,6 +471,11 @@ class WorkerServer:
         q = unpack(msg.data) or msg.header
         block_id = q["block_id"]
         hint = StorageType(q.get("storage_type", int(StorageType.MEM)))
+        # the dispatch span closes when this handler returns (chunks
+        # arrive later, in the receive loop's task); a manually-finished
+        # span covers the whole stream: request frame → EOF commit/error
+        wspan = self.tracer.span("write_block_stream", parent=msg.trace,
+                                 attrs={"block_id": block_id})
         info = self.store.create_temp(block_id, hint, q.get("len_hint", 0))
         inline_io = (info.tier.storage_type <= StorageType.MEM
                      and not info.is_extent)
@@ -513,11 +535,14 @@ class WorkerServer:
                     self.store.commit, block_id, state["total"],
                     checksum=state["crc"], checksum_algo="crc32")
                 self.metrics.inc("bytes.written", state["total"])
+                wspan.set_attr("bytes", state["total"])
+                wspan.finish()
                 await conn.send(response_for(msg, header={
                     "block_id": block_id, "len": state["total"],
                     "crc32": state["crc"], "worker_id": self.worker_id},
                     flags=Flags.RESPONSE | Flags.EOF))
             except Exception as e:  # noqa: BLE001 — surface to the client
+                wspan.error(e).finish()
                 conn.close_stream(msg.req_id)
                 try:
                     f.close()

@@ -7,6 +7,7 @@
 #
 # Usage: scripts/perf_smoke.sh [project_root]
 #   BENCH_ANN=0 skips the ANN gate (direct-IO only).
+#   BENCH_TRACE=0 skips the tracing-overhead gate.
 # Exit: 0 = at/above the regression gates, 1 = regression, 2 = harness error.
 
 set -u
@@ -55,6 +56,43 @@ print("perf_smoke: PASS")
 EOF
 rc=$?
 [ $rc -ne 0 ] && exit $rc
+
+if [ "${BENCH_TRACE:-1}" = "0" ]; then
+    echo "perf_smoke: tracing-overhead gate skipped (BENCH_TRACE=0)"
+else
+    # tracing-overhead gate: hot-path read QPS with 1% span sampling
+    # must stay within trace_overhead_pct_max of tracing-off
+    TRACE_OUT=$(JAX_PLATFORMS=cpu timeout 150 python - <<'EOF'
+import asyncio, json, os, sys
+sys.path.insert(0, os.getcwd())
+from bench import _trace_overhead_bench
+print(json.dumps(asyncio.run(_trace_overhead_bench())))
+EOF
+)
+    rc=$?
+    if [ $rc -ne 0 ] || [ -z "$TRACE_OUT" ]; then
+        echo "perf_smoke: tracing-overhead microbench failed (rc=$rc)" >&2
+        exit 2
+    fi
+    echo "$TRACE_OUT"
+    python - "$FLOOR_FILE" <<'EOF' "$TRACE_OUT"
+import json, sys
+floor_file, result = sys.argv[1], json.loads(sys.argv[2])
+ceiling = json.load(open(floor_file))["trace_overhead_pct_max"]
+pct = result.get("trace_overhead_pct", 100.0)
+print(f"perf_smoke: trace_overhead_pct={pct} ceiling={ceiling} "
+      f"(qps off={result.get('trace_read_qps_off')} "
+      f"on={result.get('trace_read_qps_on')})")
+if pct > ceiling:
+    print(f"perf_smoke: FAIL — tracing overhead {pct}% > {ceiling}% "
+          "at 1% sampling (hot-path instrumentation too heavy)",
+          file=sys.stderr)
+    sys.exit(1)
+print("perf_smoke: PASS")
+EOF
+    rc=$?
+    [ $rc -ne 0 ] && exit $rc
+fi
 
 if [ "${BENCH_ANN:-1}" = "0" ]; then
     echo "perf_smoke: ANN gate skipped (BENCH_ANN=0)"

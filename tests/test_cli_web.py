@@ -156,6 +156,66 @@ async def test_web_load_submit_rest():
             await web.stop()
 
 
+async def test_web_mount_rest():
+    """REST mount mutation plane: POST /api/mount and DELETE /api/mount
+    delegate to the master's mount manager — the REST face of
+    `cv mount`/`cv umount`, alongside the /api/load plane."""
+    import aiohttp
+    from curvine_tpu.ufs import create_ufs
+    from curvine_tpu.ufs import memory as memufs
+    from curvine_tpu.web.server import WebServer
+    memufs.reset()
+    async with MiniCluster(workers=1) as mc:
+        ufs = create_ufs("mem://mntbkt")
+        await ufs.write_all("mem://mntbkt/d/a.bin", b"M" * 2048)
+        c = mc.client()
+        web = WebServer(0, master=mc.master, host="127.0.0.1")
+        await web.start()
+        try:
+            base = f"http://127.0.0.1:{web.port}"
+            async with aiohttp.ClientSession() as s:
+                # mount over REST, then load + read through it
+                async with s.post(f"{base}/api/mount", json={
+                        "cv_path": "/wm2", "ufs_path": "mem://mntbkt",
+                        "auto_cache": True}) as r:
+                    assert r.status == 200
+                    m = await r.json()
+                    assert m["cv_path"] == "/wm2"
+                    assert m["ufs_path"] == "mem://mntbkt"
+                async with s.get(f"{base}/api/mounts") as r:
+                    assert any(x["cv_path"] == "/wm2"
+                               for x in await r.json())
+                assert await c.read_all("/wm2/d/a.bin") == b"M" * 2048
+                # duplicate mount → 400, not 500
+                async with s.post(f"{base}/api/mount", json={
+                        "cv_path": "/wm2",
+                        "ufs_path": "mem://other"}) as r:
+                    assert r.status == 400
+                # missing fields / malformed body → 400
+                async with s.post(f"{base}/api/mount",
+                                  json={"cv_path": "/x"}) as r:
+                    assert r.status == 400
+                async with s.post(f"{base}/api/mount",
+                                  data=b"not json") as r:
+                    assert r.status == 400
+                # umount via query param
+                async with s.delete(f"{base}/api/mount",
+                                    params={"cv_path": "/wm2"}) as r:
+                    assert r.status == 200
+                    assert (await r.json())["unmounted"] == "/wm2"
+                async with s.get(f"{base}/api/mounts") as r:
+                    assert not any(x["cv_path"] == "/wm2"
+                                   for x in await r.json())
+                # unknown mount → 404; missing cv_path → 400
+                async with s.delete(f"{base}/api/mount",
+                                    params={"cv_path": "/nope"}) as r:
+                    assert r.status == 404
+                async with s.delete(f"{base}/api/mount") as r:
+                    assert r.status == 400
+        finally:
+            await web.stop()
+
+
 async def test_web_dashboard_spa():
     """The static SPA (parity: curvine-web/webui Vue views) served by
     aiohttp and fed by the JSON API, driven against a MiniCluster."""

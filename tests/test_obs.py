@@ -1,0 +1,369 @@
+"""Observability plane: tracing primitives, propagation, collection,
+histogram interpolation, StepProfiler, and the MiniCluster e2e trace.
+
+docs/observability.md is the companion; the e2e test here is the
+acceptance criterion: one traced cached read assembles into a tree with
+spans from client, master AND worker, correct parent/child links, and
+monotone span intervals."""
+
+import asyncio
+import os
+
+import pytest
+
+from curvine_tpu.common.metrics import Histogram, MetricsRegistry
+from curvine_tpu.obs.profiler import StepProfiler
+from curvine_tpu.obs.trace import (
+    TRACE_KEY, SpanCtx, SpanStore, Tracer, assemble_tree, current_ctx,
+    render_tree,
+)
+from curvine_tpu.testing import MiniCluster
+
+KB = 1024
+
+
+# ---------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------
+
+def test_span_ctx_wire_roundtrip():
+    ctx = SpanCtx("ab12cd34ef56ab78", 0x1234, True)
+    hdr = ctx.stamp({})
+    assert TRACE_KEY in hdr
+    back = SpanCtx.from_header(hdr)
+    assert back.trace_id == ctx.trace_id
+    assert back.span_id == ctx.span_id
+    assert back.sampled is True
+    # absent / hostile headers are not traces
+    assert SpanCtx.from_header({}) is None
+    assert SpanCtx.from_header(None) is None
+    assert SpanCtx.from_header({TRACE_KEY: "garbage"}) is None
+    assert SpanCtx.from_header({TRACE_KEY: [1]}) is None
+
+
+def test_span_store_is_a_bounded_ring():
+    store = SpanStore(capacity=16)
+    for i in range(100):
+        store.append({"trace_id": f"t{i}", "span_id": i})
+    assert len(store) == 16
+    assert store.appended == 100
+    # oldest fell off the head
+    assert store.for_trace("t0") == []
+    assert store.for_trace("t99")
+    drained = store.drain(max_n=1000)
+    assert len(drained) == 16 and len(store) == 0
+
+
+def test_tracer_sampling_and_backstops():
+    m = MetricsRegistry("t")
+    tr = Tracer("client", sample_rate=0.0, slow_op_ms=10_000,
+                metrics=m)
+    # unsampled + ok + fast → dropped
+    with tr.span("op_ok"):
+        pass
+    assert len(tr.store) == 0
+    assert m.counters["trace.spans_dropped"] == 1
+    # unsampled but ERROR → always recorded
+    with pytest.raises(ValueError):
+        with tr.span("op_err"):
+            raise ValueError("boom")
+    spans = list(tr.store.drain())
+    assert len(spans) == 1 and spans[0]["status"] == "error"
+    assert "boom" in spans[0]["attrs"]["error"]
+    # unsampled but SLOW → always recorded (slow threshold 0.0s here)
+    slow = Tracer("client", sample_rate=0.0, slow_op_ms=0)
+    slow.slow_s = 1e-9
+    with slow.span("op_slow"):
+        pass
+    assert len(slow.store) == 1
+    # sampled=1.0 → recorded
+    full = Tracer("client", sample_rate=1.0)
+    with full.span("op"):
+        pass
+    assert len(full.store) == 1
+    # disabled → no-op spans, nothing recorded, no ambient ctx
+    off = Tracer("client", sample_rate=1.0, enabled=False)
+    with off.span("op") as sp:
+        assert sp.ctx is None
+        assert current_ctx() is None
+    assert len(off.store) == 0
+
+
+def test_ambient_context_nesting_and_inheritance():
+    tr = Tracer("client", sample_rate=1.0)
+    assert current_ctx() is None
+    with tr.start_trace("root", sampled=True) as root:
+        assert current_ctx() is root.ctx
+        with tr.span("child") as child:
+            assert child.ctx.trace_id == root.ctx.trace_id
+            assert child.parent_id == root.ctx.span_id
+            assert current_ctx() is child.ctx
+        assert current_ctx() is root.ctx
+    assert current_ctx() is None
+    spans = tr.store.for_trace(root.ctx.trace_id)
+    assert {s["op"] for s in spans} == {"root", "child"}
+    # an explicit wire parent wins over the ambient context
+    wire = SpanCtx("feedfeedfeedfeed", 77, True)
+    with tr.span("server_side", parent=wire) as sp:
+        assert sp.ctx.trace_id == "feedfeedfeedfeed"
+        assert sp.parent_id == 77
+
+
+def test_assemble_and_render_tree():
+    spans = [
+        {"trace_id": "t", "span_id": 1, "parent": 0, "component": "client",
+         "op": "read", "start": 1.0, "dur": 0.5, "status": "ok",
+         "attrs": {}},
+        {"trace_id": "t", "span_id": 2, "parent": 1, "component": "worker",
+         "op": "read_block", "start": 1.1, "dur": 0.3, "status": "ok",
+         "attrs": {}},
+        # orphan (parent never collected) surfaces as an extra root
+        {"trace_id": "t", "span_id": 9, "parent": 404, "component": "x",
+         "op": "stray", "start": 0.5, "dur": 0.1, "status": "ok",
+         "attrs": {}},
+    ]
+    roots = assemble_tree(spans)
+    assert len(roots) == 2
+    main = next(r for r in roots if r["span_id"] == 1)
+    assert [c["span_id"] for c in main["children"]] == [2]
+    text = render_tree(roots, "t")
+    assert "client:read" in text and "worker:read_block" in text
+    assert "3 spans" in text
+
+
+# ---------------------------------------------------------------------
+# histogram interpolation + overflow (satellite)
+# ---------------------------------------------------------------------
+
+def test_histogram_quantile_interpolates_within_bucket():
+    h = Histogram()
+    # 100 observations all inside the (0.05, 0.1] bucket
+    for _ in range(100):
+        h.observe(0.07)
+    p50 = h.quantile(0.5)
+    # old behavior returned the 0.1 upper bound exactly; interpolation
+    # must land strictly inside the bucket
+    assert 0.05 < p50 < 0.1
+    # spread across two buckets: median sits in the second's range
+    h2 = Histogram()
+    for _ in range(50):
+        h2.observe(0.02)     # (0.01, 0.025]
+    for _ in range(50):
+        h2.observe(0.2)      # (0.1, 0.25]
+    assert 0.01 < h2.quantile(0.25) <= 0.025
+    assert 0.1 < h2.quantile(0.75) <= 0.25
+
+
+def test_histogram_overflow_not_clamped_to_10s():
+    h = Histogram()
+    for _ in range(10):
+        h.observe(60.0)          # a minute — way past the 10s top bucket
+    assert h.overflow == 10
+    assert h.max == 60.0
+    # p99 of all-overflow observations must exceed the old 10.0 clamp
+    assert h.quantile(0.99) > 10.0
+    # mixed: fast ops + a slow tail — p50 stays fast, p99 sees the tail
+    h2 = Histogram()
+    for _ in range(95):
+        h2.observe(0.001)
+    for _ in range(5):
+        h2.observe(30.0)
+    assert h2.quantile(0.5) <= 0.001
+    assert h2.quantile(0.99) > 10.0
+    assert h2.overflow == 5
+    snap_reg = MetricsRegistry("x")
+    snap_reg.histograms["h"] = h2
+    snap = snap_reg.snapshot()["histograms"]["h"]
+    assert snap["overflow"] == 5 and snap["max"] == 30.0
+
+
+# ---------------------------------------------------------------------
+# StepProfiler
+# ---------------------------------------------------------------------
+
+def test_step_profiler_stages_and_summary():
+    p = StepProfiler()
+    p.record("cache_fetch", 0.010, nbytes=4096)
+    p.record("decode", 0.002)
+    p.record("host_to_hbm", 0.005, nbytes=4096)
+    p.record("compute_wait", 0.020)
+    with p.measure("input_wait"):
+        pass
+    p.step_done()
+    snap = p.snapshot()
+    assert snap["steps"] == 1
+    assert snap["stages"]["cache_fetch"]["bytes"] == 4096
+    assert snap["stages"]["compute_wait"]["count"] == 1
+    summary = p.summary()
+    fr = summary["fractions"]
+    assert abs(sum(fr.values()) - 1.0) < 1e-6
+    # compute_wait dominates this synthetic step
+    assert max(fr, key=fr.get) == "compute_wait"
+    text = p.prometheus_text()
+    assert "curvine_ingest_stage_compute_wait" in text
+    assert "curvine_ingest_steps 1" in text
+
+
+async def test_step_profiler_through_train_feed():
+    """The profiler wired through CacheShardSource +
+    AsyncDevicePrefetcher attributes real pipeline time."""
+    import numpy as np
+    from curvine_tpu.tpu.loader import TpuTrainFeed, write_token_shards
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        tokens = np.arange(4 * 64, dtype=np.int32)
+        await write_token_shards(c, "/prof", tokens, shard_tokens=128)
+        feed = TpuTrainFeed(c, "/prof", batch=2, seq_len=32, depth=1)
+        n = 0
+        async for _batch in feed:
+            n += 1
+        assert n == 4 * 64 // (2 * 32)
+        snap = feed.profiler.snapshot()
+        assert snap["steps"] == n
+        assert snap["stages"]["cache_fetch"]["count"] >= 2   # 2 shards
+        assert snap["stages"]["host_to_hbm"]["count"] == n
+        # one wait per step, plus the final get that returned DONE
+        assert snap["stages"]["input_wait"]["count"] >= n
+
+
+# ---------------------------------------------------------------------
+# e2e: the acceptance trace
+# ---------------------------------------------------------------------
+
+async def test_trace_e2e_cached_read(tmp_path):
+    """One traced cached read → /api/trace/<id> assembles ≥4 spans
+    across client, master and worker with correct parent/child links
+    and monotone intervals."""
+    import aiohttp
+    from curvine_tpu.web.server import WebServer
+    mc = MiniCluster(workers=1, base_dir=str(tmp_path))
+    mc.conf.obs.trace_sample_rate = 1.0
+    mc.conf.client.short_circuit = False   # exercise the worker RPC leg
+    await mc.start()
+    try:
+        c = mc.client()
+        await c.write_all("/obs/a.bin", b"t" * (256 * KB))
+        with c.tracer.start_trace("e2e_read", sampled=True) as root:
+            r = await c.open("/obs/a.bin")
+            try:
+                data = await r.read_all()
+            finally:
+                await r.close()
+        assert data == b"t" * (256 * KB)
+        tid = root.ctx.trace_id
+
+        spans = await c.get_trace(tid)
+        assert len(spans) >= 4
+        comps = {s["component"] for s in spans}
+        assert {"client", "master", "worker"} <= comps
+
+        by_id = {s["span_id"]: s for s in spans}
+        roots = [s for s in spans if s["parent"] not in by_id]
+        assert len(roots) == 1 and roots[0]["op"] == "e2e_read"
+        # parent/child links: the master span hangs off a client meta
+        # span; the worker span hangs off a client read_block span
+        master_span = next(s for s in spans if s["component"] == "master")
+        assert by_id[master_span["parent"]]["component"] == "client"
+        worker_span = next(s for s in spans
+                           if s["component"] == "worker")
+        assert by_id[worker_span["parent"]]["component"] == "client"
+        # monotone intervals: children start within (and after the
+        # start of) their parent's window; durations are non-negative
+        eps = 0.05
+        for s in spans:
+            assert s["dur"] >= 0.0
+            p = by_id.get(s["parent"])
+            if p is not None:
+                assert s["start"] >= p["start"] - eps
+                assert s["start"] + s["dur"] <= \
+                    p["start"] + p["dur"] + eps
+
+        # the web endpoint serves the assembled tree
+        web = WebServer(0, master=mc.master, host="127.0.0.1")
+        await web.start()
+        try:
+            base = f"http://127.0.0.1:{web.port}"
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"{base}/api/trace/{tid}") as resp:
+                    j = await resp.json()
+                    assert j["span_count"] >= 4
+                    assert len(j["roots"]) == 1
+                    assert j["roots"][0]["op"] == "e2e_read"
+                    assert j["roots"][0]["children"]
+                # span-store occupancy gauge rides /metrics
+                async with s.get(f"{base}/metrics") as resp:
+                    text = await resp.text()
+                    assert "curvine_master_trace_spans_stored" in text
+                    assert "curvine_master_rpc_get_block_locations" in text
+        finally:
+            await web.stop()
+    finally:
+        await mc.stop()
+
+
+async def test_trace_header_rides_the_wire(tmp_path):
+    """TRACE_KEY propagates exactly like deadline_ms: stamped by the
+    client under an active span, visible to server dispatch."""
+    async with MiniCluster(workers=1, base_dir=str(tmp_path)) as mc:
+        seen = {}
+
+        async def spy(server_name, msg):
+            if TRACE_KEY in msg.header:
+                seen[msg.code] = list(msg.header[TRACE_KEY])
+            return True
+
+        mc.master.rpc.fault_hook = spy
+        c = mc.client()
+        from curvine_tpu.rpc import RpcCode
+        # meta.call directly: exists() may detour to the native fast
+        # plane, which is a different (untraced) port
+        with c.tracer.start_trace("wire", sampled=True) as root:
+            await c.meta.call(RpcCode.EXISTS, {"path": "/"})
+        mc.master.rpc.fault_hook = None
+        got = seen.get(int(RpcCode.EXISTS))
+        assert got is not None, "trace context never crossed the wire"
+        assert got[0] == root.ctx.trace_id and got[2] == 1
+        # without an explicit root, the meta op heads its own trace and
+        # the (unsampled, rate=0) decision still propagates — standard
+        # head sampling: downstream error spans can link to the trace
+        seen.clear()
+        c.tracer.sample_rate = 0.0
+        mc.master.rpc.fault_hook = spy
+        await c.meta.call(RpcCode.EXISTS, {"path": "/"})
+        mc.master.rpc.fault_hook = None
+        got = seen.get(int(RpcCode.EXISTS))
+        assert got is not None and got[2] == 0
+
+
+async def test_traced_write_and_replication_fanout(tmp_path):
+    """A traced write links client → worker write_block_stream spans;
+    the master's replication fan-out roots its own trace that reaches
+    the destination worker AND the source peer."""
+    async with MiniCluster(workers=2, base_dir=str(tmp_path)) as mc:
+        mc.conf.obs.trace_sample_rate = 1.0
+        c = mc.client()
+        c.tracer.sample_rate = 1.0
+        c.conf.client.short_circuit = False
+        with c.tracer.start_trace("e2e_write", sampled=True) as root:
+            await c.write_all("/obsw/w.bin", os.urandom(64 * KB),
+                              replicas=1)
+        spans = await c.get_trace(root.ctx.trace_id)
+        ops = {(s["component"], s["op"]) for s in spans}
+        assert ("worker", "write_block_stream") in ops
+        assert ("master", "complete_file") in ops
+
+        # force an under-replicated block (desired 2, held once) and
+        # exercise the master's replication fan-out directly
+        mc.master.replication.tracer.sample_rate = 1.0
+        fb = await c.meta.get_block_locations("/obsw/w.bin")
+        bid = fb.block_locs[0].block.id
+        mc.master.fs.blocks.desired[bid] = 2
+        ok = await mc.master.replication._replicate(bid)
+        assert ok
+        tid = mc.master.replication.tracer.last_trace_id
+        assert tid is not None
+        await asyncio.sleep(0.2)        # let worker spans finish
+        spans = (await mc.master.collect_trace(tid))["spans"]
+        ops = {(s["component"], s["op"]) for s in spans}
+        assert ("master", "replicate_block") in ops
+        assert ("worker", "submit_block_replication_job") in ops

@@ -137,3 +137,81 @@ async def test_prefetch_correct_after_pattern_flips(tmp_path):
                 assert bytes(await r.pread_view(off, 4096)) == \
                     payload[off:off + 4096]
         await r.close()
+
+
+# ---------------- sparse/hole block reads ----------------
+
+async def test_hole_reads_serve_zeros(tmp_path):
+    """A file resized PAST its last written block has a tail hole with
+    no backing block; the cached read path serves it as zeros instead
+    of short-reading or erroring (parity: block_reader_hole.rs)."""
+    conf = ClusterConf()
+    conf.data_dir = str(tmp_path)
+    async with MiniCluster(workers=1, conf=conf, block_size=256 * 1024) as mc:
+        c = mc.client()
+        data = os.urandom(300 * 1024)       # 2 blocks: 256K + 44K
+        await c.write_all("/hole/f.bin", data)
+        # extend well past the last written block (hole spans a whole
+        # would-be third block and then some)
+        await c.meta.resize_file("/hole/f.bin", 900 * 1024)
+        st = await c.meta.file_status("/hole/f.bin")
+        assert st.len == 900 * 1024
+
+        r = await c.open("/hole/f.bin")
+        assert r.len == 900 * 1024
+        out = await r.read_all()
+        assert len(out) == 900 * 1024
+        assert out[:300 * 1024] == data
+        assert out[300 * 1024:] == b"\x00" * (600 * 1024)
+        # positional read fully inside the hole
+        assert await r.pread(500 * 1024, 4096) == b"\x00" * 4096
+        # pread_view straddling the data→hole boundary
+        v = await r.pread_view(296 * 1024, 8192)
+        assert bytes(v[:4096]) == data[296 * 1024:300 * 1024]
+        assert bytes(v[4096:]) == b"\x00" * 4096
+        # sharded parallel range covering data + hole
+        buf = await r.read_range(0, 900 * 1024, parallel=4)
+        assert bytes(buf) == out
+        assert r.counters.get("hole.bytes.read", 0) > 0
+        await r.close()
+
+        # the unified read path serves the hole too (a hole file still
+        # counts as fully cached: every EXISTING block has locations)
+        assert await c.read_all("/hole/f.bin") == out
+
+
+async def test_hole_survives_master_restart(tmp_path):
+    """The resize-extend journals like any mutation: the hole length
+    survives recovery."""
+    conf = ClusterConf()
+    conf.data_dir = str(tmp_path)
+    async with MiniCluster(workers=1, conf=conf, base_dir=str(tmp_path),
+                           block_size=128 * 1024) as mc:
+        c = mc.client()
+        await c.write_all("/hole/j.bin", b"j" * 1000)
+        await c.meta.resize_file("/hole/j.bin", 64 * 1024)
+        await mc.restart_master()
+        import asyncio
+        c2 = mc.client()
+        # block locations repopulate from the worker's report_now push
+        for _ in range(100):
+            fb = await c2.meta.get_block_locations("/hole/j.bin")
+            if all(lb.locs for lb in fb.block_locs):
+                break
+            await asyncio.sleep(0.05)
+        out = await c2.read_all("/hole/j.bin")
+        assert out == b"j" * 1000 + b"\x00" * (64 * 1024 - 1000)
+
+
+async def test_resize_shrink_still_works(tmp_path):
+    """Growing didn't break shrinking: blocks past the cut are dropped
+    and reads stop at the new length."""
+    conf = ClusterConf()
+    conf.data_dir = str(tmp_path)
+    async with MiniCluster(workers=1, conf=conf, block_size=128 * 1024) as mc:
+        c = mc.client()
+        data = os.urandom(300 * 1024)
+        await c.write_all("/hole/s.bin", data)
+        await c.meta.resize_file("/hole/s.bin", 100 * 1024)
+        out = await c.read_all("/hole/s.bin")
+        assert out == data[:100 * 1024]

@@ -56,6 +56,24 @@ async def test_storm_long_randomized(seed, tmp_path):
     assert report.acked_files > 3
 
 
+async def test_storm_trace_probe(tmp_path):
+    """Observability under chaos (docs/observability.md): a sampled
+    traced read that fails over a wedged replica records the failed
+    attempt as a status=error span (never a gap), and the master's span
+    store starts EMPTY after a master restart (no leak)."""
+    storm = ChaosStorm(13, workers=3, replicas=2, duration_s=1.0,
+                       event_interval_s=0.2, writer_tasks=2,
+                       reader_tasks=1, file_size=64 * 1024,
+                       degraded_probe=False, trace_probe=True,
+                       base_dir=str(tmp_path))
+    report = await storm.run()
+    report.assert_invariants()
+    assert report.trace_span_count >= 3, \
+        f"trace probe collected only {report.trace_span_count} spans"
+    assert report.trace_error_spans >= 1, \
+        "wedged replica attempt left no error span"
+
+
 def test_storm_bytes_deterministic():
     a = storm_bytes(7, "w0/f1", 1000)
     assert a == storm_bytes(7, "w0/f1", 1000)

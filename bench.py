@@ -315,6 +315,41 @@ async def _ann_smoke(n_rows: int = 100_000, dim: int = 128,
     return out
 
 
+async def _meta_smoke(n_create: int = 8_000, bs: int = 500) -> dict:
+    """Metadata write-plane gate for scripts/perf_smoke.sh: batched file
+    creates through the RPC + group-commit + KV-batch path on a journal-
+    less master (same shape as the full bench's meta_create_qps phase,
+    sized for CI). Returns {meta_create_qps} for perf_floor.json."""
+    from curvine_tpu.rpc import RpcCode
+    from curvine_tpu.testing import MiniCluster
+    base = os.path.join(_pick_shm_dir(), f"curvine-metasmoke-{os.getpid()}")
+    out: dict = {}
+    try:
+        async with MiniCluster(workers=0, base_dir=base,
+                               journal=False) as mc:
+            c = mc.client()
+            offs = list(range(0, n_create, bs))
+
+            async def create_batch(lo: int):
+                await c.meta.call(RpcCode.CREATE_FILES_BATCH, {"requests": [
+                    {"path": f"/smoke/crt/f{j:07d}", "overwrite": True,
+                     "block_size": 4 * MB, "replicas": 1,
+                     "client_name": c.meta.client_id}
+                    for j in range(lo, lo + bs)]}, mutate=True)
+
+            t0 = time.perf_counter()
+            for group in range(0, len(offs), 4):
+                await asyncio.gather(*(create_batch(lo)
+                                       for lo in offs[group:group + 4]))
+            out["meta_create_qps"] = round(
+                n_create / (time.perf_counter() - t0), 1)
+            await c.close()
+    finally:
+        import shutil
+        shutil.rmtree(base, ignore_errors=True)
+    return out
+
+
 async def run_bench(total_mb: int = 256, block_mb: int = 64,
                     latency_block_mb: int = 1, latency_iters: int = 200):
     import jax
@@ -466,6 +501,22 @@ async def run_bench(total_mb: int = 256, block_mb: int = 64,
                                    for lo in offs[group:group + 4]))
         results["meta_create_qps"] = n_create / (time.perf_counter() - t0)
         await c.meta.delete("/bench/crt", recursive=True)
+
+        # ---- META_BATCH: heterogeneous batched mutations (mkdir/create/
+        # delete in one RPC), the client-side half of group commit
+        t0 = time.perf_counter()
+        async def meta_batch_batch(lo: int):
+            await c.meta.meta_batch(
+                [{"op": "create", "path": f"/bench/crtb/f{j:07d}",
+                  "overwrite": True, "block_size": 4 * MB, "replicas": 1}
+                 for j in range(lo, lo + bs)])
+
+        for group in range(0, len(offs), 4):
+            await asyncio.gather(*(meta_batch_batch(lo)
+                                   for lo in offs[group:group + 4]))
+        results["meta_create_batch_qps"] = \
+            n_create / (time.perf_counter() - t0)
+        await c.meta.delete("/bench/crtb", recursive=True)
 
         # ---- native metadata read plane (C++ mirror, fast port) ----
         # the C++ load generator pipelines stats at the C++ server so
@@ -991,6 +1042,8 @@ def main(argv: list[str] | None = None):
         "pipeline_vs_link": round(results.get("pipeline_vs_link", 0), 3),
         "meta_qps": round(results.get("meta_qps", 0), 1),
         "meta_create_qps": round(results.get("meta_create_qps", 0), 1),
+        "meta_create_batch_qps": round(
+            results.get("meta_create_batch_qps", 0), 1),
         "meta_qps_native": round(results.get("meta_qps_native", 0), 1),
         "p99_block_fetch_ms": round(results["p99_block_fetch_ms"], 3),
         "p50_block_fetch_ms": round(results["p50_block_fetch_ms"], 3),

@@ -202,6 +202,23 @@ class FsClient:
         await self.call(RpcCode.DELETE,
                         {"path": path, "recursive": recursive}, mutate=True)
 
+    async def meta_batch(self, requests: list[dict]) -> list[dict]:
+        """Batched metadata mutations in ONE round trip. Each request is
+        ``{"op": "mkdir"|"create"|"delete", "path": ..., ...}``; the reply
+        list is positional, with per-item failures returned as
+        ``{"error", "error_code"}`` instead of raising."""
+        reqs = []
+        for r in requests:
+            r = dict(r)
+            if r.get("op") == "create":
+                r.setdefault("replicas", self.conf.client.replicas)
+                r.setdefault("block_size", self.conf.client.block_size)
+                r.setdefault("client_name", self.client_id)
+            reqs.append(r)
+        rep = await self.call(RpcCode.META_BATCH, {"requests": reqs},
+                              mutate=True)
+        return rep["responses"]
+
     async def rename(self, src: str, dst: str) -> bool:
         rep = await self.call(RpcCode.RENAME, {"src": src, "dst": dst},
                               mutate=True)

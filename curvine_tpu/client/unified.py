@@ -197,10 +197,16 @@ class CurvineClient:
         cc = self.conf.client
         st = _TIERS.get(storage_type or cc.storage_type, StorageType.MEM)
         paths = list(files)
-        await self.meta.call(RpcCode.CREATE_FILES_BATCH, {"requests": [
-            {"path": p, "overwrite": True, "block_size": cc.block_size,
-             "replicas": 1, "client_name": self.meta.client_id}
-            for p in paths]}, mutate=True)
+        # create phase rides META_BATCH: the whole create list lands in
+        # one journal group. Per-item errors fail the batch, matching the
+        # old CREATE_FILES_BATCH all-or-error behavior.
+        for r in await self.meta.meta_batch(
+                [{"op": "create", "path": p, "overwrite": True,
+                  "block_size": cc.block_size, "replicas": 1}
+                 for p in paths]):
+            if "error" in r:
+                raise err.CurvineError.from_wire(r.get("error_code", 0),
+                                                 r["error"])
         rep = await self.meta.call(RpcCode.ADD_BLOCKS_BATCH, {"requests": [
             {"path": p, "client_host": self.meta.client_host,
              "commit_blocks": [], "exclude_workers": []}

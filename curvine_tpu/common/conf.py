@@ -38,6 +38,12 @@ class MasterConf:
     # journal
     journal_dir: str = "data/journal"
     journal_fsync: bool = False   # fsync every WAL append (crash durability)
+    # group commit: coalesce concurrent mutations into one journal flush
+    # + one KV batch. Idle ops commit immediately; under load the window
+    # lingers up to journal_group_commit_ms (0 = no linger, still batches
+    # whatever is runnable) capped at journal_group_max entries per group.
+    journal_group_commit_ms: float = 1.0
+    journal_group_max: int = 1024
     snapshot_interval_entries: int = 100_000
     # heartbeats
     worker_heartbeat_ms: int = 3_000

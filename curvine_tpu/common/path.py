@@ -96,4 +96,11 @@ def norm_path(p: "str | Path") -> str:
     """Normalize a user-supplied path to its in-namespace form (no scheme)."""
     if isinstance(p, Path):
         return p.path
+    # fast path: already-normal absolute paths (the overwhelmingly common
+    # RPC case) skip the Path parse — batched metadata ops normalize
+    # every sub-request path, so this is hot at namespace-bench rates
+    if (len(p) > 1 and p[0] == "/" and p[-1] != "/"
+            and "//" not in p and "/./" not in p and "/../" not in p
+            and not p.endswith(("/.", "/.."))):
+        return p
     return Path(p).path

@@ -23,7 +23,6 @@ rebuild's native read plane over the Python mutation plane.
 
 from __future__ import annotations
 
-import copy
 import ctypes
 import logging
 import os
@@ -214,7 +213,15 @@ class MirroredStore:
         # mirror must track it eagerly too; the KV store's pending
         # overlay commits per journal entry, so buffer until then
         self._eager = inner.kind == "mem"
-        self._buf: list[tuple] = []
+        self._buf: list[tuple] = []        # current entry's mirror ops
+        self._staged_buf: list[tuple] = []  # earlier group entries' ops
+        # bind the hot read-only delegates once: path resolution calls
+        # get/child_get per component, and __getattr__ dispatch is
+        # measurable at namespace-bench rates
+        for m in ("get", "child_get", "children_of", "get_counter",
+                  "set_counter", "bump_counter"):
+            if hasattr(inner, m):
+                setattr(self, m, getattr(inner, m))
 
     # -- attribute passthrough (blocks, mounts, jobs, counters, ...) --
     def __getattr__(self, name):
@@ -234,7 +241,12 @@ class MirroredStore:
     def _apply_one(self, op: tuple) -> None:
         kind = op[0]
         if kind == "put":
-            self._mirror.put_inode(op[1])
+            node = op[1]
+            if isinstance(node, int):       # kv mode: id capture
+                node = self._inner.get(node)
+                if node is None:            # deleted later in the group
+                    return
+            self._mirror.put_inode(node)
         elif kind == "del":
             self._mirror.remove_inode(op[1])
         elif kind == "cput":
@@ -248,11 +260,12 @@ class MirroredStore:
 
     def put(self, inode, new: bool = False) -> None:
         self._inner.put(inode, new=new)
-        # snapshot the fields NOW (kv mode defers; the object may be
-        # mutated again before commit — the last put wins either way,
-        # but a buffered reference could also be mutated by a LATER
-        # failed apply that rolls back, so copy at capture time)
-        self._op(("put", copy.copy(inode) if not self._eager else inode))
+        # kv mode captures only the id: _flush runs after commit_applied,
+        # so reading the inode back from the inner store yields exactly
+        # the committed state — no per-put copy (a buffered object
+        # reference could be mutated by a later failed apply), and puts
+        # of the same inode dedupe naturally
+        self._op(("put", inode if self._eager else inode.id))
 
     def remove(self, inode_id: int) -> None:
         self._inner.remove(inode_id)
@@ -275,6 +288,15 @@ class MirroredStore:
         self._op(("mdel", cv_path))
 
     # -- commit surface --
+    # Two-level buffering mirrors the store's group-commit overlay:
+    # stage_entry moves the entry's ops to _staged_buf so a LATER entry's
+    # rollback() (which clears only _buf) can't drop them.
+    def stage_entry(self) -> None:
+        self._inner.stage_entry()
+        if self._buf:
+            self._staged_buf.extend(self._buf)
+            self._buf.clear()
+
     def commit_applied(self, seq: int) -> None:
         self._inner.commit_applied(seq)
         self._flush()
@@ -287,12 +309,39 @@ class MirroredStore:
         self._inner.rollback()
         self._buf.clear()
 
-    def _flush(self) -> None:
-        for op in self._buf:
-            self._apply_one(op)
+    def rollback_group(self) -> None:
+        self._inner.rollback_group()
         self._buf.clear()
+        self._staged_buf.clear()
+
+    def _flush(self) -> None:
+        ops = self._staged_buf + self._buf
+        self._staged_buf.clear()
+        self._buf.clear()
+        if len(ops) > 1:
+            # last-wins per logical key: a group of N creates in one dir
+            # puts the parent inode N times — the mirror only needs the
+            # final state (ops are independent upserts, so cross-key
+            # order is irrelevant)
+            last: dict[tuple, tuple] = {}
+            for op in ops:
+                k = op[0]
+                if k == "put":
+                    v = op[1]
+                    key = ("i", v if isinstance(v, int) else v.id)
+                elif k == "del":
+                    key = ("i", op[1])
+                elif k in ("cput", "cdel"):
+                    key = ("c", op[1], op[2])
+                else:
+                    key = ("m", op[1])
+                last[key] = op
+            ops = list(last.values())
+        for op in ops:
+            self._apply_one(op)
 
     def clear(self) -> None:
         self._inner.clear()
         self._buf.clear()
+        self._staged_buf.clear()
         self._mirror.clear()

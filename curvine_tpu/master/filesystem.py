@@ -34,6 +34,10 @@ from curvine_tpu.master.worker_map import WorkerMap
 
 log = logging.getLogger(__name__)
 
+# default storage policy in wire form, hoisted off the create hot path
+# (copied per entry — journal args must never share mutable state)
+_DEFAULT_POLICY_WIRE = StoragePolicy().to_wire()
+
 
 class MasterFilesystem:
     def __init__(self, journal: Journal | None = None,
@@ -62,6 +66,11 @@ class MasterFilesystem:
         self.on_worker_lost = None  # hook: ReplicationManager
         self.on_mutation = None     # hook: RaftLite journal replication
         self.acl = None             # set by AclEnforcer (permission checks)
+        # GroupCommitter (common/journal.py), installed by MasterServer:
+        # when present, _log journals unflushed + stages KV writes; the
+        # RPC handler awaits committer.sync() before replying.
+        self.committer = None
+        self._walk_hint = None          # leader-local walk pass-through
         self.start_ms = now_ms()
 
     @property
@@ -84,17 +93,24 @@ class MasterFilesystem:
                 applied = snap_seq
                 self.store.commit_applied(applied)
             replayed = 0
+            tail_seq = applied
             for seq, op, args, _term in entries:
                 if seq <= applied:
                     continue
                 try:
                     self._apply(op, args)
-                    self.store.commit_applied(seq)
+                    self.store.stage_entry()
                 except err.CurvineError as e:
                     self.store.rollback()
-                    self.store.commit_applied(seq)
                     log.warning("journal replay: %s(%s) -> %s", op, args, e)
+                tail_seq = seq
                 replayed += 1
+                # batched replay: one KV write_batch per ~4096 entries
+                # makes restart cost track the group-commit write path
+                if replayed % 4096 == 0:
+                    self.store.commit_applied(tail_seq)
+            if tail_seq > applied:
+                self.store.commit_applied(tail_seq)
             self.journal.seq = max(self.journal.seq, applied)
             log.info("kv recovery: %d inodes, %d blocks, applied_seq=%d, "
                      "replayed %d tail entries",
@@ -120,23 +136,39 @@ class MasterFilesystem:
         # Mutations are validated before journaling; if an apply still
         # fails, on_mutation fires anyway so follower seqs stay contiguous
         # (followers fail the same deterministic way and skip the entry).
+        #
+        # Group commit: with a committer installed, the journal write is
+        # buffered (flush=False) and the entry's KV effects are STAGED,
+        # not committed — the committer later syncs the journal and lands
+        # the whole group as one KV batch. Durability therefore moves to
+        # committer.sync(), which the RPC handler awaits before replying;
+        # validate→journal→apply is one synchronous stretch on the actor
+        # loop, so applied state is visible to later ops immediately.
+        grouped = self.committer is not None and self.committer.accepting
         seq = None
         if self.journal is not None:
-            seq = self.journal.append(op, args)
+            seq = self.journal.append(op, args, flush=not grouped)
         try:
             result = self._apply(op, args)
         except BaseException:
             if self._kv:
                 self.store.rollback()
-                if seq is not None:
+                if seq is not None and not grouped:
                     self.store.commit_applied(seq)
+            if grouped:
+                self.committer.note()
             if seq is not None and self.on_mutation is not None:
                 self.on_mutation(seq, op, args, self.journal.last_term)
             raise
         if self._kv:
-            self.store.commit_applied(
-                seq if seq is not None
-                else self.store.get_counter("applied_seq", 0))
+            if grouped:
+                self.store.stage_entry()
+            else:
+                self.store.commit_applied(
+                    seq if seq is not None
+                    else self.store.get_counter("applied_seq", 0))
+        if grouped:
+            self.committer.note()
         if self.audit_log:
             from curvine_tpu.common.logging import audit
             audit.log(op, str(args.get("path", args.get("src", ""))))
@@ -150,30 +182,41 @@ class MasterFilesystem:
 
     def apply_replicated(self, seq: int, op: str, args: dict,
                          term: int) -> None:
-        """Follower-side apply of a leader-streamed entry: journal first
-        (WAL), then apply, then commit the KV batch under the entry seq —
-        the same discipline as the leader's _log. ANY failure rolls back
-        the pending overlay (a partial apply must never ride the next
-        entry's atomic batch); applies are deterministic, so the leader
-        failed the same way."""
+        self.apply_replicated_batch([(seq, op, args, term)])
+
+    def apply_replicated_batch(
+            self, entries: list[tuple[int, str, dict, int]]) -> None:
+        """Follower-side apply of a leader-streamed batch: journal the
+        WHOLE batch with ONE flush (WAL), then apply in order, then land
+        the group's KV effects as one atomic batch under the tail seq —
+        the follower-side half of group commit. Per-entry failures are
+        deterministic (the leader failed identically): roll back that
+        entry's pending writes, keep the rest of the batch. CancelledError
+        propagates — a cancelled handler must NOT mark entries applied
+        (the journal has the batch; restart replays it)."""
         assert self.journal is not None
-        self.journal.append(op, args, term=term)
+        if not entries:
+            return
+        self.journal.append_batch([(op, args, term)
+                                   for _seq, op, args, term in entries])
         try:
-            self._apply(op, args)
-        except Exception as e:
-            # deterministic failures only: the leader failed identically.
-            # CancelledError propagates — a cancelled handler must NOT
-            # mark the entry applied (the journal has it; restart replays)
-            if self._kv:
-                self.store.rollback()
-            lvl = log.warning if isinstance(e, err.CurvineError) else log.error
-            lvl("follower apply %s failed: %s", op, e)
+            for _seq, op, args, _term in entries:
+                try:
+                    self._apply(op, args)
+                    if self._kv:
+                        self.store.stage_entry()
+                except Exception as e:
+                    if self._kv:
+                        self.store.rollback()
+                    lvl = (log.warning if isinstance(e, err.CurvineError)
+                           else log.error)
+                    lvl("follower apply %s failed: %s", op, e)
         except BaseException:
             if self._kv:
-                self.store.rollback()
+                self.store.rollback_group()
             raise
         if self._kv:
-            self.store.commit_applied(seq)
+            self.store.commit_applied(entries[-1][0])
 
     def install_snapshot(self, state: dict, seq: int, last_term: int) -> None:
         """Replace the whole state machine (HA catch-up / divergence heal)."""
@@ -189,9 +232,16 @@ class MasterFilesystem:
             self.journal.note_term(seq, last_term)
             self.journal.write_snapshot(state)
 
+    def flush_group(self) -> None:
+        """Commit any open journal group inline. Snapshot scans, restarts
+        and direct-KV reads must not observe staged-but-unflushed state."""
+        if self.committer is not None:
+            self.committer.flush_sync()
+
     def checkpoint(self) -> None:
         if self.journal is None:
             return
+        self.flush_group()
         if self._kv:
             # KV mode: the store IS the checkpoint. Flush the memtable and
             # drop journal segments fully covered by applied_seq — no full
@@ -204,6 +254,7 @@ class MasterFilesystem:
 
     def _snapshot_state(self) -> dict:
         """Full-state dump (HA snapshot transfer / mem-mode checkpoints)."""
+        self.flush_group()
         ch_map: dict[int, dict[str, int]] = {}
         for pid, name, cid in self.store.iter_children_all():
             ch_map.setdefault(pid, {})[name] = cid
@@ -344,46 +395,62 @@ class MasterFilesystem:
                     owner: str = "root", group: str = "root",
                     client_name: str = "", x_attr: dict | None = None,
                     storage_policy: dict | None = None,
-                    file_type: int = int(FileType.FILE)) -> FileStatus:
+                    file_type: int = int(FileType.FILE),
+                    walked: tuple | None = None) -> FileStatus:
         # cache-warming loads mark themselves with the ufs_mtime they
         # observed; those creates are allowed on read-only mounts
         caching = bool((storage_policy or {}).get("ufs_mtime"))
         self._mount_write_guard(path, caching=caching)
-        existing = self.tree.resolve(path)
+        # one walk replaces resolve + check_parent_dirs + resolve_parent;
+        # the RPC layer passes its acl/quota walk through (same
+        # synchronous actor-loop stretch, so the tree cannot change
+        # between the two)
+        parent, _name, existing = walked or self.tree.walk_parent(path)
         if existing is not None:
             if existing.is_dir:
                 raise err.IsADirectory(path)
             if not overwrite:
                 raise err.FileAlreadyExists(path)
-        self.tree.check_parent_dirs(path)
-        parent, _name = self.tree.resolve_parent(path)
         if parent is None and not create_parent:
             raise err.FileNotFound(f"parent of {path} not found")
-        return self._log("create", dict(
-            path=path, overwrite=overwrite, create_parent=create_parent,
-            replicas=replicas, block_size=block_size, mode=mode, owner=owner,
-            group=group, client_name=client_name, x_attr=x_attr or {},
-            storage_policy=storage_policy or StoragePolicy().to_wire(),
-            file_type=file_type))
+        # leader fast path: hand the validated walk to _apply_create
+        # (nothing runs between here and the apply — same synchronous
+        # stretch). NOT journaled: replay and followers re-walk.
+        self._walk_hint = (parent, _name, existing)
+        try:
+            return self._log("create", dict(
+                path=path, overwrite=overwrite, create_parent=create_parent,
+                replicas=replicas, block_size=block_size, mode=mode,
+                owner=owner, group=group, client_name=client_name,
+                x_attr=x_attr or {},
+                storage_policy=storage_policy or dict(_DEFAULT_POLICY_WIRE),
+                file_type=file_type))
+        finally:
+            self._walk_hint = None
 
     def _apply_create(self, path: str, overwrite: bool, create_parent: bool,
                       replicas: int, block_size: int, mode: int, owner: str,
                       group: str, client_name: str, x_attr: dict,
                       storage_policy: dict, file_type: int) -> FileStatus:
-        existing = self.tree.resolve(path)
+        hint, self._walk_hint = self._walk_hint, None
+        parent, name, existing = hint if hint is not None \
+            else self.tree.walk_parent(path)
         if existing is not None:
-            p, n = self.tree.resolve_parent(path)
-            self._delete_inode(existing, recursive=False, parent=p, name=n)
-        parent, name = self.tree.resolve_parent(path)
+            self._delete_inode(existing, recursive=False, parent=parent,
+                               name=name)
         if parent is None:
             parent, _ = self.tree.mkdirs("/".join(path.split("/")[:-1]) or "/")
         if not parent.is_dir:
             raise err.NotADirectory(self.tree.path_of(parent))
+        ts = now_ms()
+        # the wire->object parse is hot; the overwhelmingly common case
+        # is the default policy, which the default ctor builds cheaper
+        sp = StoragePolicy() if storage_policy == _DEFAULT_POLICY_WIRE \
+            else StoragePolicy.from_wire(storage_policy)
         node = Inode(id=self.tree._alloc_id(), name=name,
                      file_type=FileType(file_type), parent_id=parent.id,
-                     mtime=now_ms(), atime=now_ms(), owner=owner, group=group,
-                     mode=mode, x_attr=dict(x_attr),
-                     storage_policy=StoragePolicy.from_wire(storage_policy),
+                     mtime=ts, atime=ts, owner=owner, group=group,
+                     mode=mode, x_attr=dict(x_attr), storage_policy=sp,
                      replicas=replicas, block_size=block_size,
                      is_complete=False, client_name=client_name)
         self.tree.add_child(parent, node)

@@ -469,15 +469,24 @@ class RaftLite:
                         if self.fs.journal else 0)
                 if ours is None or ours != q.get("prev_term", 0):
                     need_snapshot = True
+        # collect the contiguous suffix of new entries, then journal +
+        # apply them as ONE batch (one follower-side flush per RPC — the
+        # follower half of group commit)
+        batch: list[tuple[int, str, dict, int]] = []
+        nxt = self.last_seq() + 1
         for rec in ([] if need_snapshot else entries):
             seq, op, args = rec[0], rec[1], rec[2]
             eterm = rec[3] if len(rec) > 3 else 0
-            if seq <= self.last_seq():
+            if seq < nxt:
                 continue                      # already have it
-            if seq != self.last_seq() + 1:
+            if seq != nxt:
                 need_snapshot = True          # gap: ask for catch-up
+                batch = []
                 break
-            self.fs.apply_replicated(seq, op, args, eterm)
+            batch.append((seq, op, args, eterm))
+            nxt += 1
+        if batch:
+            self.fs.apply_replicated_batch(batch)
         # log-matching check: same head seq must mean same head term; a
         # follower that diverged (e.g. deposed leader with extra applied
         # entries, or a different term at the same seq) takes a snapshot

@@ -82,14 +82,10 @@ class InodeTree:
 
     # -- id allocation (journaled via op replay determinism) --
     def _alloc_id(self) -> int:
-        i = self.store.get_counter("next_id", ROOT_ID + 1)
-        self.store.set_counter("next_id", i + 1)
-        return i
+        return self.store.bump_counter("next_id", 1, ROOT_ID + 1)
 
     def alloc_block_id(self) -> int:
-        b = self.store.get_counter("next_block_id", 1)
-        self.store.set_counter("next_block_id", b + 1)
-        return b
+        return self.store.bump_counter("next_block_id", 1, 1)
 
     @property
     def root(self) -> Inode:
@@ -128,6 +124,32 @@ class InodeTree:
                 return None, comps[-1]
             node = self.store.get(cid)
         return node, comps[-1]
+
+    def walk_parent(self, path: str) -> tuple[Inode | None, str, Inode | None]:
+        """ONE walk for the create/mkdir hot path: (parent, name, existing).
+
+        Replaces the resolve + check_parent_dirs + resolve_parent triple
+        (3 full-path walks -> 1) on the metadata write plane. `parent` is
+        None when an intermediate component is missing; an existing
+        intermediate that is a file raises NotADirectory (same contract
+        as check_parent_dirs); `existing` is the inode already at `path`,
+        if any."""
+        comps = _components(path)
+        if not comps:
+            return None, "", self.root
+        node = self.root
+        for i, comp in enumerate(comps[:-1]):
+            cid = self.store.child_get(node.id, comp)
+            if cid is None:
+                return None, comps[-1], None
+            node = self.store.get(cid)
+            if node is None:
+                return None, comps[-1], None
+            if not node.is_dir:
+                raise err.NotADirectory(f"/{'/'.join(comps[:i + 1])} is a file")
+        cid = self.store.child_get(node.id, comps[-1])
+        existing = self.store.get(cid) if cid is not None else None
+        return node, comps[-1], existing
 
     def check_parent_dirs(self, path: str) -> None:
         """Raise NotADirectory if any existing intermediate component is a

@@ -104,11 +104,19 @@ class QuotaManager:
                 if node.parent_id else None
 
     def check_create(self, path: str, new_bytes: int = 0,
-                     new_files: int = 1) -> None:
-        """Walk ancestors of `path`; any quota'd dir must have room."""
-        parent, _ = self.fs.tree.resolve_parent(path)
+                     new_files: int = 1, parent=None) -> None:
+        """Walk ancestors of `path`; any quota'd dir must have room.
+        Callers that already resolved the parent pass it to skip the
+        path walk (create hot path)."""
+        if parent is None:
+            parent, _ = self.fs.tree.resolve_parent(path)
         node = parent
         while node is not None:
+            xa = node.x_attr
+            if not xa or (QUOTA_BYTES not in xa and QUOTA_FILES not in xa):
+                node = self.fs.tree.get(node.parent_id) \
+                    if node.parent_id else None
+                continue
             qb = _int_attr(node, QUOTA_BYTES)
             qf = _int_attr(node, QUOTA_FILES)
             if qb is not None or qf is not None:

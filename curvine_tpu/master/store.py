@@ -143,6 +143,12 @@ class MemMetaStore:
     def set_counter(self, name: str, value: int) -> None:
         self.counters[name] = value
 
+    def bump_counter(self, name: str, delta: int, default: int = 0) -> int:
+        """Add ``delta`` and return the PRIOR value (fused get+set)."""
+        cur = self.counters.get(name, default)
+        self.counters[name] = cur + delta
+        return cur
+
     # transaction surface (no-ops in RAM)
     def commit_applied(self, seq: int) -> None:
         self.counters["applied_seq"] = seq
@@ -151,6 +157,12 @@ class MemMetaStore:
         pass
 
     def rollback(self) -> None:
+        pass
+
+    def stage_entry(self) -> None:
+        pass
+
+    def rollback_group(self) -> None:
         pass
 
     def flush(self) -> None:
@@ -170,28 +182,38 @@ class MemMetaStore:
 
 
 def _enc_inode(node) -> bytes:
-    return msgpack.packb({
-        "id": node.id, "n": node.name, "ft": int(node.file_type),
-        "p": node.parent_id, "mt": node.mtime, "at": node.atime,
-        "o": node.owner, "g": node.group, "md": node.mode,
-        "x": node.x_attr, "sp": node.storage_policy.to_wire(),
-        "nl": node.nlink, "ln": node.len, "bs": node.block_size,
-        "rp": node.replicas, "bl": node.blocks, "dn": node.is_complete,
-        "tg": node.target, "cn": node.children_num, "cl": node.client_name,
-    }, use_bin_type=True)
+    # positional frame (v2): packing 20 key strings per inode was
+    # measurable on the create hot path; the leading None tags the
+    # format (legacy frames are maps)
+    return msgpack.packb([
+        None, node.id, node.name, int(node.file_type), node.parent_id,
+        node.mtime, node.atime, node.owner, node.group, node.mode,
+        node.x_attr, node.storage_policy.to_wire(), node.nlink, node.len,
+        node.block_size, node.replicas, node.blocks, node.is_complete,
+        node.target, node.children_num, node.client_name,
+    ], use_bin_type=True)
 
 
 def _dec_inode(raw: bytes):
     from curvine_tpu.master.inode import Inode
     d = msgpack.unpackb(raw, raw=False, strict_map_key=False)
+    if isinstance(d, dict):             # legacy map frame (pre-v2 stores)
+        return Inode(
+            id=d["id"], name=d["n"], file_type=FileType(d["ft"]),
+            parent_id=d["p"], mtime=d["mt"], atime=d["at"], owner=d["o"],
+            group=d["g"], mode=d["md"], x_attr=d["x"] or {},
+            storage_policy=StoragePolicy.from_wire(d["sp"]), nlink=d["nl"],
+            len=d["ln"], block_size=d["bs"], replicas=d["rp"],
+            blocks=list(d["bl"]), is_complete=d["dn"], target=d.get("tg"),
+            children_num=d.get("cn", 0), client_name=d.get("cl", ""))
+    (_tag, iid, name, ft, pid, mt, at, owner, group, mode, x, spw, nl, ln,
+     bs, rp, bl, dn, tg, cn, cl) = d
     return Inode(
-        id=d["id"], name=d["n"], file_type=FileType(d["ft"]),
-        parent_id=d["p"], mtime=d["mt"], atime=d["at"], owner=d["o"],
-        group=d["g"], mode=d["md"], x_attr=d["x"] or {},
-        storage_policy=StoragePolicy.from_wire(d["sp"]), nlink=d["nl"],
-        len=d["ln"], block_size=d["bs"], replicas=d["rp"],
-        blocks=list(d["bl"]), is_complete=d["dn"], target=d.get("tg"),
-        children_num=d.get("cn", 0), client_name=d.get("cl", ""))
+        id=iid, name=name, file_type=FileType(ft), parent_id=pid, mtime=mt,
+        atime=at, owner=owner, group=group, mode=mode, x_attr=x or {},
+        storage_policy=StoragePolicy.from_wire(spw), nlink=nl, len=ln,
+        block_size=bs, replicas=rp, blocks=list(bl), is_complete=dn,
+        target=tg, children_num=cn, client_name=cl)
 
 
 class KvMetaStore:
@@ -228,6 +250,11 @@ class KvMetaStore:
             OrderedDict()
         self._child_cache_max = 4 * cache_inodes
         self._pending: dict[bytes, bytes | None] = {}
+        # group-commit overlay: stage_entry() moves a finished entry's
+        # pending writes here; commit_applied flushes the WHOLE group as
+        # one kv.write_batch. rollback() (a single failed apply) leaves
+        # staged entries intact.
+        self._staged: dict[bytes, bytes | None] = {}
         self._counters: dict[str, int] = {}        # write-back cache
 
     # ---- key builders ----
@@ -246,6 +273,8 @@ class KvMetaStore:
     def _read(self, key: bytes) -> bytes | None:
         if key in self._pending:
             return self._pending[key]
+        if key in self._staged:
+            return self._staged[key]
         return self.kv.get(key)
 
     # ---- inodes ----
@@ -312,13 +341,14 @@ class KvMetaStore:
         out = {}
         for k, raw in self.kv.scan(prefix=prefix):
             out[k[len(prefix):].decode()] = _U64.unpack(raw)[0]
-        for k, raw in self._pending.items():
-            if k.startswith(prefix):
-                name = k[len(prefix):].decode()
-                if raw is None:
-                    out.pop(name, None)
-                else:
-                    out[name] = _U64.unpack(raw)[0]
+        for overlay in (self._staged, self._pending):
+            for k, raw in overlay.items():
+                if k.startswith(prefix):
+                    name = k[len(prefix):].decode()
+                    if raw is None:
+                        out.pop(name, None)
+                    else:
+                        out[name] = _U64.unpack(raw)[0]
         return sorted(out.items())
 
     def iter_children_all(self):
@@ -403,28 +433,70 @@ class KvMetaStore:
         self._pending[b"M" + name.encode()] = msgpack.packb(value)
 
     def _bump(self, name: str, delta: int) -> None:
-        self.set_counter(name, self.get_counter(name) + delta)
+        self.bump_counter(name, delta)
+
+    def bump_counter(self, name: str, delta: int, default: int = 0) -> int:
+        """Add ``delta`` and return the PRIOR value. Fused get+set —
+        one cache probe and one key pack on the id-allocation hot path."""
+        cur = self._counters.get(name)
+        if cur is None:
+            cur = self.get_counter(name, default)
+        self._counters[name] = new = cur + delta
+        self._pending[b"M" + name.encode()] = msgpack.packb(new)
+        return cur
 
     # ---- transactions ----
+    def stage_entry(self) -> None:
+        """Move this entry's pending writes into the group overlay.
+
+        Group commit: each applied entry stages here; the whole group
+        lands as ONE kv.write_batch in commit_applied (tagged with the
+        group's head seq). rollback() of a LATER failed entry leaves
+        staged entries intact."""
+        if self._pending:
+            self._staged.update(self._pending)
+            self._pending.clear()
+
     def commit_applied(self, seq: int) -> None:
-        """Commit this entry's pending writes + applied_seq as ONE atomic
+        """Commit staged + pending writes + applied_seq as ONE atomic
         WAL record: replay after a crash resumes at exactly seq+1."""
         self.set_counter("applied_seq", seq)
-        self.kv.write_batch(list(self._pending.items()))
-        self._pending.clear()
+        if self._pending:
+            self._staged.update(self._pending)
+            self._pending.clear()
+        self.kv.write_batch(list(self._staged.items()))
+        self._staged.clear()
 
     def commit_runtime(self) -> None:
         """Persist pending writes WITHOUT moving applied_seq (block-report
-        len bumps — durable state that isn't journaled)."""
-        if self._pending:
-            self.kv.write_batch(list(self._pending.items()))
+        len bumps — durable state that isn't journaled). Mid-group the
+        writes fold into the staged overlay instead: a direct batch here
+        would land runtime state ahead of an unflushed journal group."""
+        if not self._pending:
+            return
+        if self._staged:
+            self._staged.update(self._pending)
             self._pending.clear()
+            return
+        self.kv.write_batch(list(self._pending.items()))
+        self._pending.clear()
 
     def rollback(self) -> None:
         """Discard pending writes of a failed apply. The whole inode cache
         is dropped: a failed apply may have mutated cached objects in place
-        before it raised, and those mutations were never put()."""
+        before it raised, and those mutations were never put(). Staged
+        (earlier group entries') writes survive — _read consults them."""
         self._pending.clear()
+        self._cache.clear()
+        self._child_cache.clear()
+        self._counters.clear()
+
+    def rollback_group(self) -> None:
+        """Discard the WHOLE open group (staged + pending). Only for
+        non-deterministic batch failures where the journal was never
+        written — restart must not see these effects."""
+        self._pending.clear()
+        self._staged.clear()
         self._cache.clear()
         self._child_cache.clear()
         self._counters.clear()
@@ -437,6 +509,7 @@ class KvMetaStore:
         self._cache.clear()
         self._child_cache.clear()
         self._pending.clear()
+        self._staged.clear()
         self._counters.clear()
 
     def close(self) -> None:

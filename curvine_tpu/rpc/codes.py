@@ -40,6 +40,7 @@ class RpcCode(enum.IntEnum):
     FREE = 26
     LIST_OPTIONS = 27
     CONTENT_SUMMARY = 28
+    META_BATCH = 29           # heterogeneous mkdir/create/delete list
 
     # manager interface
     MOUNT = 30

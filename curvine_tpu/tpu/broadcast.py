@@ -24,13 +24,61 @@ from curvine_tpu.client import CurvineClient
 
 log = logging.getLogger(__name__)
 
+_warned_pickle = False
+
+
+def _tree_skeleton(tree):
+    """JSON-safe structure encoding of a pytree built from dicts, lists,
+    tuples and None — leaves become indices into the flat tensor list.
+    Returns (skeleton, leaves). Dict keys iterate SORTED to match
+    jax.tree.flatten's ordering. Raises TypeError on containers this
+    encoding can't represent (custom pytree nodes) — callers fall back
+    to the legacy pickled treedef."""
+    leaves: list = []
+
+    def enc(node):
+        if isinstance(node, dict):
+            if not all(isinstance(k, str) for k in node):
+                raise TypeError("non-string dict key")
+            return {"k": "dict",
+                    "v": {k: enc(node[k]) for k in sorted(node)}}
+        if isinstance(node, (list, tuple)):
+            return {"k": "list" if isinstance(node, list) else "tuple",
+                    "v": [enc(c) for c in node]}
+        if node is None:
+            return {"k": "none"}
+        leaves.append(node)
+        return {"k": "leaf", "i": len(leaves) - 1}
+
+    return enc(tree), leaves
+
+
+def _tree_build(skel, leaves):
+    k = skel["k"]
+    if k == "dict":
+        return {key: _tree_build(c, leaves) for key, c in skel["v"].items()}
+    if k == "list":
+        return [_tree_build(c, leaves) for c in skel["v"]]
+    if k == "tuple":
+        return tuple(_tree_build(c, leaves) for c in skel["v"])
+    if k == "none":
+        return None
+    return leaves[skel["i"]]
+
 
 async def save_checkpoint(client: CurvineClient, path: str,
                           params: dict) -> None:
-    """Write a pytree of arrays as manifest + raw tensor blobs."""
-    flat, treedef = jax.tree.flatten(params)
-    manifest = {"tree": None, "tensors": []}
-    import pickle
+    """Write a pytree of arrays as manifest + raw tensor blobs. The tree
+    structure is JSON-encoded INSIDE the manifest (safe to load); only
+    trees with custom pytree nodes fall back to a pickled treedef
+    side-file, which readers accept with a warn-once."""
+    manifest = {"tensors": []}
+    treedef = None
+    try:
+        skel, flat = _tree_skeleton(params)
+        manifest["tree"] = skel
+    except TypeError:
+        flat, treedef = jax.tree.flatten(params)
     await client.meta.mkdir(path)
     for i, arr in enumerate(flat):
         arr = np.asarray(arr)
@@ -39,8 +87,10 @@ async def save_checkpoint(client: CurvineClient, path: str,
             {"name": name, "dtype": str(arr.dtype), "shape": list(arr.shape)})
         await client.write_all(f"{path}/{name}", arr.tobytes())
     await client.write_all(f"{path}/manifest.json",
-                           json.dumps(manifest["tensors"]).encode())
-    await client.write_all(f"{path}/treedef.pkl", pickle.dumps(treedef))
+                           json.dumps(manifest).encode())
+    if treedef is not None:
+        import pickle
+        await client.write_all(f"{path}/treedef.pkl", pickle.dumps(treedef))
 
 
 async def load_checkpoint(client: CurvineClient, path: str,
@@ -51,12 +101,24 @@ async def load_checkpoint(client: CurvineClient, path: str,
     soon as its bytes land — cache reads overlap device transfers instead
     of the round-2 read-everything-then-transfer-everything sequence."""
     import asyncio
-    import pickle
-    manifest_t = asyncio.ensure_future(
-        _read_all(client, f"{path}/manifest.json"))
-    treedef_t = asyncio.ensure_future(_read_all(client, f"{path}/treedef.pkl"))
-    manifest = json.loads(await manifest_t)
-    treedef = pickle.loads(await treedef_t)
+    raw = json.loads(await _read_all(client, f"{path}/manifest.json"))
+    if isinstance(raw, list):
+        # legacy layout: bare tensor list + pickled treedef side-file
+        manifest, skel = raw, None
+    else:
+        manifest, skel = raw["tensors"], raw.get("tree")
+    treedef = None
+    if skel is None:
+        # unpickling is arbitrary code execution for anyone who can write
+        # the checkpoint path — only the legacy fallback still does it
+        global _warned_pickle
+        if not _warned_pickle:
+            _warned_pickle = True
+            log.warning("loading legacy pickled treedef from %s; re-save "
+                        "the checkpoint to use the safe JSON structure",
+                        path)
+        import pickle
+        treedef = pickle.loads(await _read_all(client, f"{path}/treedef.pkl"))
 
     async def load_one(t):
         reader = await client.open(f"{path}/{t['name']}")
@@ -74,6 +136,8 @@ async def load_checkpoint(client: CurvineClient, path: str,
     flat = await asyncio.gather(*(load_one(t) for t in manifest))
     if placer is not None:
         flat = [jax.block_until_ready(a) for a in flat]
+    if skel is not None:
+        return _tree_build(skel, flat)
     return jax.tree.unflatten(treedef, flat)
 
 

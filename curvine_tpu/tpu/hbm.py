@@ -26,6 +26,7 @@ class HbmTier:
         self._atime: dict[int, float] = {}
         self.hits = 0
         self.misses = 0
+        self.spills = 0
 
     def __contains__(self, block_id: int) -> bool:
         return block_id in self._blocks
@@ -69,12 +70,13 @@ class HbmTier:
         while self.used + need > self.capacity and self._blocks:
             victim = min(self._atime, key=self._atime.get)
             log.debug("hbm tier evicting block %d", victim)
+            self.spills += 1
             self.drop(victim)
 
     def stats(self) -> dict:
         return {"capacity": self.capacity, "used": self.used,
                 "blocks": len(self._blocks), "hits": self.hits,
-                "misses": self.misses}
+                "misses": self.misses, "spills": self.spills}
 
 
 class MultiHbmTier:
@@ -181,6 +183,23 @@ class MultiHbmTier:
         agg = {"capacity": self.capacity, "used": self.used,
                "devices": len(self.tiers),
                "blocks": len({b for t in self.tiers.values()
-                              for b in t._blocks})}
+                              for b in t._blocks}),
+               "hits": sum(t.hits for t in self.tiers.values()),
+               "misses": sum(t.misses for t in self.tiers.values()),
+               "spills": sum(t.spills for t in self.tiers.values())}
         agg["per_device"] = self.per_device_stats()
         return agg
+
+
+def export_metrics(tier, registry, prefix: str = "hbm") -> None:
+    """Surface HbmTier/MultiHbmTier counters on a MetricsRegistry
+    (/metrics): hits, misses, spills, occupancy. Counted since round 2,
+    but never exported until now."""
+    st = tier.stats()
+    registry.gauge(f"{prefix}.hits", st.get("hits", 0))
+    registry.gauge(f"{prefix}.misses", st.get("misses", 0))
+    registry.gauge(f"{prefix}.spills", st.get("spills", 0))
+    registry.gauge(f"{prefix}.used", st["used"])
+    registry.gauge(f"{prefix}.capacity", st["capacity"])
+    registry.gauge(f"{prefix}.occupancy",
+                   st["used"] / st["capacity"] if st["capacity"] else 0.0)

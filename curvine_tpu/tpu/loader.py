@@ -85,12 +85,31 @@ class CacheShardSource:
 async def write_token_shards(client: CurvineClient, path: str,
                              tokens: np.ndarray, shard_tokens: int,
                              dtype=np.int32) -> list[str]:
-    """Utility: split a token stream into cached shard files."""
+    """Utility: split a token stream into cached shard files.
+
+    Warm-up is ONE batched metadata round trip (META_BATCH): mkdir plus
+    deletion of stale shard files from any previous run — re-sharding
+    over an existing dir used to leave higher-numbered stale shards that
+    the reader would then stream into the token flow."""
+    from curvine_tpu.common import errors as err
     tokens = tokens.astype(dtype)
-    await client.meta.mkdir(path)
+    base = path.rstrip("/")
+    n_shards = (tokens.size + shard_tokens - 1) // shard_tokens
+    keep = {f"{base}/shard-{i:05d}.bin" for i in range(n_shards)}
+    warmup = [{"op": "mkdir", "path": path, "create_parent": True}]
+    try:
+        stale = [s.path for s in await client.meta.list_status(path)
+                 if not s.is_dir and s.path not in keep]
+        warmup += [{"op": "delete", "path": p} for p in sorted(stale)]
+    except err.FileNotFound:
+        pass
+    for r in await client.meta.meta_batch(warmup):
+        if "error" in r:
+            raise err.CurvineError.from_wire(r.get("error_code", 0),
+                                             r["error"])
     out = []
     for i, off in enumerate(range(0, tokens.size, shard_tokens)):
-        p = f"{path.rstrip('/')}/shard-{i:05d}.bin"
+        p = f"{base}/shard-{i:05d}.bin"
         await client.write_all(p, tokens[off:off + shard_tokens].tobytes())
         out.append(p)
     return out

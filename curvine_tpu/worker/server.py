@@ -255,6 +255,9 @@ class WorkerServer:
         until the backoff lapses, and recovery logs once."""
         if time.monotonic() < self._hb_backoff_until:
             return
+        if self.hbm is not None:
+            from curvine_tpu.tpu.hbm import export_metrics
+            export_metrics(self.hbm, self.metrics)
         payload = pack({"info": self._info().to_wire(),
                         "metrics": {
             "bytes.read": self.metrics.counters.get("bytes.read", 0),

@@ -8,6 +8,7 @@
 # Usage: scripts/perf_smoke.sh [project_root]
 #   BENCH_ANN=0 skips the ANN gate (direct-IO only).
 #   BENCH_TRACE=0 skips the tracing-overhead gate.
+#   BENCH_META=0 skips the metadata write-plane gate.
 # Exit: 0 = at/above the regression gates, 1 = regression, 2 = harness error.
 
 set -u
@@ -56,6 +57,60 @@ print("perf_smoke: PASS")
 EOF
 rc=$?
 [ $rc -ne 0 ] && exit $rc
+
+if [ "${BENCH_META:-1}" = "0" ]; then
+    echo "perf_smoke: metadata write-plane gate skipped (BENCH_META=0)"
+else
+    # metadata write-plane gate: batched creates through RPC + group
+    # commit + KV batch on a journal-less master (bench meta phase shape)
+    META_OUT=$(JAX_PLATFORMS=cpu timeout 150 python - <<'EOF'
+import asyncio, json, os, sys
+sys.path.insert(0, os.getcwd())
+from bench import _meta_smoke
+print(json.dumps(asyncio.run(_meta_smoke())))
+EOF
+)
+    rc=$?
+    if [ $rc -ne 0 ] || [ -z "$META_OUT" ]; then
+        echo "perf_smoke: metadata microbench failed to run (rc=$rc)" >&2
+        exit 2
+    fi
+    echo "$META_OUT"
+    python - "$FLOOR_FILE" <<'EOF' "$META_OUT"
+import json, sys
+floor_file, result = sys.argv[1], json.loads(sys.argv[2])
+floor = json.load(open(floor_file))["meta_create_qps"]
+got = result.get("meta_create_qps", 0.0)
+gate = floor * 0.7                      # >30% regression fails
+print(f"perf_smoke: meta_create_qps={got} floor={floor} "
+      f"gate={gate:.1f}")
+if got < gate:
+    print(f"perf_smoke: FAIL — meta_create_qps {got} < {gate:.1f} "
+          f"(floor {floor} - 30%)", file=sys.stderr)
+    sys.exit(1)
+print("perf_smoke: PASS")
+EOF
+    rc=$?
+    [ $rc -ne 0 ] && exit $rc
+
+    # namespace-scale smoke: 50K-file curve + restart replay must
+    # complete and self-report ok (group sizes, recovery) — a
+    # correctness gate for the group-commit path, not a throughput gate
+    SCALE_JSON=$(mktemp)
+    JAX_PLATFORMS=cpu timeout 150 python scripts/namespace_scale.py \
+        --quick --out "$SCALE_JSON" >/dev/null 2>&1
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "perf_smoke: FAIL — namespace_scale --quick (rc=$rc)" >&2
+        rm -f "$SCALE_JSON"
+        exit 1
+    fi
+    python -c 'import json, sys
+print("perf_smoke: namespace_scale --quick",
+      json.dumps(json.load(open(sys.argv[1]))))' "$SCALE_JSON"
+    rm -f "$SCALE_JSON"
+    echo "perf_smoke: PASS"
+fi
 
 if [ "${BENCH_TRACE:-1}" = "0" ]; then
     echo "perf_smoke: tracing-overhead gate skipped (BENCH_TRACE=0)"

@@ -274,3 +274,161 @@ def test_conf_env_overrides(tmp_path):
     assert c.client.short_circuit is False
     assert c.data_dir == "/data"
     assert c.worker.tiers and c.worker.tiers[0].storage_type == "mem"
+
+
+# ---------------- group commit (journal batching) ----------------
+
+def _segment_frames(path):
+    """Parse [off, frame_len] for each whole frame in a segment file."""
+    import struct
+    with open(path, "rb") as f:
+        data = f.read()
+    hdr = struct.Struct(">II")
+    out, off = [], 0
+    while off + hdr.size <= len(data):
+        length, _crc = hdr.unpack_from(data, off)
+        out.append((off, hdr.size + length))
+        off += hdr.size + length
+    return out
+
+
+def _only_segment(j):
+    segs = [f for f in os.listdir(j.dir) if f.startswith("edits-")]
+    assert len(segs) == 1
+    return os.path.join(j.dir, segs[0])
+
+
+def test_journal_append_batch_roundtrip(tmp_path):
+    j = Journal(str(tmp_path / "j"))
+    j.append("op", {"i": 0})
+    seqs = j.append_batch([("op", {"i": 1}), ("op", {"i": 2}),
+                           ("op", {"i": 3})])
+    assert seqs == [2, 3, 4]
+    assert j.seq == 4
+    j.append("op", {"i": 4})
+    j.close()
+    j2 = Journal(str(tmp_path / "j"))
+    _, entries = j2.recover()
+    assert [a["i"] for _, _, a, _ in entries] == [0, 1, 2, 3, 4]
+    assert j2.seq == 5
+
+
+def test_journal_append_batch_torn_mid_batch(tmp_path):
+    """A torn tail landing MID-BATCH must replay only the whole entries
+    of the batch and position seq after the last good one."""
+    j = Journal(str(tmp_path / "j"))
+    j.append_batch([("op", {"i": i}) for i in range(4)])
+    j.close()
+    full = _only_segment(j)
+    frames = _segment_frames(full)
+    assert len(frames) == 4
+    # cut INTO the 3rd frame of the batch: entries 0,1 stay whole
+    cut = frames[2][0] + 5
+    with open(full, "ab") as f:
+        f.truncate(cut)
+    j2 = Journal(str(tmp_path / "j"))
+    _, entries = j2.recover()
+    assert [a["i"] for _, _, a, _ in entries] == [0, 1]
+    assert j2.seq == 2
+    # the journal must be appendable right where the tear was truncated
+    j2.append("op", {"i": 99})
+    j2.close()
+    j3 = Journal(str(tmp_path / "j"))
+    _, entries = j3.recover()
+    assert [a["i"] for _, _, a, _ in entries] == [0, 1, 99]
+    assert j3.seq == 3
+
+
+def test_journal_append_batch_bad_crc_mid_batch(tmp_path):
+    """A corrupt frame mid-batch truncates there: whole entries before it
+    replay, everything after (same batch!) is discarded."""
+    j = Journal(str(tmp_path / "j"))
+    j.append_batch([("op", {"i": i}) for i in range(5)])
+    j.close()
+    full = _only_segment(j)
+    frames = _segment_frames(full)
+    off, flen = frames[2]
+    with open(full, "r+b") as f:
+        f.seek(off + flen - 1)       # flip a payload byte of frame 3
+        b = f.read(1)
+        f.seek(off + flen - 1)
+        f.write(bytes([b[0] ^ 0xFF]))
+    j2 = Journal(str(tmp_path / "j"))
+    _, entries = j2.recover()
+    assert [a["i"] for _, _, a, _ in entries] == [0, 1]
+    assert j2.seq == 2
+
+
+def test_journal_unflushed_append_then_sync(tmp_path):
+    j = Journal(str(tmp_path / "j"))
+    j.append("op", {"i": 0}, flush=False)
+    j.append("op", {"i": 1}, flush=False)
+    j.sync()
+    j.close()
+    j2 = Journal(str(tmp_path / "j"))
+    _, entries = j2.recover()
+    assert [a["i"] for _, _, a, _ in entries] == [0, 1]
+
+
+async def test_group_committer_coalesces(tmp_path):
+    """Concurrent mutations awaiting the group barrier land in FEWER
+    journal flushes than ops, and all survive a reopen."""
+    import asyncio
+    from curvine_tpu.common.journal import GroupCommitter
+    from curvine_tpu.master.filesystem import MasterFilesystem
+    from curvine_tpu.master.store import KvMetaStore
+
+    j = Journal(str(tmp_path / "j"))
+    fs = MasterFilesystem(journal=j,
+                          store=KvMetaStore(str(tmp_path / "kv"),
+                                            engine="python"))
+    fs.recover()
+    fs.committer = GroupCommitter(j, fs.store, window_ms=0.0)
+
+    async def one(i: int):
+        fs.mkdir(f"/g{i}")
+        await fs.committer.sync()
+
+    await asyncio.gather(*(one(i) for i in range(64)))
+    assert fs.committer.entries == 64
+    assert fs.committer.groups < 64          # coalesced
+    j.close()
+    fs.store.close()
+
+    j2 = Journal(str(tmp_path / "j"))
+    fs2 = MasterFilesystem(journal=j2,
+                           store=KvMetaStore(str(tmp_path / "kv"),
+                                             engine="python"))
+    fs2.recover()
+    for i in range(64):
+        assert fs2.exists(f"/g{i}")
+
+
+async def test_group_rollback_keeps_earlier_entries(tmp_path):
+    """A failed apply MID-GROUP must not drop earlier staged entries."""
+    import asyncio
+    from curvine_tpu.common.journal import GroupCommitter
+    from curvine_tpu.master.filesystem import MasterFilesystem
+    from curvine_tpu.master.store import KvMetaStore
+
+    j = Journal(str(tmp_path / "j"))
+    fs = MasterFilesystem(journal=j,
+                          store=KvMetaStore(str(tmp_path / "kv"),
+                                            engine="python"))
+    fs.recover()
+    fs.committer = GroupCommitter(j, fs.store, window_ms=0.0)
+    fs.mkdir("/ok1")
+    with pytest.raises(err.CurvineError):
+        fs.create_file("/missing/parent/f", create_parent=False)
+    fs.mkdir("/ok2")
+    await fs.committer.sync()
+    assert fs.exists("/ok1") and fs.exists("/ok2")
+    j.close()
+    fs.store.close()
+    j2 = Journal(str(tmp_path / "j"))
+    fs2 = MasterFilesystem(journal=j2,
+                           store=KvMetaStore(str(tmp_path / "kv"),
+                                             engine="python"))
+    fs2.recover()
+    assert fs2.exists("/ok1") and fs2.exists("/ok2")
+    assert not fs2.exists("/missing")

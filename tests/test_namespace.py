@@ -137,7 +137,7 @@ def test_journal_append_failure_keeps_state_consistent(fs, tmp_path):
     j = Journal(str(tmp_path / "j"))
     fsj = MasterFilesystem(journal=j)
 
-    def boom(op, args):
+    def boom(op, args, **kw):
         raise OSError(28, "No space left on device")
     j.append = boom
     with pytest.raises(OSError):

@@ -1,0 +1,31 @@
+"""Namespace-scale quick mode: scripts/namespace_scale.py --quick as a
+slow-marked tier-1 member — the 50K-file creation curve on the KV engine
+plus the restart-replay check, end to end through the group-commit path.
+The full 10M curve lives in docs/metadata-scale.md."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_namespace_scale_quick(tmp_path):
+    out = tmp_path / "ns.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "namespace_scale.py"),
+         "--quick", "--engine", "auto",
+         "--base-dir", str(tmp_path / "ns"), "--out", str(out)],
+        capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    res = json.loads(out.read_text())
+    assert res["ok"]
+    assert res["curve"][-1]["files"] == 50_000
+    assert res["curve"][-1]["creates_per_s"] > 500
+    # group commit actually batched (not one flush per create)
+    assert res["curve"][-1]["avg_group_size"] > 10
+    assert res["restart_s"] < 120

@@ -132,6 +132,35 @@ def test_hbm_tier():
     assert tier.used == 8 * MB
     stats = tier.stats()
     assert stats["blocks"] == 2 and stats["hits"] == 1
+    assert stats["spills"] == 1                       # block 2's eviction
+
+
+def test_hbm_export_metrics():
+    """hits/misses/spills/occupancy surface on the common registry."""
+    from curvine_tpu.common.metrics import MetricsRegistry
+    from curvine_tpu.tpu.hbm import HbmTier, MultiHbmTier, export_metrics
+    tier = HbmTier(capacity_bytes=2 * MB, device=CPUS[0])
+    tier.put(1, np.zeros(MB, dtype=np.uint8))
+    tier.get(1)                                       # hit
+    tier.get(99)                                      # miss
+    tier.put(2, np.zeros(MB, dtype=np.uint8))
+    tier.put(3, np.zeros(2 * MB, dtype=np.uint8))     # spills 1 and 2
+    m = MetricsRegistry("worker")
+    export_metrics(tier, m)
+    g = m.snapshot()["gauges"]
+    assert g["hbm.hits"] == 1 and g["hbm.misses"] == 1
+    assert g["hbm.spills"] == 2
+    assert g["hbm.used"] == 2 * MB and g["hbm.capacity"] == 2 * MB
+    assert g["hbm.occupancy"] == 1.0
+    # the multi-chip tier aggregates across devices (capacity is split
+    # per chip, so size blocks under the per-chip share)
+    mt = MultiHbmTier(len(CPUS) * MB, devices=CPUS)
+    mt.put(1, np.zeros(MB // 2, dtype=np.uint8))
+    mt.get(1)
+    m2 = MetricsRegistry("worker")
+    export_metrics(mt, m2)
+    g2 = m2.snapshot()["gauges"]
+    assert g2["hbm.hits"] >= 1 and g2["hbm.used"] == MB // 2
 
 
 async def test_checkpoint_roundtrip_and_broadcast():
@@ -161,6 +190,47 @@ async def test_checkpoint_roundtrip_and_broadcast():
         # TP-sharded distribution
         tp = broadcast_params(back, mesh, param_spec_tree(back))
         assert tp["embed"].sharding.spec == P(None, "model")
+        # the new manifest carries the tree structure as JSON — no
+        # pickled treedef side-file for plain dict/list/tuple trees
+        from curvine_tpu.common import errors as cverr
+        with pytest.raises(cverr.FileNotFound):
+            await c.meta.file_status("/ckpt/step0/treedef.pkl")
+
+
+def test_checkpoint_tree_skeleton():
+    """JSON structure encoding: flatten order matches build order for
+    dicts (sorted keys), lists, tuples and None; custom nodes refuse."""
+    from curvine_tpu.tpu.broadcast import _tree_build, _tree_skeleton
+    tree = {"b": [np.arange(3), (np.arange(2), None)], "a": np.arange(4)}
+    skel, leaves = _tree_skeleton(tree)
+    assert len(leaves) == 3
+    # sorted dict keys: "a" flattens first, matching jax.tree.flatten
+    assert np.array_equal(leaves[0], tree["a"])
+    back = _tree_build(skel, leaves)
+    assert isinstance(back["b"][1], tuple) and back["b"][1][1] is None
+    assert np.array_equal(back["b"][0], tree["b"][0])
+    with pytest.raises(TypeError):
+        _tree_skeleton({1: np.arange(2)})        # non-string dict key
+
+
+async def test_checkpoint_legacy_pickle_fallback():
+    """Old checkpoints (bare-list manifest + treedef.pkl) still load."""
+    import json as _json
+    import pickle
+    from curvine_tpu.tpu.broadcast import load_checkpoint
+    params = {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    flat, treedef = jax.tree.flatten(params)
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.meta.mkdir("/ckpt/legacy")
+        manifest = [{"name": "t00000.bin", "dtype": "float32",
+                     "shape": [2, 3]}]
+        await c.write_all("/ckpt/legacy/t00000.bin", flat[0].tobytes())
+        await c.write_all("/ckpt/legacy/manifest.json",
+                          _json.dumps(manifest).encode())
+        await c.write_all("/ckpt/legacy/treedef.pkl", pickle.dumps(treedef))
+        back = await load_checkpoint(c, "/ckpt/legacy")
+        assert np.array_equal(np.asarray(back["w"]), params["w"])
 
 
 def test_pallas_checksum_interpret():

@@ -315,6 +315,52 @@ async def _ann_smoke(n_rows: int = 100_000, dim: int = 128,
     return out
 
 
+async def _rpc_smoke(n: int = 3_000, depth: int = 64) -> dict:
+    """Transport microbench for scripts/perf_smoke.sh: small-op pings
+    against a bare loopback RpcServer with a trivial echo handler — no
+    filesystem behind it, so the figure is pure wire/transport cost
+    (frame encode, coalesced writer, bulk-recv decode, dispatch).
+    Returns {rpc_rtt_us, rpc_pipelined_qps, loop_impl}: serialized
+    round-trip latency, small-op throughput with `depth` concurrent
+    callers (where send coalescing kicks in), and which event loop ran
+    (rpc.uvloop) so numbers stay attributable."""
+    from curvine_tpu.rpc import RpcServer
+    from curvine_tpu.rpc.client import Connection
+    from curvine_tpu.rpc.loops import loop_impl
+
+    async def echo(msg, conn):
+        return {"ok": True}
+
+    srv = RpcServer("127.0.0.1", 0, "bench")
+    srv.register(9_999, echo)
+    await srv.start()
+    conn = await Connection(f"127.0.0.1:{srv.port}").connect()
+    out: dict = {}
+    try:
+        hdr = {"p": "/bench/ping"}
+        for _ in range(200):                                  # warm
+            await conn.call(9_999, dict(hdr))
+        t0 = time.perf_counter()
+        for _ in range(n):
+            await conn.call(9_999, dict(hdr))
+        out["rpc_rtt_us"] = round((time.perf_counter() - t0) / n * 1e6, 1)
+
+        async def caller(k: int):
+            for _ in range(k):
+                await conn.call(9_999, dict(hdr))
+
+        per = max(1, n // depth)
+        t0 = time.perf_counter()
+        await asyncio.gather(*(caller(per) for _ in range(depth)))
+        out["rpc_pipelined_qps"] = round(
+            per * depth / (time.perf_counter() - t0), 1)
+        out["loop_impl"] = loop_impl()
+    finally:
+        await conn.close()
+        await srv.stop()
+    return out
+
+
 async def _meta_smoke(n_create: int = 8_000, bs: int = 500) -> dict:
     """Metadata write-plane gate for scripts/perf_smoke.sh: batched file
     creates through the RPC + group-commit + KV-batch path on a journal-
@@ -517,6 +563,10 @@ async def run_bench(total_mb: int = 256, block_mb: int = 64,
         results["meta_create_batch_qps"] = \
             n_create / (time.perf_counter() - t0)
         await c.meta.delete("/bench/crtb", recursive=True)
+
+        # ---- wire transport: small-op round trip + pipelined QPS on a
+        # bare echo server (the denominator under every meta figure)
+        results.update(await _rpc_smoke())
 
         # ---- native metadata read plane (C++ mirror, fast port) ----
         # the C++ load generator pipelines stats at the C++ server so
@@ -1029,6 +1079,11 @@ def main(argv: list[str] | None = None):
         env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
         import subprocess
         return subprocess.call([sys.executable, __file__], env=env)
+    # optional rpc.uvloop (CURVINE_RPC_UVLOOP=1): swap the policy before
+    # the loop exists; the artifact's loop_impl records what actually ran
+    from curvine_tpu.common.conf import ClusterConf
+    from curvine_tpu.rpc.loops import install_event_loop
+    install_event_loop(ClusterConf.load().rpc)
     results = asyncio.run(run_bench(total_mb=total_mb))
     value = round(results["read_gibs_into_hbm"], 3)
     out = {
@@ -1045,6 +1100,9 @@ def main(argv: list[str] | None = None):
         "meta_create_batch_qps": round(
             results.get("meta_create_batch_qps", 0), 1),
         "meta_qps_native": round(results.get("meta_qps_native", 0), 1),
+        "rpc_rtt_us": round(results.get("rpc_rtt_us", 0), 1),
+        "rpc_pipelined_qps": round(results.get("rpc_pipelined_qps", 0), 1),
+        "loop_impl": results.get("loop_impl", "asyncio"),
         "p99_block_fetch_ms": round(results["p99_block_fetch_ms"], 3),
         "p50_block_fetch_ms": round(results["p50_block_fetch_ms"], 3),
         "read_gibs_host": round(results["read_gibs_host"], 3),

@@ -32,7 +32,8 @@ class CurvineClient:
         self.conf = conf or ClusterConf()
         self.meta = FsClient(self.conf)
         self.pool = ConnectionPool(size=self.conf.client.conn_pool_size,
-                                   timeout_ms=self.conf.client.rpc_timeout_ms)
+                                   timeout_ms=self.conf.client.rpc_timeout_ms,
+                                   rpc_conf=self.conf.rpc)
         # per-worker circuit breakers, SHARED by every reader/writer this
         # client opens: a wedged worker is learned once, then skipped in
         # replica choice and excluded from placement until it heals

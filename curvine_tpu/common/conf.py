@@ -221,6 +221,25 @@ class ObsConf:
 
 
 @dataclass
+class RpcConf:
+    """Wire transport knobs (curvine_tpu/rpc/transport.py), shared by
+    every peer in the process: clients, the master and worker servers."""
+    # optional uvloop acceleration for the whole process event loop;
+    # warn-once fallback to stock asyncio when uvloop is not installed
+    uvloop: bool = False
+    # coalesced writer: all frames queued within one event-loop tick
+    # leave in a single vectored send, bounded per batch by bytes/frames
+    send_coalesce_bytes: int = 256 * 1024
+    send_coalesce_frames: int = 128
+    # frames whose data payload is at most this long are flattened into
+    # the batch buffer; larger payloads ride the iovec uncopied
+    send_inline_max: int = 8 * 1024
+    # bulk-recv buffer: one sock_recv_into typically lands many small
+    # frames, decoded back-to-back with no further syscalls
+    recv_buffer_bytes: int = 256 * 1024
+
+
+@dataclass
 class GatewayConf:
     # S3 gateway SigV4 verification: static credential pair. Empty access
     # key = anonymous mode (explicit opt-in for cluster-internal use);
@@ -243,6 +262,7 @@ class ClusterConf:
     fuse: FuseConf = field(default_factory=FuseConf)
     gateway: GatewayConf = field(default_factory=GatewayConf)
     obs: ObsConf = field(default_factory=ObsConf)
+    rpc: RpcConf = field(default_factory=RpcConf)
     data_dir: str = "data"
 
     @staticmethod
@@ -303,7 +323,7 @@ def _coerce(cur, raw: str, annotation: str = ""):
 def _apply_env(conf: "ClusterConf", env: dict) -> None:
     sections = {"master": conf.master, "worker": conf.worker,
                 "client": conf.client, "fuse": conf.fuse,
-                "obs": conf.obs}
+                "obs": conf.obs, "rpc": conf.rpc}
     for key, raw in env.items():
         if not key.startswith("CURVINE_") or key == "CURVINE_CONF":
             continue

@@ -392,6 +392,11 @@ class GroupCommitter:
         self.entries += n
         if self.metrics is not None:
             self.metrics.observe("journal.group_size", n)
+        # releasing every waiter in one tick matters beyond fairness:
+        # the resumed mutation handlers all enqueue their replies on the
+        # connection's coalesced writer (rpc/transport.py) before it
+        # next drains, so a whole group's responses leave in ONE
+        # vectored send instead of one syscall+wakeup per reply
         keep = []
         for tgt, fut in self._waiters:
             if tgt <= self._synced:

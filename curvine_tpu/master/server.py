@@ -87,7 +87,8 @@ class MasterServer:
         self.watchdog = DirWatchdog(self.metrics, self.locks,
                                     stall_s=mc.watchdog_stall_ms / 1000)
         self.monitor = MasterMonitor(self)
-        self.rpc = RpcServer(mc.hostname, mc.rpc_port, "master")
+        self.rpc = RpcServer(mc.hostname, mc.rpc_port, "master",
+                             rpc_conf=self.conf.rpc)
         # in-flight requests register at the DISPATCH level so a wedge
         # anywhere (fault hook, handler, commit barrier) is visible
         self.rpc.watchdog = self.watchdog

@@ -4,11 +4,13 @@ Parity: orpc/src/client/ (ClusterConnector/conn pool) and
 orpc/src/io/retry/ (exponential backoff, retryable error classification).
 
 The connection runs on a raw non-blocking socket (loop.sock_* APIs, no
-asyncio streams): frame payloads are received with recv_into, and a
-caller-registered *sink* buffer lets block-read streams land directly in
-the destination (numpy/HBM staging) buffer — no intermediate bytes
-objects, which matters doubly on virtualized hosts where first-touch
-page faults dominate large allocations."""
+asyncio streams) through the coalesced transport (rpc/transport.py):
+sends from all in-flight requests leave in vectored batches drained by
+one writer task, and the read loop bulk-decodes many frames per
+recv_into. A caller-registered *sink* buffer still lets block-read
+streams land directly in the destination (numpy/HBM staging) buffer —
+no intermediate bytes objects, which matters doubly on virtualized
+hosts where first-touch page faults dominate large allocations."""
 
 from __future__ import annotations
 
@@ -23,10 +25,8 @@ from typing import Any, AsyncIterator
 from curvine_tpu.common.errors import ConnectError, CurvineError, RpcTimeout
 from curvine_tpu.obs.trace import TRACE_KEY, current_ctx
 from curvine_tpu.rpc.deadline import DEADLINE_KEY, Deadline
-from curvine_tpu.rpc.frame import (
-    FIXED_LEN, LEN_PREFIX, MAX_FRAME, Flags, Message, pack, unpack,
-)
-from curvine_tpu.rpc import frame as frame_mod
+from curvine_tpu.rpc.frame import Flags, Message, pack, unpack
+from curvine_tpu.rpc.transport import BulkDecoder, CoalescedWriter
 
 log = logging.getLogger(__name__)
 
@@ -45,15 +45,19 @@ class _Sink:
 class Connection:
     """One TCP connection; multiplexes concurrent requests by req_id."""
 
-    def __init__(self, addr: str, timeout_ms: int = 30_000):
+    def __init__(self, addr: str, timeout_ms: int = 30_000,
+                 rpc_conf=None, metrics=None):
         self.addr = addr
         self.timeout = timeout_ms / 1000
+        self.rpc_conf = rpc_conf
+        self.metrics = metrics
         self._sock: socket.socket | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
         self._waiters: dict[int, asyncio.Queue] = {}
         self._sinks: dict[int, _Sink] = {}
         self._reader_task: asyncio.Task | None = None
-        self._wlock = asyncio.Lock()
+        self._writer: CoalescedWriter | None = None
+        self._dec: BulkDecoder | None = None
         self.closed = False
         # client-side fault hook mirroring RpcServer.fault_hook: called
         # with (addr, msg) before each request leaves; may sleep (delay),
@@ -73,54 +77,62 @@ class Connection:
         except (OSError, asyncio.TimeoutError) as e:
             raise ConnectError(f"connect {self.addr}: {e}") from e
         self._sock = sock
+        rc = self.rpc_conf
+        self._writer = CoalescedWriter(
+            sock, self._loop,
+            max_bytes=getattr(rc, "send_coalesce_bytes", 256 * 1024),
+            max_frames=getattr(rc, "send_coalesce_frames", 128),
+            inline_max=getattr(rc, "send_inline_max", 8 * 1024),
+            metrics=self.metrics, on_broken=self._on_send_broken,
+            name=f"client {self.addr}")
+        self._dec = BulkDecoder(
+            size=getattr(rc, "recv_buffer_bytes", 256 * 1024),
+            metrics=self.metrics)
         self._reader_task = asyncio.ensure_future(self._read_loop())
         return self
 
+    def _on_send_broken(self, exc: BaseException) -> None:
+        # the writer died mid-batch: a partial frame may be on the wire,
+        # so the stream is unrecoverable — poison the connection (the
+        # pool must never hand it to another request) and close the
+        # socket so the read loop fails every waiter out
+        self.closed = True
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
     # ---------------- receive plumbing ----------------
 
-    async def _recv_into(self, view: memoryview) -> None:
-        sock, loop = self._sock, self._loop
-        assert sock is not None and loop is not None
-        off = 0
-        n = len(view)
-        while off < n:
-            got = await loop.sock_recv_into(sock, view[off:])
-            if got == 0:
-                raise ConnectionResetError("peer closed")
-            off += got
-
     async def _read_loop(self) -> None:
-        prefix = bytearray(4)
-        fixed = bytearray(FIXED_LEN)
+        dec, loop, sock = self._dec, self._loop, self._sock
+        assert dec is not None and loop is not None and sock is not None
         try:
             while True:
-                await self._recv_into(memoryview(prefix))
-                (total,) = LEN_PREFIX.unpack(prefix)
-                if total > MAX_FRAME or total < FIXED_LEN:
-                    raise CurvineError(f"bad frame length {total}")
-                await self._recv_into(memoryview(fixed))
-                version, code, req_id, status, flags, hdr_len = \
-                    frame_mod._FIXED.unpack(fixed)
-                header: dict = {}
-                if hdr_len:
-                    hdr_buf = bytearray(hdr_len)
-                    await self._recv_into(memoryview(hdr_buf))
-                    import msgpack
-                    header = msgpack.unpackb(bytes(hdr_buf), raw=False,
-                                             strict_map_key=False)
-                data_len = total - FIXED_LEN - hdr_len
+                env = dec.try_next()
+                if env is None:
+                    await dec.fill(loop, sock)
+                    continue
+                code, req_id, status, flags, header, data_len = env
                 sink = self._sinks.get(req_id)
                 data: bytes = b""
                 if data_len:
                     if (sink is not None and status == 0
                             and sink.filled + data_len <= len(sink.view)):
-                        await self._recv_into(
-                            sink.view[sink.filled:sink.filled + data_len])
+                        # zero-copy sink: the buffered prefix of this
+                        # chunk is copied out of the bulk buffer, the
+                        # (typically multi-MB) remainder is received
+                        # straight into the caller's view
+                        dst = sink.view[sink.filled:
+                                        sink.filled + data_len]
+                        got = dec.take_into(dst)
+                        if got < data_len:
+                            await dec.recv_exact(loop, sock, dst[got:])
                         sink.filled += data_len
                     else:
-                        buf = bytearray(data_len)
-                        await self._recv_into(memoryview(buf))
-                        data = bytes(buf)
+                        data = bytes(await dec.read_payload(
+                            loop, sock, data_len))
                 msg = Message(code=code, req_id=req_id, status=status,
                               flags=flags, header=header, data=data)
                 q = self._waiters.get(req_id)
@@ -139,6 +151,12 @@ class Connection:
             log.exception("connection %s read loop", self.addr)
         finally:
             self.closed = True
+            # the read loop dying is the one teardown path every broken
+            # connection goes through (peer reset, poison, close): take
+            # the writer task down with it or it leaks, parked on its
+            # wake event forever
+            if self._writer is not None:
+                self._writer.close()
             err = Message(status=1, header={"error_code": 26,
                                             "error": f"connection {self.addr} closed"},
                           flags=Flags.RESPONSE | Flags.EOF)
@@ -147,6 +165,8 @@ class Connection:
 
     async def close(self) -> None:
         self.closed = True
+        if self._writer is not None:
+            await self._writer.aclose()
         if self._reader_task:
             self._reader_task.cancel()
         if self._sock is not None:
@@ -159,33 +179,25 @@ class Connection:
     # ---------------- send plumbing ----------------
 
     async def send(self, msg: Message) -> None:
-        if self.closed or self._sock is None:
+        if self.closed or self._writer is None:
             raise ConnectError(f"connection {self.addr} is closed")
-        bufs = msg.encode()
-        async with self._wlock:
-            try:
-                assert self._loop is not None
-                for b in bufs:
-                    await self._loop.sock_sendall(self._sock, b)
-            except asyncio.CancelledError:
-                # cancelled mid-send (teardown of a prefetch/stream
-                # task): a PARTIAL frame may be on the wire, so the
-                # stream is unrecoverable mid-protocol. Poison the
-                # connection NOW — the pool must never hand it to
-                # another request, whose frames would queue behind
-                # garbage the peer can't parse (the peer would sit in
-                # recv forever and the next sender would wedge in an
-                # unbounded sendall once the socket buffer filled).
-                self.closed = True
-                if self._sock is not None:
-                    try:
-                        self._sock.close()
-                    except OSError:
-                        pass
-                raise
-            except (OSError, RuntimeError) as e:
-                self.closed = True
-                raise ConnectError(f"send to {self.addr}: {e}") from e
+        try:
+            await self._writer.send(msg)
+        except asyncio.CancelledError:
+            # cancelled send (teardown of a prefetch/stream task): on
+            # the coalesced queue path a cancel severs at a frame
+            # boundary — a queued frame is dropped whole, an in-flight
+            # one is written out whole — so the connection stays usable
+            # un-poisoned. Only the uncontended INLINE fast path keeps
+            # the PR-2 behavior: a cancel mid-write leaves a partial
+            # frame, and the writer poisons us via _on_send_broken.
+            raise
+        except ConnectError:
+            self.closed = True
+            raise
+        except (OSError, RuntimeError) as e:
+            self.closed = True
+            raise ConnectError(f"send to {self.addr}: {e}") from e
 
     def register(self, req_id: int) -> asyncio.Queue:
         q: asyncio.Queue = asyncio.Queue()
@@ -342,9 +354,12 @@ class Connection:
 class ConnectionPool:
     """Per-address connection pool with lazy dial and broken-conn eviction."""
 
-    def __init__(self, size: int = 4, timeout_ms: int = 30_000):
+    def __init__(self, size: int = 4, timeout_ms: int = 30_000,
+                 rpc_conf=None, metrics=None):
         self.size = size
         self.timeout_ms = timeout_ms
+        self.rpc_conf = rpc_conf
+        self.metrics = metrics
         self._conns: dict[str, list[Connection]] = {}
         self._rr: dict[str, int] = {}
         self._lock = asyncio.Lock()
@@ -389,7 +404,9 @@ class ConnectionPool:
         last: Exception | None = None
         for i in range(attempts):
             try:
-                conn = Connection(addr, self.timeout_ms)
+                conn = Connection(addr, self.timeout_ms,
+                                  rpc_conf=self.rpc_conf,
+                                  metrics=self.metrics)
                 conn.fault_hook = self.fault_hook
                 return await conn.connect()
             except ConnectError as e:

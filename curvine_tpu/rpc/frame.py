@@ -101,6 +101,28 @@ class Message:
             out.append(self.data)
         return out
 
+    def encode_into(self, out: bytearray, inline_max: int = 0,
+                    ) -> "bytes | bytearray | memoryview | None":
+        """Append this frame to ``out`` (the coalesced-writer batch
+        path: many small frames flattened into one buffer → one send).
+        A data payload longer than ``inline_max`` is NOT copied — it is
+        returned for the caller to emit as its own iovec entry right
+        after ``out``'s bytes; smaller payloads are flattened into
+        ``out`` and None is returned."""
+        hdr = msgpack.packb(self.header, use_bin_type=True) if self.header else b""
+        total = FIXED_LEN + len(hdr) + len(self.data)
+        out += LEN_PREFIX.pack(total)
+        out += _FIXED.pack(VERSION, self.code, self.req_id, self.status,
+                           self.flags, len(hdr))
+        if hdr:
+            out += hdr
+        if not len(self.data):
+            return None
+        if len(self.data) <= inline_max:
+            out += self.data
+            return None
+        return self.data
+
     @staticmethod
     def decode(payload: memoryview) -> "Message":
         """Decode one frame body (without the u32 length prefix)."""
@@ -143,16 +165,45 @@ def unpack(buf: bytes | memoryview) -> Any:
     return msgpack.unpackb(buf, raw=False, strict_map_key=False) if len(buf) else None
 
 
-async def read_frame(reader) -> Message:
-    """Read one frame from an asyncio StreamReader."""
-    prefix = await reader.readexactly(4)
-    (total,) = LEN_PREFIX.unpack(prefix)
+ENVELOPE_MAX = 4 + FIXED_LEN  # bytes needed before hdr_len is known
+
+
+def decode_envelope(buf, pos: int, limit: int,
+                    ) -> "tuple[int, int, int, int, int, dict, int] | None":
+    """Batch decode: one frame *envelope* (length prefix + fixed block +
+    msgpack header) out of ``buf[pos:limit]``, leaving the data payload
+    unread. Returns ``(end, code, req_id, status, flags, header,
+    data_len)`` with ``end`` = the first payload byte's offset, or None
+    when the envelope isn't fully buffered yet. This is the single
+    framing parser shared by both peers (client read loop and server
+    conn loop drive it through ``transport.BulkDecoder``); validation
+    errors raise CurvineError before any state is consumed."""
+    avail = limit - pos
+    if avail < 4:
+        return None
+    (total,) = LEN_PREFIX.unpack_from(buf, pos)
     if total > MAX_FRAME or total < FIXED_LEN:
-        raise CurvineError(f"bad frame length {total}", code=ErrorCode.ABNORMAL_DATA)
-    body = await reader.readexactly(total)
-    return Message.decode(memoryview(body))
-
-
-def write_frame(writer, msg: Message) -> None:
-    """Queue a frame on an asyncio StreamWriter (caller drains)."""
-    writer.writelines(msg.encode())
+        raise CurvineError(f"bad frame length {total}",
+                           code=ErrorCode.ABNORMAL_DATA)
+    if avail < ENVELOPE_MAX:
+        return None
+    version, code, req_id, status, flags, hdr_len = \
+        _FIXED.unpack_from(buf, pos + 4)
+    if version != VERSION:
+        raise CurvineError(f"unsupported frame version {version}",
+                           code=ErrorCode.ABNORMAL_DATA)
+    if FIXED_LEN + hdr_len > total:
+        raise CurvineError(f"bad header length {hdr_len}",
+                           code=ErrorCode.ABNORMAL_DATA)
+    end = pos + ENVELOPE_MAX + hdr_len
+    if limit < end:
+        return None
+    header: dict = {}
+    if hdr_len:
+        header = msgpack.unpackb(memoryview(buf)[pos + ENVELOPE_MAX:end],
+                                 raw=False, strict_map_key=False)
+        if not isinstance(header, dict):
+            raise CurvineError(
+                f"frame header is {type(header).__name__}, not a map",
+                code=ErrorCode.ABNORMAL_DATA)
+    return end, code, req_id, status, flags, header, total - FIXED_LEN - hdr_len

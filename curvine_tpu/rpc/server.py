@@ -10,9 +10,13 @@ RpcCode. A handler may:
     (an async callback invoked inline from the receive loop with a view
     into the connection's reusable buffer).
 
-The receive path allocates nothing per frame: payloads land in one
-grow-only buffer per connection (first-touch page faults are paid once),
-which is what makes multi-GiB/s upload streams possible in Python."""
+The receive path allocates nothing per frame: frames are bulk-decoded
+out of one grow-only buffer per connection (first-touch page faults are
+paid once, and one recv_into typically lands many small frames), which
+is what makes multi-GiB/s upload streams AND 100K+ small-op rates
+possible in Python. Sends ride the coalesced writer (rpc/transport.py):
+replies released together — e.g. a whole journal group commit — leave
+in one vectored send instead of one syscall+wakeup each."""
 
 from __future__ import annotations
 
@@ -24,10 +28,10 @@ from typing import Awaitable, Callable
 
 from curvine_tpu.common.errors import CurvineError
 from curvine_tpu.rpc.frame import (
-    FIXED_LEN, LEN_PREFIX, MAX_FRAME, Flags, Message, error_for,
-    response_for,
+    FIXED_LEN, LEN_PREFIX, Flags, Message, error_for, response_for,
 )
 from curvine_tpu.rpc import frame as frame_mod
+from curvine_tpu.rpc.transport import BulkDecoder, CoalescedWriter
 
 log = logging.getLogger(__name__)
 
@@ -39,7 +43,8 @@ StreamSink = Callable[[dict, memoryview, bool], Awaitable[None]]
 class ServerConn:
     """One accepted connection; single receive loop, serialized sends."""
 
-    def __init__(self, sock: socket.socket, loop: asyncio.AbstractEventLoop):
+    def __init__(self, sock: socket.socket, loop: asyncio.AbstractEventLoop,
+                 rpc_conf=None, metrics=None, depth_cell: dict | None = None):
         self.sock = sock
         self.loop = loop
         try:
@@ -48,9 +53,26 @@ class ServerConn:
             self.peer = None
         self._streams: dict[int, asyncio.Queue] = {}
         self._sinks: dict[int, StreamSink] = {}
-        self._wlock = asyncio.Lock()
-        self._buf = bytearray(256 * 1024)   # grow-only payload buffer
+        self._writer = CoalescedWriter(
+            sock, loop,
+            max_bytes=getattr(rpc_conf, "send_coalesce_bytes", 256 * 1024),
+            max_frames=getattr(rpc_conf, "send_coalesce_frames", 128),
+            inline_max=getattr(rpc_conf, "send_inline_max", 8 * 1024),
+            metrics=metrics, depth_cell=depth_cell,
+            on_broken=self._on_send_broken, name="server")
+        self._dec = BulkDecoder(
+            size=getattr(rpc_conf, "recv_buffer_bytes", 256 * 1024),
+            metrics=metrics)
         self.closed = False
+
+    def _on_send_broken(self, exc: BaseException) -> None:
+        # writer died mid-batch → a partial frame may be on the wire:
+        # close the socket so the conn loop tears the connection down
+        self.closed = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
 
     # -------- inbound streams --------
 
@@ -92,10 +114,7 @@ class ServerConn:
     async def send(self, msg: Message) -> None:
         if self.closed:
             raise CurvineError("connection closed")
-        bufs = msg.encode()
-        async with self._wlock:
-            for b in bufs:
-                await self.loop.sock_sendall(self.sock, b)
+        await self._writer.send(msg)
 
     async def send_chunk_from_file(self, code: int, req_id: int, f,
                                    offset: int, count: int,
@@ -103,36 +122,25 @@ class ServerConn:
                                    ) -> int:
         """Zero-copy chunk frame: header via sendall, payload via
         kernel-side sendfile straight from the block file (orpc sendfile
-        parity — data never enters userspace)."""
+        parity — data never enters userspace). Rides the coalesced
+        writer queue so it stays FIFO-ordered with regular frames."""
+        if self.closed:
+            raise CurvineError("connection closed")
         prefix = LEN_PREFIX.pack(FIXED_LEN + count) + frame_mod._FIXED.pack(
             frame_mod.VERSION, code, req_id, 0, flags, 0)
-        async with self._wlock:
-            await self.loop.sock_sendall(self.sock, prefix)
-            f.seek(offset)
-            sent = await self.loop.sock_sendfile(self.sock, f, offset, count,
-                                                 fallback=True)
-        return sent
-
-    async def _recv_into(self, view: memoryview) -> None:
-        off = 0
-        n = len(view)
-        while off < n:
-            got = await self.loop.sock_recv_into(self.sock, view[off:])
-            if got == 0:
-                raise ConnectionResetError
-            off += got
-
-    def _payload_view(self, n: int) -> memoryview:
-        if len(self._buf) < n:
-            self._buf = bytearray(max(n, 2 * len(self._buf)))
-        return memoryview(self._buf)[:n]
+        return await self._writer.send_file(prefix, f, offset, count)
 
 
 class RpcServer:
-    def __init__(self, host: str, port: int, name: str = "rpc"):
+    def __init__(self, host: str, port: int, name: str = "rpc",
+                 rpc_conf=None):
         self.host = host
         self.port = port
         self.name = name
+        self.rpc_conf = rpc_conf
+        # shared by every connection's writer: the exported
+        # rpc.send_queue_depth gauge is the process-wide queued count
+        self._sendq_depth: dict = {"n": 0}
         self._handlers: dict[int, Handler] = {}
         self._lsock: socket.socket | None = None
         self._accept_task: asyncio.Task | None = None
@@ -227,65 +235,50 @@ class RpcServer:
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             except OSError:
                 pass
-            conn = ServerConn(sock, loop)
+            conn = ServerConn(sock, loop, rpc_conf=self.rpc_conf,
+                              metrics=self.metrics,
+                              depth_cell=self._sendq_depth)
             self._conns.add(conn)
             t = asyncio.ensure_future(self._conn_loop(conn))
             self._conn_tasks.add(t)
             t.add_done_callback(self._conn_tasks.discard)
 
     async def _conn_loop(self, conn: ServerConn) -> None:
-        prefix = bytearray(4)
-        fixed = bytearray(FIXED_LEN)
+        dec = conn._dec
         pending: set[asyncio.Task] = set()
         try:
             while True:
                 try:
-                    await conn._recv_into(memoryview(prefix))
+                    env = dec.try_next()
+                    if env is None:
+                        # one recv typically lands many frames; every
+                        # complete frame already buffered is dispatched
+                        # above without touching the socket again
+                        await dec.fill(conn.loop, conn.sock)
+                        continue
                 except (ConnectionResetError, OSError):
                     break
-                (total,) = LEN_PREFIX.unpack(prefix)
-                if total > MAX_FRAME or total < FIXED_LEN:
-                    log.warning("%s: bad frame length %d from %s",
-                                self.name, total, conn.peer)
-                    break
-                try:
-                    await conn._recv_into(memoryview(fixed))
-                    version, code, req_id, status, flags, hdr_len = \
-                        frame_mod._FIXED.unpack(fixed)
-                    if FIXED_LEN + hdr_len > total:
-                        log.warning("%s: bad header length %d from %s",
-                                    self.name, hdr_len, conn.peer)
-                        break
-                    header: dict = {}
-                    if hdr_len:
-                        hview = conn._payload_view(hdr_len)
-                        await conn._recv_into(hview)
-                        import msgpack
-                        header = msgpack.unpackb(bytes(hview), raw=False,
-                                                 strict_map_key=False)
-                        if not isinstance(header, dict):
-                            raise ValueError(
-                                f"header is {type(header).__name__}, "
-                                "not a map")
-                except OSError:
-                    break          # peer hung up mid-frame: just close
+                except asyncio.CancelledError:
+                    raise
                 except Exception as e:  # noqa: BLE001 — hostile bytes
                     log.warning("%s: malformed frame from %s: %s",
                                 self.name, conn.peer, e)
                     break
-                data_len = total - FIXED_LEN - hdr_len
+                code, req_id, status, flags, header, data_len = env
                 is_chunk = bool(flags & (Flags.CHUNK | Flags.EOF)) and \
                     not (flags & Flags.RESPONSE)
 
                 if is_chunk and req_id in conn._sinks:
-                    # zero-copy upload: consume inline from the buffer
-                    # (replay any chunks that were queued pre-registration)
+                    # zero-copy upload: consume inline from the decoder
+                    # buffer (replay any chunks queued pre-registration)
                     q = conn._streams.get(req_id)
                     if q is not None and not q.empty():
                         await conn._drain_queue_into_sink(req_id)
-                    view = conn._payload_view(data_len)
-                    if data_len:
-                        await conn._recv_into(view)
+                    try:
+                        view = await dec.read_payload(
+                            conn.loop, conn.sock, data_len)
+                    except (ConnectionResetError, OSError):
+                        break
                     sink = conn._sinks.get(req_id)
                     if sink is None:       # sink errored during drain
                         continue
@@ -298,12 +291,15 @@ class RpcServer:
                         conn.close_stream(req_id)
                     continue
 
-                view = conn._payload_view(data_len)
+                data = b""
                 if data_len:
-                    await conn._recv_into(view)
+                    try:
+                        data = bytes(await dec.read_payload(
+                            conn.loop, conn.sock, data_len))
+                    except (ConnectionResetError, OSError):
+                        break
                 msg = Message(code=code, req_id=req_id, status=status,
-                              flags=flags, header=header,
-                              data=bytes(view) if data_len else b"")
+                              flags=flags, header=header, data=data)
                 if is_chunk:
                     # NEVER block the receive loop on a stream queue: if
                     # the request frame was dropped (fault injection) or
@@ -339,6 +335,7 @@ class RpcServer:
                     await t
                 except (asyncio.CancelledError, Exception):  # noqa: BLE001
                     pass
+            await conn._writer.aclose()
             try:
                 conn.sock.close()
             except OSError:

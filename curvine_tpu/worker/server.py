@@ -60,7 +60,8 @@ class WorkerServer:
                  worker_id: int | None = None):
         self.conf = conf or ClusterConf()
         wc = self.conf.worker
-        self.rpc = RpcServer(wc.hostname, wc.rpc_port, "worker")
+        self.rpc = RpcServer(wc.hostname, wc.rpc_port, "worker",
+                             rpc_conf=self.conf.rpc)
         tiers = [
             (BdevTier if getattr(t, "layout", "file") == "bdev" else TierDir)(
                 _TIER_NAMES.get(t.storage_type, StorageType.MEM),
@@ -100,8 +101,8 @@ class WorkerServer:
         self.rpc.metrics = self.metrics
         if self.io_engine is not None:
             self.io_engine.metrics = self.metrics
-        self.master_pool = ConnectionPool(size=2)
-        self.peer_pool = ConnectionPool(size=2)
+        self.master_pool = ConnectionPool(size=2, rpc_conf=self.conf.rpc)
+        self.peer_pool = ConnectionPool(size=2, rpc_conf=self.conf.rpc)
         self.worker_id = worker_id if worker_id is not None else 0
         self.chunk_size = wc.io_chunk_size
         # HBM tier-0: device-resident block cache for workers co-located

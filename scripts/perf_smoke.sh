@@ -9,6 +9,7 @@
 #   BENCH_ANN=0 skips the ANN gate (direct-IO only).
 #   BENCH_TRACE=0 skips the tracing-overhead gate.
 #   BENCH_META=0 skips the metadata write-plane gate.
+#   BENCH_RPC=0 skips the RPC transport gate.
 # Exit: 0 = at/above the regression gates, 1 = regression, 2 = harness error.
 
 set -u
@@ -110,6 +111,52 @@ print("perf_smoke: namespace_scale --quick",
       json.dumps(json.load(open(sys.argv[1]))))' "$SCALE_JSON"
     rm -f "$SCALE_JSON"
     echo "perf_smoke: PASS"
+fi
+
+if [ "${BENCH_RPC:-1}" = "0" ]; then
+    echo "perf_smoke: RPC transport gate skipped (BENCH_RPC=0)"
+else
+    # RPC transport gate: loopback echo round-trips through the
+    # coalesced-send / bulk-recv wire path. The RTT ceiling is absolute
+    # (per-call transport overhead must not creep back up); the
+    # pipelined-QPS floor gets the usual 30% slack.
+    RPC_OUT=$(JAX_PLATFORMS=cpu timeout 150 python - <<'EOF'
+import asyncio, json, os, sys
+sys.path.insert(0, os.getcwd())
+from bench import _rpc_smoke
+print(json.dumps(asyncio.run(_rpc_smoke())))
+EOF
+)
+    rc=$?
+    if [ $rc -ne 0 ] || [ -z "$RPC_OUT" ]; then
+        echo "perf_smoke: RPC transport microbench failed (rc=$rc)" >&2
+        exit 2
+    fi
+    echo "$RPC_OUT"
+    python - "$FLOOR_FILE" <<'EOF' "$RPC_OUT"
+import json, sys
+floor_file, result = sys.argv[1], json.loads(sys.argv[2])
+floors = json.load(open(floor_file))
+ceiling = floors["rpc_rtt_us_max"]
+qps_floor = floors["rpc_pipelined_qps"]
+rtt = result.get("rpc_rtt_us", 1e9)
+qps = result.get("rpc_pipelined_qps", 0.0)
+qps_gate = qps_floor * 0.7              # >30% regression fails
+print(f"perf_smoke: rpc_rtt_us={rtt} ceiling={ceiling} "
+      f"rpc_pipelined_qps={qps} floor={qps_floor} gate={qps_gate:.1f} "
+      f"loop={result.get('loop_impl')}")
+if rtt > ceiling:
+    print(f"perf_smoke: FAIL — rpc_rtt_us {rtt} > {ceiling} "
+          "(per-call transport overhead regressed)", file=sys.stderr)
+    sys.exit(1)
+if qps < qps_gate:
+    print(f"perf_smoke: FAIL — rpc_pipelined_qps {qps} < {qps_gate:.1f} "
+          f"(floor {qps_floor} - 30%)", file=sys.stderr)
+    sys.exit(1)
+print("perf_smoke: PASS")
+EOF
+    rc=$?
+    [ $rc -ne 0 ] && exit $rc
 fi
 
 if [ "${BENCH_TRACE:-1}" = "0" ]; then

@@ -396,6 +396,64 @@ async def _meta_smoke(n_create: int = 8_000, bs: int = 500) -> dict:
     return out
 
 
+async def _shard_smoke(shards: int = 2, n_create: int = 6_000,
+                       bs: int = 500, backend: str | None = None,
+                       dirs: int = 16) -> dict:
+    """Sharded-namespace write-plane gate: the same batched-create storm
+    as _meta_smoke, against a master running `shards` metadata shards
+    behind the path router. Files spread over `dirs` parent directories
+    so the crc32(parent) placement exercises every shard. The backend
+    defaults to real child processes when the box has cores to run them
+    concurrently and the in-process backend (identical wire path, one
+    core) otherwise; the artifact records which ran plus the core count,
+    so a flat curve on a 1-core box cannot masquerade as a scaling
+    regression. Returns {meta_create_shard_qps, shards, shard_backend,
+    cpus} for perf_floor.json / scripts/namespace_scale.py --shards."""
+    from curvine_tpu.rpc import RpcCode
+    from curvine_tpu.testing import MiniCluster
+    cpus = os.cpu_count() or 1
+    if backend is None:
+        backend = os.environ.get(
+            "BENCH_SHARD_BACKEND",
+            "process" if cpus > shards else "inproc")
+    base = os.path.join(_pick_shm_dir(),
+                        f"curvine-shardsmoke-{os.getpid()}-{shards}")
+    out: dict = {"shards": shards, "cpus": cpus,
+                 "shard_backend": backend if shards > 1 else "none"}
+    try:
+        async with MiniCluster(workers=0, base_dir=base, journal=False,
+                               shards=shards,
+                               shard_backend=backend) as mc:
+            c = mc.client()
+            paths = [f"/smoke/shard/d{j % dirs:02d}/f{j:07d}"
+                     for j in range(n_create)]
+            # parents up front: the timed storm measures create
+            # throughput, not the one-time mkdir broadcast fan-out
+            for d in range(dirs):
+                await c.meta.mkdir(f"/smoke/shard/d{d:02d}")
+            offs = list(range(0, n_create, bs))
+
+            async def create_batch(lo: int):
+                await c.meta.call(RpcCode.CREATE_FILES_BATCH, {"requests": [
+                    {"path": paths[j], "overwrite": True,
+                     "block_size": 4 * MB, "replicas": 1,
+                     "client_name": c.meta.client_id}
+                    for j in range(lo, min(lo + bs, n_create))]},
+                    mutate=True)
+
+            t0 = time.perf_counter()
+            for group in range(0, len(offs), 4):
+                await asyncio.gather(*(create_batch(lo)
+                                       for lo in offs[group:group + 4]))
+            out["meta_create_shard_qps"] = round(
+                n_create / (time.perf_counter() - t0), 1)
+            await c.close()
+    finally:
+        import shutil
+        shutil.rmtree(base, ignore_errors=True)
+    return out
+
+
 async def run_bench(total_mb: int = 256, block_mb: int = 64,
                     latency_block_mb: int = 1, latency_iters: int = 200):
     import jax
@@ -770,6 +828,16 @@ async def run_bench(total_mb: int = 256, block_mb: int = 64,
         await c.close()
     import shutil
     shutil.rmtree(base, ignore_errors=True)
+
+    # ---- sharded namespace: create-QPS A/B curve (same storm at
+    # shards=1/2/4; shards=1 is the unsharded master, the true A side) ----
+    if os.environ.get("BENCH_SHARDS", "1") != "0":
+        rs = [await _shard_smoke(s) for s in (1, 2, 4)]
+        results["meta_create_shard_curve"] = {
+            str(r["shards"]): r["meta_create_shard_qps"] for r in rs}
+        results["meta_create_shard_qps"] = rs[-1]["meta_create_shard_qps"]
+        results["shard_backend"] = rs[-1]["shard_backend"]
+        results["shard_cpus"] = rs[-1]["cpus"]
     return results
 
 
@@ -1100,6 +1168,12 @@ def main(argv: list[str] | None = None):
         "meta_create_batch_qps": round(
             results.get("meta_create_batch_qps", 0), 1),
         "meta_qps_native": round(results.get("meta_qps_native", 0), 1),
+        "meta_create_shard_qps": round(
+            results.get("meta_create_shard_qps", 0), 1),
+        "meta_create_shard_curve": results.get(
+            "meta_create_shard_curve", {}),
+        "shard_backend": results.get("shard_backend", "none"),
+        "shard_cpus": results.get("shard_cpus", os.cpu_count() or 1),
         "rpc_rtt_us": round(results.get("rpc_rtt_us", 0), 1),
         "rpc_pipelined_qps": round(results.get("rpc_pipelined_qps", 0), 1),
         "loop_impl": results.get("loop_impl", "asyncio"),

@@ -285,6 +285,22 @@ async def cmd_report(args):
         for l in wd.get("long_held_locks", []):
             print(f"  LONG-HELD lock {l['path']} by {l['owner']} "
                   f"for {l['age_s']}s")
+        # sharded-namespace table (empty / absent on unsharded masters)
+        try:
+            rows = await c.meta.shard_table()
+        except err.CurvineError:
+            return
+        if rows:
+            print(f"Namespace shards: {len(rows)}")
+            print("  shard  state        qps   inodes   blocks  "
+                  "jseq  qdepth  addr")
+            for r in rows:
+                print(f"  {r.get('shard', '?'):>5}  "
+                      f"{r.get('state', '?'):<11}  "
+                      f"{r.get('qps', 0):>5.0f}  "
+                      f"{r.get('inodes', 0):>7}  {r.get('blocks', 0):>7}  "
+                      f"{r.get('journal_seq', 0):>4}  "
+                      f"{r.get('queue_depth', 0):>6}  {r.get('addr', '')}")
     finally:
         await c.close()
 
@@ -386,6 +402,8 @@ async def cmd_load(args):
                 print(f"  {job.state.name}: {done}/{len(job.tasks)} tasks")
                 if job.state in (JobState.COMPLETED, JobState.FAILED,
                                  JobState.CANCELLED):
+                    if job.message:
+                        print(f"  {job.message}", file=sys.stderr)
                     break
                 await asyncio.sleep(1)
     finally:
@@ -433,6 +451,8 @@ async def cmd_export(args):
                 print(f"  {job.state.name}: {done}/{len(job.tasks)} tasks")
                 if job.state in (JobState.COMPLETED, JobState.FAILED,
                                  JobState.CANCELLED):
+                    if job.message:
+                        print(f"  {job.message}", file=sys.stderr)
                     break
                 await asyncio.sleep(1)
     finally:

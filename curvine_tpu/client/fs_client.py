@@ -290,6 +290,13 @@ class FsClient:
         Parity: master_monitor.rs + fs_dir_watchdog.rs."""
         return await self.call(RpcCode.CLUSTER_HEALTH, {})
 
+    async def shard_table(self) -> list[dict]:
+        """Per-shard rows of the sharded namespace plane (empty on an
+        unsharded master): inode/block counts, journal seq, queue
+        depth, qps."""
+        rep = await self.call(RpcCode.SHARD_TABLE, {})
+        return rep.get("shards", [])
+
     async def list_options(self, path: str, pattern: str | None = None,
                            dirs_only: bool = False, files_only: bool = False,
                            offset: int = 0, limit: int = 0

@@ -76,6 +76,20 @@ class MasterConf:
     # master dir restores from it on start. "" disables.
     ufs_backup_uri: str = ""
     ufs_backup_interval_s: int = 300
+    # sharded namespace (master/sharding.py): >1 partitions the inode
+    # tree across meta_shards single-writer shard actors, the RPC
+    # endpoint becoming a thin router. 1 = today's in-process path,
+    # byte-for-byte. Sharding is mutually exclusive with raft HA for
+    # now — see docs/metadata-scale.md for the matrix.
+    meta_shards: int = 1
+    # "process": each shard is a multiprocessing (spawn) child with its
+    # own event loop — the multi-core deployment shape. "inproc": shard
+    # servers share the router's loop (tests / single-core boxes; same
+    # wire protocol, no core scaling).
+    shard_backend: str = "process"
+    # router-side LRU of directories already broadcast-created on every
+    # shard (the every-dir-everywhere invariant)
+    shard_dir_cache: int = 65_536
     # raft (HA); empty peers → single-node journal mode
     raft_peers: list[str] = field(default_factory=list)
     raft_node_id: int = 1

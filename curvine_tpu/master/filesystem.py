@@ -44,9 +44,11 @@ class MasterFilesystem:
                  placement: str | PlacementPolicy = "local",
                  lost_timeout_ms: int = 30_000,
                  snapshot_interval: int = 100_000,
-                 store: MemMetaStore | KvMetaStore | None = None):
+                 store: MemMetaStore | KvMetaStore | None = None,
+                 id_stride: int = 1, id_offset: int = 0):
         self.store = store if store is not None else MemMetaStore()
-        self.tree = InodeTree(self.store)
+        self.tree = InodeTree(self.store, id_stride=id_stride,
+                              id_offset=id_offset)
         self.blocks = BlockMap(self.store)
         self.workers = WorkerMap(lost_timeout_ms=lost_timeout_ms)
         self.journal = journal
@@ -689,6 +691,167 @@ class MasterFilesystem:
             raise err.FileNotFound(f"parent of {dst} not found")
         self.tree.add_entry(parent, name, node)
         return node.to_status(dst)
+
+    # ============ cross-shard two-phase ops (master/sharding.py) ============
+    # Presumed-abort 2PC for renames/links whose src and dst hash to
+    # different namespace shards. Each participant journals its vote
+    # (tx_prepare) and keeps a durable tx record until the coordinator
+    # tells it to commit/abort; the dst side RETAINS its record in state
+    # "committed" until the final forget, so a recovery sweep that finds
+    # any committed record knows the tx passed the commit point. All
+    # methods run on the shard's single-writer actor loop.
+
+    def tx_prepare(self, txid: str, op: str, src: str, dst: str,
+                   role: str, rec: dict | None = None) -> dict:
+        from curvine_tpu.master.store import _enc_inode
+        if op not in ("rename", "link"):
+            raise err.InvalidArgument(f"unknown shard tx op {op!r}")
+        if role == "src":
+            node = self.tree.resolve(src)
+            if node is None:
+                raise err.FileNotFound(src)
+            if node.is_dir:
+                raise err.IsADirectory(src)
+            if op == "rename":
+                self._mount_write_guard(src)
+                if node.nlink > 1:
+                    raise err.Unsupported(
+                        "cross-shard rename of a hard-linked file")
+                if not node.is_complete:
+                    raise err.InvalidArgument(
+                        f"cross-shard rename of open file {src}")
+            blocks, locs = [], []
+            for bid in node.blocks:
+                meta = self.blocks.get(bid)
+                if meta is None:
+                    blocks.append([bid, 0, 1])
+                    continue
+                blocks.append([bid, meta.len, meta.replicas])
+                for wid, loc in meta.locs.items():
+                    locs.append([bid, wid, int(loc.storage_type)])
+            rec = {"txid": txid, "role": "src", "op": op, "src": src,
+                   "dst": dst, "inode": _enc_inode(node), "blocks": blocks,
+                   "locs": locs, "state": "prepared"}
+        else:
+            if rec is None:
+                raise err.InvalidArgument("dst prepare without src payload")
+            self._mount_write_guard(dst)
+            d = self.tree.resolve(dst)
+            if d is not None:
+                if op == "link":
+                    raise err.FileAlreadyExists(dst)
+                if d.is_dir and d.children_num:
+                    raise err.DirNotEmpty(dst)
+                if d.is_dir:
+                    raise err.IsADirectory(dst)
+            self.tree.check_parent_dirs(dst)
+            rec = dict(rec)
+            rec["role"] = "dst"
+        self._log("tx_prepare", dict(rec=rec))
+        return rec
+
+    def _apply_tx_prepare(self, rec: dict) -> None:
+        self.store.tx_put(rec["txid"], rec)
+
+    def tx_commit(self, txid: str) -> None:
+        # idempotent: a retried/replayed commit for a forgotten tx no-ops
+        if self.store.tx_get(txid) is None:
+            return
+        self._log("tx_commit", dict(txid=txid))
+
+    def _apply_tx_commit(self, txid: str) -> None:
+        rec = self.store.tx_get(txid)
+        if rec is None:
+            return
+        if rec["role"] == "src":
+            self._tx_commit_src(rec)
+            self.store.tx_remove(txid)
+            return
+        self._tx_commit_dst(rec)
+        # dst keeps the record ("committed") until the coordinator's
+        # forget — it is the durable marker that the tx passed the
+        # commit point, consulted by the crash-recovery sweep
+        rec = dict(rec)
+        rec["state"] = "committed"
+        self.store.tx_put(txid, rec)
+
+    def _tx_commit_src(self, rec: dict) -> None:
+        node = self.tree.resolve(rec["src"])
+        if node is None:
+            return                     # replay after the entry moved
+        if rec["op"] == "link":
+            # the dst shard now holds a mirrored entry referencing the
+            # same blocks: count it here so a later delete of this copy
+            # never frees blocks the mirror still reads
+            node.nlink += 1
+            self.tree.save(node)
+            return
+        parent, name = self.tree.resolve_parent(rec["src"])
+        if parent is None:
+            return
+        removed = self.tree.remove_child(parent, name)
+        if removed is not None:
+            # drop block METAS only — ownership moved to the dst shard,
+            # so no worker-side deletes are queued
+            for bid in list(removed.blocks):
+                self.blocks.remove_block(bid)
+            if self.open_files is not None:
+                self.open_files.discard(removed.id)
+
+    def _tx_commit_dst(self, rec: dict) -> None:
+        from curvine_tpu.master.store import _dec_inode
+        node = _dec_inode(rec["inode"])
+        dst = rec["dst"]
+        parent, name = self.tree.resolve_parent(dst)
+        if parent is None or not parent.is_dir:
+            raise err.FileNotFound(f"parent of {dst} not found")
+        existing = self.tree.resolve(dst)
+        if existing is not None:
+            if existing.id == node.id:
+                return                 # replay: already committed
+            if rec["op"] == "rename":
+                self._delete_inode(existing, recursive=False,
+                                   parent=parent, name=name)
+                parent = self.tree.get(parent.id)
+            else:
+                raise err.FileAlreadyExists(dst)
+        node.name = name
+        node.parent_id = parent.id
+        node.mtime = now_ms()
+        if rec["op"] == "link":
+            # mirrored hard link: 1 for this entry + 1 phantom for the
+            # src shard's copy — neither side ever frees the shared
+            # blocks (leak-over-corruption; see docs/metadata-scale.md)
+            node.nlink = 2
+        self.tree.add_child(parent, node)
+        for bid, length, replicas in rec.get("blocks", []):
+            self.blocks.put(bid, length, node.id, replicas)
+        for bid, wid, st in rec.get("locs", []):
+            self.blocks.add_replica(bid, wid, StorageType(st))
+
+    def tx_abort(self, txid: str) -> None:
+        if self.store.tx_get(txid) is None:
+            return
+        self._log("tx_abort", dict(txid=txid))
+
+    def _apply_tx_abort(self, txid: str) -> None:
+        self.store.tx_remove(txid)
+
+    def tx_forget(self, txid: str) -> None:
+        if self.store.tx_get(txid) is None:
+            return
+        self._log("tx_forget", dict(txid=txid))
+
+    def _apply_tx_forget(self, txid: str) -> None:
+        self.store.tx_remove(txid)
+
+    def list_tx(self) -> list[dict]:
+        """In-doubt tx records for the recovery sweep (no inode bytes)."""
+        out = []
+        for rec in self.store.iter_tx():
+            out.append({k: rec[k] for k in
+                        ("txid", "role", "op", "src", "dst", "state")})
+        return out
 
     def resize_file(self, path: str, new_len: int) -> None:
         """Shrink OR extend. Extending past the last written block

@@ -68,24 +68,33 @@ class InodeTree:
     """Path resolution + mutations over a MetaStore. Single-writer
     (master actor); every mutation writes through to the store."""
 
-    def __init__(self, store=None) -> None:
+    def __init__(self, store=None, id_stride: int = 1,
+                 id_offset: int = 0) -> None:
         from curvine_tpu.master.store import MemMetaStore
         self.store = store if store is not None else MemMetaStore()
+        # striped id allocation for the sharded namespace: shard k of N
+        # allocates ids ≡ k (mod N), so ids are globally unique with no
+        # cross-shard coordination and replay stays deterministic.
+        # stride=1/offset=0 (the default) is today's sequence unchanged.
+        self.id_stride = max(1, id_stride)
+        self.id_offset = id_offset
         if self.store.get(ROOT_ID) is None:
             root = Inode(id=ROOT_ID, name="", file_type=FileType.DIR,
                          parent_id=0, mtime=now_ms(), atime=now_ms())
             self.store.put(root, new=True)
-            self.store.set_counter("next_id", ROOT_ID + 1)
+            self.store.set_counter("next_id", ROOT_ID + 1 + self.id_offset)
             if self.store.kind == "kv":
                 self.store.commit_applied(self.store.get_counter(
                     "applied_seq", 0))
 
     # -- id allocation (journaled via op replay determinism) --
     def _alloc_id(self) -> int:
-        return self.store.bump_counter("next_id", 1, ROOT_ID + 1)
+        return self.store.bump_counter("next_id", self.id_stride,
+                                       ROOT_ID + 1 + self.id_offset)
 
     def alloc_block_id(self) -> int:
-        return self.store.bump_counter("next_block_id", 1, 1)
+        return self.store.bump_counter("next_block_id", self.id_stride,
+                                       1 + self.id_offset)
 
     @property
     def root(self) -> Inode:

@@ -46,10 +46,28 @@ class JobManager:
 
     def _plan(self, job: JobInfo) -> None:
         if job.kind == "load":
-            asyncio.ensure_future(
+            fut = asyncio.ensure_future(
                 self._plan_load(job, job.recursive, job.replicas))
         else:
-            asyncio.ensure_future(self._plan_export(job, job.recursive))
+            fut = asyncio.ensure_future(self._plan_export(job, job.recursive))
+        fut.add_done_callback(lambda f: self._plan_done(job, f))
+
+    def _plan_done(self, job: JobInfo, fut: asyncio.Future) -> None:
+        """Backstop for a planner coroutine that died OUTSIDE its own
+        try block (e.g. a broken ufs import). Without this the exception
+        sits in the discarded future and the job reads PENDING forever."""
+        if fut.cancelled():
+            return
+        e = fut.exception()
+        if e is None or job.state not in (JobState.PENDING,
+                                          JobState.RUNNING):
+            return
+        log.warning("%s job %s planner crashed: %s", job.kind,
+                    job.job_id, e)
+        job.state = JobState.FAILED
+        job.message = str(e) or type(e).__name__
+        job.finish_ms = now_ms()
+        self._persist(job)
 
     def _persist(self, job: JobInfo) -> None:
         """Journal the job record (sans per-file tasks — a resumed
@@ -114,6 +132,8 @@ class JobManager:
                 walk(job.path)
             else:
                 files.append(st)
+            if job.state != JobState.PENDING:
+                return                # cancelled mid-plan: stay cancelled
             for f in files:
                 task = TaskInfo(task_id=uuid.uuid4().hex[:16],
                                 job_id=job.job_id, path=f.path,
@@ -127,14 +147,17 @@ class JobManager:
         except Exception as e:  # noqa: BLE001 — job fails with message
             log.warning("export job %s planning failed: %s", job.job_id, e)
             job.state = JobState.FAILED
-            job.message = str(e)
+            job.message = str(e) or type(e).__name__
+            job.finish_ms = now_ms()
             self._persist(job)
 
     async def _plan_load(self, job: JobInfo, recursive: bool,
                          replicas: int) -> None:
         """Enumerate UFS files under job.path → one task per file."""
-        from curvine_tpu.ufs import create_ufs
         try:
+            # inside the try: a missing/broken ufs backend must surface
+            # as a FAILED job with a message, not a swallowed ImportError
+            from curvine_tpu.ufs import create_ufs
             mount, ufs_uri = self.mounts.resolve(job.path)
             ufs = create_ufs(ufs_uri, properties=mount.properties)
             files = []
@@ -147,6 +170,8 @@ class JobManager:
                         files.append(f)
             else:
                 files.append(st)
+            if job.state != JobState.PENDING:
+                return                # cancelled mid-plan: stay cancelled
             for f in files:
                 _, cv_path = self.mounts.reverse(f.path)
                 task = TaskInfo(task_id=uuid.uuid4().hex[:16],
@@ -162,7 +187,8 @@ class JobManager:
         except Exception as e:  # noqa: BLE001 — job fails with message
             log.warning("load job %s planning failed: %s", job.job_id, e)
             job.state = JobState.FAILED
-            job.message = str(e)
+            job.message = str(e) or type(e).__name__
+            job.finish_ms = now_ms()
             self._persist(job)
 
     async def run(self, leader_gate=None) -> None:

@@ -12,7 +12,7 @@ import logging
 
 from curvine_tpu.common.conf import ClusterConf
 from curvine_tpu.common.journal import Journal
-from curvine_tpu.common.types import CommitBlock, SetAttrOpts
+from curvine_tpu.common.types import CommitBlock, SetAttrOpts, now_ms
 from curvine_tpu.common.metrics import MetricsRegistry
 from curvine_tpu.common.path import norm_path
 from curvine_tpu.master.acl import AclEnforcer, R, UserCtx, W, X
@@ -31,9 +31,22 @@ log = logging.getLogger(__name__)
 
 class MasterServer:
     def __init__(self, conf: ClusterConf | None = None,
-                 journal: bool = True):
+                 journal: bool = True, shard_id: int | None = None,
+                 shard_count: int = 1):
         self.conf = conf or ClusterConf()
         mc = self.conf.master
+        # sharded namespace (master/sharding.py): shard_id is set when
+        # THIS server is one shard actor of a router's fleet (striped id
+        # allocation); meta_shards>1 with shard_id=None makes this
+        # server the ROUTER. shards=1 never constructs any of it.
+        self.shard_id = shard_id
+        self.shard_count = shard_count
+        self.sharded = mc.meta_shards > 1 and shard_id is None
+        if self.sharded and mc.raft_peers:
+            from curvine_tpu.common import errors as _err
+            raise _err.InvalidArgument(
+                "meta_shards>1 is mutually exclusive with raft HA "
+                "(set meta_shards=1 under raft; see docs/metadata-scale.md)")
         j = Journal(mc.journal_dir, fsync=mc.journal_fsync) if journal else None
         store = None
         if mc.meta_store == "kv":
@@ -43,9 +56,12 @@ class MasterServer:
                                 cache_inodes=mc.meta_cache_inodes,
                                 engine=mc.meta_engine)
         # native metadata read plane: mirror every committed namespace
-        # mutation into C++ and serve stat/exists from native threads
+        # mutation into C++ and serve stat/exists from native threads.
+        # Never on the shard ROUTER: its local store holds no files
+        # (mutations route to the shard fleet), so the mirror would
+        # serve empty stat/list answers that bypass the shards.
         self.fastmeta = None
-        if mc.fast_meta:
+        if mc.fast_meta and not self.sharded:
             from curvine_tpu.master import fastmeta
             if fastmeta.available():
                 if store is None:
@@ -58,7 +74,9 @@ class MasterServer:
         self.fs = MasterFilesystem(
             journal=j, placement=mc.block_placement_policy,
             lost_timeout_ms=mc.worker_lost_timeout_ms,
-            snapshot_interval=mc.snapshot_interval_entries, store=store)
+            snapshot_interval=mc.snapshot_interval_entries, store=store,
+            id_stride=shard_count if shard_id is not None else 1,
+            id_offset=shard_id or 0)
         self.fs.audit_log = mc.audit_log
         self.mounts = MountManager(self.fs)
         self.fs.mounts = self.mounts
@@ -111,7 +129,13 @@ class MasterServer:
                      if i + 1 != mc.raft_node_id}
             self.raft = RaftLite(mc.raft_node_id, peers, self.fs, self.rpc)
             self.fs.on_mutation = self.raft.on_mutation
+        self.shards = None
+        if self.sharded:
+            from curvine_tpu.master.sharding import ShardRouter
+            self.shards = ShardRouter(self, journal=journal)
         self._register_handlers()
+        if self.shards is not None:
+            self._register_shard_routes()
         self._worker_counters: dict[int, dict] = {}
         self._bg: list[asyncio.Task] = []
         from curvine_tpu.common.executor import ScheduledExecutor
@@ -139,6 +163,12 @@ class MasterServer:
         # durable decommission intents (KV cold starts skip replay, so
         # runtime-only state would otherwise vanish on restart)
         self.fs.workers.deco_ids |= set(self.fs.store.iter_deco())
+        if self.shards is not None:
+            # shards (and the crash-recovery sweep) come up before the
+            # endpoint accepts traffic
+            await self.shards.start()
+            self.executor.submit_periodic("shard-stats",
+                                          self.shards.poll_stats, 2.0)
         await self.rpc.start()
         if self.raft is not None:
             await self.raft.start()
@@ -244,6 +274,8 @@ class MasterServer:
             t.cancel()
         self._bg.clear()
         await self.rpc.stop()
+        if self.shards is not None:
+            await self.shards.stop()
         await self._obs_pool.close()
         try:
             self.fs.flush_group()   # drain any open journal group
@@ -310,6 +342,65 @@ class MasterServer:
         r(C.GET_JOB_STATUS, self._h(self._job_status))
         r(C.CANCEL_JOB, self._h(self._cancel_job, mutate=True))
         r(C.REPORT_TASK, self._h(self._report_task))
+        # sharded namespace plane: every master answers the 2PC
+        # participant protocol and stats (a shard IS a MasterServer);
+        # SHARD_TABLE is only meaningful on a router
+        r(C.SHARD_TX, self._h(self._shard_tx, mutate=True))
+        r(C.SHARD_TX_LIST, self._h(self._shard_tx_list))
+        r(C.SHARD_STATS, self._h(self._shard_stats))
+        r(C.SHARD_TABLE, self._h(self._shard_table))
+
+    def _register_shard_routes(self) -> None:
+        """meta_shards>1: this endpoint is a thin router. Namespace
+        codes RE-register to forwarding handlers (master/sharding.py);
+        mounts, jobs, locks, health, spans and worker assignment stay
+        router-local. Routed handlers skip _h's barriers — durability
+        is the owning shard's group commit, and retries dedup in the
+        owning shard's retry cache (routing is deterministic), except
+        the multi-step 2PC ops which cache at the router."""
+        sh = self.shards
+        r = self.rpc.register
+        C = RpcCode
+
+        def wrap(fn, cache: bool = False):
+            async def handler(msg: Message, conn: ServerConn):
+                req = self._norm_req(unpack(msg.data) or {})
+                if cache:
+                    key = (req.get("client_id"), req.get("call_id"))
+                    if key[0] is not None and key[1] is not None:
+                        hit = self.retry_cache.get(key)
+                        if hit is not None:
+                            return {}, hit
+                        data = pack(await fn(req, msg))
+                        self.retry_cache.put(key, data)
+                        return {}, data
+                return {}, pack(await fn(req, msg))
+            return handler
+
+        def fwd(code):
+            return wrap(lambda q, m, c=code: sh.r_forward(c, q, m))
+
+        for code in (C.CREATE_FILE, C.OPEN_FILE, C.APPEND_FILE,
+                     C.ADD_BLOCK, C.COMPLETE_FILE, C.GET_BLOCK_LOCATIONS,
+                     C.RESIZE_FILE, C.SYMLINK, C.MKDIR):
+            r(code, fwd(code))
+        r(C.FILE_STATUS, wrap(sh.r_file_status))
+        r(C.EXISTS, wrap(sh.r_exists))
+        r(C.LIST_STATUS, wrap(sh.r_list_status))
+        r(C.LIST_OPTIONS, wrap(sh.r_list_options))
+        r(C.CONTENT_SUMMARY, wrap(sh.r_content_summary))
+        r(C.SET_ATTR, wrap(sh.r_set_attr))
+        r(C.FREE, wrap(sh.r_free))
+        r(C.DELETE, wrap(sh.r_delete))
+        r(C.RENAME, wrap(sh.r_rename, cache=True))
+        r(C.LINK, wrap(sh.r_link, cache=True))
+        for code in (C.CREATE_FILES_BATCH, C.ADD_BLOCKS_BATCH,
+                     C.COMPLETE_FILES_BATCH, C.META_BATCH):
+            r(code, wrap(lambda q, m, c=code: sh.r_batch(c, q, m)))
+        r(C.WORKER_HEARTBEAT, wrap(
+            lambda q, m: sh.r_worker_heartbeat(q, m,
+                                               self._worker_heartbeat)))
+        r(C.WORKER_BLOCK_REPORT, wrap(sh.r_worker_block_report))
 
     # Path-valued request fields, normalized ('.'/'..' resolved, root
     # escapes rejected) before ANY handler sees them — an S3-gateway key
@@ -595,7 +686,68 @@ class MasterServer:
                 and self._is_leader()):
             host = self.addr.rsplit(":", 1)[0]
             info.fast_addr = f"{host}:{self.fastmeta.port}"
-        return {"info": info.to_wire()}
+        wire = info.to_wire()
+        if self.shards is not None:
+            # the router's own tree is (near) empty: report the fleet
+            rows = [s for s in self.shards.stats if s.get("state") == "up"]
+            if rows:
+                wire["inode_num"] = sum(s.get("inodes", 0) for s in rows)
+                wire["block_num"] = sum(s.get("blocks", 0) for s in rows)
+            wire["meta_shards"] = self.conf.master.meta_shards
+        return {"info": wire}
+
+    # --- sharded namespace plane (master/sharding.py) ---
+
+    def _shard_tx(self, q):
+        """2PC participant protocol, executed on this shard's actor
+        loop; mutate=True dispatch means every phase's journal entry is
+        group-committed before the coordinator sees the reply."""
+        from curvine_tpu.common import errors as cerr
+        phase = q["phase"]
+        if phase == "prepare_src":
+            return {"rec": self.fs.tx_prepare(
+                q["txid"], q["op"], q["src"], q["dst"], role="src")}
+        if phase == "prepare_dst":
+            self.fs.tx_prepare(q["txid"], q["op"], q["src"], q["dst"],
+                               role="dst", rec=q["rec"])
+        elif phase == "commit":
+            self.fs.tx_commit(q["txid"])
+        elif phase == "abort":
+            self.fs.tx_abort(q["txid"])
+        elif phase == "forget":
+            self.fs.tx_forget(q["txid"])
+        else:
+            raise cerr.InvalidArgument(f"unknown shard tx phase {phase!r}")
+        return {}
+
+    def _shard_tx_list(self, q):
+        return {"txs": self.fs.list_tx()}
+
+    def _shard_stats(self, q):
+        import os as _os
+        fs = self.fs
+        com = fs.committer
+        handled = sum(h.count for name, h in self.metrics.histograms.items()
+                      if name.startswith("rpc."))
+        if fs.journal is not None:
+            seq = fs.journal.seq
+        elif fs.store.kind == "kv":
+            seq = fs.store.get_counter("applied_seq", 0)
+        else:
+            seq = 0
+        return {"shard_id": -1 if self.shard_id is None else self.shard_id,
+                "inodes": fs.tree.count(), "blocks": fs.blocks.count(),
+                "journal_seq": seq,
+                "queue_depth": max(0, com._dirty - com._synced) if com else 0,
+                "groups": com.groups if com else 0,
+                "entries": com.entries if com else 0,
+                "handled": handled, "pid": _os.getpid(),
+                "uptime_ms": now_ms() - fs.start_ms}
+
+    async def _shard_table(self, q):
+        if self.shards is None:
+            return {"shards": []}
+        return {"shards": await self.shards.poll_stats()}
 
     def _set_attr(self, q):
         opts = SetAttrOpts.from_wire(q.get("opts", {}))

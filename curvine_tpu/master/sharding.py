@@ -1,0 +1,641 @@
+"""Sharded namespace: a multi-core metadata plane (HDFS-federation
+style hash partition of the inode tree).
+
+With ``master.meta_shards = N > 1`` the master RPC endpoint becomes a
+thin ROUTER: every namespace request is forwarded over a local framed
+connection (the coalesced transport — rpc/transport.py) to one of N
+shard processes, each a full single-writer metadata actor with its own
+event loop, InodeTree partition, journal directory and GroupCommitter.
+The partition function is the hash of the PARENT directory path, so a
+create and its parent walk land on one shard, and one directory's
+listing is owned by one shard.
+
+Invariants and protocol:
+
+- **Every-dir-everywhere**: directory inodes are broadcast to every
+  shard (MKDIR fans out), so path resolution works on any shard; only
+  FILES are partitioned. The router keeps an LRU of directories it has
+  already ensured everywhere and re-broadcasts an idempotent mkdir
+  (superuser identity, skeleton only) on misses — e.g. after a router
+  restart or for parents created implicitly by ``create_parent``.
+- **Striped ids**: shard k of N allocates inode/block ids ≡ k (mod N)
+  (InodeTree id_stride/id_offset), so ids are globally unique with no
+  cross-shard coordination and journal replay stays deterministic.
+- **Cross-shard rename/link** run a presumed-abort two-phase commit:
+  prepare is journaled on both participants (a durable tx record each),
+  then commit lands on the dst shard FIRST (its record flips to
+  "committed" and is retained), then on the src shard, then a forget
+  clears the dst record. The recovery sweep on router start resolves
+  in-doubt txs: any "committed" record ⇒ roll forward everywhere,
+  otherwise abort everywhere. Directory renames are Unsupported in
+  sharded mode (they would re-hash every descendant).
+- **Workers** heartbeat the router, which re-broadcasts to every shard
+  so each shard's WorkerMap (placement input) stays live; block-report
+  orphans are the INTERSECTION across shards (a block is garbage only
+  if no shard owns it); per-shard pending delete commands are unioned
+  into the heartbeat reply.
+- ``meta_shards = 1`` never constructs any of this — the in-process
+  path is byte-for-byte unchanged — and sharding is mutually exclusive
+  with raft HA (enforced at MasterServer init; see
+  docs/metadata-scale.md for the matrix).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import logging
+import os
+import uuid
+import zlib
+from collections import OrderedDict
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.common.types import now_ms
+from curvine_tpu.rpc.codes import RpcCode
+from curvine_tpu.rpc.frame import pack, unpack
+
+log = logging.getLogger(__name__)
+
+# identity fields a router-synthesized request must carry forward
+_IDENT_KEYS = ("user", "groups", "client_name", "client_id")
+
+
+def shard_of(path: str, n: int) -> int:
+    """Stable shard index for a normalized path: hash of the parent
+    directory, so all direct entries of one directory co-locate."""
+    if n <= 1:
+        return 0
+    parent = path.rsplit("/", 1)[0] or "/"
+    return zlib.crc32(parent.encode("utf-8")) % n
+
+
+def parent_of(path: str) -> str:
+    return path.rsplit("/", 1)[0] or "/"
+
+
+def derive_shard_conf(conf, idx: int):
+    """A shard child's ClusterConf: own journal/meta dirs under the
+    router's, ephemeral port, no raft, no nested sharding, no native
+    read mirror (the router fronts all reads)."""
+    sc = copy.deepcopy(conf)
+    mc = sc.master
+    base = mc.journal_dir.rstrip("/")
+    mc.journal_dir = f"{base}/shard{idx}"
+    mc.meta_dir = (mc.meta_dir.rstrip("/") or base + "-meta") + f"/shard{idx}"
+    mc.rpc_port = 0
+    mc.fast_meta = False
+    mc.raft_peers = []
+    mc.meta_shards = 1
+    return sc
+
+
+def shard_entry(conf, idx: int, count: int, journal: bool, conn) -> None:
+    """Child-process main (multiprocessing spawn target): run one shard
+    MasterServer until SIGTERM, reporting the bound port through the
+    pipe. Lives at module top level so spawn can import it."""
+    import signal
+
+    logging.basicConfig(
+        level=logging.WARNING,
+        format=f"%(asctime)s shard{idx} %(levelname)s %(name)s %(message)s")
+
+    async def main():
+        from curvine_tpu.master.server import MasterServer
+        server = MasterServer(conf, journal=journal,
+                              shard_id=idx, shard_count=count)
+        await server.start()
+        conn.send(server.rpc.port)
+        conn.close()
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, stop.set)
+        ppid = os.getppid()
+        while not stop.is_set():
+            try:
+                await asyncio.wait_for(stop.wait(), timeout=2.0)
+            except asyncio.TimeoutError:
+                if os.getppid() != ppid:      # router died; don't orphan
+                    break
+        await server.stop()
+
+    asyncio.run(main())
+
+
+class _ProcShard:
+    """A shard living in a multiprocessing (spawn) child."""
+
+    def __init__(self, idx: int, proc, addr: str):
+        self.idx = idx
+        self.proc = proc
+        self.addr = addr
+        self.pid = proc.pid
+
+    async def stop(self) -> None:
+        if self.proc.is_alive():
+            self.proc.terminate()
+            for _ in range(50):                  # 5s graceful window
+                if not self.proc.is_alive():
+                    break
+                await asyncio.sleep(0.1)
+            if self.proc.is_alive():
+                self.proc.kill()
+        self.proc.join(timeout=5)
+
+
+class _InprocShard:
+    """A shard MasterServer sharing the router's loop (tests and
+    single-core boxes: same wire protocol, no process isolation)."""
+
+    def __init__(self, idx: int, server):
+        self.idx = idx
+        self.server = server
+        self.addr = server.addr
+        self.pid = os.getpid()
+
+    async def stop(self) -> None:
+        await self.server.stop()
+
+
+class ShardRouter:
+    """Routes namespace RPCs from the master endpoint to shard actors,
+    runs the cross-shard 2PC coordinator and the stats poller."""
+
+    def __init__(self, master, journal: bool = True):
+        self.master = master
+        self.conf = master.conf
+        mc = self.conf.master
+        self.n = mc.meta_shards
+        self.journal = journal
+        self.backend = mc.shard_backend
+        self.shards: list = []
+        self._pools: list = []
+        # directories already broadcast-created on every shard
+        self._ensured: OrderedDict[str, bool] = OrderedDict()
+        self._ensured_cap = max(256, mc.shard_dir_cache)
+        # test hook: called at 2PC phase boundaries; raising simulates a
+        # coordinator crash between phases (recovery sweep cleans up)
+        self.fault_hook = None
+        self._stats_prev: list[dict] = [{} for _ in range(self.n)]
+        self._stats_prev_ts = 0.0
+        self._stats_cache: list[dict] = [{} for _ in range(self.n)]
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    async def start(self) -> None:
+        from curvine_tpu.rpc.client import ConnectionPool
+        if self.backend == "inproc":
+            from curvine_tpu.master.server import MasterServer
+            for i in range(self.n):
+                s = MasterServer(derive_shard_conf(self.conf, i),
+                                 journal=self.journal,
+                                 shard_id=i, shard_count=self.n)
+                await s.start()
+                self.shards.append(_InprocShard(i, s))
+        else:
+            import multiprocessing
+            ctx = multiprocessing.get_context("spawn")
+            waits = []
+            for i in range(self.n):
+                rd, wr = ctx.Pipe(duplex=False)
+                p = ctx.Process(
+                    target=shard_entry,
+                    args=(derive_shard_conf(self.conf, i), i, self.n,
+                          self.journal, wr),
+                    daemon=True, name=f"cv-shard{i}")
+                p.start()
+                wr.close()
+                waits.append((i, p, rd))
+            loop = asyncio.get_running_loop()
+            for i, p, rd in waits:
+                port = await loop.run_in_executor(
+                    None, self._await_port, p, rd)
+                self.shards.append(_ProcShard(
+                    i, p, f"{self.conf.master.hostname}:{port}"))
+        self._pools = [ConnectionPool(size=2) for _ in range(self.n)]
+        await self.recovery_sweep()
+        log.info("shard router up: %d shards (%s backend) at %s",
+                 self.n, self.backend, [s.addr for s in self.shards])
+
+    @staticmethod
+    def _await_port(proc, rd, timeout: float = 60.0) -> int:
+        if rd.poll(timeout):
+            port = rd.recv()
+            rd.close()
+            return port
+        proc.terminate()
+        raise err.CurvineError(
+            f"shard child pid={proc.pid} failed to report its port "
+            f"within {timeout}s")
+
+    async def stop(self) -> None:
+        for pool in self._pools:
+            await pool.close()
+        self._pools = []
+        for s in self.shards:
+            try:
+                await s.stop()
+            except Exception as e:  # noqa: BLE001 — teardown best-effort
+                log.warning("shard %d stop: %s", s.idx, e)
+        self.shards = []
+
+    # ------------------------------------------------------------------
+    # plumbing
+
+    def shard_for(self, path: str) -> int:
+        return shard_of(path, self.n)
+
+    async def call(self, idx: int, code: int, req: dict,
+                   deadline=None) -> dict:
+        conn = await self._pools[idx].get(self.shards[idx].addr)
+        rep = await conn.call(code, data=pack(req), deadline=deadline)
+        return unpack(rep.data) or {}
+
+    async def _gather(self, code: int, req: dict, deadline=None,
+                      idxs=None) -> list:
+        """Fan a request out; per-shard CurvineErrors come back in-slot
+        (callers merge), anything else propagates."""
+        idxs = range(self.n) if idxs is None else idxs
+        outs = await asyncio.gather(
+            *(self.call(i, code, req, deadline) for i in idxs),
+            return_exceptions=True)
+        for o in outs:
+            if isinstance(o, BaseException) and \
+                    not isinstance(o, err.CurvineError):
+                raise o
+        return list(outs)
+
+    @staticmethod
+    def _merge_or_raise(outs: list) -> list:
+        oks = [o for o in outs if not isinstance(o, BaseException)]
+        if not oks:
+            raise next(o for o in outs if isinstance(o, BaseException))
+        return oks
+
+    def _ident(self, q: dict) -> dict:
+        return {k: q[k] for k in _IDENT_KEYS if k in q}
+
+    def _note_dir(self, path: str) -> None:
+        self._ensured[path] = True
+        self._ensured.move_to_end(path)
+        while len(self._ensured) > self._ensured_cap:
+            self._ensured.popitem(last=False)
+
+    def _drop_dirs(self, path: str) -> None:
+        """Forget a deleted/renamed directory subtree."""
+        pre = path.rstrip("/") + "/"
+        for k in [k for k in self._ensured if k == path or k.startswith(pre)]:
+            self._ensured.pop(k, None)
+
+    async def ensure_parent(self, path: str, deadline=None) -> None:
+        """Every-dir-everywhere: make sure the parent directory chain of
+        `path` exists on every shard. Idempotent mkdir broadcast under
+        the superuser (skeleton replication, not a user create — real
+        ACL enforcement happened when the directory was first made)."""
+        parent = parent_of(path)
+        if parent == "/" or parent in self._ensured:
+            return
+        mc = self.conf.master
+        req = {"path": parent, "create_parent": True,
+               "user": mc.superuser, "groups": [mc.supergroup]}
+        self._merge_or_raise(
+            await self._gather(RpcCode.MKDIR, req, deadline))
+        self._note_dir(parent)
+
+    # ------------------------------------------------------------------
+    # routed handlers (installed by MasterServer._register_shard_routes)
+
+    async def r_forward(self, code: int, q: dict, msg) -> dict:
+        """Single-shard ops routed by the path's parent directory."""
+        key = "link" if code == RpcCode.SYMLINK else "path"
+        path = q[key]
+        if code in (RpcCode.CREATE_FILE, RpcCode.APPEND_FILE,
+                    RpcCode.RESIZE_FILE, RpcCode.SYMLINK):
+            # read-only-mount enforcement lives at the router: shards
+            # hold no mount table
+            self.master.fs._mount_write_guard(path)
+        if code in (RpcCode.CREATE_FILE, RpcCode.MKDIR, RpcCode.SYMLINK):
+            await self.ensure_parent(path, msg.deadline)
+        if code == RpcCode.MKDIR:
+            return await self.r_mkdir(q, msg)
+        return await self.call(self.shard_for(path), code, q, msg.deadline)
+
+    async def r_mkdir(self, q: dict, msg) -> dict:
+        outs = self._merge_or_raise(
+            await self._gather(RpcCode.MKDIR, q, msg.deadline))
+        self._note_dir(q["path"])
+        return outs[0]
+
+    async def r_file_status(self, q: dict, msg) -> dict:
+        try:
+            return await self.call(self.shard_for(q["path"]),
+                                   RpcCode.FILE_STATUS, q, msg.deadline)
+        except err.FileNotFound:
+            st = await self.master.mounts.ufs_status(q["path"])
+            if st is None:
+                raise
+            return {"status": st.to_wire()}
+
+    async def r_exists(self, q: dict, msg) -> dict:
+        out = await self.call(self.shard_for(q["path"]), RpcCode.EXISTS,
+                              q, msg.deadline)
+        if not out.get("exists"):
+            st = await self.master.mounts.ufs_status(q["path"])
+            return {"exists": st is not None}
+        return out
+
+    async def r_list_status(self, q: dict, msg) -> dict:
+        outs = await self._gather(RpcCode.LIST_STATUS, q, msg.deadline)
+        oks = [o for o in outs if not isinstance(o, BaseException)]
+        if not oks:
+            # surface UFS-only listings like the single-shard path would
+            if await self.master.mounts.ufs_status(q["path"]) is None:
+                raise next(o for o in outs if isinstance(o, BaseException))
+            oks = [{"statuses": []}]
+        merged: dict[str, dict] = {}
+        for s in await self.master.mounts.ufs_list(q["path"]):
+            merged[s.name] = s.to_wire()
+        for o in oks:
+            for w in o.get("statuses", []):
+                merged[w.get("name") or w.get("path", "")] = w
+        return {"statuses": [merged[k] for k in sorted(merged)]}
+
+    async def r_list_options(self, q: dict, msg) -> dict:
+        sub = {k: v for k, v in q.items() if k not in ("offset", "limit")}
+        outs = self._merge_or_raise(
+            await self._gather(RpcCode.LIST_OPTIONS, sub, msg.deadline))
+        merged: dict[str, dict] = {}
+        for o in outs:
+            for w in o.get("statuses", []):
+                merged[w.get("name") or w.get("path", "")] = w
+        names = sorted(merged)
+        total = len(names)
+        offset, limit = q.get("offset", 0), q.get("limit")
+        names = names[offset:offset + limit] if limit else names[offset:]
+        return {"statuses": [merged[k] for k in names], "total": total}
+
+    async def r_content_summary(self, q: dict, msg) -> dict:
+        # mount-intersection refusal is the ROUTER's job (shards hold no
+        # mount table) — mirror of the in-process handler's check
+        path = q["path"]
+        mounts = self.master.mounts
+        prefix = (path.rstrip("/") or "") + "/"
+        if mounts.get_mount(path) is not None or any(
+                m.cv_path.startswith(prefix) for m in mounts.table()):
+            raise err.Unsupported(
+                f"{path} intersects mounts: aggregate the unified "
+                "listing client-side")
+        outs = self._merge_or_raise(
+            await self._gather(RpcCode.CONTENT_SUMMARY, q, msg.deadline))
+        return {
+            "length": sum(o.get("length", 0) for o in outs),
+            "file_count": sum(o.get("file_count", 0) for o in outs),
+            # every shard holds the full directory skeleton: take max,
+            # not sum, or each dir would count once per shard
+            "directory_count": max(o.get("directory_count", 0)
+                                   for o in outs),
+        }
+
+    async def r_set_attr(self, q: dict, msg) -> dict:
+        self.master.fs._mount_write_guard(q["path"])
+        # uniform broadcast: for files only the owner shard succeeds;
+        # for directories every shard applies (skeleton attrs in sync)
+        outs = self._merge_or_raise(
+            await self._gather(RpcCode.SET_ATTR, q, msg.deadline))
+        return outs[0]
+
+    async def r_free(self, q: dict, msg) -> dict:
+        outs = self._merge_or_raise(
+            await self._gather(RpcCode.FREE, q, msg.deadline))
+        return {"freed": sum(o.get("freed", 0) for o in outs)}
+
+    async def r_delete(self, q: dict, msg) -> dict:
+        path, recursive = q["path"], q.get("recursive", False)
+        self.master.fs._mount_write_guard(path, subtree=recursive)
+        owner = self.shard_for(path)
+        st = (await self.call(owner, RpcCode.FILE_STATUS, q,
+                              msg.deadline))["status"]
+        if not st["is_dir"]:
+            return await self.call(owner, RpcCode.DELETE, q, msg.deadline)
+        if not recursive:
+            # non-recursive dir delete: the emptiness gate runs at the
+            # router over ALL shards (each shard only sees its own
+            # entries); the broadcast below then force-clears the
+            # skeleton. Weakly consistent like the rest of the plane.
+            listing = await self.r_list_options(
+                {**q, "limit": 1}, msg)
+            if listing["total"]:
+                raise err.DirNotEmpty(path)
+        bq = {**q, "recursive": True}
+        outs = await self._gather(RpcCode.DELETE, bq, msg.deadline)
+        self._merge_or_raise(
+            [o for o in outs if not isinstance(o, err.FileNotFound)]
+            or outs)
+        self._drop_dirs(path)
+        return {}
+
+    async def r_rename(self, q: dict, msg) -> dict:
+        src, dst = q["src"], q["dst"]
+        self.master.fs._mount_write_guard(src, subtree=True)
+        self.master.fs._mount_write_guard(dst)
+        s_idx, d_idx = self.shard_for(src), self.shard_for(dst)
+        st = (await self.call(s_idx, RpcCode.FILE_STATUS,
+                              {**self._ident(q), "path": src},
+                              msg.deadline))["status"]
+        if st["is_dir"]:
+            raise err.Unsupported(
+                "directory rename in sharded namespace (meta_shards>1): "
+                "it would re-hash every descendant path")
+        await self.ensure_parent(dst, msg.deadline)
+        if s_idx == d_idx:
+            return await self.call(s_idx, RpcCode.RENAME, q, msg.deadline)
+        await self._two_phase("rename", src, dst, s_idx, d_idx, q,
+                              msg.deadline)
+        return {"result": True}
+
+    async def r_link(self, q: dict, msg) -> dict:
+        src, dst = q["src"], q["dst"]
+        self.master.fs._mount_write_guard(dst)
+        s_idx, d_idx = self.shard_for(src), self.shard_for(dst)
+        await self.ensure_parent(dst, msg.deadline)
+        if s_idx == d_idx:
+            return await self.call(s_idx, RpcCode.LINK, q, msg.deadline)
+        await self._two_phase("link", src, dst, s_idx, d_idx, q,
+                              msg.deadline)
+        return await self.call(d_idx, RpcCode.FILE_STATUS,
+                               {**self._ident(q), "path": dst},
+                               msg.deadline)
+
+    # --- batches: split by owner shard, forward concurrently, stitch
+    # the per-item responses back into request order ---
+
+    async def r_batch(self, code: int, q: dict, msg) -> dict:
+        reqs = q["requests"]
+        outer = {k: v for k, v in q.items() if k != "requests"}
+        key = "path"
+        buckets: dict[int, list[tuple[int, dict]]] = {}
+        parents = set()
+        for pos, r in enumerate(reqs):
+            if code == RpcCode.META_BATCH and r.get("op") != "create":
+                # mkdir/delete items follow broadcast semantics: give
+                # every shard a copy, answer from the path's owner
+                for i in range(self.n):
+                    buckets.setdefault(i, []).append((pos, r))
+                if r.get("op") == "mkdir":
+                    self._note_dir(r["path"])
+                continue
+            if code in (RpcCode.CREATE_FILES_BATCH, RpcCode.META_BATCH):
+                parents.add(parent_of(r[key]))
+            buckets.setdefault(self.shard_for(r[key]), []).append((pos, r))
+        for p in sorted(parents):
+            if p != "/" and p not in self._ensured:
+                await self.ensure_parent(p + "/x", msg.deadline)
+        idxs = sorted(buckets)
+        outs = await asyncio.gather(
+            *(self.call(i, code,
+                        {**outer, "requests": [r for _p, r in buckets[i]]},
+                        msg.deadline) for i in idxs))
+        merged: list = [None] * len(reqs)
+        for i, out in zip(idxs, outs):
+            for (pos, r), rep in zip(buckets[i], out["responses"]):
+                owner = self.shard_for(r.get(key, "/"))
+                if merged[pos] is None or owner == i:
+                    merged[pos] = rep
+        return {"responses": merged}
+
+    # --- worker plane: router-local + shard broadcast ---
+
+    async def r_worker_heartbeat(self, q: dict, msg, local) -> dict:
+        cmds = local(q)                       # router worker map + gauges
+        outs = await self._gather(RpcCode.WORKER_HEARTBEAT, q, msg.deadline)
+        deletes = set(cmds.get("delete_blocks", []))
+        report_now = bool(cmds.get("report_now"))
+        for o in outs:
+            if isinstance(o, BaseException):
+                continue
+            deletes.update(o.get("delete_blocks", []))
+            report_now = report_now or bool(o.get("report_now"))
+        cmds["delete_blocks"] = sorted(deletes)
+        if report_now:
+            cmds["report_now"] = True
+        return cmds
+
+    async def r_worker_block_report(self, q: dict, msg) -> dict:
+        outs = self._merge_or_raise(
+            await self._gather(RpcCode.WORKER_BLOCK_REPORT, q,
+                               msg.deadline))
+        # a block is an orphan only if EVERY shard disowns it
+        orphans = None
+        for o in outs:
+            got = set(o.get("delete_blocks", []))
+            orphans = got if orphans is None else (orphans & got)
+        return {"delete_blocks": sorted(orphans or ())}
+
+    # ------------------------------------------------------------------
+    # cross-shard two-phase coordinator
+
+    def _crash_point(self, stage: str) -> None:
+        if self.fault_hook is not None:
+            self.fault_hook(stage)
+
+    async def _two_phase(self, op: str, src: str, dst: str,
+                         s_idx: int, d_idx: int, q: dict,
+                         deadline=None) -> None:
+        txid = uuid.uuid4().hex
+        ident = self._ident(q)
+        base = {**ident, "txid": txid, "op": op, "src": src, "dst": dst}
+        payload = await self.call(
+            s_idx, RpcCode.SHARD_TX, {**base, "phase": "prepare_src"},
+            deadline)
+        self._crash_point("after_prepare_src")
+        try:
+            await self.call(
+                d_idx, RpcCode.SHARD_TX,
+                {**base, "phase": "prepare_dst", "rec": payload["rec"]},
+                deadline)
+        except err.CurvineError:
+            await self.call(s_idx, RpcCode.SHARD_TX,
+                            {**base, "phase": "abort"}, deadline)
+            raise
+        self._crash_point("after_prepare_dst")
+        # commit point: dst first — its retained "committed" record is
+        # what the recovery sweep keys roll-forward on
+        await self.call(d_idx, RpcCode.SHARD_TX,
+                        {**base, "phase": "commit"}, deadline)
+        self._crash_point("after_commit_dst")
+        await self.call(s_idx, RpcCode.SHARD_TX,
+                        {**base, "phase": "commit"}, deadline)
+        self._crash_point("after_commit_src")
+        await self.call(d_idx, RpcCode.SHARD_TX,
+                        {**base, "phase": "forget"}, deadline)
+
+    async def recovery_sweep(self) -> None:
+        """Resolve in-doubt cross-shard txs after a crash: roll forward
+        any tx with a committed participant, abort the rest (presumed
+        abort). Runs on every router start; idempotent."""
+        txs: dict[str, list[tuple[int, dict]]] = {}
+        for i in range(self.n):
+            try:
+                out = await self.call(i, RpcCode.SHARD_TX_LIST, {})
+            except Exception as e:  # noqa: BLE001 — sweep is best-effort
+                log.warning("tx sweep: shard %d unreadable: %s", i, e)
+                continue
+            for rec in out.get("txs", []):
+                txs.setdefault(rec["txid"], []).append((i, rec))
+        for txid, parts in txs.items():
+            committed = any(r["state"] == "committed" for _i, r in parts)
+            phase = "commit" if committed else "abort"
+            log.info("tx sweep: %s %s (%d participant records)",
+                     phase, txid, len(parts))
+            for i, rec in parts:
+                if rec["state"] == "prepared":
+                    await self.call(i, RpcCode.SHARD_TX,
+                                    {"txid": txid, "phase": phase})
+            if committed:
+                # src committed above; clear the dst marker(s) last
+                for i, rec in parts:
+                    if rec["state"] == "committed":
+                        await self.call(i, RpcCode.SHARD_TX,
+                                        {"txid": txid, "phase": "forget"})
+
+    # ------------------------------------------------------------------
+    # observability
+
+    async def poll_stats(self) -> list[dict]:
+        """Refresh per-shard stats; computes qps from the handled-count
+        delta since the previous poll. Feeds /metrics gauges, the
+        SHARD_TABLE handler, `cv report` and the web UI."""
+        now = now_ms() / 1000.0
+        dt = max(1e-3, now - self._stats_prev_ts) \
+            if self._stats_prev_ts else 0.0
+        outs = await self._gather(RpcCode.SHARD_STATS, {})
+        table = []
+        metrics = self.master.metrics
+        for i, o in enumerate(outs):
+            if isinstance(o, BaseException):
+                row = {"shard": i, "addr": self.shards[i].addr,
+                       "state": "unreachable", "error": str(o)}
+                table.append(row)
+                continue
+            prev = self._stats_prev[i]
+            qps = 0.0
+            if dt and "handled" in prev:
+                qps = max(0.0, (o.get("handled", 0) -
+                                prev.get("handled", 0)) / dt)
+            row = {"shard": i, "addr": self.shards[i].addr,
+                   "pid": self.shards[i].pid, "state": "up",
+                   "qps": round(qps, 1), **o}
+            table.append(row)
+            self._stats_prev[i] = o
+            for k in ("inodes", "blocks", "journal_seq", "queue_depth"):
+                metrics.gauge(f"shard.{i}.{k}", o.get(k, 0))
+            metrics.gauge(f"shard.{i}.qps", qps)
+        self._stats_prev_ts = now
+        self._stats_cache = table
+        return table
+
+    @property
+    def stats(self) -> list[dict]:
+        return self._stats_cache

@@ -53,6 +53,7 @@ class MemMetaStore:
         self.mounts_tbl: dict[str, dict] = {}
         self.jobs_tbl: dict[str, dict] = {}
         self.deco_tbl: set[int] = set()
+        self.tx_tbl: dict[str, dict] = {}
 
     # inodes
     def get(self, inode_id: int):
@@ -126,6 +127,21 @@ class MemMetaStore:
     def iter_jobs(self):
         return iter(list(self.jobs_tbl.values()))
 
+    # cross-shard two-phase tx records (master/sharding.py): a prepared
+    # participant persists its vote here so the recovery sweep can
+    # resolve in-doubt transactions after a crash
+    def tx_put(self, txid: str, wire: dict) -> None:
+        self.tx_tbl[txid] = wire
+
+    def tx_get(self, txid: str):
+        return self.tx_tbl.get(txid)
+
+    def tx_remove(self, txid: str) -> None:
+        self.tx_tbl.pop(txid, None)
+
+    def iter_tx(self):
+        return iter(list(self.tx_tbl.values()))
+
     # worker decommission intents (durable: KV cold starts skip replay)
     def deco_put(self, worker_id: int) -> None:
         self.deco_tbl.add(worker_id)
@@ -176,6 +192,7 @@ class MemMetaStore:
         self.mounts_tbl.clear()
         self.jobs_tbl.clear()
         self.deco_tbl.clear()
+        self.tx_tbl.clear()
 
     def close(self) -> None:
         pass
@@ -407,6 +424,36 @@ class KvMetaStore:
     def iter_jobs(self):
         for _k, raw in self.kv.scan(prefix=b"J"):
             yield msgpack.unpackb(raw, raw=False, strict_map_key=False)
+
+    # ---- cross-shard two-phase tx records (master/sharding.py) ----
+    def tx_put(self, txid: str, wire: dict) -> None:
+        self._pending[b"T" + txid.encode()] = msgpack.packb(
+            wire, use_bin_type=True)
+
+    def tx_get(self, txid: str):
+        raw = self._read(b"T" + txid.encode())
+        if raw is None:
+            return None
+        return msgpack.unpackb(raw, raw=False, strict_map_key=False)
+
+    def tx_remove(self, txid: str) -> None:
+        self._pending[b"T" + txid.encode()] = None
+
+    def iter_tx(self):
+        # merge the uncommitted overlays so a sweep racing a group
+        # commit still sees every prepared vote
+        seen = set()
+        for overlay in (self._pending, self._staged):
+            for k, raw in list(overlay.items()):
+                if k[:1] != b"T" or k in seen:
+                    continue
+                seen.add(k)
+                if raw is not None:
+                    yield msgpack.unpackb(raw, raw=False,
+                                          strict_map_key=False)
+        for k, raw in self.kv.scan(prefix=b"T"):
+            if k not in seen:
+                yield msgpack.unpackb(raw, raw=False, strict_map_key=False)
 
     # ---- worker decommission intents ----
     def deco_put(self, worker_id: int) -> None:

@@ -73,6 +73,16 @@ class RpcCode(enum.IntEnum):
     # out to workers when asked to collect (web /api/trace, `cv trace`)
     GET_SPANS = 62
 
+    # sharded namespace plane (master/sharding.py). SHARD_TX drives the
+    # cross-shard two-phase protocol on a participant shard
+    # (prepare/commit/abort/forget); SHARD_TX_LIST feeds the crash-
+    # recovery sweep; SHARD_STATS/SHARD_TABLE feed /metrics, the web UI
+    # and `cv report`.
+    SHARD_TX = 70
+    SHARD_TX_LIST = 71
+    SHARD_STATS = 72
+    SHARD_TABLE = 73
+
     # block interface (worker)
     WRITE_BLOCK = 80
     READ_BLOCK = 81

@@ -24,7 +24,8 @@ class MiniCluster:
                  conf: ClusterConf | None = None, journal: bool = True,
                  tier_capacity: int = 256 * MB, block_size: int = 4 * MB,
                  worker_heartbeat_ms: int = 200,
-                 lost_timeout_ms: int = 2_000):
+                 lost_timeout_ms: int = 2_000,
+                 shards: int = 1, shard_backend: str = "inproc"):
         self.n_workers = workers
         self.base_dir = base_dir or tempfile.mkdtemp(prefix="curvine-test-")
         self.conf = conf or ClusterConf()
@@ -34,6 +35,12 @@ class MiniCluster:
         self.conf.master.meta_dir = os.path.join(self.base_dir, "meta")
         self.conf.master.worker_lost_timeout_ms = lost_timeout_ms
         self.conf.master.heartbeat_check_ms = 200
+        if shards > 1:
+            # sharded namespace: defaults to the inproc backend (shard
+            # servers share this loop — same wire path, no processes)
+            self.conf.master.meta_shards = shards
+            self.conf.master.shard_backend = shard_backend
+            self.conf.master.fast_meta = False
         self.conf.client.block_size = block_size
         self.journal = journal
         self.tier_capacity = tier_capacity

@@ -68,6 +68,7 @@ class WebServer:
         r.add_get("/api/health", self._health)
         r.add_get("/api/config", self._config)
         r.add_get("/api/blocks", self._blocks)
+        r.add_get("/api/shards", self._shards)
         # mutation plane (parity: curvine-web/src/router/load_handler.rs
         # submit_loading_task): REST load-job submission + cancel
         r.add_post("/api/load", self._submit_load)
@@ -209,6 +210,17 @@ class WebServer:
                         "addr": f"{a.hostname}:{a.rpc_port}",
                     } for a in lb.locs],
                 } for lb in fb.block_locs]})
+        except Exception as e:  # noqa: BLE001 — http boundary
+            return self._json({"error": str(e)})
+
+    async def _shards(self, req):
+        """Sharded-namespace table: one row per metadata shard (empty
+        list on an unsharded master)."""
+        if self.master is None or getattr(
+                self.master, "shards", None) is None:
+            return self._json([])
+        try:
+            return self._json(await self.master.shards.poll_stats())
         except Exception as e:  # noqa: BLE001 — http boundary
             return self._json({"error": str(e)})
 

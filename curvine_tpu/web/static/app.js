@@ -14,6 +14,17 @@ const bytesFmt = n => n >= 2 ** 30 ? gib(n)
   : n >= 2 ** 20 ? (n / 2 ** 20).toFixed(1) + " MiB"
   : n >= 1024 ? (n / 1024).toFixed(1) + " KiB" : n + " B";
 const api = p => fetch(p).then(r => r.json());
+/* mutation helpers: REST POST/DELETE with a JSON body; non-2xx replies
+   still carry a JSON {"error"} payload we surface in the banner */
+const post = (p, body) => fetch(p, {
+  method: "POST", headers: { "Content-Type": "application/json" },
+  body: JSON.stringify(body || {}) }).then(r => r.json());
+const del = p => fetch(p, { method: "DELETE" }).then(r => r.json());
+/* one-line action feedback above the active view's table */
+function banner(msg, ok) {
+  const b = $("#banner");
+  if (b) b.innerHTML = `<span class="${ok ? "ok" : "err"}">${esc(msg)}</span>`;
+}
 const TIERS = { "-1": "HBM", 0: "MEM", 1: "SSD", 2: "HDD", 3: "UFS", 4: "DISK" };
 
 /* ---------- throughput history (polled; survives view switches) ---------- */
@@ -176,22 +187,98 @@ function fmtMode(s) {
 
 async function mounts() {
   const ms = await api("/api/mounts");
-  const rows = ms.map(m => `<tr><td>${esc(m.cv_path)}</td><td>${esc(m.ufs_path)}</td>
-    <td>${esc(m.write_type)}</td><td>${m.auto_cache ? "yes" : "no"}</td></tr>`).join("");
-  view.innerHTML = `<h2>Mount table</h2><table>
-    <tr><th>cv path</th><th>ufs path</th><th>write mode</th><th>auto-cache</th></tr>
-    ${rows || `<tr><td colspan="4" class="empty">no mounts</td></tr>`}</table>`;
+  const rows = ms.map((m, i) => `<tr><td>${esc(m.cv_path)}</td><td>${esc(m.ufs_path)}</td>
+    <td>${esc(m.write_type)}</td><td>${m.auto_cache ? "yes" : "no"}</td>
+    <td><button class="btn danger" data-umount="${i}">umount</button></td></tr>`).join("");
+  view.innerHTML = `<h2>Mount table</h2><div id="banner"></div>
+    <form id="mount-form" class="bar">
+      <input id="m-cv" placeholder="/cv/path" required>
+      <input id="m-ufs" placeholder="s3://bucket/prefix" required
+             style="min-width:220px">
+      <label><input id="m-auto" type="checkbox"> auto-cache</label>
+      <label><input id="m-ro" type="checkbox"> read-only</label>
+      <button class="btn" type="submit">mount</button>
+    </form>
+    <table>
+    <tr><th>cv path</th><th>ufs path</th><th>write mode</th><th>auto-cache</th><th></th></tr>
+    ${rows || `<tr><td colspan="5" class="empty">no mounts</td></tr>`}</table>`;
+  $("#mount-form").onsubmit = async ev => {
+    ev.preventDefault();
+    const r = await post("/api/mount", {
+      cv_path: $("#m-cv").value, ufs_path: $("#m-ufs").value,
+      auto_cache: $("#m-auto").checked,
+      access_mode: $("#m-ro").checked ? "r" : "rw" });
+    if (r.error) banner(r.error, false);
+    else { banner(`mounted ${r.cv_path}`, true); await mounts(); }
+  };
+  view.querySelectorAll("[data-umount]").forEach(b => b.onclick = async () => {
+    const m = ms[+b.dataset.umount];
+    const r = await del("/api/mount?cv_path=" + encodeURIComponent(m.cv_path));
+    if (r.error) banner(r.error, false);
+    else { banner(`unmounted ${r.unmounted}`, true); await mounts(); }
+  });
 }
 
 async function jobs() {
   const js = await api("/api/jobs");
   const STATES = ["PENDING", "RUNNING", "COMPLETED", "FAILED", "CANCELLED"];
-  const rows = js.map(j => `<tr><td>${esc(j.job_id)}</td><td>${esc(j.kind)}</td>
+  const active = j => j.state === 0 || j.state === 1;
+  const rows = js.map((j, i) => `<tr><td>${esc(j.job_id)}</td><td>${esc(j.kind)}</td>
     <td>${esc(j.path || "")}</td><td>${esc(STATES[j.state] ?? j.state)}</td>
-    <td>${j.progress != null ? (j.progress * 100).toFixed(0) + "%" : ""}</td></tr>`).join("");
-  view.innerHTML = `<h2>Jobs</h2><table>
-    <tr><th>id</th><th>kind</th><th>path</th><th>state</th><th>progress</th></tr>
-    ${rows || `<tr><td colspan="5" class="empty">no jobs</td></tr>`}</table>`;
+    <td>${j.progress != null ? (j.progress * 100).toFixed(0) + "%" : ""}</td>
+    <td class="msg">${esc(j.message || "")}</td>
+    <td>${active(j) ? `<button class="btn danger" data-cancel="${i}">cancel</button>` : ""}</td>
+  </tr>`).join("");
+  view.innerHTML = `<h2>Jobs</h2><div id="banner"></div>
+    <form id="load-form" class="bar">
+      <input id="j-path" placeholder="/mnt/ufs/path" required
+             style="min-width:220px">
+      <select id="j-kind"><option value="load">load</option>
+        <option value="export">export</option></select>
+      <input id="j-repl" type="number" value="1" min="1" max="9"
+             title="replicas" style="width:58px">
+      <label><input id="j-rec" type="checkbox" checked> recursive</label>
+      <button class="btn" type="submit">submit</button>
+    </form>
+    <table>
+    <tr><th>id</th><th>kind</th><th>path</th><th>state</th><th>progress</th>
+    <th>message</th><th></th></tr>
+    ${rows || `<tr><td colspan="7" class="empty">no jobs</td></tr>`}</table>`;
+  $("#load-form").onsubmit = async ev => {
+    ev.preventDefault();
+    const r = await post("/api/load", {
+      path: $("#j-path").value, kind: $("#j-kind").value,
+      recursive: $("#j-rec").checked, replicas: +$("#j-repl").value || 1 });
+    if (r.error) banner(r.error, false);
+    else { banner(`submitted job ${r.job_id}`, true); await jobs(); }
+  };
+  view.querySelectorAll("[data-cancel]").forEach(b => b.onclick = async () => {
+    const j = js[+b.dataset.cancel];
+    const r = await post(`/api/jobs/${encodeURIComponent(j.job_id)}/cancel`);
+    if (r.error) banner(r.error, false);
+    else { banner(`cancelled ${j.job_id}`, true); await jobs(); }
+  });
+}
+
+/* shards view: per-shard namespace plane rows (sharded masters only) */
+async function shards() {
+  const rows = await api("/api/shards");
+  if (rows.error) { view.innerHTML = `<div class="empty">${esc(rows.error)}</div>`; return; }
+  if (!rows.length) {
+    view.innerHTML = `<h2>Namespace shards</h2>
+      <div class="empty">unsharded master (master.meta_shards = 1)</div>`;
+    return;
+  }
+  const tr = rows.map(r => `<tr><td>${r.shard}</td>
+    <td>${esc(r.addr || "")}</td>
+    <td><span class="status ${r.state === "up" ? "live" : "lost"}">
+      <span class="dot"></span>${esc(r.state)}</span></td>
+    <td>${(r.qps || 0).toFixed(0)}</td><td>${r.inodes ?? ""}</td>
+    <td>${r.blocks ?? ""}</td><td>${r.journal_seq ?? ""}</td>
+    <td>${r.queue_depth ?? ""}</td></tr>`).join("");
+  view.innerHTML = `<h2>Namespace shards</h2><table>
+    <tr><th>shard</th><th>addr</th><th>state</th><th>qps</th><th>inodes</th>
+    <th>blocks</th><th>journal seq</th><th>queue depth</th></tr>${tr}</table>`;
 }
 
 /* blocks view: file → block map with locations
@@ -228,7 +315,7 @@ async function config() {
 }
 
 /* ---------- router ---------- */
-const routes = { overview, workers, mounts, jobs, config };
+const routes = { overview, workers, mounts, jobs, shards, config };
 async function route() {
   const hash = location.hash || "#/overview";
   const m = hash.match(/^#\/([a-z]+)(\/.*)?$/);
@@ -249,5 +336,8 @@ window.addEventListener("hashchange", route);
 route();
 setInterval(() => {   // live refresh for the non-browser views
   const name = (location.hash || "#/overview").slice(2).split("/")[0];
-  if (name !== "browse") route();
+  // don't yank a half-typed mount/load form out from under the user
+  const typing = document.activeElement &&
+    ["INPUT", "SELECT", "TEXTAREA"].includes(document.activeElement.tagName);
+  if (name !== "browse" && !typing) route();
 }, 5000);

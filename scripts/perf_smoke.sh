@@ -111,6 +111,62 @@ print("perf_smoke: namespace_scale --quick",
       json.dumps(json.load(open(sys.argv[1]))))' "$SCALE_JSON"
     rm -f "$SCALE_JSON"
     echo "perf_smoke: PASS"
+
+    # sharded-namespace correctness smoke: the shards=2 create storm over
+    # the full router → shard RPC plane must complete and self-report ok
+    # (always runs — it is a correctness gate, not a throughput gate)
+    SHARD_JSON=$(mktemp)
+    JAX_PLATFORMS=cpu timeout 150 python scripts/namespace_scale.py \
+        --quick --shards 2 --out "$SHARD_JSON" >/dev/null 2>&1
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "perf_smoke: FAIL — namespace_scale --quick --shards 2 (rc=$rc)" >&2
+        rm -f "$SHARD_JSON"
+        exit 1
+    fi
+    python -c 'import json, sys
+print("perf_smoke: namespace_scale --quick --shards 2",
+      json.dumps(json.load(open(sys.argv[1]))))' "$SHARD_JSON"
+    rm -f "$SHARD_JSON"
+
+    # shard-scaling throughput gate: two shard PROCESSES must beat the
+    # single actor by 1.5x — only meaningful when real cores exist for
+    # them (nproc < 4: the shards time-slice one core and a flat curve
+    # is physics, not regression — skip the floor, keep the smoke above)
+    if [ "$(nproc)" -lt 4 ]; then
+        echo "perf_smoke: shard-scaling gate skipped (nproc=$(nproc) < 4)"
+    else
+        SHARD_OUT=$(JAX_PLATFORMS=cpu timeout 150 python - <<'EOF'
+import asyncio, json, os, sys
+sys.path.insert(0, os.getcwd())
+from bench import _shard_smoke
+print(json.dumps(asyncio.run(_shard_smoke(2, backend="process"))))
+EOF
+)
+        rc=$?
+        if [ $rc -ne 0 ] || [ -z "$SHARD_OUT" ]; then
+            echo "perf_smoke: shard microbench failed to run (rc=$rc)" >&2
+            exit 2
+        fi
+        echo "$SHARD_OUT"
+        python - "$FLOOR_FILE" <<'EOF' "$SHARD_OUT"
+import json, sys
+floor_file, result = sys.argv[1], json.loads(sys.argv[2])
+floor = json.load(open(floor_file))["meta_create_shard2_qps"]
+got = result.get("meta_create_shard_qps", 0.0)
+gate = floor * 0.7                      # >30% regression fails
+print(f"perf_smoke: meta_create_shard2_qps={got} floor={floor} "
+      f"gate={gate:.1f} backend={result.get('shard_backend')}")
+if got < gate:
+    print(f"perf_smoke: FAIL — meta_create_shard2_qps {got} < {gate:.1f} "
+          f"(floor {floor} - 30%)", file=sys.stderr)
+    sys.exit(1)
+print("perf_smoke: PASS")
+EOF
+        rc=$?
+        [ $rc -ne 0 ] && exit $rc
+    fi
+    echo "perf_smoke: PASS"
 fi
 
 if [ "${BENCH_RPC:-1}" = "0" ]; then

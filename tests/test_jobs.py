@@ -1,0 +1,112 @@
+"""Load/export job lifecycle: planning failures must SURFACE, not hang.
+
+Covers master/jobs.py: planning failure → FAILED with message +
+finish_ms, cancel racing the planner, invalid-kind rejection at submit,
+the NoAvailableWorker terminal path, and the done-callback backstop for
+a planner coroutine that dies outside its own try block."""
+
+import asyncio
+
+import pytest
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.common.types import JobState, TaskInfo
+from curvine_tpu.testing import MiniCluster
+from curvine_tpu.ufs import create_ufs
+from curvine_tpu.ufs import memory as memufs
+
+
+async def _wait_state(c, job_id, *states, timeout=10.0):
+    async def wait():
+        while True:
+            job = await c.meta.job_status(job_id)
+            if job.state in states:
+                return job
+            await asyncio.sleep(0.05)
+    return await asyncio.wait_for(wait(), timeout)
+
+
+async def test_planning_failure_surfaces_as_failed():
+    """A load for a path under no mount: mounts.resolve raises inside the
+    planner — the job must land FAILED with the error in `message` and a
+    finish stamp, visible over the status RPC (the /api/jobs face)."""
+    async with MiniCluster(workers=0) as mc:
+        c = mc.client()
+        job_id = await c.meta.submit_load("/not/mounted/anywhere")
+        job = await _wait_state(c, job_id, JobState.FAILED)
+        assert job.message               # the why, not a bare FAILED
+        assert "mount" in job.message.lower() or "not" in job.message.lower()
+        assert job.finish_ms > 0
+        # the wire face carries it too (what /api/jobs/<id> serves)
+        assert job.to_wire()["message"] == job.message
+
+
+async def test_export_planning_failure_surfaces():
+    async with MiniCluster(workers=0) as mc:
+        c = mc.client()
+        job_id = await c.meta.submit_export("/no/mount/here")
+        job = await _wait_state(c, job_id, JobState.FAILED)
+        assert job.message and job.finish_ms > 0
+
+
+async def test_invalid_kind_rejected_at_submit():
+    async with MiniCluster(workers=0) as mc:
+        c = mc.client()
+        with pytest.raises(err.Unsupported):
+            await c.meta.submit_job("restore", "/whatever")
+        # nothing half-registered
+        assert mc.master.jobs.jobs == {}
+
+
+async def test_cancel_races_planner_and_sticks():
+    """Cancel lands between submit and the planner coroutine running:
+    the job must stay CANCELLED — the planner may not resurrect it to
+    RUNNING when its enumeration finishes."""
+    memufs.reset()
+    ufs = create_ufs("mem://cxl")
+    for i in range(3):
+        await ufs.write_all(f"mem://cxl/ds/f{i}", b"x" * 100)
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        await c.meta.mount("/cxl", "mem://cxl")
+        # submit in-process: cancel before the loop ever runs the planner
+        job = mc.master.jobs.submit("load", "/cxl/ds")
+        mc.master.jobs.cancel(job.job_id)
+        assert job.state == JobState.CANCELLED
+        await asyncio.sleep(0.3)         # planner runs (and must no-op)
+        job2 = await c.meta.job_status(job.job_id)
+        assert job2.state == JobState.CANCELLED
+        assert job2.tasks == []
+
+
+async def test_no_available_worker_terminal():
+    """With no live workers the dispatcher retries with backoff, then
+    fails terminally with NoAvailableWorker once attempts run out."""
+    async with MiniCluster(workers=0) as mc:
+        jobs = mc.master.jobs
+        task = TaskInfo(task_id="t0", job_id="j0", path="/x")
+        task.attempts = 20               # final attempt: no more requeues
+        with pytest.raises(err.NoAvailableWorker):
+            await jobs._dispatch(task)
+        # below the cap it requeues instead of raising
+        task2 = TaskInfo(task_id="t1", job_id="j0", path="/y")
+        await jobs._dispatch(task2)      # attempt 1: backs off, no raise
+        assert task2.attempts == 1
+
+
+async def test_planner_crash_outside_try_hits_backstop():
+    """A planner that dies before its own try/except (broken import,
+    bad signature) must be caught by the done-callback backstop, not
+    leave the job PENDING forever."""
+    async with MiniCluster(workers=0) as mc:
+        c = mc.client()
+        jobs = mc.master.jobs
+
+        async def bad_plan(job, recursive, replicas):
+            raise RuntimeError("planner exploded outside its try")
+
+        jobs._plan_load = bad_plan       # instance attr shadows the method
+        job_id = await c.meta.submit_load("/anything")
+        job = await _wait_state(c, job_id, JobState.FAILED)
+        assert "exploded" in job.message
+        assert job.finish_ms > 0

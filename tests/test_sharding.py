@@ -1,0 +1,368 @@
+"""Sharded namespace: router semantics, cross-shard 2PC, crash recovery.
+
+Covers master/sharding.py end to end over the inproc backend (real RPC
+sockets, shard servers on the test loop): placement, every-dir-
+everywhere, striped ids, fan-out merges, cross-shard rename/link, the
+presumed-abort coordinator's full crash matrix, and a seeded rename
+storm with random crash injection."""
+
+import asyncio
+
+import pytest
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.common.conf import ClusterConf
+from curvine_tpu.master.sharding import parent_of, shard_of
+from curvine_tpu.rpc import RpcCode
+from curvine_tpu.testing import MiniCluster
+
+MB = 1024 * 1024
+
+# fixed storm seed — the crash matrix below is deterministic, this keeps
+# the randomized mini-storm reproducible too
+STORM_SEED = 0xC04F1E
+
+
+def _dir_pair(n: int = 2) -> tuple[str, str]:
+    """Two top-level dirs whose FILES land on different shards."""
+    d0 = d1 = None
+    for i in range(256):
+        d = f"/s{i}"
+        s = shard_of(f"{d}/x", n)
+        if s == 0 and d0 is None:
+            d0 = d
+        elif s == 1 and d1 is None:
+            d1 = d
+        if d0 and d1:
+            return d0, d1
+    raise AssertionError("crc32 could not split 256 dirs over 2 shards")
+
+
+# ---------------------------------------------------------------------------
+# unit: placement function
+
+
+def test_shard_of_props():
+    # all direct entries of one directory co-locate
+    assert shard_of("/a/b/f1", 4) == shard_of("/a/b/f2", 4)
+    # in range, deterministic
+    for n in (1, 2, 3, 8):
+        for p in ("/x", "/a/b/c", "/" + "d" * 200):
+            s = shard_of(p, n)
+            assert 0 <= s < max(n, 1)
+            assert s == shard_of(p, n)
+    # n<=1 degenerates to shard 0
+    assert shard_of("/anything/at/all", 1) == 0
+    assert shard_of("/anything/at/all", 0) == 0
+    # parent_of
+    assert parent_of("/a/b/c") == "/a/b"
+    assert parent_of("/a") == "/"
+
+
+def test_dir_pair_really_splits():
+    d0, d1 = _dir_pair()
+    assert shard_of(d0 + "/x", 2) == 0
+    assert shard_of(d1 + "/x", 2) == 1
+
+
+# ---------------------------------------------------------------------------
+# routed namespace ops (inproc backend, 2 shards)
+
+
+async def test_sharded_crud_and_merge():
+    async with MiniCluster(workers=0, shards=2) as mc:
+        c = mc.client()
+        d0, d1 = _dir_pair()
+        # mkdir broadcasts: both shards resolve the path
+        await c.meta.mkdir(d0)
+        await c.meta.mkdir(d1)
+        for i, srv in enumerate(mc.master.shards.shards):
+            assert srv.server.fs.exists(d0), f"shard {i} missing {d0}"
+            assert srv.server.fs.exists(d1), f"shard {i} missing {d1}"
+        # creates partition by parent dir; only the owner holds the file
+        await c.meta.create_file(f"{d0}/f0")
+        await c.meta.complete_file(f"{d0}/f0", 0)
+        await c.meta.create_file(f"{d1}/f1")
+        await c.meta.complete_file(f"{d1}/f1", 0)
+        assert mc.master.shards.shards[0].server.fs.exists(f"{d0}/f0")
+        assert not mc.master.shards.shards[1].server.fs.exists(f"{d0}/f0")
+        assert mc.master.shards.shards[1].server.fs.exists(f"{d1}/f1")
+        # routed status/exists/list
+        assert (await c.meta.file_status(f"{d1}/f1")).name == "f1"
+        assert await c.meta.exists(f"{d0}/f0")
+        assert not await c.meta.exists(f"{d0}/nope")
+        # root listing merges the broadcast skeleton without duplicates
+        names = [s.name for s in await c.meta.list_status("/")]
+        assert names == sorted({d0[1:], d1[1:]})
+        # delete a file on its owner shard
+        await c.meta.delete(f"{d0}/f0")
+        assert not await c.meta.exists(f"{d0}/f0")
+        # non-recursive delete of a non-empty dir refuses at the router
+        with pytest.raises(err.DirNotEmpty):
+            await c.meta.delete(d1)
+        # recursive delete broadcasts and clears the skeleton everywhere
+        await c.meta.delete(d1, recursive=True)
+        for srv in mc.master.shards.shards:
+            assert not srv.server.fs.exists(d1)
+
+
+async def test_striped_ids_unique_across_shards():
+    async with MiniCluster(workers=0, shards=2) as mc:
+        c = mc.client()
+        d0, d1 = _dir_pair()
+        ids0, ids1 = [], []
+        for i in range(8):
+            st = await c.meta.create_file(f"{d0}/a{i}")
+            ids0.append(st.id)
+            st = await c.meta.create_file(f"{d1}/b{i}")
+            ids1.append(st.id)
+        allocated = ids0 + ids1
+        assert len(set(allocated)) == len(allocated)
+        # each shard allocates one residue class mod n, and they differ
+        assert len({i % 2 for i in ids0}) == 1
+        assert len({i % 2 for i in ids1}) == 1
+        assert ids0[0] % 2 != ids1[0] % 2
+
+
+async def test_sharded_batch_split_and_stitch():
+    async with MiniCluster(workers=0, shards=2) as mc:
+        c = mc.client()
+        d0, d1 = _dir_pair()
+        paths = [f"{d0 if i % 2 else d1}/f{i:03d}" for i in range(40)]
+        await c.meta.call(RpcCode.CREATE_FILES_BATCH, {"requests": [
+            {"path": p, "overwrite": True, "block_size": 4 * MB,
+             "replicas": 1, "client_name": c.meta.client_id}
+            for p in paths]}, mutate=True)
+        for p in paths:
+            assert await c.meta.exists(p), p
+        # META_BATCH: heterogeneous ops — mkdir broadcasts, creates
+        # bucket, deletes broadcast; replies stitch back in order
+        reps = await c.meta.meta_batch([
+            {"op": "mkdir", "path": f"{d0}/sub"},
+            {"op": "create", "path": f"{d0}/sub/x", "overwrite": True},
+            {"op": "delete", "path": paths[0], "recursive": False},
+        ])
+        assert len(reps) == 3
+        assert await c.meta.exists(f"{d0}/sub/x")
+        assert not await c.meta.exists(paths[0])
+
+
+async def test_sharded_shard_table_and_metrics():
+    async with MiniCluster(workers=0, shards=2) as mc:
+        c = mc.client()
+        d0, _d1 = _dir_pair()
+        await c.meta.mkdir(d0)
+        rows = await c.meta.shard_table()
+        assert [r["shard"] for r in rows] == [0, 1]
+        assert all(r["state"] == "up" for r in rows)
+        assert all(r["inodes"] >= 2 for r in rows)   # root + broadcast dir
+        # per-shard gauges land on the router's registry
+        m = mc.master.metrics.as_dict()
+        assert "shard.0.inodes" in m and "shard.1.queue_depth" in m
+        # master_info aggregates inode/block counts across shards
+        info = await c.meta.master_info()
+        assert info.inode_num == sum(r["inodes"] for r in rows)
+
+
+async def test_shards1_degenerates_and_raft_exclusive():
+    # shards=1 builds no router at all — the unsharded code path
+    async with MiniCluster(workers=0, shards=1) as mc:
+        assert mc.master.shards is None
+        c = mc.client()
+        await c.meta.mkdir("/plain")
+        assert await c.meta.exists("/plain")
+    # meta_shards>1 + raft_peers is a config error, surfaced at init
+    conf = ClusterConf()
+    conf.master.meta_shards = 2
+    conf.master.raft_peers = ["127.0.0.1:7001", "127.0.0.1:7002"]
+    from curvine_tpu.master import MasterServer
+    with pytest.raises(err.InvalidArgument):
+        MasterServer(conf, journal=False)
+
+
+def test_router_never_builds_fastmeta():
+    """The native read plane must stay OFF on the shard router: its
+    local store owns no files, so the mirror would serve empty
+    stat/list answers that bypass the shard fleet (found live — the
+    default conf has fast_meta on, while MiniCluster turns it off)."""
+    from curvine_tpu.master import MasterServer
+    conf = ClusterConf()
+    conf.master.meta_shards = 2
+    assert conf.master.fast_meta      # the default that bit us
+    srv = MasterServer(conf, journal=False)
+    assert srv.sharded
+    assert srv.fastmeta is None
+
+
+# ---------------------------------------------------------------------------
+# cross-shard rename / link (the 2PC happy path), with real data
+
+
+async def test_cross_shard_rename_with_data():
+    async with MiniCluster(workers=1, shards=2) as mc:
+        c = mc.client()
+        d0, d1 = _dir_pair()
+        await c.meta.mkdir(d0)
+        await c.meta.mkdir(d1)
+        payload = b"shard-me" * 4096
+        await c.write_all(f"{d0}/data.bin", payload)
+        assert await c.meta.rename(f"{d0}/data.bin", f"{d1}/moved.bin")
+        assert not await c.meta.exists(f"{d0}/data.bin")
+        st = await c.meta.file_status(f"{d1}/moved.bin")
+        assert st.len == len(payload)
+        # block metadata + live locations travelled with the 2PC payload
+        assert await c.read_all(f"{d1}/moved.bin") == payload
+        # no tx debris on either participant
+        for i in range(2):
+            out = await mc.master.shards.call(i, RpcCode.SHARD_TX_LIST, {})
+            assert out.get("txs", []) == []
+
+
+async def test_cross_shard_link_and_refusals():
+    async with MiniCluster(workers=1, shards=2) as mc:
+        c = mc.client()
+        d0, d1 = _dir_pair()
+        await c.meta.mkdir(d0)
+        await c.meta.mkdir(d1)
+        payload = b"linked" * 1000
+        await c.write_all(f"{d0}/orig", payload)
+        st = await c.meta.link(f"{d0}/orig", f"{d1}/alias")
+        assert st.path == f"{d1}/alias"
+        assert await c.read_all(f"{d1}/alias") == payload
+        assert await c.read_all(f"{d0}/orig") == payload
+        # directory rename across shards is refused (would re-hash the
+        # whole subtree)
+        with pytest.raises(err.Unsupported):
+            await c.meta.rename(d0, f"{d1}/sub")
+        # cross-shard rename of a hard-linked file is refused (block
+        # ownership would split)
+        with pytest.raises(err.Unsupported):
+            await c.meta.rename(f"{d0}/orig", f"{d1}/moved")
+
+
+# ---------------------------------------------------------------------------
+# 2PC crash matrix: kill the coordinator at every phase boundary, then
+# run the recovery sweep and check exactly-one-copy
+
+
+_STAGES = {
+    # stage → (file survives at src, file appears at dst) after sweep
+    "after_prepare_src": (True, False),    # presumed abort
+    "after_prepare_dst": (True, False),    # no committed record → abort
+    "after_commit_dst": (False, True),     # committed marker → roll fwd
+    "after_commit_src": (False, True),     # forget pending → roll fwd
+}
+
+
+@pytest.mark.parametrize("stage", sorted(_STAGES))
+async def test_two_phase_crash_matrix(stage):
+    at_src, at_dst = _STAGES[stage]
+    async with MiniCluster(workers=0, shards=2) as mc:
+        c = mc.client()
+        router = mc.master.shards
+        d0, d1 = _dir_pair()
+        await c.meta.mkdir(d0)
+        await c.meta.mkdir(d1)
+        src, dst = f"{d0}/victim", f"{d1}/target"
+        await c.meta.create_file(src)
+        await c.meta.complete_file(src, 0)
+
+        def boom(s):
+            if s == stage:
+                raise err.CurvineError(f"injected coordinator crash @ {s}")
+
+        router.fault_hook = boom
+        with pytest.raises(err.CurvineError):
+            await c.meta.rename(src, dst)
+        router.fault_hook = None
+
+        # the sweep a restarted router would run
+        await router.recovery_sweep()
+
+        assert await c.meta.exists(src) == at_src, stage
+        assert await c.meta.exists(dst) == at_dst, stage
+        # exactly one copy, never zero, never two
+        assert at_src != at_dst
+        # all tx records resolved on both participants
+        for i in range(2):
+            out = await router.call(i, RpcCode.SHARD_TX_LIST, {})
+            assert out.get("txs", []) == [], (stage, i)
+
+
+async def test_two_phase_prepare_dst_conflict_aborts_src():
+    """dst-side prepare failure (target exists) must abort the src
+    prepare inline — no sweep needed, src keeps the file."""
+    async with MiniCluster(workers=0, shards=2) as mc:
+        c = mc.client()
+        d0, d1 = _dir_pair()
+        await c.meta.mkdir(d0)
+        await c.meta.mkdir(d1)
+        await c.meta.create_file(f"{d0}/f")
+        await c.meta.complete_file(f"{d0}/f", 0)
+        # a DIRECTORY at the destination: rename-over refuses on prepare
+        await c.meta.mkdir(f"{d1}/occupied")
+        with pytest.raises(err.CurvineError):
+            await c.meta.rename(f"{d0}/f", f"{d1}/occupied")
+        assert await c.meta.exists(f"{d0}/f")
+        for i in range(2):
+            out = await mc.master.shards.call(i, RpcCode.SHARD_TX_LIST, {})
+            assert out.get("txs", []) == []
+
+
+async def test_two_phase_storm_seeded():
+    """Randomized rename storm with crash injection: STORM_SEED drives
+    which renames get a coordinator crash at which stage. After every
+    round the sweep must restore exactly-one-copy; after the storm both
+    shards' tx tables are empty."""
+    import random
+    rng = random.Random(STORM_SEED)
+    stages = [None] + sorted(_STAGES)
+    async with MiniCluster(workers=0, shards=2) as mc:
+        c = mc.client()
+        router = mc.master.shards
+        d0, d1 = _dir_pair()
+        await c.meta.mkdir(d0)
+        await c.meta.mkdir(d1)
+        for round_no in range(12):
+            src = f"{d0}/storm{round_no}"
+            dst = f"{d1}/storm{round_no}"
+            await c.meta.create_file(src)
+            await c.meta.complete_file(src, 0)
+            stage = rng.choice(stages)
+
+            def boom(s, _stage=stage):
+                if s == _stage:
+                    raise err.CurvineError(f"storm crash @ {s}")
+
+            router.fault_hook = boom if stage else None
+            try:
+                await c.meta.rename(src, dst)
+            except err.CurvineError:
+                pass
+            router.fault_hook = None
+            await router.recovery_sweep()
+            here = await c.meta.exists(src)
+            there = await c.meta.exists(dst)
+            assert here != there, (round_no, stage)
+        for i in range(2):
+            out = await router.call(i, RpcCode.SHARD_TX_LIST, {})
+            assert out.get("txs", []) == [], i
+
+
+# ---------------------------------------------------------------------------
+# worker plane through the router
+
+
+async def test_sharded_worker_plane_write_read_delete():
+    async with MiniCluster(workers=1, shards=2, block_size=1 * MB) as mc:
+        c = mc.client()
+        d0, d1 = _dir_pair()
+        await c.meta.mkdir(d0)
+        payload = bytes(range(256)) * 8192       # 2 MiB, 2 blocks
+        await c.write_all(f"{d0}/blob", payload)
+        assert await c.read_all(f"{d0}/blob") == payload
+        # every shard's WorkerMap sees the worker (broadcast heartbeat)
+        for srv in mc.master.shards.shards:
+            assert len(srv.server.fs.workers.live_workers()) == 1
+        await c.meta.delete(f"{d0}/blob")
+        assert not await c.meta.exists(f"{d0}/blob")

@@ -1,0 +1,230 @@
+"""TTL expiry: bucket mechanics, DELETE-vs-FREE actions, refresh
+re-indexing, rescan rebuild, and per-shard expiry on a sharded master.
+
+Covers master/ttl.py (TtlBuckets, TtlManager.check/rescan) plus the
+interaction the sharded plane relies on: each shard actor runs its OWN
+TtlManager over its partition, so expiry must act only on files the
+shard owns while the router-visible namespace reflects the reclaim."""
+
+import os
+
+from curvine_tpu.common.types import SetAttrOpts, TtlAction, now_ms
+from curvine_tpu.master.sharding import shard_of
+from curvine_tpu.master.ttl import TtlBuckets, TtlManager
+from curvine_tpu.testing import MiniCluster
+
+MB = 1024 * 1024
+
+
+def _dir_pair(n: int = 2) -> tuple[str, str]:
+    """Two top-level dirs whose FILES land on different shards."""
+    d0 = d1 = None
+    for i in range(256):
+        d = f"/t{i}"
+        s = shard_of(f"{d}/x", n)
+        if s == 0 and d0 is None:
+            d0 = d
+        elif s == 1 and d1 is None:
+            d1 = d
+        if d0 and d1:
+            return d0, d1
+    raise AssertionError("crc32 could not split 256 dirs over 2 shards")
+
+
+# ---------------------------------------------------------------------------
+# unit: bucket mechanics
+
+
+def test_buckets_add_due_remove():
+    b = TtlBuckets(bucket_ms=1_000)
+    b.add(1, 1_500)
+    b.add(2, 2_500)
+    b.add(3, 99_000)
+    # nothing due before the first bucket
+    assert b.due(900) == []
+    # due() pops everything in buckets <= now's bucket, and only once
+    got = b.due(2_999)
+    assert sorted(got) == [1, 2]
+    assert b.due(2_999) == []
+    # remove() keeps a dropped id from ever coming due
+    b.remove(3, 99_000)
+    assert b.due(200_000) == []
+    # removing an id that was never added is a no-op
+    b.remove(42, 1_000)
+
+
+def test_buckets_are_coarse():
+    """Buckets quantize by expire//bucket_ms: an id whose exact expiry
+    is later in the CURRENT bucket still comes back from due() — the
+    manager's check() re-verifies node.mtime+ttl against now, so the
+    coarseness costs a re-index, never an early reclaim."""
+    b = TtlBuckets(bucket_ms=1_000)
+    b.add(7, 1_999)                      # bucket key 1
+    assert b.due(1_000) == [7]           # now=1000 -> key 1: popped early
+
+
+def test_manager_index_reindex_clear():
+    m = TtlManager(fs=None)              # index() never touches fs
+    m.index(5, mtime=0, ttl_ms=3_000)
+    assert m._indexed[5] == 3_000
+    # re-index moves the id between buckets instead of duplicating it
+    m.index(5, mtime=10_000, ttl_ms=3_000)
+    assert m._indexed[5] == 13_000
+    assert m.buckets.due(9_000) == []    # old slot vacated
+    assert m.buckets.due(13_500) == [5]
+    # ttl_ms=0 clears the entry entirely
+    m.index(5, mtime=10_000, ttl_ms=3_000)
+    m.index(5, mtime=10_000, ttl_ms=0)
+    assert 5 not in m._indexed
+    assert m.buckets.due(1 << 50) == []
+
+
+# ---------------------------------------------------------------------------
+# actions on a live cluster: DELETE removes, FREE keeps metadata
+
+
+async def test_ttl_delete_vs_free_actions():
+    async with MiniCluster(workers=1) as mc:
+        c = mc.client()
+        data = os.urandom(1 * MB)
+        await c.write_all("/ttl/gone", data)
+        await c.write_all("/ttl/freed", data)
+        ttl = mc.master.ttl
+        await c.meta.set_attr("/ttl/gone", SetAttrOpts(
+            ttl_ms=500, ttl_action=int(TtlAction.DELETE)))
+        await c.meta.set_attr("/ttl/freed", SetAttrOpts(
+            ttl_ms=500, ttl_action=int(TtlAction.FREE)))
+        # set_attr hook indexed both
+        assert len(ttl._indexed) == 2
+        # not due yet: nothing acted, both files intact
+        assert ttl.check(now_ms() - 10_000) == 0
+        assert await c.meta.exists("/ttl/gone")
+        # drive the clock past expiry instead of sleeping on the checker
+        assert ttl.check(now_ms() + 60_000) == 2
+        # DELETE: metadata gone
+        assert not await c.meta.exists("/ttl/gone")
+        # FREE: metadata kept, cache dropped
+        st = await c.meta.file_status("/ttl/freed")
+        assert st.len == 1 * MB
+        fb = await c.meta.get_block_locations("/ttl/freed")
+        assert fb.block_locs == []
+        # both consumed from the index — no repeat firing
+        assert ttl._indexed == {}
+        assert ttl.check(now_ms() + 120_000) == 0
+
+
+async def test_ttl_refresh_reindexes_instead_of_reclaiming():
+    """A file whose mtime moved forward after indexing (touch/rewrite)
+    must survive the stale bucket firing: check() re-verifies against
+    the node and re-indexes at the new expiry."""
+    async with MiniCluster(workers=0) as mc:
+        c = mc.client()
+        await c.meta.create_file("/fresh")
+        await c.meta.complete_file("/fresh", 0)
+        await c.meta.set_attr("/fresh", SetAttrOpts(
+            ttl_ms=1_000, ttl_action=int(TtlAction.DELETE)))
+        ttl = mc.master.ttl
+        fs = mc.master.fs
+        node = fs.tree.resolve("/fresh")
+        # bump mtime behind the index's back (journal replay / install
+        # can do this): the indexed expiry is now stale
+        node.mtime = now_ms() + 600_000
+        fs.tree.save(node)
+        stale_fire = now_ms() + 60_000
+        assert ttl.check(stale_fire) == 0
+        assert await c.meta.exists("/fresh")
+        # re-indexed at mtime+ttl, not dropped
+        assert ttl._indexed[node.id] == node.mtime + 1_000
+        # once the REAL expiry passes, the action lands
+        assert ttl.check(node.mtime + 60_000) == 1
+        assert not await c.meta.exists("/fresh")
+
+
+async def test_ttl_rescan_rebuilds_index():
+    """rescan() reconstructs the bucket index from the tree (restart /
+    HA promotion path) and drops entries for files without a ttl."""
+    async with MiniCluster(workers=0) as mc:
+        c = mc.client()
+        for name in ("a", "b", "plain"):
+            await c.meta.create_file(f"/rs/{name}")
+            await c.meta.complete_file(f"/rs/{name}", 0)
+        await c.meta.set_attr("/rs/a", SetAttrOpts(
+            ttl_ms=1_000, ttl_action=int(TtlAction.DELETE)))
+        await c.meta.set_attr("/rs/b", SetAttrOpts(
+            ttl_ms=2_000, ttl_action=int(TtlAction.DELETE)))
+        ttl = mc.master.ttl
+        want = dict(ttl._indexed)
+        assert len(want) == 2
+        # wipe and rebuild — the promoted-follower scenario
+        ttl.buckets = TtlBuckets(ttl.buckets.bucket_ms)
+        ttl._indexed.clear()
+        ttl.rescan()
+        assert ttl._indexed == want
+        assert ttl.check(now_ms() + 60_000) == 2
+        assert not await c.meta.exists("/rs/a")
+        assert not await c.meta.exists("/rs/b")
+        assert await c.meta.exists("/rs/plain")
+
+
+# ---------------------------------------------------------------------------
+# sharded: each shard's TtlManager expires only its own partition
+
+
+async def test_sharded_ttl_expires_per_shard():
+    async with MiniCluster(workers=0, shards=2) as mc:
+        c = mc.client()
+        d0, d1 = _dir_pair()
+        for d in (d0, d1):
+            await c.meta.mkdir(d)
+            await c.meta.create_file(f"{d}/exp")
+            await c.meta.complete_file(f"{d}/exp", 0)
+            # routed set_attr broadcasts; only the owner shard holds the
+            # file, so only the owner's TtlManager indexes it
+            await c.meta.set_attr(f"{d}/exp", SetAttrOpts(
+                ttl_ms=500, ttl_action=int(TtlAction.DELETE)))
+        s0 = mc.master.shards.shards[0].server
+        s1 = mc.master.shards.shards[1].server
+        n0 = s0.fs.tree.resolve(f"{d0}/exp")
+        n1 = s1.fs.tree.resolve(f"{d1}/exp")
+        assert n0 is not None and n1 is not None
+        assert set(s0.ttl._indexed) == {n0.id}
+        assert set(s1.ttl._indexed) == {n1.id}
+        late = now_ms() + 60_000
+        # shard 0's checker fires: ITS file goes, shard 1's survives
+        assert s0.ttl.check(late) == 1
+        assert not await c.meta.exists(f"{d0}/exp")
+        assert await c.meta.exists(f"{d1}/exp")
+        # shard 1 reclaims its own on its own cadence
+        assert s1.ttl.check(late) == 1
+        assert not await c.meta.exists(f"{d1}/exp")
+        # dir skeleton stays put everywhere
+        for srv in (s0, s1):
+            assert srv.fs.exists(d0) and srv.fs.exists(d1)
+
+
+async def test_sharded_ttl_rescan_stays_partitioned():
+    """A per-shard rescan (shard restart) re-indexes only files that
+    shard owns — the every-dir-everywhere skeleton contributes no file
+    entries on non-owner shards."""
+    async with MiniCluster(workers=0, shards=2) as mc:
+        c = mc.client()
+        d0, d1 = _dir_pair()
+        for d in (d0, d1):
+            await c.meta.mkdir(d)
+        for i in range(3):
+            await c.meta.create_file(f"{d0}/f{i}")
+            await c.meta.complete_file(f"{d0}/f{i}", 0)
+            await c.meta.set_attr(f"{d0}/f{i}", SetAttrOpts(
+                ttl_ms=1_000, ttl_action=int(TtlAction.DELETE)))
+        s0 = mc.master.shards.shards[0].server
+        s1 = mc.master.shards.shards[1].server
+        for srv in (s0, s1):
+            srv.ttl.rescan()
+        assert len(s0.ttl._indexed) == 3
+        assert s1.ttl._indexed == {}
+        # firing the non-owner's checker is a no-op on the namespace
+        assert s1.ttl.check(now_ms() + 60_000) == 0
+        assert await c.meta.exists(f"{d0}/f0")
+        assert s0.ttl.check(now_ms() + 60_000) == 3
+        for i in range(3):
+            assert not await c.meta.exists(f"{d0}/f{i}")

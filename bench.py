@@ -244,6 +244,67 @@ async def _trace_overhead_bench(file_kb: int = 4096, read_kb: int = 64,
     return out
 
 
+async def _read_verify_overhead_bench(block_kb: int = 1024,
+                                      blocks: int = 4, ops: int = 25,
+                                      rounds: int = 4) -> dict:
+    """End-to-end read-verification gate: whole-file reads over the RPC
+    path (full-block reads are exactly where the client recomputes the
+    commit-time checksum — partial preads skip it) with client
+    verification ON must stay within read_verify_overhead_pct_max of
+    OFF. Rounds alternate off/on and the best of each side is compared,
+    the same noise filter as _trace_overhead_bench. Returns
+    {verify_read_qps_off, verify_read_qps_on, verify_algo,
+    read_verify_overhead_pct}."""
+    import copy
+    import shutil
+    import tempfile
+    from curvine_tpu.client import CurvineClient
+    from curvine_tpu.common import checksum
+    from curvine_tpu.testing.cluster import MiniCluster
+
+    base = tempfile.mkdtemp(prefix="curvine-verifyov-")
+    mc = MiniCluster(workers=1, base_dir=base,
+                     block_size=block_kb * 1024)
+    mc.conf.client.short_circuit = False
+    out: dict = {"verify_algo": checksum.preferred_algo()}
+    try:
+        await mc.start()
+        c_on = mc.client()
+        conf_off = copy.deepcopy(mc.conf)
+        conf_off.client.read_verify = False
+        c_off = CurvineClient(conf_off)
+        path = "/verifyov/data.bin"
+        await c_on.write_all(path, os.urandom(block_kb * 1024 * blocks))
+
+        async def qps(client) -> float:
+            for _ in range(2):                 # warm connections
+                r = await client.open(path)
+                await r.read_all()
+                await r.close()
+            t0 = time.perf_counter()
+            for _ in range(ops):
+                r = await client.open(path)
+                await r.read_all()
+                await r.close()
+            return ops / (time.perf_counter() - t0)
+
+        best_off = best_on = 0.0
+        for _ in range(rounds):
+            best_off = max(best_off, await qps(c_off))
+            best_on = max(best_on, await qps(c_on))
+        await c_off.close()
+        out["verify_read_qps_off"] = round(best_off, 1)
+        out["verify_read_qps_on"] = round(best_on, 1)
+        out["read_verify_overhead_pct"] = round(
+            max(0.0, (best_off - best_on) / best_off * 100), 2)
+    finally:
+        try:
+            await mc.stop()
+        finally:
+            shutil.rmtree(base, ignore_errors=True)
+    return out
+
+
 def _tmpfs_raw_gibs(base: str) -> float:
     """Raw sequential write rate to the cache tier's backing dir (the
     hardware ceiling for the write path on this host)."""

@@ -265,6 +265,7 @@ async def cmd_report(args):
         for w in info.live_workers:
             tiers = ", ".join(
                 f"{s.storage_type.name}:{_human(s.available)}/{_human(s.capacity)}"
+                + (f"!{s.health.upper()}" if s.health != "healthy" else "")
                 for s in w.storages)
             coords = f" ici={w.ici_coords}" if w.ici_coords else ""
             print(f"  worker {w.address.worker_id} "

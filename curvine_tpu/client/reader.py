@@ -11,6 +11,7 @@ import asyncio
 import logging
 import os
 import time
+import zlib
 from contextlib import nullcontext
 
 from curvine_tpu.common import errors as err  # noqa: F401
@@ -21,6 +22,18 @@ from curvine_tpu.rpc.deadline import Deadline
 from curvine_tpu.rpc.frame import pack, unpack
 
 log = logging.getLogger(__name__)
+
+
+def _block_crc(algo: str, data) -> int | None:
+    """Checksum `data` with the block's commit-time algorithm; None →
+    algorithm unknown to this client (skip verification, e.g. during a
+    rolling upgrade that introduced a new algo on the workers first)."""
+    if algo == "crc32":
+        return zlib.crc32(data)
+    if algo == "crc32c":
+        from curvine_tpu.common import native
+        return native.crc32c(data)
+    return None
 
 
 class ReadDetector:
@@ -69,7 +82,8 @@ class FsReader:
                  short_circuit: bool = True, read_ahead: int = 2,
                  counters: dict | None = None,
                  smart_prefetch: bool = True, seq_threshold: int = 3,
-                 health=None, op_deadline_ms: int = 0, tracer=None):
+                 health=None, op_deadline_ms: int = 0, tracer=None,
+                 verify: bool = True):
         # shared per-client WorkerHealth scoreboard (client/health.py):
         # replica choice deprioritizes open-circuit workers and every
         # remote outcome feeds back into it
@@ -125,6 +139,17 @@ class FsReader:
         self._sc_pending = 0
         self._sc_flush_task: asyncio.Task | None = None
         self.counters = counters if counters is not None else {}
+        # end-to-end integrity: every read that covers a FULL block is
+        # checked against the block's commit-time checksum (carried on
+        # the READ_BLOCK EOF frame / GET_BLOCK_INFO reply). A mismatch
+        # means bytes changed somewhere between the writer's commit and
+        # this process — bad media, a torn page, a buggy middlebox — and
+        # is treated as a replica failure: count, tell the master (so
+        # re-replication heals from a good copy), fail over.
+        self.verify = verify
+        # block_id -> (crc, algo) captured from GET_BLOCK_INFO for the
+        # short-circuit paths (remote reads get it on the EOF frame)
+        self._block_crc: dict[int, tuple[int, str]] = {}
 
     # ---------------- positioning ----------------
 
@@ -241,6 +266,9 @@ class FsReader:
                         self.direct_queue_depth = max(
                             self.direct_queue_depth,
                             int(info.get("queue_depth", 0)))
+                    if info.get("crc32") is not None:
+                        self._block_crc[bid] = (
+                            info["crc32"], info.get("crc_algo", "crc32"))
                     p = info.get("path")
                     if p and os.path.exists(p):
                         path = p
@@ -273,6 +301,53 @@ class FsReader:
                     os.close(cached[0])
                 except OSError:
                     pass
+
+    # ---------------- read integrity ----------------
+
+    def _flag_corrupt(self, lb: LocatedBlock, loc) -> None:
+        """A read of block `lb` from `loc` failed checksum verification:
+        count it and tell the master (fire-and-forget) so the bad replica
+        is retired and re-replicated from a good copy. The caller then
+        treats the attempt as a read failure and fails over."""
+        self.counters["read.checksum_mismatch"] = \
+            self.counters.get("read.checksum_mismatch", 0) + 1
+        log.warning("block %d from %s failed checksum verification",
+                    lb.block.id, self._addr(loc))
+
+        async def _report():
+            try:
+                await self.fs.call(
+                    RpcCode.REPORT_UNDER_REPLICATED_BLOCKS,
+                    {"block_ids": [lb.block.id],
+                     "worker_id": loc.worker_id})
+            except Exception as e:  # noqa: BLE001 — scrub is the backstop
+                log.debug("corrupt-replica report failed: %s", e)
+        asyncio.ensure_future(_report())
+
+    def _sc_verify_ok(self, lb: LocatedBlock, data) -> bool:
+        """Verify a FULL-block short-circuit read against the commit-time
+        checksum from GET_BLOCK_INFO. On mismatch: flag the replica and
+        drop every local cache for the block so this read (and the next)
+        goes through the remote failover path instead."""
+        ent = self._block_crc.get(lb.block.id)
+        if ent is None:
+            return True
+        want, algo = ent
+        got = _block_crc(algo, data)
+        if got is None or got == want:
+            return True
+        self._flag_corrupt(lb, self._pick_loc(lb))
+        bid = lb.block.id
+        self._local_paths[bid] = None
+        self._local_offs.pop(bid, None)
+        self._local_expiry.pop(bid, None)
+        cached = self._local_fds.pop(bid, None)
+        if cached is not None:
+            try:
+                os.close(cached[0])
+            except OSError:
+                pass
+        return False
 
     # ---------------- short-circuit read accounting ----------------
 
@@ -394,13 +469,18 @@ class FsReader:
             fd = await self._local_fd(lb)
             if fd is not None:
                 base = self._local_offs.get(lb.block.id, 0)
-                got = os.preadv(fd, [memoryview(out[filled:filled + seg])],
-                                base + block_off)
-                self._note_sc_read(lb.block.id, got)
-                filled += max(0, got)
-                if got < seg:
-                    break
-            else:
+                view = memoryview(out[filled:filled + seg])
+                got = os.preadv(fd, [view], base + block_off)
+                if self.verify and block_off == 0 \
+                        and got == lb.block.len \
+                        and not self._sc_verify_ok(lb, view[:got]):
+                    fd = None     # bad local bytes: re-read remotely
+                else:
+                    self._note_sc_read(lb.block.id, got)
+                    filled += max(0, got)
+                    if got < seg:
+                        break
+            if fd is None:
                 # remote: stream chunks straight into the output buffer
                 got = await self._readinto_remote(
                     lb, block_off, memoryview(out[filled:filled + seg]),
@@ -565,6 +645,7 @@ class FsReader:
             try:
                 # one span per replica ATTEMPT: a failed first replica
                 # leaves a status=error span in the trace, not a gap
+                eof: dict = {}
                 with self._span("read_block", addr=addr,
                                 block=lb.block.id):
                     conn = await self.pool.get(addr)
@@ -572,7 +653,17 @@ class FsReader:
                         RpcCode.READ_BLOCK, sink, header={
                             "block_id": lb.block.id, "offset": block_off,
                             "len": len(sink), "chunk_size": self.chunk_size},
-                        deadline=hop)
+                        deadline=hop, eof_header=eof)
+                if self.verify and block_off == 0 \
+                        and got == lb.block.len \
+                        and eof.get("block_crc32") is not None:
+                    have = _block_crc(eof.get("block_crc_algo", ""),
+                                      sink[:got])
+                    if have is not None and have != eof["block_crc32"]:
+                        self._flag_corrupt(lb, loc)
+                        raise err.AbnormalData(
+                            f"block {lb.block.id} from {addr} failed "
+                            f"checksum verification")
                 if self.health is not None:
                     self.health.ok(addr)
                 return got
@@ -643,6 +734,9 @@ class FsReader:
         got = os.preadv(fd, [memoryview(buf)], base + block_off)
         if got != n:
             return None
+        if self.verify and block_off == 0 and n == lb.block.len \
+                and not self._sc_verify_ok(lb, buf):
+            return None       # caller falls back to the verified path
         self._note_sc_read(lb.block.id, n)
         return buf
 
@@ -663,8 +757,13 @@ class FsReader:
         if fd is not None:
             base = self._local_offs.get(lb.block.id, 0)
             data = os.pread(fd, n, base + block_off)
-            self._note_sc_read(lb.block.id, len(data))
-            return data
+            if self.verify and block_off == 0 \
+                    and len(data) == lb.block.len \
+                    and not self._sc_verify_ok(lb, data):
+                pass        # bad local bytes: fall through to remote
+            else:
+                self._note_sc_read(lb.block.id, len(data))
+                return data
         # failover across replica locations (local-first, breaker-aware)
         locs = self._failover_locs(lb)
         last_err: Exception | None = None
@@ -676,8 +775,8 @@ class FsReader:
             try:
                 with self._span("read_block", addr=self._addr(loc),
                                 block=lb.block.id):
-                    return await self._read_from(loc, lb.block.id,
-                                                 block_off, n, deadline=hop)
+                    return await self._read_from(loc, lb, block_off, n,
+                                                 deadline=hop)
             except err.CurvineError as e:
                 log.warning("read block %d from %s:%d failed (%s), "
                             "trying next replica", lb.block.id,
@@ -696,16 +795,18 @@ class FsReader:
             for loc in lb2.locs:
                 try:
                     return await self._read_from(
-                        loc, lb2.block.id, off2,
+                        loc, lb2, off2,
                         min(n, lb2.block.len - off2), deadline=deadline)
                 except err.CurvineError as e:
                     last_err = e
         raise last_err or err.BlockNotFound(f"block {lb.block.id} unreadable")
 
-    async def _read_from(self, loc, block_id: int, offset: int, n: int,
+    async def _read_from(self, loc, lb: LocatedBlock, offset: int, n: int,
                          deadline: Deadline | None = None) -> bytes:
         addr = self._addr(loc)
+        block_id = lb.block.id
         out = bytearray()
+        eof: dict = {}
         try:
             conn = await self.pool.get(addr)
             async for m in conn.call_stream(RpcCode.READ_BLOCK, header={
@@ -713,6 +814,16 @@ class FsReader:
                     "chunk_size": self.chunk_size}, deadline=deadline):
                 if len(m.data):
                     out += m.data
+                if m.is_eof and m.header:
+                    eof = m.header
+            if self.verify and offset == 0 and len(out) == lb.block.len \
+                    and eof.get("block_crc32") is not None:
+                have = _block_crc(eof.get("block_crc_algo", ""), out)
+                if have is not None and have != eof["block_crc32"]:
+                    self._flag_corrupt(lb, loc)
+                    raise err.AbnormalData(
+                        f"block {block_id} from {addr} failed "
+                        f"checksum verification")
         except err.CurvineError:
             if self.health is not None:
                 self.health.fail(addr, worker_id=loc.worker_id)

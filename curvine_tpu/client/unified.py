@@ -171,7 +171,8 @@ class CurvineClient:
                         seq_threshold=cc.sequential_read_threshold,
                         health=self.health,
                         op_deadline_ms=cc.op_deadline_ms,
-                        tracer=self.tracer)
+                        tracer=self.tracer,
+                        verify=cc.read_verify)
 
     async def write_all(self, path: str, data: bytes, **kw) -> None:
         # one root span covers create + uploads + complete; every RPC

@@ -13,6 +13,7 @@ import logging
 import os
 import zlib
 
+from curvine_tpu.common import checksum
 from curvine_tpu.common import errors as err
 from curvine_tpu.common.types import CommitBlock, LocatedBlock, StorageType
 from curvine_tpu.rpc import RpcCode
@@ -55,6 +56,10 @@ class FsWriter:
         self._uploads: list = []           # one per replica location
         self._block_written = 0
         self._block_crc = 0
+        # commit-time checksum algo: hardware crc32c when the native lib
+        # is loaded, zlib crc32 otherwise; rides every commit header so
+        # any verifier can recompute it (common/checksum.py)
+        self._crc_algo = checksum.preferred_algo()
         self._commits: list[CommitBlock] = []
         self._closed = False
         # short-circuit local write state (co-located single-replica)
@@ -112,7 +117,8 @@ class FsWriter:
         if self._sc_file is not None:
             # short-circuit: hash + write straight into the worker's temp
             # block file — one hash pass, no socket copies
-            self._block_crc = zlib.crc32(chunk, self._block_crc)
+            self._block_crc = checksum.crc_update(
+                self._crc_algo, chunk, self._block_crc)
             self._sc_file.write(chunk)
             self._block_written += len(chunk)
             self.counters["sc.bytes.written"] = \
@@ -124,9 +130,11 @@ class FsWriter:
         crc_task = None
         if _OFFLOAD and len(chunk) >= 256 * 1024:
             crc_task = asyncio.get_running_loop().run_in_executor(
-                None, zlib.crc32, chunk, self._block_crc)
+                None, checksum.crc_update, self._crc_algo, chunk,
+                self._block_crc)
         else:
-            self._block_crc = zlib.crc32(chunk, self._block_crc)
+            self._block_crc = checksum.crc_update(
+                self._crc_algo, chunk, self._block_crc)
         try:
             if len(self._uploads) == 1:
                 await self._uploads[0].send_chunk(chunk)
@@ -237,6 +245,7 @@ class FsWriter:
                 up = await conn.open_upload(RpcCode.WRITE_BLOCK, header={
                     "block_id": self._block.block.id,
                     "storage_type": int(self.storage_type),
+                    "algo": self._crc_algo,
                     "len_hint": self.block_size})
             except err.CurvineError:
                 # feeds the breaker so the add_block retry can exclude
@@ -300,12 +309,13 @@ class FsWriter:
             await self._sc_conn.call(RpcCode.SC_WRITE_COMMIT, data=pack({
                 "block_id": self._block.block.id,
                 "len": self._block_written,
-                "crc32": self._block_crc, "algo": "crc32"}))
+                "crc32": self._block_crc, "algo": self._crc_algo}))
             worker_ids = [self._sc_worker_id]
         else:
             worker_ids = []
             for up, loc in zip(self._uploads, self._block.locs):
-                ack = await up.finish(header={"crc32": self._block_crc})
+                ack = await up.finish(header={
+                    "crc32": self._block_crc, "algo": self._crc_algo})
                 worker_ids.append(ack.header.get("worker_id", loc.worker_id))
         self._commits.append(CommitBlock(
             block_id=self._block.block.id, block_len=self._block_written,

@@ -144,6 +144,26 @@ class WorkerConf:
     direct_io_alignment: int = 4096
     direct_io_threads: int = 2
     direct_io_segment: int = 1 * MB    # split size for batched reads
+    # background checksum scrub: every scrub_interval_s verify the
+    # scrub_batch least-recently-verified committed blocks (full-store
+    # progress within ceil(N/batch) cycles)
+    scrub_interval_s: float = 60.0
+    scrub_batch: int = 16
+    # per-tier-dir DiskHealth state machine (worker/storage.py):
+    # >= disk_error_threshold IO errors within disk_error_decay_s mark a
+    # dir SUSPECT; a write/read/unlink probe every disk_probe_interval_s
+    # then either rehabilitates it (disk_probe_successes consecutive
+    # passes) or quarantines it (disk_probe_failures consecutive fails).
+    # Quarantined dirs stop allocating, advertise zero capacity, and
+    # their committed blocks are evacuated by the master — at most
+    # disk_evac_batch block ids advertised per heartbeat so a disk-fault
+    # storm can't flood the replication queue.
+    disk_error_threshold: int = 3
+    disk_error_decay_s: float = 60.0
+    disk_probe_interval_s: float = 5.0
+    disk_probe_failures: int = 2
+    disk_probe_successes: int = 3
+    disk_evac_batch: int = 256
 
 
 @dataclass
@@ -189,6 +209,11 @@ class ClientConf:
     breaker_fail_threshold: int = 3
     breaker_open_ms: int = 5_000
     breaker_decay_ms: int = 30_000
+    # end-to-end read integrity: verify full-block reads against the
+    # commit-time crc carried by GET_BLOCK_INFO / READ_BLOCK EOF frames
+    # before returning bytes; mismatches count read.checksum_mismatch,
+    # report the corrupt replica, and fail over to the next replica
+    read_verify: bool = True
     # route stat/exists to the master's native fast port when advertised
     fast_meta: bool = True
 

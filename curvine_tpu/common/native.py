@@ -65,10 +65,18 @@ def available() -> bool:
 
 def crc32c(data, seed: int = 0) -> int:
     lib = _load()
-    if lib is not None:
-        buf = bytes(data) if not isinstance(data, bytes) else data
-        return lib.cv_crc32c(buf, len(buf), seed)
-    return _crc32c_py(data, seed)
+    if lib is None:
+        return _crc32c_py(data, seed)
+    if isinstance(data, bytes):
+        return lib.cv_crc32c(data, len(data), seed)
+    n = data.nbytes if isinstance(data, memoryview) else len(data)
+    try:
+        # zero-copy for writable buffers (read-path views into sinks):
+        # hashing at hardware speed is pointless behind a memcpy
+        buf = (ctypes.c_char * n).from_buffer(data)
+    except TypeError:
+        buf = bytes(data)
+    return lib.cv_crc32c(buf, n, seed)
 
 
 def xxh64(data, seed: int = 0) -> int:

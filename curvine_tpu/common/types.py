@@ -237,6 +237,9 @@ class StorageInfo(Wire):
     capacity: int = 0
     available: int = 0
     block_num: int = 0
+    # DiskHealth state the worker advertises per dir (healthy / suspect
+    # / quarantined); optional on the wire for rolling upgrades
+    health: str = "healthy"
 
 
 @dataclass

@@ -1,3 +1,5 @@
+from curvine_tpu.fault.disk import DiskFaultInjector, DiskFaultSpec
 from curvine_tpu.fault.runtime import FaultInjector, FaultSpec
 
-__all__ = ["FaultInjector", "FaultSpec"]
+__all__ = ["DiskFaultInjector", "DiskFaultSpec", "FaultInjector",
+           "FaultSpec"]

@@ -1,0 +1,142 @@
+"""Disk-level fault injection for the worker's storage plane.
+
+The RPC-plane FaultInjector (fault/runtime.py) exercises the process
+and network fault domains; this module exercises the MEDIA fault domain
+— the one that actually degrades first on real hosts with local NVMe.
+A DiskFaultInjector hangs off BlockStore.fault_hook (and the direct-IO
+engine's fault_hook) and perturbs file IO per tier directory:
+
+  eio_read    OSError(EIO) raised before a block read
+  eio_write   OSError(EIO) raised before a block write
+  enospc      OSError(ENOSPC) raised before a block write
+  bitflip     one bit flipped in the bytes a read returns (media rot /
+              controller bitrot as seen by the reader; the file on disk
+              is untouched, so the fault clears with the spec)
+  torn_write  a write is silently truncated (crash-consistency hole:
+              the caller believes the full buffer landed)
+
+Specs match on a per-directory glob against the block file path (or the
+bdev backing file path), mirroring FaultSpec's target-glob idiom, with
+the same probability / max_hits shaping. All methods are thread-safe:
+storage IO runs on event-loop threads, asyncio.to_thread workers, and
+the direct-IO engine's ring thread concurrently.
+"""
+
+from __future__ import annotations
+
+import errno
+import fnmatch
+import itertools
+import random
+import threading
+from dataclasses import dataclass, field
+
+READ_KINDS = ("eio_read", "bitflip")
+WRITE_KINDS = ("eio_write", "enospc", "torn_write")
+KINDS = READ_KINDS + WRITE_KINDS
+
+_ids = itertools.count(1)
+
+
+@dataclass
+class DiskFaultSpec:
+    kind: str                     # one of KINDS
+    path_glob: str = "*"          # fnmatch against the file path
+    probability: float = 1.0
+    max_hits: int = 0             # 0 = unlimited
+    seed: int = 0                 # bitflip/torn determinism
+    fault_id: int = field(default_factory=lambda: next(_ids))
+    hits: int = 0
+
+    def matches(self, path: str) -> bool:
+        if self.max_hits and self.hits >= self.max_hits:
+            return False
+        return fnmatch.fnmatch(path, self.path_glob)
+
+
+class DiskFaultInjector:
+    """Mutable set of DiskFaultSpecs consulted by the storage plane."""
+
+    def __init__(self, rng: random.Random | None = None):
+        self._specs: dict[int, DiskFaultSpec] = {}
+        self._lock = threading.Lock()
+        self._rng = rng or random.Random()
+
+    # ---- spec management (test/storm control plane) ----
+    def add(self, spec: DiskFaultSpec) -> int:
+        with self._lock:
+            self._specs[spec.fault_id] = spec
+        return spec.fault_id
+
+    def remove(self, fault_id: int) -> None:
+        with self._lock:
+            self._specs.pop(fault_id, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._specs.clear()
+
+    def specs(self) -> list[DiskFaultSpec]:
+        with self._lock:
+            return list(self._specs.values())
+
+    def _pick(self, path: str, kinds: tuple[str, ...]) -> DiskFaultSpec | None:
+        with self._lock:
+            for spec in self._specs.values():
+                if spec.kind in kinds and spec.matches(path) \
+                        and self._rng.random() < spec.probability:
+                    spec.hits += 1
+                    return spec
+        return None
+
+    # ---- hooks consulted by the storage plane ----
+    def check_read(self, path: str) -> None:
+        """Raise OSError(EIO) when an eio_read spec fires for `path`."""
+        spec = self._pick(path, ("eio_read",))
+        if spec is not None:
+            raise OSError(errno.EIO,
+                          f"injected EIO on read (fault {spec.fault_id})",
+                          path)
+
+    def check_write(self, path: str) -> None:
+        """Raise OSError(EIO/ENOSPC) when a write-error spec fires."""
+        spec = self._pick(path, ("eio_write", "enospc"))
+        if spec is not None:
+            code = errno.ENOSPC if spec.kind == "enospc" else errno.EIO
+            raise OSError(code,
+                          f"injected {errno.errorcode[code]} on write "
+                          f"(fault {spec.fault_id})", path)
+
+    def mutate_read(self, path: str, data) -> bool:
+        """Flip one bit of `data` (a writable buffer: bytearray or
+        memoryview) in place when a bitflip spec fires. Returns True
+        when a flip happened. Empty buffers are never mutated."""
+        if not len(data):
+            return False
+        spec = self._pick(path, ("bitflip",))
+        if spec is None:
+            return False
+        # deterministic per (seed, hit): storms replay identically
+        r = random.Random((spec.seed << 20) ^ spec.hits)
+        i = r.randrange(len(data))
+        data[i] ^= 1 << r.randrange(8)
+        return True
+
+    def torn_write_len(self, path: str, n: int) -> int:
+        """Length a write of `n` bytes should be truncated to when a
+        torn_write spec fires; `n` unchanged otherwise."""
+        if n <= 1:
+            return n
+        spec = self._pick(path, ("torn_write",))
+        if spec is None:
+            return n
+        r = random.Random((spec.seed << 20) ^ spec.hits)
+        return r.randrange(1, n)
+
+    def wants_read_data(self, path: str) -> bool:
+        """True when a bitflip spec could fire for `path` — read paths
+        that cannot expose bytes to the hook (kernel sendfile) fall back
+        to a buffered read so the fault can actually apply."""
+        with self._lock:
+            return any(s.kind == "bitflip" and s.matches(path)
+                       for s in self._specs.values())

@@ -43,12 +43,40 @@ class ReplicationManager:
         # per-block re-enqueue backoff (ms): doubles on each failed /
         # unplaceable dispatch, resets when the dispatch succeeds
         self._backoff_ms: dict[int, int] = {}
+        # disk-quarantine evacuation: block_id -> worker whose replica
+        # sits on a quarantined dir. That replica never counts toward
+        # the live-replica goal and is never a copy destination; once a
+        # full replica set exists ELSEWHERE the entry resolves by
+        # retiring the quarantined copy. Workers re-advertise their
+        # quarantined blocks every heartbeat, so this map survives a
+        # master restart without being persisted.
+        self._evac: dict[int, int] = {}
 
     def enqueue(self, block_ids: list[int]) -> None:
         for bid in block_ids:
             if bid not in self._inflight and bid not in self._queued:
                 self._queued.add(bid)
                 self.queue.put_nowait(bid)
+
+    def enqueue_evacuation(self, worker_id: int, block_ids: list[int]) -> None:
+        """Blocks whose replica on `worker_id` must be moved off in a
+        copy-first-delete-last handshake: quarantined-dir residents
+        (heartbeat-advertised) and scrub/read-detected corrupt replicas
+        both land here. The flagged replica stops counting toward the
+        live total (forcing re-replication) but stays on disk as a
+        last-resort source — pulls are end-to-end verified, so a bad
+        source fails the job instead of spreading — and is retired only
+        once the block is back at desired strength. Idempotent; senders
+        repeat the set until the move completes."""
+        fresh = []
+        for bid in block_ids:
+            if self._evac.get(bid) != worker_id:
+                self._evac[bid] = worker_id
+                fresh.append(bid)
+        if fresh:
+            log.info("evacuating %d flagged replicas off worker %d",
+                     len(fresh), worker_id)
+            self.enqueue(fresh)
 
     def on_worker_lost(self, worker: WorkerInfo, affected: list[int]) -> None:
         log.info("worker %d lost; %d blocks affected",
@@ -104,6 +132,10 @@ class ReplicationManager:
             if under:
                 log.info("scan: %d under-replicated blocks", len(under))
                 self.enqueue(under)
+            if self._evac:
+                # sweep unresolved evacuations: a dropped dispatch (lost
+                # race, restart) is retried at scan cadence
+                self.enqueue(list(self._evac))
             self._drain_scan()
 
     def _live_replicas(self, block_id: int) -> int:
@@ -176,7 +208,12 @@ class ReplicationManager:
         from curvine_tpu.common.types import WorkerState
         meta = self.fs.blocks.get(block_id)
         if meta is None or not meta.locs:
+            self._evac.pop(block_id, None)
             return True                  # deleted or no holders to copy
+        evac_wid = self._evac.get(block_id)
+        if evac_wid is not None and evac_wid not in meta.locs:
+            self._evac.pop(block_id, None)   # quarantined copy already gone
+            evac_wid = None
         # Only LIVE replicas count toward the goal, and only LIVE or
         # DECOMMISSIONING holders can SERVE a pull: a LOST worker's
         # address would make the destination burn its whole pull budget
@@ -184,9 +221,18 @@ class ReplicationManager:
         # worker may disappear mid-pull.
         serving = []
         live = 0
+        evac_src = None
         for wid in meta.locs:
             w = self.fs.workers.workers.get(wid)
             if w is None:
+                continue
+            if wid == evac_wid:
+                # a replica on a quarantined dir never counts toward the
+                # goal and serves a pull only as the copy of last resort
+                # (its media is suspect — that's why it's being moved)
+                if w.state in (WorkerState.LIVE,
+                               WorkerState.DECOMMISSIONING):
+                    evac_src = w
                 continue
             if w.state == WorkerState.LIVE:
                 live += 1
@@ -194,7 +240,11 @@ class ReplicationManager:
             elif w.state == WorkerState.DECOMMISSIONING:
                 serving.append(w)      # fallback source only
         if live >= self.fs.blocks.desired_of(block_id):
+            if evac_wid is not None:
+                self._retire_evacuated(block_id, evac_wid)
             return True
+        if evac_src is not None:
+            serving.append(evac_src)
         if not serving:
             # every holder is LOST/retired: nothing can serve the pull
             # right now — back off and retry (the holder may come back)
@@ -236,9 +286,22 @@ class ReplicationManager:
             self._inflight.discard(block_id)
         return True
 
+    def _retire_evacuated(self, block_id: int, worker_id: int) -> None:
+        """A full replica set now exists off the flagged copy: retire it
+        (location drop now, physical delete rides the worker's next
+        heartbeat) and close the evacuation entry."""
+        log.info("block %d evacuated off worker %d", block_id, worker_id)
+        self.fs.blocks.remove_replica(block_id, worker_id)
+        self.fs.pending_deletes.setdefault(worker_id, set()).add(block_id)
+        self._evac.pop(block_id, None)
+
     def on_result(self, block_id: int, worker_id: int, success: bool,
                   message: str) -> None:
         if not success:
             log.warning("replication of %d on worker %d failed: %s",
                         block_id, worker_id, message)
+            self.enqueue([block_id])
+        elif block_id in self._evac:
+            # the new copy landed: re-run the dispatch check, which
+            # retires the quarantined replica once the count holds
             self.enqueue([block_id])

@@ -137,6 +137,9 @@ class MasterServer:
         if self.shards is not None:
             self._register_shard_routes()
         self._worker_counters: dict[int, dict] = {}
+        # worker_id -> count of non-healthy tier dirs (from heartbeats);
+        # feeds the cluster-wide dirs.unhealthy gauge
+        self._dirs_unhealthy: dict[int, int] = {}
         self._bg: list[asyncio.Task] = []
         from curvine_tpu.common.executor import ScheduledExecutor
         self.executor = ScheduledExecutor("master")
@@ -937,6 +940,21 @@ class MasterServer:
     def _worker_heartbeat(self, q):
         cmds = self.fs.worker_heartbeat(q["info"])
         self.metrics.gauge("workers.live", len(self.fs.workers.live_workers()))
+        wid_hb = q["info"]["address"]["worker_id"]
+        evac = q.get("evac_blocks")
+        if evac:
+            # blocks stranded on this worker's quarantined dirs: copy
+            # them elsewhere, then retire the quarantined replica. The
+            # worker repeats the (bounded) set every beat until it
+            # drains, so nothing here needs to be persisted.
+            self.replication.enqueue_evacuation(
+                wid_hb, [int(b) for b in evac])
+        unhealthy = sum(1 for s in (q["info"].get("storages") or [])
+                        if s.get("health", "healthy") != "healthy")
+        if unhealthy or wid_hb in self._dirs_unhealthy:
+            self._dirs_unhealthy[wid_hb] = unhealthy
+            self.metrics.gauge("dirs.unhealthy",
+                               sum(self._dirs_unhealthy.values()))
         wm = q.get("metrics")
         if wm:
             # aggregate worker-plane byte counters (dashboard throughput);
@@ -976,14 +994,20 @@ class MasterServer:
             # report being silently dropped by the gated repair queue
             from curvine_tpu.common import errors as cerr
             raise cerr.NotLeader("repair reports go to the leader")
-        # the reporting worker DROPPED its corrupt replica: retire the
-        # stale location now so the periodic under-replication scan can
-        # re-detect the block even if this immediate dispatch fails
+        # a corrupt replica is FLAGGED, never summarily deleted: it
+        # stops counting toward the live replica total (forcing
+        # re-replication) but stays on disk as a verified last-resort
+        # source until the block is back at desired strength — only then
+        # does the replication manager retire the location and order the
+        # physical delete. Dropping it any earlier turns possible
+        # bit-rot into certain data loss if the remaining holder dies
+        # mid-heal (or the mismatch was a transient read fault).
         wid = q.get("worker_id")
-        for bid in q.get("block_ids", []):
-            if wid is not None:
-                self.fs.blocks.remove_replica(bid, wid)
-        self.replication.enqueue(q.get("block_ids", []))
+        bids = q.get("block_ids", [])
+        if wid is not None:
+            self.replication.enqueue_evacuation(wid, bids)
+        else:
+            self.replication.enqueue(bids)
         return {"success": True}
 
     def _replication_result(self, q):

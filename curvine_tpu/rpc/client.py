@@ -283,9 +283,13 @@ class Connection:
     async def call_readinto(self, code: int, sink: memoryview,
                             header: dict | None = None,
                             timeout: float | None = None,
-                            deadline: "Deadline | None" = None) -> int:
+                            deadline: "Deadline | None" = None,
+                            eof_header: dict | None = None) -> int:
         """Streaming read whose chunk payloads are scattered straight into
-        `sink`; returns bytes filled (the zero-copy remote-read path)."""
+        `sink`; returns bytes filled (the zero-copy remote-read path).
+        When `eof_header` is given, the EOF frame's header fields are
+        merged into it — the caller sees server-side trailers (e.g. the
+        block's commit-time checksum) without a second RPC."""
         req_id = next(_req_ids)
         q = self.register(req_id)
         state = _Sink(view=sink)
@@ -306,6 +310,8 @@ class Connection:
                     sink[state.filled:state.filled + n] = rep.data[:n]
                     state.filled += n
                 if rep.is_eof:
+                    if eof_header is not None and rep.header:
+                        eof_header.update(rep.header)
                     return state.filled
         finally:
             self.unregister(req_id)

@@ -34,6 +34,7 @@ import time
 from dataclasses import dataclass, field
 
 from curvine_tpu.common import errors as err
+from curvine_tpu.fault.disk import DiskFaultInjector, DiskFaultSpec
 from curvine_tpu.fault.runtime import FaultInjector, FaultSpec
 from curvine_tpu.rpc import RpcCode
 from curvine_tpu.testing.cluster import MiniCluster
@@ -89,6 +90,16 @@ class StormReport:
     trace_problems: list[str] = field(default_factory=list)
     trace_span_count: int = 0
     trace_error_spans: int = 0
+    # disk-fault storms (disk_faults=True): quarantined dirs must drain
+    # to zero resident blocks (evacuation through the replication
+    # manager) before the storm is over
+    evacuation_converged: bool = True
+    unevacuated: dict = field(default_factory=dict)
+    quarantined_dirs: int = 0
+    # reads that client-side verification caught and failed over (a
+    # nonzero count under bitflip faults proves detection fired; the
+    # integrity invariant proves none of them reached a reader)
+    checksum_mismatches: int = 0
     elapsed_s: float = 0.0
 
     @property
@@ -116,6 +127,9 @@ class StormReport:
                 f">= bound {self.degraded_read_bound_s:.2f}s")
         if self.trace_problems:
             problems.append(f"trace: {self.trace_problems}")
+        if not self.evacuation_converged:
+            problems.append(
+                f"quarantined dirs not evacuated: {self.unevacuated}")
         assert not problems, (
             f"storm seed={self.seed} invariants violated: "
             + "; ".join(problems) + f" (events={self.events})")
@@ -125,7 +139,8 @@ class ChaosStorm:
     """One seeded storm run. Construct, then ``await run()``."""
 
     EVENTS = ("kill_worker", "restart_worker", "restart_master",
-              "fault_delay", "fault_drop", "fault_error", "clear_faults")
+              "fault_delay", "fault_drop", "fault_error", "clear_faults",
+              "disk_bitflip", "disk_eio", "disk_enospc")
 
     def __init__(self, seed: int, workers: int = 3, replicas: int = 2,
                  duration_s: float = 2.5, event_interval_s: float = 0.25,
@@ -136,6 +151,7 @@ class ChaosStorm:
                  master_restarts: bool = True,
                  degraded_probe: bool = True,
                  trace_probe: bool = False,
+                 disk_faults: bool = False,
                  base_dir: str | None = None,
                  overall_timeout_s: float | None = None):
         self.seed = seed
@@ -153,6 +169,7 @@ class ChaosStorm:
         self.master_restarts = master_restarts
         self.degraded_probe = degraded_probe
         self.trace_probe = trace_probe
+        self.disk_faults = disk_faults
         self.base_dir = base_dir
         # self-watchdog: a wedged storm must FAIL with task stacks, not
         # hang the suite — any unbounded wait the chaos uncovers becomes
@@ -165,6 +182,16 @@ class ChaosStorm:
         self._alive: set[int] = set()         # indexes into mc.workers
         self._minj = FaultInjector()          # master-side faults
         self._winj: dict[int, FaultInjector] = {}
+        # per-worker disk (media) fault injectors — disk_faults=True only
+        self._dinj: dict[int, DiskFaultInjector] = {}
+        # disk faults strike ONE worker at a time: two simultaneously
+        # quarantined workers in a 3-node/2-replica cluster would leave
+        # evacuation with no legal placement, wedging the invariant on
+        # cluster shape instead of testing the heal path
+        self._disk_victim: int | None = None
+        # every workload client's counter dict, so post-quiesce sweeps
+        # can total read.checksum_mismatch across the whole storm
+        self._client_counters: list[dict] = []
 
     def _count(self, op: str, n: int = 1) -> None:
         self.report.ops[op] = self.report.ops.get(op, 0) + n
@@ -187,6 +214,17 @@ class ChaosStorm:
         if self.trace_probe:
             # sample EVERY trace so failover paths are fully recorded
             mc.conf.obs.trace_sample_rate = 1.0
+        if self.disk_faults:
+            # compressed disk-health clock: a few injected IO errors
+            # must walk a dir through SUSPECT → probe → QUARANTINED
+            # within the storm's couple of seconds, and the scrubber
+            # must cover the store fast enough to catch media faults
+            wc = mc.conf.worker
+            wc.disk_error_threshold = 2
+            wc.disk_error_decay_s = 30.0
+            wc.disk_probe_interval_s = 0.2
+            wc.disk_probe_failures = 2
+            wc.scrub_interval_s = 0.5
 
     def _tune_master(self, mc: MiniCluster) -> None:
         mc.master.replication.scan_interval_s = 0.3
@@ -200,12 +238,19 @@ class ChaosStorm:
         if inj is None:
             inj = self._winj[idx] = FaultInjector()
         inj.install(worker.rpc)
+        if self.disk_faults:
+            dinj = self._dinj.get(idx)
+            if dinj is None:
+                dinj = self._dinj[idx] = DiskFaultInjector(
+                    random.Random((self.seed << 4) ^ idx))
+            worker.install_disk_faults(dinj)
         self._alive.add(idx)
 
     # ---------------- workloads ----------------
 
     async def _writer(self, mc: MiniCluster, wid: int) -> None:
         c = mc.client()
+        self._client_counters.append(c.counters)
         k = 0
         while not self._stop:
             tag = f"w{wid}/f{k}"
@@ -227,6 +272,7 @@ class ChaosStorm:
 
     async def _reader(self, mc: MiniCluster, rid: int) -> None:
         c = mc.client()
+        self._client_counters.append(c.counters)
         rng = random.Random((self.seed << 8) ^ rid)
         while not self._stop:
             if not self.acked:
@@ -263,6 +309,9 @@ class ChaosStorm:
         }
         if not self.master_restarts:
             weights["restart_master"] = 0
+        if self.disk_faults:
+            weights.update({"disk_bitflip": 3, "disk_eio": 3,
+                            "disk_enospc": 2})
         names = list(weights)
         return self.rng.choices(names, [weights[n] for n in names])[0]
 
@@ -306,6 +355,9 @@ class ChaosStorm:
                 idx = rng.choice(sorted(self._alive))
                 self._alive.discard(idx)
                 self._winj.pop(idx, None)
+                self._dinj.pop(idx, None)
+                if self._disk_victim == idx:
+                    self._disk_victim = None
                 await mc.kill_worker(idx)
                 rec["worker"] = idx
         elif ev == "restart_worker":
@@ -341,10 +393,35 @@ class ChaosStorm:
                 self._winj[idx].add(spec)
                 rec["target"] = f"worker{idx}"
             rec["kind"] = kind
+        elif ev in ("disk_bitflip", "disk_eio", "disk_enospc"):
+            # media faults (fault/disk.py): injected under the worker's
+            # storage IO, NOT the RPC plane — exercising scrub detection,
+            # client end-to-end verification, and dir quarantine.
+            # torn_write stays out of storms: it corrupts data the
+            # client was acked for, which the integrity invariant
+            # rightly treats as a product bug.
+            kind = {"disk_bitflip": "bitflip",
+                    "disk_eio": rng.choice(["eio_read", "eio_write"]),
+                    "disk_enospc": "enospc"}[ev]
+            if self._disk_victim not in self._alive:
+                self._disk_victim = None
+            if self._disk_victim is None and self._alive:
+                self._disk_victim = rng.choice(sorted(self._alive))
+            idx = self._disk_victim
+            if idx is not None:
+                self._dinj[idx].add(DiskFaultSpec(
+                    kind=kind,
+                    probability=rng.choice([0.5, 1.0]),
+                    max_hits=rng.randint(3, 12),
+                    seed=rng.randint(0, 1 << 16)))
+                rec["target"] = f"worker{idx}"
+                rec["kind"] = kind
         elif ev == "clear_faults":
             self._minj.clear()
             for inj in self._winj.values():
                 inj.clear()
+            for dinj in self._dinj.values():
+                dinj.clear()
         self.report.events.append(rec)
 
     # ---------------- invariants ----------------
@@ -372,6 +449,34 @@ class ChaosStorm:
         self.report.replication_converged = False
         self.report.unconverged_blocks = under[:32]
 
+    async def _await_evacuation(self, mc: MiniCluster) -> None:
+        """Disk-fault invariant: every dir the storm drove into
+        QUARANTINED must converge to fully evacuated — zero committed
+        blocks resident — via heartbeat-advertised evac batches, master
+        re-replication, and the retire-then-delete handshake. Bounded by
+        the same budget as replication convergence."""
+        deadline = time.monotonic() + self.converge_timeout_s
+        remaining: dict[int, list[int]] = {}
+        while True:
+            remaining.clear()
+            quarantined = 0
+            for i in sorted(self._alive):
+                w = mc.workers[i]
+                if any(t.health.quarantined for t in w.store.tiers):
+                    quarantined += 1
+                stuck = w.store.quarantined_blocks(limit=9)
+                if stuck:
+                    remaining[i] = stuck
+            self.report.quarantined_dirs = max(
+                self.report.quarantined_dirs, quarantined)
+            if not remaining:
+                return
+            if time.monotonic() >= deadline:
+                self.report.evacuation_converged = False
+                self.report.unevacuated = dict(remaining)
+                return
+            await asyncio.sleep(0.2)
+
     async def _verify_integrity(self, mc: MiniCluster) -> None:
         c = mc.client()
         for path in sorted(self.acked):
@@ -393,6 +498,29 @@ class ChaosStorm:
                     f"{got[:12]} != acked {want[:12]}")
         self.report.acked_files = len(self.acked)
 
+    async def _probe_victim(self, mc: MiniCluster, c, path: str,
+                            timeout: float = 12.0) -> int | None:
+        """Pick a wedge victim for the failover probes: a LIVE holder of
+        the path's first block with at least one other LIVE holder left
+        to fail over to. Post-quiesce the master can still advertise a
+        stale location for a worker the storm killed (the LOST timeout
+        can outlast the convergence sweep), so wait for two live-worker
+        locations instead of trusting the raw loc list — wedging the
+        only real holder would fail the read on the probe's broken
+        premise, not on the deadline plane it means to measure."""
+        alive_ports = {mc.workers[i].rpc.port for i in self._alive}
+        t_end = time.monotonic() + timeout
+        while time.monotonic() < t_end:
+            fb = await c.meta.get_block_locations(path)
+            locs = fb.block_locs[0].locs if fb.block_locs else []
+            live = [loc for loc in locs if loc.rpc_port in alive_ports]
+            if len(live) >= 2:
+                return next((i for i in self._alive
+                             if mc.workers[i].rpc.port == live[0].rpc_port),
+                            None)
+            await asyncio.sleep(0.25)
+        return None
+
     async def _probe_degraded_read(self, mc: MiniCluster) -> None:
         """With one replica's worker wedged by a drop fault, a deadline-
         budgeted read must finish via failover within budget + slack —
@@ -402,12 +530,7 @@ class ChaosStorm:
             return
         path = sorted(self.acked)[0]
         c = mc.client()                   # fresh client: cold breakers
-        fb = await c.meta.get_block_locations(path)
-        if not fb.block_locs or len(fb.block_locs[0].locs) < 2:
-            return
-        first = fb.block_locs[0].locs[0]
-        victim = next((i for i in self._alive
-                       if mc.workers[i].rpc.port == first.rpc_port), None)
+        victim = await self._probe_victim(mc, c, path)
         if victim is None:
             return
         inj = self._winj[victim]
@@ -444,12 +567,7 @@ class ChaosStorm:
             return
         path = sorted(self.acked)[0]
         c = mc.client()                   # fresh client: cold breakers
-        fb = await c.meta.get_block_locations(path)
-        if not fb.block_locs or len(fb.block_locs[0].locs) < 2:
-            return
-        first = fb.block_locs[0].locs[0]
-        victim = next((i for i in self._alive
-                       if mc.workers[i].rpc.port == first.rpc_port), None)
+        victim = await self._probe_victim(mc, c, path)
         if victim is None:
             return
         inj = self._winj[victim]
@@ -519,6 +637,8 @@ class ChaosStorm:
         self._minj.clear()
         for inj in self._winj.values():
             inj.clear()
+        for dinj in self._dinj.values():
+            dinj.clear()
         while len(self._alive) < self.n_workers:
             w = await mc.add_worker()
             self._install_worker(len(mc.workers) - 1, w)
@@ -532,7 +652,12 @@ class ChaosStorm:
         del workers[:]
         await mc.await_workers(self.n_workers, timeout=15.0)
         await self._await_convergence(mc)
+        if self.disk_faults:
+            await self._await_evacuation(mc)
         await self._verify_integrity(mc)
+        self.report.checksum_mismatches = sum(
+            c.get("read.checksum_mismatch", 0)
+            for c in self._client_counters)
         if self.degraded_probe:
             await self._probe_degraded_read(mc)
         if self.trace_probe:

@@ -20,7 +20,7 @@ a{color:#58a6ff}</style></head><body>
 <h1>curvine-tpu</h1>
 <div id=info>loading…</div>
 <h2>workers</h2><table id=workers><tr><th>id</th><th>addr</th><th>state</th>
-<th>capacity</th><th>available</th><th>ici</th></tr></table>
+<th>capacity</th><th>available</th><th>dirs</th><th>ici</th></tr></table>
 <h2>mounts</h2><table id=mounts><tr><th>cv</th><th>ufs</th><th>mode</th></tr>
 </table>
 <p><a href=/metrics>/metrics</a> · <a href=/api/info>/api/info</a> ·
@@ -38,6 +38,9 @@ fetch('/api/info').then(r=>r.json()).then(d=>{
    `<td>${w.state===0?'LIVE':'LOST'}</td>`+
    `<td>${gb(w.storages.reduce((a,s)=>a+s.capacity,0))}</td>`+
    `<td>${gb(w.storages.reduce((a,s)=>a+s.available,0))}</td>`+
+   `<td>${w.storages.every(s=>(s.health||'healthy')==='healthy')?'ok':
+     w.storages.filter(s=>(s.health||'healthy')!=='healthy')
+      .map(s=>s.dir_id+'!'+s.health).join(' ')}</td>`+
    `<td>${JSON.stringify(w.ici_coords)}</td>`;}});
 fetch('/api/mounts').then(r=>r.json()).then(ms=>{
  const t=document.getElementById('mounts');

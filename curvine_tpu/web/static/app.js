@@ -131,11 +131,16 @@ async function workers() {
     const tiers = w.storages.map(s => {
       const used = s.capacity - s.available;
       const p = s.capacity ? used / s.capacity : 0;
+      const health = s.health || "healthy";
       return `<div style="display:flex;gap:8px;align-items:center;margin:2px 0">
         <span style="width:38px">${TIERS[s.storage_type] ?? s.storage_type}</span>
         <div class="meter ${p > 0.92 ? "crit" : p > 0.8 ? "warn" : ""}" style="flex:1">
           <div style="width:${(p * 100).toFixed(1)}%"></div></div>
         <span style="width:150px;text-align:right">${gib(used)} / ${gib(s.capacity)}</span>
+        ${health !== "healthy"
+          ? `<span class="status ${health === "quarantined" ? "lost" : "warn"}">
+               <span class="dot"></span>${esc(health)}</span>`
+          : ""}
       </div>`;
     }).join("");
     return `<tr>

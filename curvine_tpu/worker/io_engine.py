@@ -357,6 +357,10 @@ class DirectIOEngine:
         # Histogram mutation is dict arithmetic under the GIL — safe
         # enough from the engine threads for metrics purposes.
         self.metrics = None
+        # optional DiskFaultInjector (fault/disk.py, set by WorkerServer
+        # alongside BlockStore.fault_hook): submissions consult it so
+        # injected per-dir EIO reaches direct-IO readers too
+        self.fault_hook = None
         self._fds: dict[str, tuple[int, bool]] = {}   # path -> (fd, direct)
         self._fd_lock = threading.Lock()
         self._closed = False
@@ -442,6 +446,16 @@ class DirectIOEngine:
             f: Future = Future()
             f.set_exception(EngineShutdown("engine is shut down"))
             return f
+        hook = self.fault_hook
+        if hook is not None:
+            try:
+                hook.check_read(path)
+            except OSError as e:
+                with self.stats_lock:
+                    self.counters["errors"] += 1
+                f = Future()
+                f.set_exception(e)
+                return f
         fd, direct = self._get_fd(path)
         addr = ctypes.addressof(ctypes.c_char.from_buffer(buf.mm))
         req = _Request(fd, offset, length, addr, buffered=not direct)

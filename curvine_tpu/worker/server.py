@@ -9,9 +9,11 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import re
 import time
 import zlib
 
+from curvine_tpu.common import checksum
 from curvine_tpu.common import errors as err
 from curvine_tpu.common.conf import ClusterConf
 from curvine_tpu.common.metrics import MetricsRegistry
@@ -45,9 +47,39 @@ def _open_block_writer(info):
     return open(info.path, "wb")
 
 
-def _write_block_bytes(info, data: bytes) -> None:
+def _read_back(info, length: int) -> bytes:
+    """Re-read a just-written block file (cross-algo checksum check on
+    the replication pull path — rare: only when the source committed
+    with an algo this worker doesn't stream)."""
+    with open(info.path, "rb") as f:
+        if getattr(info, "is_extent", False):
+            f.seek(info.offset)
+        return f.read(length)
+
+
+def _write_block_bytes(info, data: bytes, hook=None) -> None:
+    if hook is not None:
+        hook.check_write(info.path)
+        data = data[:hook.torn_write_len(info.path, len(data))]
     with _open_block_writer(info) as f:
         f.write(data)
+
+
+_HEALTH_LEVEL = {"healthy": 0, "suspect": 1, "quarantined": 2}
+
+
+def _metric_key(dir_id: str) -> str:
+    """dir ids carry ':' and '/' — flatten to a metric-safe suffix."""
+    return re.sub(r"[^0-9A-Za-z_.]+", "_", dir_id).strip("_")
+
+
+def _integrity_header(info) -> dict:
+    """Commit-time checksum riding every READ_BLOCK EOF frame (pure
+    metadata — no extra IO): clients verify full-block reads against it
+    end to end, catching media rot the wire checksums can't see."""
+    if info.crc32c is None:
+        return {}
+    return {"block_crc32": info.crc32c, "block_crc_algo": info.crc_algo}
 
 
 def _write_file_bytes(path: str, data: bytes) -> None:
@@ -91,6 +123,13 @@ class WorkerServer:
                     self.conf.client.rpc_timeout_ms / 1000.0)
         self.store = BlockStore(tiers, wc.eviction_high_water,
                                 wc.eviction_low_water)
+        # per-dir DiskHealth thresholds from conf (the state machine
+        # itself lives on each TierDir — worker/storage.py)
+        for tier in self.store.tiers:
+            tier.health.error_threshold = max(1, wc.disk_error_threshold)
+            tier.health.decay_s = wc.disk_error_decay_s
+            tier.health.probe_failures = max(1, wc.disk_probe_failures)
+            tier.health.probe_successes = max(1, wc.disk_probe_successes)
         self.metrics = MetricsRegistry("worker")
         # observability plane: server spans per dispatch + per-code
         # rpc.<name> histograms; the io engine reports submit→complete
@@ -157,7 +196,10 @@ class WorkerServer:
                                       wc.block_report_interval_ms / 1000,
                                       initial_delay_s=1.0)
         self.executor.submit_periodic("eviction", self._evict_once, 1.0)
-        self.executor.submit_periodic("scrub", self._scrub_once, 60.0)
+        self.executor.submit_periodic("scrub", self._scrub_once,
+                                      max(0.1, wc.scrub_interval_s))
+        self.executor.submit_periodic("disk-probe", self._disk_probe_once,
+                                      max(0.05, wc.disk_probe_interval_s))
         # host tiers to promote between, OR an HBM tier-0 to auto-pin
         # into — either gives the promote cycle work to do
         if wc.promote_interval_ms > 0 and (len(self.store.tiers) > 1
@@ -259,11 +301,22 @@ class WorkerServer:
         if self.hbm is not None:
             from curvine_tpu.tpu.hbm import export_metrics
             export_metrics(self.hbm, self.metrics)
-        payload = pack({"info": self._info().to_wire(),
-                        "metrics": {
+        body = {"info": self._info().to_wire(),
+                "metrics": {
             "bytes.read": self.metrics.counters.get("bytes.read", 0),
             "bytes.written": self.metrics.counters.get("bytes.written", 0),
-        }})
+        }}
+        # quarantined dirs: advertise (a bounded batch of) their resident
+        # committed blocks so the master drives evacuation through the
+        # replication manager — re-sent every beat until evacuated, so a
+        # master restart mid-storm loses nothing; the cap keeps a fault
+        # storm from flooding the replication queue
+        evac = self.store.quarantined_blocks(
+            limit=self.conf.worker.disk_evac_batch)
+        if evac:
+            body["evac_blocks"] = evac
+            body["worker_id"] = self.worker_id
+        payload = pack(body)
         deletes: set[int] = set()
         report_now = False
 
@@ -419,10 +472,40 @@ class WorkerServer:
                     os.preadv(fd, [memoryview(buf)], info.offset)
                 finally:
                     os.close(fd)
-                self.hbm.put(block_id, buf)
+                if info.crc32c is not None \
+                        and checksum.supported(info.crc_algo):
+                    # verify the media copy BEFORE promotion — a bad
+                    # replica must never become the hottest copy
+                    if checksum.crc_update(info.crc_algo,
+                                           buf.data) != info.crc32c:
+                        raise err.AbnormalData(
+                            f"block {block_id} failed promotion verify")
+                arr = self.hbm.put(block_id, buf)
+                try:
+                    from curvine_tpu.tpu import pallas_ops
+                    if (pallas_ops.block_checksum(arr)
+                            != pallas_ops.block_checksum_host(buf)):
+                        raise err.AbnormalData(
+                            f"block {block_id} device copy diverges")
+                except ImportError:
+                    pass
                 return info.len
 
-            n = await asyncio.to_thread(work)
+            try:
+                n = await asyncio.to_thread(work)
+            except err.AbnormalData:
+                # on-disk copy (or the device transfer) is bad: drop the
+                # pin, count it, and hand the replica to the heal path
+                self.hbm.drop(block_id)
+                self.metrics.inc("blocks.corrupt")
+                try:
+                    await self._leader_call(
+                        RpcCode.REPORT_UNDER_REPLICATED_BLOCKS,
+                        pack({"block_ids": [block_id],
+                              "worker_id": self.worker_id}))
+                except Exception as e:  # noqa: BLE001 — scrub retries
+                    log.warning("promotion corrupt report failed: %s", e)
+                return 0
         finally:
             self.store.unpin_read(block_id)
         if not self.store.contains(block_id):
@@ -433,14 +516,70 @@ class WorkerServer:
         return n
 
     async def _scrub_once(self) -> None:
-        """Checksum scrub; corrupt blocks get dropped and the master is
-        told so re-replication can heal them."""
-        corrupt = await asyncio.to_thread(self.store.scrub)
+        """Checksum scrub; corrupt blocks are reported to the master —
+        WITH our worker id, so it can retire the location and order the
+        physical delete once a clean replica exists. The block stays on
+        disk until then: the worker never unilaterally destroys what
+        might be the last copy."""
+        corrupt = await asyncio.to_thread(self.store.scrub,
+                                          self.conf.worker.scrub_batch)
+        stats = self.store.scrub_last
+        if stats.get("verified"):
+            self.metrics.inc("blocks.scrub_verified", stats["verified"])
+        if stats.get("truncated"):
+            self.metrics.inc("blocks.corrupt_truncated", stats["truncated"])
+        if stats.get("io_error"):
+            self.metrics.inc("scrub.io_errors", stats["io_error"])
+        self._export_dir_health()
         if corrupt:
             self.metrics.inc("blocks.corrupt", len(corrupt))
-            mc = await self._master_conn()
-            await mc.call(RpcCode.REPORT_UNDER_REPLICATED_BLOCKS,
-                          data=pack({"block_ids": corrupt}))
+            if self.hbm is not None:
+                for bid in corrupt:
+                    self.hbm.drop(bid)     # never serve a corrupt pin
+            try:
+                await self._leader_call(
+                    RpcCode.REPORT_UNDER_REPLICATED_BLOCKS,
+                    pack({"block_ids": corrupt,
+                          "worker_id": self.worker_id}))
+            except Exception as e:  # noqa: BLE001 — next scrub retries
+                log.warning("corrupt-block report failed: %s", e)
+
+    # ---------------- disk health plane ----------------
+
+    def install_disk_faults(self, injector) -> None:
+        """Attach a fault/disk.DiskFaultInjector to every storage IO
+        path (block store + direct-IO engine). Test/storm control plane."""
+        self.store.fault_hook = injector
+        if self.io_engine is not None:
+            self.io_engine.fault_hook = injector
+
+    def _export_dir_health(self) -> None:
+        """Per-dir health level and scrub staleness gauges (level 0 =
+        healthy, 1 = suspect, 2 = quarantined)."""
+        ages = self.store.scrub_ages()
+        for t in self.store.tiers:
+            key = _metric_key(t.dir_id)
+            self.metrics.gauge(f"dir.health.{key}",
+                               _HEALTH_LEVEL.get(t.health.state, 0))
+            self.metrics.gauge(f"dir.scrub_age_s.{key}",
+                               round(ages.get(t.dir_id, 0.0), 3))
+
+    async def _disk_probe_once(self) -> None:
+        """Background write/read/unlink probe of SUSPECT dirs:
+        consecutive failures quarantine the dir (allocation stops, the
+        master evacuates), consecutive successes rehabilitate it."""
+        for tier in self.store.tiers:
+            if not tier.health.suspect:
+                continue
+            ok = await asyncio.to_thread(self.store.probe_dir, tier)
+            state = tier.health.probe_result(ok)
+            if state == tier.health.QUARANTINED:
+                log.error("dir %s QUARANTINED after failed probes; "
+                          "blocks will be evacuated", tier.dir_id)
+                self.metrics.inc("disk.quarantined")
+            elif state == tier.health.HEALTHY:
+                log.info("dir %s rehabilitated by probes", tier.dir_id)
+        self._export_dir_health()
 
     # ---------------- handlers ----------------
 
@@ -481,10 +620,33 @@ class WorkerServer:
         wspan = self.tracer.span("write_block_stream", parent=msg.trace,
                                  attrs={"block_id": block_id})
         info = self.store.create_temp(block_id, hint, q.get("len_hint", 0))
+        hook = self.store.fault_hook
+        if hook is not None:
+            try:
+                hook.check_write(info.path)
+            except OSError:
+                self.store.note_io_error(info.tier)
+                self.store.delete(block_id)
+                wspan.finish()
+                raise
         inline_io = (info.tier.storage_type <= StorageType.MEM
                      and not info.is_extent)
-        f = _open_block_writer(info) if inline_io else \
-            await asyncio.to_thread(_open_block_writer, info)
+        try:
+            f = _open_block_writer(info) if inline_io else \
+                await asyncio.to_thread(_open_block_writer, info)
+        except OSError as e:
+            # allocation-time media failure (mkdir/open of the temp
+            # file) — must count against dir health like a mid-stream
+            # write error, or a disk that dies at open never quarantines
+            self.store.note_io_error(info.tier)
+            self.store.delete(block_id)
+            wspan.error(e).finish()
+            raise
+        # commit-checksum algo is the CLIENT's choice (it streams the
+        # same hash for wire verification) — carried in the open header
+        algo = q.get("algo", "crc32")
+        if not checksum.supported(algo):
+            algo = "crc32"
         state = {"crc": 0, "total": 0}
         max_len = info.alloc_len if info.is_extent else None
         # hash+write: on multi-core hosts each chunk is copied out of the
@@ -496,9 +658,18 @@ class WorkerServer:
         offload = (os.cpu_count() or 1) > 1
         tail: dict = {"t": None}
 
-        def _hash_write(data) -> None:
-            state["crc"] = zlib.crc32(data, state["crc"])
+        def _file_write(data) -> None:
+            # fault hook: per-chunk EIO/ENOSPC, and torn writes (the crc
+            # covers what the CLIENT sent — a silently truncated write is
+            # exactly what verify_detail later flags as "truncated")
+            if hook is not None:
+                hook.check_write(info.path)
+                data = data[:hook.torn_write_len(info.path, len(data))]
             f.write(data)
+
+        def _hash_write(data) -> None:
+            state["crc"] = checksum.crc_update(algo, data, state["crc"])
+            _file_write(data)
 
         async def _chained(prev, data: bytes) -> None:
             if prev is not None:
@@ -522,8 +693,9 @@ class WorkerServer:
                     elif inline_io:
                         _hash_write(view)
                     else:
-                        state["crc"] = zlib.crc32(view, state["crc"])
-                        await asyncio.to_thread(f.write, bytes(view))
+                        state["crc"] = checksum.crc_update(
+                            algo, view, state["crc"])
+                        await asyncio.to_thread(_file_write, bytes(view))
                 if not is_eof:
                     return
                 if tail["t"] is not None:
@@ -531,13 +703,15 @@ class WorkerServer:
                 conn.close_stream(msg.req_id)
                 f.close()
                 want = header.get("crc32")
-                if want is not None and want != state["crc"]:
+                if want is not None \
+                        and header.get("algo", algo) == algo \
+                        and want != state["crc"]:
                     raise err.AbnormalData(
                         f"block {block_id} crc mismatch: "
                         f"{state['crc']:#x} != {want:#x}")
                 await asyncio.to_thread(
                     self.store.commit, block_id, state["total"],
-                    checksum=state["crc"], checksum_algo="crc32")
+                    checksum=state["crc"], checksum_algo=algo)
                 self.metrics.inc("bytes.written", state["total"])
                 wspan.set_attr("bytes", state["total"])
                 wspan.finish()
@@ -546,6 +720,10 @@ class WorkerServer:
                     "crc32": state["crc"], "worker_id": self.worker_id},
                     flags=Flags.RESPONSE | Flags.EOF))
             except Exception as e:  # noqa: BLE001 — surface to the client
+                if isinstance(e, OSError):
+                    # real (or injected) media write failure: feed the
+                    # dir health machinery
+                    self.store.note_io_error(info.tier)
                 wspan.error(e).finish()
                 conn.close_stream(msg.req_id)
                 try:
@@ -610,6 +788,14 @@ class WorkerServer:
             end = info.len if length < 0 else min(info.len, offset + length)
             inline_io = info.tier.storage_type <= StorageType.MEM
             want_crc = bool(q.get("verify", False))
+            hook = self.store.fault_hook
+            # a bit-flip fault needs the bytes in userspace to mutate —
+            # the kernel-sendfile path can't expose them, so fall through
+            # to the copying path while such a spec is armed
+            force_copy = hook is not None \
+                and hook.wants_read_data(info.path)
+            if hook is not None:
+                hook.check_read(info.path)
 
             base = info.offset              # bdev extents start mid-file
             engine = info.tier.io_engine
@@ -634,12 +820,15 @@ class WorkerServer:
                     if got <= 0:
                         break
                     view = view[:got]
+                    if force_copy:
+                        hook.mutate_read(info.path, view)
                     if want_crc:
                         crc = zlib.crc32(view, crc)
                     pos += got
                     await conn.send(response_for(
                         msg, data=view, flags=Flags.RESPONSE | Flags.CHUNK))
                 header = {"len": pos - offset, "direct_io": True}
+                header.update(_integrity_header(info))
                 if want_crc:
                     header["crc32"] = crc
                 await conn.send(response_for(
@@ -647,10 +836,11 @@ class WorkerServer:
                 self.metrics.inc("bytes.read", pos - offset)
                 self.metrics.inc("bytes.read.direct", pos - offset)
                 return None
-            if not want_crc:
+            if not want_crc and not force_copy:
                 # zero-copy: chunk payloads leave via kernel sendfile, data
                 # never enters userspace (TCP checksums the wire; at-rest
-                # integrity is the scrubber's job)
+                # integrity is the scrubber's job, end-to-end integrity
+                # the client's — the commit-time crc rides the EOF frame)
                 f = open(info.path, "rb")
                 try:
                     pos = offset
@@ -664,8 +854,10 @@ class WorkerServer:
                         if sent <= 0:
                             break
                         pos += sent
+                    header = {"len": pos - offset}
+                    header.update(_integrity_header(info))
                     await conn.send(response_for(
-                        msg, header={"len": pos - offset},
+                        msg, header=header,
                         flags=Flags.RESPONSE | Flags.EOF))
                     self.metrics.inc("bytes.read", pos - offset)
                 finally:
@@ -694,17 +886,27 @@ class WorkerServer:
                     if got <= 0:
                         break
                     view = view[:got]
+                    if force_copy:
+                        hook.mutate_read(info.path, view)
                     crc = zlib.crc32(view, crc)
                     pos += got
                     await conn.send(response_for(
                         msg, data=view, flags=Flags.RESPONSE | Flags.CHUNK))
+                header = {"crc32": crc, "len": pos - offset}
+                header.update(_integrity_header(info))
                 await conn.send(response_for(
-                    msg, header={"crc32": crc, "len": pos - offset},
+                    msg, header=header,
                     flags=Flags.RESPONSE | Flags.EOF))
                 self.metrics.inc("bytes.read", pos - offset)
             finally:
                 os.close(fd)
             return None
+        except OSError:
+            # media refused the read (real or injected): count it
+            # against the dir health and surface the error to the
+            # client, which fails over to another replica
+            self.store.note_io_error(info.tier)
+            raise
         finally:
             self.store.unpin_read(q["block_id"])
 
@@ -726,7 +928,9 @@ class WorkerServer:
                                         b["block_id"], len(data))
                 results.append({"block_id": b["block_id"], "len": len(data),
                                 "worker_id": self.worker_id})
-            except Exception:
+            except Exception as e:
+                if isinstance(e, OSError):
+                    self.store.note_io_error(info.tier)
                 self.store.delete(b["block_id"])
                 raise
         self.metrics.inc("bytes.written",
@@ -761,6 +965,11 @@ class WorkerServer:
             # extent grants expire: the client must re-probe before the
             # tier's quarantine can return the freed extent to reuse
             rep["lease_ms"] = lease_ms
+        if info.crc32c is not None:
+            # commit-time checksum: short-circuit readers verify the
+            # mmap/pread bytes against it without a worker round-trip
+            rep["crc32"] = info.crc32c
+            rep["crc_algo"] = info.crc_algo
         return rep
 
     async def _sc_read_report(self, msg: Message, conn: ServerConn):
@@ -788,7 +997,12 @@ class WorkerServer:
                 info = self.store.create_temp(block_id,
                                               size_hint=q.get("block_len", 0))
                 total = 0
+                crc = 0
+                crc_algo = checksum.preferred_algo()
+                src_crc = None
+                src_algo = None
                 cap = info.alloc_len if info.is_extent else None
+                hook = self.store.fault_hook
                 f = await asyncio.to_thread(_open_block_writer, info)
                 try:
                     # the master's pull budget rides the submit header:
@@ -805,10 +1019,31 @@ class WorkerServer:
                                 raise err.CapacityExceeded(
                                     f"replica {block_id} exceeds its "
                                     f"{cap}B extent")
+                            crc = checksum.crc_update(crc_algo, m.data, crc)
+                            if hook is not None:
+                                hook.check_write(info.path)
                             await asyncio.to_thread(f.write, m.data)
+                        if m.is_eof:
+                            h = m.header or {}
+                            src_crc = h.get("block_crc32")
+                            src_algo = h.get("block_crc_algo")
                 finally:
                     await asyncio.to_thread(f.close)
-                self.store.commit(block_id, total)
+                if src_crc is not None:
+                    got = crc if src_algo == crc_algo else (
+                        checksum.crc_update(src_algo,
+                                            _read_back(info, total))
+                        if checksum.supported(src_algo) else None)
+                    if got is not None and got != src_crc:
+                        # the SOURCE replica (or the wire) is bad —
+                        # healing must never multiply corruption; fail
+                        # the job so the master retries another holder
+                        raise err.AbnormalData(
+                            f"replica pull of {block_id} checksum "
+                            f"mismatch (got {got:#010x} want "
+                            f"{src_crc:#010x})")
+                self.store.commit(block_id, total, checksum=crc,
+                                  checksum_algo=crc_algo)
                 # tell master about the new replica via commit on next report;
                 # also push an immediate incremental report
                 await self._leader_call(RpcCode.WORKER_BLOCK_REPORT, pack({
@@ -818,6 +1053,11 @@ class WorkerServer:
                     "incremental": True}))
         except Exception as e:  # noqa: BLE001
             ok, message = False, str(e)
+            if isinstance(e, OSError) and "info" in locals():
+                # local media failure while landing the pull (open or
+                # write) — connection errors ride CurvineError types, so
+                # an OSError here is this disk's fault, not the source's
+                self.store.note_io_error(info.tier)
             self.store.delete(block_id)
         try:
             await self._leader_call(

@@ -37,6 +37,7 @@ class BlockInfo:
     offset: int = 0
     alloc_len: int = 0
     heat: int = 0                 # reads since the last promotion scan
+    verified_at: float = 0.0      # last successful scrub pass (0 = never)
 
     @property
     def is_extent(self) -> bool:
@@ -48,6 +49,87 @@ class BlockInfo:
             return self.tier.path
         suffix = ".tmp" if self.state == BlockState.TEMP else ".blk"
         return self.tier.block_path(self.block_id, suffix)
+
+
+class DiskHealth:
+    """Per-tier-directory health state machine (GFS/HDFS volume-failure
+    discipline): decaying IO-error counts drive HEALTHY → SUSPECT; a
+    background write/read/unlink probe (WorkerServer duty) either
+    rehabilitates a SUSPECT dir or condemns it to QUARANTINED.
+    Quarantined dirs advertise zero available capacity, are excluded
+    from allocation / demotion / promotion, and the master evacuates
+    their committed blocks. Quarantine is sticky for the process
+    lifetime — a dir that failed its probes is not trusted again until
+    an operator restarts the worker."""
+
+    HEALTHY = "healthy"
+    SUSPECT = "suspect"
+    QUARANTINED = "quarantined"
+
+    def __init__(self, error_threshold: int = 3, decay_s: float = 60.0,
+                 probe_failures: int = 2, probe_successes: int = 3):
+        self.state = self.HEALTHY
+        self.error_threshold = max(1, error_threshold)
+        self.decay_s = decay_s
+        self.probe_failures = max(1, probe_failures)
+        self.probe_successes = max(1, probe_successes)
+        self.quarantined_at = 0.0
+        self.errors_total = 0
+        self._errors: list[float] = []    # recent error timestamps
+        self._probe_fail = 0
+        self._probe_ok = 0
+        self._lock = threading.Lock()
+
+    @property
+    def healthy(self) -> bool:
+        return self.state == self.HEALTHY
+
+    @property
+    def suspect(self) -> bool:
+        return self.state == self.SUSPECT
+
+    @property
+    def quarantined(self) -> bool:
+        return self.state == self.QUARANTINED
+
+    def note_error(self, now: float | None = None) -> bool:
+        """Record one IO error; True on the HEALTHY → SUSPECT edge."""
+        now = time.time() if now is None else now
+        with self._lock:
+            self.errors_total += 1
+            if self.state == self.QUARANTINED:
+                return False
+            cut = now - self.decay_s
+            self._errors = [t for t in self._errors if t >= cut]
+            self._errors.append(now)
+            if self.state == self.HEALTHY \
+                    and len(self._errors) >= self.error_threshold:
+                self.state = self.SUSPECT
+                self._probe_fail = self._probe_ok = 0
+                return True
+        return False
+
+    def probe_result(self, ok: bool, now: float | None = None) -> str:
+        """Fold one background-probe outcome in; returns the resulting
+        state. Only SUSPECT dirs are probed — consecutive failures
+        condemn, consecutive successes rehabilitate."""
+        now = time.time() if now is None else now
+        with self._lock:
+            if self.state != self.SUSPECT:
+                return self.state
+            if ok:
+                self._probe_ok += 1
+                self._probe_fail = 0
+                if self._probe_ok >= self.probe_successes:
+                    self.state = self.HEALTHY
+                    self._errors.clear()
+            else:
+                self._probe_fail += 1
+                self._probe_ok = 0
+                if self._probe_fail >= self.probe_failures:
+                    self.state = self.QUARANTINED
+                    self.quarantined_at = now
+            return self.state
 
 
 class TierDir:
@@ -64,6 +146,7 @@ class TierDir:
         self.capacity = capacity
         self.used = 0
         self.dir_id = dir_id or f"{storage_type.name.lower()}:{root}"
+        self.health = DiskHealth()
         os.makedirs(root, exist_ok=True)
 
     def block_path(self, block_id: int, suffix: str = ".blk") -> str:
@@ -72,13 +155,22 @@ class TierDir:
         return os.path.join(sub, f"{block_id}{suffix}")
 
     @property
+    def probe_path(self) -> str:
+        return os.path.join(self.root, ".cv_probe")
+
+    @property
     def available(self) -> int:
+        # a quarantined dir has no allocatable space: placement, spill
+        # and promotion all key off this, and the heartbeat advertises
+        # it so the master stops counting the capacity
+        if self.health.quarantined:
+            return 0
         return max(0, self.capacity - self.used)
 
     def info(self, block_num: int = 0) -> StorageInfo:
         return StorageInfo(storage_type=self.storage_type, dir_id=self.dir_id,
                            capacity=self.capacity, available=self.available,
-                           block_num=block_num)
+                           block_num=block_num, health=self.health.state)
 
 
 class BdevTier(TierDir):
@@ -122,6 +214,7 @@ class BdevTier(TierDir):
         self.capacity = capacity
         self.used = 0
         self.dir_id = dir_id or f"bdev:{path}"
+        self.health = DiskHealth()
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         if not os.path.exists(path):
             with open(path, "wb") as f:
@@ -141,10 +234,18 @@ class BdevTier(TierDir):
         raise err.Unsupported("bdev tier has no per-block files")
 
     @property
+    def probe_path(self) -> str:
+        # media-health probe rides a sidecar next to the backing file
+        # (the backing file itself is the allocator's, extent-for-extent)
+        return self.path + ".probe"
+
+    @property
     def available(self) -> int:
         # pure read (heartbeat storages() reads it without the store
         # lock); BlockStore._reclaim_locked harvests expired quarantine
         # before every allocation/eviction decision
+        if self.health.quarantined:
+            return 0
         return max(0, self.capacity - self.used - self._quarantined)
 
     @property
@@ -332,6 +433,13 @@ class BlockStore:
         self.blocks: dict[int, BlockInfo] = {}
         self.high_water = high_water
         self.low_water = low_water
+        self.started_at = time.time()
+        # disk-level fault injection (fault/disk.DiskFaultInjector);
+        # None in production — storms and tests install one
+        self.fault_hook = None
+        # last scrub cycle's outcome counts (metrics exporter reads it)
+        self.scrub_last = {"verified": 0, "mismatch": 0, "truncated": 0,
+                           "io_error": 0}
         self._lock = threading.Lock()
         # block ids mid-tier-move (copy runs lock-free; see _move_block)
         self._moving: set[int] = set()
@@ -381,11 +489,15 @@ class BlockStore:
     # ---------- lifecycle ----------
     def pick_tier(self, hint: StorageType | None, size_hint: int) -> TierDir:
         # Preferred tier first, then any tier fastest-first with room.
+        # Quarantined dirs never allocate — their blocks are being
+        # evacuated, writing new data there would feed the failure.
         self._reclaim_locked()
-        ordered = self.tiers
+        ordered = [t for t in self.tiers if not t.health.quarantined]
+        if not ordered:
+            raise err.CapacityExceeded("all tier dirs quarantined")
         if hint is not None:
-            ordered = ([t for t in self.tiers if t.storage_type == hint]
-                       + [t for t in self.tiers if t.storage_type != hint])
+            ordered = ([t for t in ordered if t.storage_type == hint]
+                       + [t for t in ordered if t.storage_type != hint])
         for tier in ordered:
             if tier.available >= size_hint:
                 return tier
@@ -488,43 +600,117 @@ class BlockStore:
 
     def verify(self, block_id: int) -> bool:
         """Re-checksum a committed block against its commit-time value."""
+        ok, _reason = self.verify_detail(block_id)
+        return ok
+
+    def verify_detail(self, block_id: int) -> tuple[bool, str]:
+        """Re-checksum a committed block; (ok, reason) where reason is
+        "ok", "mismatch" (bit-rot: the full length read back but hashed
+        wrong) or "truncated" (a torn write / shrunk file: fewer bytes
+        than committed) — operators triage the two very differently.
+        OSError from the media (including injected faults) propagates to
+        the caller, which feeds the dir health machinery."""
         import zlib
         from curvine_tpu.common import native
         info = self.get(block_id, touch=False)
         if info.state != BlockState.COMMITTED or info.crc32c is None:
-            return True
-        if info.crc_algo == "crc32":
-            with open(info.path, "rb") as f:
-                f.seek(info.offset)
-                crc = 0
-                left = info.len
-                while left > 0:
-                    chunk = f.read(min(1 << 20, left))
-                    if not chunk:
-                        break
-                    crc = zlib.crc32(chunk, crc)
-                    left -= len(chunk)
-            return crc == info.crc32c
-        return native.checksum_file(info.path, info.offset,
-                                    info.len or 0) == info.crc32c
+            return True, "ok"
+        hook = self.fault_hook
+        if hook is not None:
+            hook.check_read(info.path)
+        # file-layout blocks can cheaply pre-detect truncation; extent
+        # blocks live inside the shared backing file, so the read loop's
+        # short-read check is the only signal there
+        if not info.is_extent:
+            try:
+                size = os.path.getsize(info.path)
+            except FileNotFoundError:
+                return False, "truncated"
+            if size < info.len:
+                return False, "truncated"
+        use_native = info.crc_algo != "crc32" \
+            and (hook is None or not hook.wants_read_data(info.path))
+        if use_native:
+            got = native.checksum_file(info.path, info.offset, info.len or 0)
+            return got == info.crc32c, \
+                ("ok" if got == info.crc32c else "mismatch")
+        # chunked python read: streaming crc (zlib for crc32, the native
+        # helper's incremental crc32c otherwise) with the fault hook
+        # applied per chunk so injected bit-flips are observable
+        crc = 0
+        left = info.len
+        with open(info.path, "rb") as f:
+            f.seek(info.offset)
+            while left > 0:
+                chunk = f.read(min(1 << 20, left))
+                if not chunk:
+                    return False, "truncated"
+                if hook is not None and hook.wants_read_data(info.path):
+                    buf = bytearray(chunk)
+                    hook.mutate_read(info.path, buf)
+                    chunk = bytes(buf)
+                crc = (zlib.crc32(chunk, crc)
+                       if info.crc_algo == "crc32"
+                       else native.crc32c(chunk, crc))
+                left -= len(chunk)
+        return crc == info.crc32c, \
+            ("ok" if crc == info.crc32c else "mismatch")
 
     def scrub(self, limit: int = 16) -> list[int]:
         """Verify up to `limit` least-recently-verified blocks; corrupt
-        blocks are dropped (the master re-replicates them). Parity: the
-        reference's abnormal-data detection on the worker data path."""
+        blocks are REPORTED but kept — only the master may order the
+        delete, and only once another live replica exists. Deleting
+        locally would destroy the last copy when the mismatch is a
+        transient read fault (or every other holder is down); a kept
+        corrupt replica is harmless because readers verify and refuse
+        it. Parity: the reference's abnormal-data detection on the
+        worker data path. `scrub_last` holds the last cycle's verified /
+        mismatch / truncated / io_error counts for the metrics
+        exporter."""
         with self._lock:
-            candidates = [b.block_id for b in self.blocks.values()
-                          if b.state == BlockState.COMMITTED
-                          and b.crc32c is not None][:limit]
+            candidates = [b.block_id for b in sorted(
+                (b for b in self.blocks.values()
+                 if b.state == BlockState.COMMITTED
+                 and b.crc32c is not None),
+                key=lambda b: b.verified_at)[:limit]]
+        stats = {"verified": 0, "mismatch": 0, "truncated": 0,
+                 "io_error": 0}
         corrupt = []
         for bid in candidates:
             try:
-                if not self.verify(bid):
-                    log.error("block %d failed checksum scrub; dropping", bid)
-                    self.delete(bid)
-                    corrupt.append(bid)
+                ok, reason = self.verify_detail(bid)
             except err.CurvineError:
                 continue
+            except OSError as e:
+                # the media refused the read: not evidence of bit-rot —
+                # keep the block, count the error against the dir health
+                stats["io_error"] += 1
+                with self._lock:
+                    b = self.blocks.get(bid)
+                    tier = b.tier if b is not None else None
+                if tier is not None:
+                    tier.health.note_error()
+                log.warning("scrub read of block %d failed: %s", bid, e)
+                continue
+            if ok:
+                stats["verified"] += 1
+                with self._lock:
+                    b = self.blocks.get(bid)
+                    if b is not None:
+                        b.verified_at = time.time()
+                continue
+            log.error("block %d failed checksum scrub (%s); reporting "
+                      "to master (kept until a clean replica exists)",
+                      bid, reason)
+            stats[reason] += 1
+            # stamp it checked so the rotation moves on — re-reporting
+            # is bounded to once per full scrub sweep
+            with self._lock:
+                b = self.blocks.get(bid)
+                if b is not None:
+                    b.verified_at = time.time()
+            corrupt.append(bid)
+        self.scrub_last = stats
         return corrupt
 
     def get(self, block_id: int, touch: bool = True) -> BlockInfo:
@@ -622,6 +808,12 @@ class BlockStore:
             os.unlink(info.path)
         except FileNotFoundError:
             pass
+        except OSError as e:
+            # a dying disk may refuse even the unlink: drop the index
+            # entry anyway (GET_BLOCK_INFO must stop serving the block)
+            # and let the health machinery see the error
+            log.warning("unlink of %s failed: %s", info.path, e)
+            info.tier.health.note_error()
         if info.tier.io_engine is not None:
             # drop the engine's cached fd: a recreated block at this
             # path must never be served from the unlinked file
@@ -824,10 +1016,11 @@ class BlockStore:
         return plan, target_free, freed
 
     def _slower_tier_for(self, tier: TierDir, size: int) -> TierDir | None:
-        """Next tier strictly slower than `tier` with room for `size`."""
+        """Next tier strictly slower than `tier` with room for `size`.
+        Quarantined dirs are never demotion targets."""
         for t in self.tiers:
             if int(t.storage_type) > int(tier.storage_type) \
-                    and t.available >= size:
+                    and not t.health.quarantined and t.available >= size:
                 return t
         return None
 
@@ -967,7 +1160,12 @@ class BlockStore:
         reference README's transparent hot-data promotion headline (its
         code ships write-time tiering only — this EXCEEDS parity)."""
         with self._lock:
-            fastest = self.tiers[0]
+            # promotion targets the fastest HEALTHY-enough tier: pinning
+            # hot data onto a quarantined dir would race its evacuation
+            fastest = next((t for t in self.tiers
+                            if not t.health.quarantined), None)
+            if fastest is None:
+                return []
             hot = [(b.block_id, b.len) for b in sorted(
                 (b for b in self.blocks.values()
                  if b.state == BlockState.COMMITTED and b.tier is not fastest
@@ -1001,6 +1199,76 @@ class BlockStore:
             log.info("promoted %d hot blocks to %s", len(promoted),
                      self.tiers[0].dir_id)
         return promoted
+
+    # ---------- disk health ----------
+    def probe_dir(self, tier: TierDir) -> bool:
+        """One write/read/unlink media probe against `tier`. Consults
+        the fault hook so injected dir faults fail the probe exactly
+        like real media would. Blocking — run via asyncio.to_thread.
+        Returns True when the round-trip came back intact."""
+        path = tier.probe_path
+        payload = os.urandom(4096)
+        hook = self.fault_hook
+        try:
+            if hook is not None:
+                hook.check_write(path)
+            with open(path, "wb") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            if hook is not None:
+                hook.check_read(path)
+            with open(path, "rb") as f:
+                back = f.read()
+            if hook is not None and len(back):
+                buf = bytearray(back)
+                hook.mutate_read(path, buf)
+                back = bytes(buf)
+            os.unlink(path)
+            return back == payload
+        except OSError:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return False
+
+    def note_io_error(self, tier: TierDir) -> bool:
+        """Feed one media IO error into `tier`'s health; True on the
+        HEALTHY → SUSPECT edge (the caller schedules probing)."""
+        moved = tier.health.note_error()
+        if moved:
+            log.warning("dir %s marked SUSPECT after repeated IO errors",
+                        tier.dir_id)
+        return moved
+
+    def quarantined_blocks(self, limit: int = 0) -> list[int]:
+        """Committed blocks residing on quarantined dirs — the worker
+        advertises (a bounded slice of) these every heartbeat so the
+        master can drive evacuation; sorted for deterministic batching."""
+        with self._lock:
+            out = sorted(b.block_id for b in self.blocks.values()
+                         if b.state == BlockState.COMMITTED
+                         and b.tier.health.quarantined)
+        return out[:limit] if limit else out
+
+    def scrub_ages(self) -> dict[str, float]:
+        """dir_id → seconds since the oldest committed block on that dir
+        was last scrub-verified (i.e. the staleness of the dir's full
+        scrub sweep). Dirs with nothing to scrub report 0."""
+        now = time.time()
+        with self._lock:
+            oldest: dict[str, float] = {}
+            for b in self.blocks.values():
+                if b.state != BlockState.COMMITTED or b.crc32c is None:
+                    continue
+                t = b.verified_at or self.started_at
+                d = b.tier.dir_id
+                if d not in oldest or t < oldest[d]:
+                    oldest[d] = t
+        return {t.dir_id: max(0.0, now - oldest[t.dir_id])
+                if t.dir_id in oldest else 0.0
+                for t in self.tiers}
 
     # ---------- reporting ----------
     def storages(self) -> list[StorageInfo]:

@@ -10,6 +10,7 @@
 #   BENCH_TRACE=0 skips the tracing-overhead gate.
 #   BENCH_META=0 skips the metadata write-plane gate.
 #   BENCH_RPC=0 skips the RPC transport gate.
+#   BENCH_VERIFY=0 skips the read-verification overhead gate.
 # Exit: 0 = at/above the regression gates, 1 = regression, 2 = harness error.
 
 set -u
@@ -244,6 +245,46 @@ print(f"perf_smoke: trace_overhead_pct={pct} ceiling={ceiling} "
 if pct > ceiling:
     print(f"perf_smoke: FAIL — tracing overhead {pct}% > {ceiling}% "
           "at 1% sampling (hot-path instrumentation too heavy)",
+          file=sys.stderr)
+    sys.exit(1)
+print("perf_smoke: PASS")
+EOF
+    rc=$?
+    [ $rc -ne 0 ] && exit $rc
+fi
+
+if [ "${BENCH_VERIFY:-1}" = "0" ]; then
+    echo "perf_smoke: read-verification gate skipped (BENCH_VERIFY=0)"
+else
+    # read-verification gate: whole-file reads with client checksum
+    # verification ON (the default) must stay within
+    # read_verify_overhead_pct_max of OFF — integrity must not tax the
+    # read path (hardware crc32c keeps it cheap; see common/checksum.py)
+    VERIFY_OUT=$(JAX_PLATFORMS=cpu timeout 150 python - <<'EOF'
+import asyncio, json, os, sys
+sys.path.insert(0, os.getcwd())
+from bench import _read_verify_overhead_bench
+print(json.dumps(asyncio.run(_read_verify_overhead_bench())))
+EOF
+)
+    rc=$?
+    if [ $rc -ne 0 ] || [ -z "$VERIFY_OUT" ]; then
+        echo "perf_smoke: read-verification microbench failed (rc=$rc)" >&2
+        exit 2
+    fi
+    echo "$VERIFY_OUT"
+    python - "$FLOOR_FILE" <<'EOF' "$VERIFY_OUT"
+import json, sys
+floor_file, result = sys.argv[1], json.loads(sys.argv[2])
+ceiling = json.load(open(floor_file))["read_verify_overhead_pct_max"]
+pct = result.get("read_verify_overhead_pct", 100.0)
+print(f"perf_smoke: read_verify_overhead_pct={pct} ceiling={ceiling} "
+      f"algo={result.get('verify_algo')} "
+      f"(qps off={result.get('verify_read_qps_off')} "
+      f"on={result.get('verify_read_qps_on')})")
+if pct > ceiling:
+    print(f"perf_smoke: FAIL — read verification costs {pct}% > "
+          f"{ceiling}% (always-on integrity must not tax the read path)",
           file=sys.stderr)
     sys.exit(1)
 print("perf_smoke: PASS")

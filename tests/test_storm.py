@@ -44,6 +44,27 @@ async def test_storm_deterministic_seed(seed, tmp_path):
     assert report.events, "no chaos events fired"
 
 
+DISK_STORM_SEEDS = [4, 9]
+
+
+@pytest.mark.parametrize("seed", DISK_STORM_SEEDS)
+async def test_storm_disk_faults_deterministic(seed, tmp_path):
+    """Disk-fault storms (docs/resilience.md): seeded media faults
+    (bit-flips, EIO, ENOSPC) drive tier dirs toward quarantine while
+    readers and writers hammer the cluster. Post-quiesce invariants: no
+    reader ever observed corrupt bytes, and every quarantined dir
+    converged to fully evacuated."""
+    storm = ChaosStorm(seed, workers=3, replicas=2, duration_s=2.0,
+                       event_interval_s=0.2, writer_tasks=2,
+                       reader_tasks=2, file_size=64 * 1024,
+                       disk_faults=True, base_dir=str(tmp_path))
+    report = await storm.run()
+    report.assert_invariants()
+    assert report.acked_files > 0
+    assert any(e["event"].startswith("disk_") for e in report.events), \
+        "no disk-fault events fired"
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("seed", [11, 23, 42])
 async def test_storm_long_randomized(seed, tmp_path):

@@ -305,6 +305,71 @@ async def _read_verify_overhead_bench(block_kb: int = 1024,
     return out
 
 
+async def _qos_overhead_bench(file_kb: int = 4096, read_kb: int = 64,
+                              ops: int = 600, rounds: int = 3) -> dict:
+    """Admission-overhead gate: hot-path read QPS with the QoS admission
+    plane ON (default conf: enabled, unlimited buckets, a tenant id on
+    every request) must stay within qos_overhead_pct_max of admission
+    OFF. Remote (RPC) preads so every op crosses the admitted dispatch
+    path — the un-throttled admit is a handful of float compares and a
+    dict lookup, and this gate keeps it that way. One cluster, the
+    controllers' `enabled` flag toggled between rounds, best-of-each
+    side compared (same noise filter as _trace_overhead_bench).
+    Returns {qos_read_qps_off, qos_read_qps_on, qos_overhead_pct}."""
+    import shutil
+    import tempfile
+    from curvine_tpu.common.qos import tenant_scope
+    from curvine_tpu.testing.cluster import MiniCluster
+
+    base = tempfile.mkdtemp(prefix="curvine-qosov-")
+    mc = MiniCluster(workers=1, base_dir=base)
+    mc.conf.client.short_circuit = False
+    out: dict = {}
+    try:
+        await mc.start()
+        c = mc.client()
+        path = "/qosov/hot.bin"
+        size = file_kb * 1024
+        await c.write_all(path, os.urandom(size))
+        n = read_kb * 1024
+        ctrls = [mc.master.qos] + [w.qos for w in mc.workers]
+
+        def set_enabled(v: bool) -> None:
+            for q in ctrls:
+                q.enabled = v
+
+        async def qps() -> float:
+            r = await c.open(path)
+            try:
+                for i in range(8):                # warm connections
+                    await r.pread((i * n) % (size - n), n)
+                t0 = time.perf_counter()
+                for i in range(ops):
+                    await r.pread((i * n) % (size - n), n)
+                return ops / (time.perf_counter() - t0)
+            finally:
+                await r.close()
+
+        best_off = best_on = 0.0
+        with tenant_scope("bench"):               # real tenant accounting
+            await qps()               # cold-start pass, never measured
+            for _ in range(rounds):
+                set_enabled(False)
+                best_off = max(best_off, await qps())
+                set_enabled(True)
+                best_on = max(best_on, await qps())
+        out["qos_read_qps_off"] = round(best_off, 1)
+        out["qos_read_qps_on"] = round(best_on, 1)
+        out["qos_overhead_pct"] = round(
+            max(0.0, (best_off - best_on) / best_off * 100), 2)
+    finally:
+        try:
+            await mc.stop()
+        finally:
+            shutil.rmtree(base, ignore_errors=True)
+    return out
+
+
 def _tmpfs_raw_gibs(base: str) -> float:
     """Raw sequential write rate to the cache tier's backing dir (the
     hardware ceiling for the write path on this host)."""
@@ -1187,27 +1252,44 @@ def main(argv: list[str] | None = None):
                          "device results)")
     args = ap.parse_args(argv)
     total_mb = int(os.environ.get("BENCH_TOTAL_MB", "256"))
-    if (os.environ.get("_CURVINE_BENCH_CHILD") != "1"
-            and not _device_backend_alive()):
-        reason = ("device backend unreachable (probe subprocess "
-                  "failed or timed out)")
-        if args.require_device or os.environ.get("BENCH_REQUIRE_DEVICE"):
-            print(f"bench: {reason}; --require-device set, refusing the "
-                  "CPU fallback", file=sys.stderr)
-            return 2
-        print("bench: device backend unreachable; re-running on CPU",
-              file=sys.stderr)
-        env = {k: v for k, v in os.environ.items()
-               if not k.startswith(("TPU_", "PJRT_", "AXON_", "PALLAS_AXON",
-                                    "LIBTPU", "MEGASCALE"))}
-        env["_CURVINE_BENCH_CHILD"] = "1"
-        # the artifact must carry WHY it is a CPU run (VERDICT Weak #1:
-        # CPU numbers masquerading as device results)
-        env["_CURVINE_BENCH_FALLBACK_REASON"] = reason
-        env["JAX_PLATFORMS"] = "cpu"
-        env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
-        import subprocess
-        return subprocess.call([sys.executable, __file__], env=env)
+    if os.environ.get("_CURVINE_BENCH_CHILD") != "1":
+        # bounded probe retry before the CPU fallback: remote-device
+        # tunnels routinely take one flaky handshake to come up, and a
+        # CPU artifact is a far worse outcome than a short wait. The
+        # attempt count is stamped into the artifact either way, so a
+        # fallback after N tries is distinguishable from a first-try one.
+        tries = 1 + max(0, int(os.environ.get("BENCH_DEVICE_RETRIES", "2")))
+        alive, attempt = False, 0
+        for attempt in range(1, tries + 1):
+            if _device_backend_alive():
+                alive = True
+                break
+            if attempt < tries:
+                wait = 5.0 * attempt
+                print(f"bench: device probe {attempt}/{tries} failed; "
+                      f"retrying in {wait:.0f}s", file=sys.stderr)
+                time.sleep(wait)
+        os.environ["_CURVINE_BENCH_PROBE_ATTEMPTS"] = str(attempt)
+        if not alive:
+            reason = (f"device backend unreachable after {attempt} probe "
+                      "attempts (probe subprocess failed or timed out)")
+            if args.require_device or os.environ.get("BENCH_REQUIRE_DEVICE"):
+                print(f"bench: {reason}; --require-device set, refusing "
+                      "the CPU fallback", file=sys.stderr)
+                return 2
+            print(f"bench: {reason}; re-running on CPU", file=sys.stderr)
+            env = {k: v for k, v in os.environ.items()
+                   if not k.startswith(("TPU_", "PJRT_", "AXON_",
+                                        "PALLAS_AXON", "LIBTPU",
+                                        "MEGASCALE"))}
+            env["_CURVINE_BENCH_CHILD"] = "1"
+            # the artifact must carry WHY it is a CPU run (VERDICT Weak
+            # #1: CPU numbers masquerading as device results)
+            env["_CURVINE_BENCH_FALLBACK_REASON"] = reason
+            env["JAX_PLATFORMS"] = "cpu"
+            env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+            import subprocess
+            return subprocess.call([sys.executable, __file__], env=env)
     # optional rpc.uvloop (CURVINE_RPC_UVLOOP=1): swap the policy before
     # the loop exists; the artifact's loop_impl records what actually ran
     from curvine_tpu.common.conf import ClusterConf
@@ -1295,6 +1377,9 @@ def main(argv: list[str] | None = None):
     reason = os.environ.get("_CURVINE_BENCH_FALLBACK_REASON")
     if reason:
         out["cpu_fallback_reason"] = reason
+    attempts = os.environ.get("_CURVINE_BENCH_PROBE_ATTEMPTS")
+    if attempts:
+        out["device_probe_attempts"] = int(attempts)
     print(json.dumps(out))
 
 

@@ -302,6 +302,27 @@ async def cmd_report(args):
                       f"{r.get('inodes', 0):>7}  {r.get('blocks', 0):>7}  "
                       f"{r.get('journal_seq', 0):>4}  "
                       f"{r.get('queue_depth', 0):>6}  {r.get('addr', '')}")
+        # tenants table (admission plane; absent on a pre-QoS master —
+        # degrade quietly like the shard table)
+        try:
+            qs = await c.meta.tenant_stats()
+        except err.CurvineError:
+            return
+        tenants = qs.get("tenants") or {}
+        if tenants:
+            print(f"Tenants: {len(tenants)}  "
+                  f"shed_level={qs.get('shed_level', 0)}")
+            print("  tenant            qps  quota  prio  inflight  "
+                  "admitted  throttled  shed")
+            for name in sorted(tenants):
+                t = tenants[name]
+                quota = t.get("quota_qps", 0)
+                print(f"  {name:<15} {t.get('qps', 0):>6.1f}  "
+                      f"{'inf' if not quota else f'{quota:.0f}':>5}  "
+                      f"{t.get('priority', 0):>4}  "
+                      f"{t.get('inflight', 0):>8}  "
+                      f"{t.get('admitted', 0):>8}  "
+                      f"{t.get('throttled', 0):>9}  {t.get('shed', 0):>4}")
     finally:
         await c.close()
 
@@ -580,8 +601,16 @@ async def cmd_gateway(args):
     from curvine_tpu.gateway.webhdfs import WebHdfsGateway
     conf = _conf(args)
     client = CurvineClient(conf)
+    # front-door admission: the gateway runs its own controller (HTTP-
+    # level quotas per access key) and the tenant id it derives rides
+    # every downstream RPC, so master/worker quotas see the same caller
+    from curvine_tpu.common.qos import AdmissionController
+    qos = AdmissionController.from_conf(conf.qos,
+                                        slow_op_ms=conf.obs.slow_op_ms)
     s3 = S3Gateway(client, port=args.s3_port, host="0.0.0.0",
-                   credentials=conf.gateway.s3_credentials())
+                   credentials=conf.gateway.s3_credentials(),
+                   qos=qos,
+                   gc_interval_s=conf.gateway.stale_gc_interval_s)
     hdfs = WebHdfsGateway(client, port=args.webhdfs_port, host="0.0.0.0")
     await s3.start()
     await hdfs.start()

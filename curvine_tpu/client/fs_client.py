@@ -297,6 +297,11 @@ class FsClient:
         rep = await self.call(RpcCode.SHARD_TABLE, {})
         return rep.get("shards", [])
 
+    async def tenant_stats(self) -> dict:
+        """The master's admission-control snapshot (common/qos.py):
+        shed level plus per-tenant qps/quota/inflight/throttled."""
+        return await self.call(RpcCode.TENANT_STATS, {})
+
     async def list_options(self, path: str, pattern: str | None = None,
                            dirs_only: bool = False, files_only: bool = False,
                            offset: int = 0, limit: int = 0

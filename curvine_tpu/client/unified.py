@@ -50,6 +50,13 @@ class CurvineClient:
         # the periodic metrics flush so /api/trace sees the client side
         self.tracer = Tracer.from_conf("client", self.conf.obs)
         self.meta.tracer = self.tracer
+        # native-client tenant identity (common/qos.py): the process-
+        # wide fallback covers the common single-tenant process; multi-
+        # tenant processes (the gateway, the tenant storm) use
+        # tenant_scope(), which always wins over this default
+        if cc.tenant:
+            from curvine_tpu.common.qos import set_process_tenant
+            set_process_tenant(cc.tenant)
         self._mount_cache: dict[str, object] = {}
         # client-side IO counters: short-circuit reads/writes bypass the
         # worker entirely, so their bytes are invisible to worker metrics

@@ -172,6 +172,11 @@ class ClientConf:
     # identity sent with every request (empty → the OS user / its group)
     user: str = ""
     groups: list[str] = field(default_factory=list)
+    # tenant id for admission control (common/qos.py): stamped into the
+    # RPC header beside deadline_ms/trace_ctx on every outbound request.
+    # Empty → "default". The S3 gateway derives it from the access key
+    # instead; this field is the explicit path for native clients.
+    tenant: str = ""
     block_size: int = 64 * MB
     replicas: int = 1
     write_chunk_size: int = 4 * MB
@@ -279,12 +284,57 @@ class RpcConf:
 
 
 @dataclass
+class QosConf:
+    """Multi-tenant admission control (common/qos.py): token-bucket
+    quotas, inflight caps, overload shedding. All rates default to 0 =
+    unlimited, so the admission plane is wired in everywhere but admits
+    everything until quotas are set — byte-compatible with a pre-QoS
+    cluster."""
+    enabled: bool = True
+    # process-wide request rate across all tenants (0 = unlimited)
+    global_qps: float = 0.0
+    global_burst: float = 0.0
+    # per-tenant defaults; burst 0 → one second's worth of tokens
+    tenant_default_qps: float = 0.0
+    tenant_default_burst: float = 0.0
+    # DAGOR-style priority: under overload, tenants with priority below
+    # the current shed level are rejected first (higher = keep longer)
+    tenant_default_priority: int = 5
+    # concurrent admitted requests per tenant (0 = unlimited)
+    tenant_inflight_cap: int = 0
+    # op-class sub-buckets as a fraction of the tenant rate: each class
+    # (meta/read/write) may use share × qps; the tenant bucket still
+    # caps the sum, so 1.0 shares mean "any mix up to the tenant rate"
+    meta_share: float = 1.0
+    read_share: float = 1.0
+    write_share: float = 1.0
+    # per-tenant overrides, "name:qps[:priority[:inflight_cap]]"
+    tenants: list[str] = field(default_factory=list)
+    # overload shedding: raise the shed level while the admitted-
+    # inflight depth exceeds the high-water mark or >= slow_frac of a
+    # window's completions ran slower than obs.slow_op_ms
+    shed_enabled: bool = True
+    shed_inflight_hi: int = 512
+    shed_slow_frac: float = 0.5
+    shed_adjust_interval_s: float = 0.25
+    shed_retry_after_ms: int = 250
+    # dead-on-arrival fast-fail: drop requests whose remaining deadline
+    # budget < doa_margin × the op class's EWMA service time
+    doa_enabled: bool = True
+    doa_margin: float = 1.0
+
+
+@dataclass
 class GatewayConf:
     # S3 gateway SigV4 verification: static credential pair. Empty access
     # key = anonymous mode (explicit opt-in for cluster-internal use);
     # set both to require signed requests (403 otherwise).
     s3_access_key: str = ""
     s3_secret_key: str = ""
+    # background sweep of abandoned multipart uploads (an idle gateway
+    # must still reclaim; the inline sweep only fires on initiates).
+    # 0 disables the background task.
+    stale_gc_interval_s: float = 3600.0
 
     def s3_credentials(self) -> dict | None:
         if self.s3_access_key:
@@ -302,6 +352,7 @@ class ClusterConf:
     gateway: GatewayConf = field(default_factory=GatewayConf)
     obs: ObsConf = field(default_factory=ObsConf)
     rpc: RpcConf = field(default_factory=RpcConf)
+    qos: QosConf = field(default_factory=QosConf)
     data_dir: str = "data"
 
     @staticmethod
@@ -362,7 +413,7 @@ def _coerce(cur, raw: str, annotation: str = ""):
 def _apply_env(conf: "ClusterConf", env: dict) -> None:
     sections = {"master": conf.master, "worker": conf.worker,
                 "client": conf.client, "fuse": conf.fuse,
-                "obs": conf.obs, "rpc": conf.rpc}
+                "obs": conf.obs, "rpc": conf.rpc, "qos": conf.qos}
     for key, raw in env.items():
         if not key.startswith("CURVINE_") or key == "CURVINE_CONF":
             continue

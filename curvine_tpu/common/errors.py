@@ -44,6 +44,10 @@ class ErrorCode(enum.IntEnum):
     # the fast plane is gated off (non-leader): EVERY request will miss,
     # so the caller should drop the address and rediscover the leader's
     FAST_GATED = 29
+    # admission control rejected the request before it queued (quota /
+    # inflight cap / overload shed — common/qos.py); carries a
+    # retry_after_ms hint the retry policy honors over its own backoff
+    THROTTLED = 30
 
     # Errors where the operation may succeed if retried (possibly against a
     # different master/worker).
@@ -57,6 +61,7 @@ _RETRYABLE = {
     ErrorCode.NOT_LEADER,
     ErrorCode.CONNECT,
     ErrorCode.IN_PROGRESS,
+    ErrorCode.THROTTLED,
 }
 
 
@@ -64,6 +69,10 @@ class CurvineError(Exception):
     """Base error carrying an ErrorCode across the RPC boundary."""
 
     code: ErrorCode = ErrorCode.UNDEFINED
+    # server-supplied backoff hint (ms), set on THROTTLED errors and
+    # carried across the wire in the error response header; the retry
+    # policy prefers it over its own exponential backoff
+    retry_after_ms: int | None = None
 
     def __init__(self, message: str = "", code: ErrorCode | None = None):
         super().__init__(message)
@@ -118,6 +127,22 @@ ConnectError = _make("ConnectError", ErrorCode.CONNECT)
 Uncompleted = _make("Uncompleted", ErrorCode.UNCOMPLETED)
 FastMiss = _make("FastMiss", ErrorCode.FAST_MISS)
 FastGated = _make("FastGated", ErrorCode.FAST_GATED)
+
+
+class Throttled(CurvineError):
+    """Admission control rejected the request *before* it queued.
+    Retryable; ``retry_after_ms`` tells the client when the quota
+    bucket will admit again (surfaced as HTTP 503 + Retry-After at the
+    S3 gateway)."""
+
+    code = ErrorCode.THROTTLED
+
+    def __init__(self, message: str = "",
+                 retry_after_ms: int | None = None,
+                 code: ErrorCode | None = None):
+        super().__init__(message, code=code)
+        if retry_after_ms is not None:
+            self.retry_after_ms = int(retry_after_ms)
 # Capacity shortfall that clears by itself (lease-encumbered bdev
 # extents / unexpired quarantine, e.g. the ~lease_s window right after a
 # worker restart when load_index grants synthetic leases): IN_PROGRESS
@@ -133,6 +158,7 @@ _CODE_TO_CLASS: dict[ErrorCode, type[CurvineError]] = {
         BlockNotFound, WorkerNotFound, NoAvailableWorker, CapacityExceeded,
         QuotaExceeded, NotLeader, RpcTimeout, Cancelled, Unsupported,
         AbnormalData, UfsError, MountNotFound, PermissionDenied, JobNotFound,
-        ConnectError, Uncompleted, FastMiss, FastGated, CapacityPending,
+        ConnectError, Uncompleted, FastMiss, FastGated, Throttled,
+        CapacityPending,
     ]
 }

@@ -16,9 +16,12 @@ anonymous mode for cluster-internal deployments.
 
 from __future__ import annotations
 
+import asyncio
 import hashlib
 import logging
+import math
 import re
+import time
 import urllib.parse
 import uuid
 import xml.sax.saxutils as sax
@@ -26,27 +29,48 @@ import xml.sax.saxutils as sax
 from aiohttp import web
 
 from curvine_tpu.common import errors as cerr
+from curvine_tpu.common.metrics import MetricsRegistry
+from curvine_tpu.common.qos import tenant_scope
 from curvine_tpu.gateway.sigv4 import SigV4Error, verify_sigv4
 
 log = logging.getLogger(__name__)
 
 _NS = 'xmlns="http://s3.amazonaws.com/doc/2006-03-01/"'
 
+# cheap access-key extraction for tenant identity: full SigV4/OSS
+# verification still happens in the auth middleware — admission only
+# needs WHO is asking, and must not burn HMAC cycles on a request that
+# is about to be shed (overload control 101)
+_CRED_RE = re.compile(r"Credential=([^/,\s]+)/")
+
 
 class S3Gateway:
     def __init__(self, client, port: int = 0, host: str = "127.0.0.1",
-                 credentials: dict[str, str] | None = None):
+                 credentials: dict[str, str] | None = None,
+                 qos=None, metrics=None,
+                 gc_interval_s: float = 3600.0):
         self.client = client
         self.host = host
         self.port = port
         self.credentials = credentials or None
-        middlewares = [self._auth_middleware] if self.credentials else []
+        # multi-tenant admission (common/qos.py AdmissionController):
+        # the QoS middleware runs FIRST — shed before auth crypto, shed
+        # before the handler — and installs regardless of auth mode
+        self.qos = qos
+        self.metrics = metrics or MetricsRegistry("gateway")
+        self.gc_interval_s = gc_interval_s
+        middlewares = []
+        if self.qos is not None:
+            middlewares.append(self._qos_middleware)
+        if self.credentials:
+            middlewares.append(self._auth_middleware)
         self.app = web.Application(client_max_size=1024 ** 3,
                                    middlewares=middlewares)
         self.app.router.add_route("GET", "/", self._list_buckets)
         self.app.router.add_route("*", "/{bucket}", self._bucket)
         self.app.router.add_route("*", "/{bucket}/{key:.*}", self._object)
         self._runner: web.AppRunner | None = None
+        self._gc_task: asyncio.Task | None = None
 
     async def start(self) -> None:
         self._runner = web.AppRunner(self.app, access_log=None)
@@ -55,11 +79,75 @@ class S3Gateway:
         await site.start()
         for s in self._runner.sites:
             self.port = s._server.sockets[0].getsockname()[1]
+        if self.gc_interval_s > 0:
+            # an idle gateway must still reclaim abandoned multipart
+            # uploads — the inline sweep only fires on initiate traffic
+            self._gc_task = asyncio.ensure_future(self._gc_loop())
         log.info("s3 gateway on %s:%d", self.host, self.port)
 
     async def stop(self) -> None:
+        if self._gc_task is not None:
+            self._gc_task.cancel()
+            try:
+                await self._gc_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._gc_task = None
         if self._runner:
             await self._runner.cleanup()
+
+    async def _gc_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.gc_interval_s)
+            try:
+                await self._gc_stale_uploads()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — GC must never kill serving
+                log.exception("s3 gateway stale-upload gc")
+
+    # ---------------- tenant admission ----------------
+
+    @staticmethod
+    def tenant_of(req: web.Request) -> str:
+        """Tenant id = the access key the request claims (SigV4
+        Credential scope or OSS header); forged claims fail auth right
+        after admission, so a throttled tenant cannot evade its quota
+        by lying — it can only get itself 403s instead of 503s."""
+        auth = req.headers.get("Authorization", "")
+        if auth.startswith("OSS "):
+            return auth[4:].partition(":")[0].strip() or "anonymous"
+        m = _CRED_RE.search(auth)
+        if m:
+            return m.group(1)
+        return "anonymous"
+
+    @web.middleware
+    async def _qos_middleware(self, req: web.Request, handler):
+        """Admission check before auth and before the handler: HTTP
+        503 + Retry-After with the S3 ``SlowDown`` code on rejection
+        (what AWS itself returns under prefix overload). The tenant
+        scope wraps the handler so downstream RPCs to master/worker
+        carry the tenant id on the header rail."""
+        tenant = self.tenant_of(req)
+        op_class = "read" if req.method in ("GET", "HEAD") else "write"
+        try:
+            token = self.qos.admit(tenant, op_class)
+        except cerr.Throttled as e:
+            retry_s = max(1, math.ceil((e.retry_after_ms or 1000) / 1000))
+            self.metrics.inc("gateway.throttled")
+            return self._error(
+                503, "SlowDown", req.rel_url.raw_path,
+                headers={"Retry-After": str(retry_s)})
+        except cerr.CurvineError as e:
+            # DOA and other admission failures: plain 503, retryable
+            return self._error(503, "SlowDown", str(e))
+        t0 = time.perf_counter()
+        try:
+            with tenant_scope(tenant):
+                return await handler(req)
+        finally:
+            self.qos.release(token, time.perf_counter() - t0)
 
     @web.middleware
     async def _auth_middleware(self, req: web.Request, handler):
@@ -371,22 +459,27 @@ class S3Gateway:
 
     async def _gc_stale_uploads(self, max_age_ms: int = 24 * 3600 * 1000):
         """Abandoned multipart scratch dirs (no complete/abort) age out —
-        real S3 needs lifecycle rules; the gateway sweeps lazily on each
-        initiate."""
+        real S3 needs lifecycle rules; the gateway sweeps on each
+        initiate AND from the background interval task (idle gateways
+        still reclaim)."""
         from curvine_tpu.common.types import now_ms
+        self.metrics.inc("gateway.stale_uploads_gc")
         try:
             cutoff = now_ms() - max_age_ms
             for st in await self.client.meta.list_status("/.s3mpu"):
                 if st.is_dir and st.mtime < cutoff:
                     try:
                         await self.client.meta.delete(st.path, recursive=True)
+                        self.metrics.inc("gateway.stale_uploads_reclaimed")
                     except cerr.CurvineError:
                         pass
         except cerr.CurvineError:
             pass
 
-    def _error(self, status: int, code: str, resource: str) -> web.Response:
+    def _error(self, status: int, code: str, resource: str,
+               headers: dict | None = None) -> web.Response:
         body = (f'<?xml version="1.0"?><Error><Code>{code}</Code>'
                 f"<Resource>{sax.escape(resource)}</Resource></Error>")
         return web.Response(status=status, text=body,
-                            content_type="application/xml")
+                            content_type="application/xml",
+                            headers=headers)

@@ -118,6 +118,13 @@ class MasterServer:
                                        metrics=self.metrics)
         self.rpc.obs = self.tracer
         self.rpc.metrics = self.metrics
+        # multi-tenant admission control (common/qos.py): checked in the
+        # conn loop before a request queues; unlimited by default
+        from curvine_tpu.common.qos import AdmissionController
+        self.qos = AdmissionController.from_conf(
+            self.conf.qos, slow_op_ms=self.conf.obs.slow_op_ms,
+            metrics=self.metrics)
+        self.rpc.qos = self.qos
         self.replication.tracer = self.tracer
         # pool for the GET_SPANS fan-out to workers (trace assembly)
         from curvine_tpu.rpc.client import ConnectionPool
@@ -352,6 +359,7 @@ class MasterServer:
         r(C.SHARD_TX_LIST, self._h(self._shard_tx_list))
         r(C.SHARD_STATS, self._h(self._shard_stats))
         r(C.SHARD_TABLE, self._h(self._shard_table))
+        r(C.TENANT_STATS, self._h(self._tenant_stats))
 
     def _register_shard_routes(self) -> None:
         """meta_shards>1: this endpoint is a thin router. Namespace
@@ -751,6 +759,9 @@ class MasterServer:
         if self.shards is None:
             return {"shards": []}
         return {"shards": await self.shards.poll_stats()}
+
+    def _tenant_stats(self, q):
+        return self.qos.snapshot()
 
     def _set_attr(self, q):
         opts = SetAttrOpts.from_wire(q.get("opts", {}))

@@ -23,6 +23,7 @@ from dataclasses import dataclass
 from typing import Any, AsyncIterator
 
 from curvine_tpu.common.errors import ConnectError, CurvineError, RpcTimeout
+from curvine_tpu.common.qos import TENANT_KEY, current_tenant
 from curvine_tpu.obs.trace import TRACE_KEY, current_ctx
 from curvine_tpu.rpc.deadline import DEADLINE_KEY, Deadline
 from curvine_tpu.rpc.frame import Flags, Message, pack, unpack
@@ -225,6 +226,12 @@ class Connection:
         ctx = current_ctx()
         if ctx is not None and TRACE_KEY not in msg.header:
             ctx.stamp(msg.header)
+        # tenant identity rides the same rail: the ambient tenant (set
+        # per-request by the gateway, per-process by native clients)
+        # lets the receiving server's admission control see the caller
+        tenant = current_tenant()
+        if tenant is not None and TENANT_KEY not in msg.header:
+            msg.header[TENANT_KEY] = tenant
         if self.fault_hook is not None:
             if not await self.fault_hook(self.addr, msg):
                 return
@@ -452,8 +459,16 @@ class RetryPolicy:
             except CurvineError as e:
                 if not e.retryable or attempt >= self.max_retries:
                     raise
-                delay = min(self.max_ms, self.base_ms * (2 ** attempt))
-                delay = delay * (0.5 + random.random() / 2) / 1000
+                hint = getattr(e, "retry_after_ms", None)
+                if hint is not None:
+                    # server-supplied backoff (THROTTLED): the server
+                    # knows when its bucket refills — honor the hint
+                    # instead of blind exponential backoff, jittered
+                    # UP so a retry never lands before capacity exists
+                    delay = float(hint) * (1.0 + random.random() / 4) / 1000
+                else:
+                    delay = min(self.max_ms, self.base_ms * (2 ** attempt))
+                    delay = delay * (0.5 + random.random() / 2) / 1000
                 if deadline is not None and \
                         delay >= deadline.remaining():
                     raise            # sleeping would outlive the budget

@@ -83,6 +83,11 @@ class RpcCode(enum.IntEnum):
     SHARD_STATS = 72
     SHARD_TABLE = 73
 
+    # multi-tenant admission plane (common/qos.py): per-tenant
+    # qps/throttled/inflight snapshot feeding /api/tenants, /metrics
+    # and the `cv report` tenants table
+    TENANT_STATS = 74
+
     # block interface (worker)
     WRITE_BLOCK = 80
     READ_BLOCK = 81

@@ -84,7 +84,13 @@ class Message:
         """Raise the carried remote error, if any."""
         if self.status != STATUS_OK:
             code = self.header.get("error_code", ErrorCode.UNDEFINED)
-            raise CurvineError.from_wire(code, self.header.get("error", ""))
+            e = CurvineError.from_wire(code, self.header.get("error", ""))
+            ra = self.header.get("retry_after_ms")
+            if ra is not None:
+                # server-supplied backoff hint (THROTTLED): the retry
+                # policy prefers it over its own exponential backoff
+                e.retry_after_ms = int(ra)
+            raise e
         return self
 
     def encode(self) -> list[bytes | memoryview]:
@@ -152,9 +158,12 @@ def error_for(req: Message, err: Exception) -> Message:
         code, msg = int(err.code), str(err)
     else:
         code, msg = int(ErrorCode.IO), f"{type(err).__name__}: {err}"
+    header = {"error_code": code, "error": msg}
+    ra = getattr(err, "retry_after_ms", None)
+    if ra is not None:
+        header["retry_after_ms"] = int(ra)
     return Message(code=req.code, req_id=req.req_id, status=STATUS_ERROR,
-                   flags=Flags.RESPONSE | Flags.EOF,
-                   header={"error_code": code, "error": msg})
+                   flags=Flags.RESPONSE | Flags.EOF, header=header)
 
 
 def pack(obj: Any) -> bytes:

@@ -26,7 +26,8 @@ import socket
 import time
 from typing import Awaitable, Callable
 
-from curvine_tpu.common.errors import CurvineError
+from curvine_tpu.common.errors import CurvineError, Throttled
+from curvine_tpu.common.qos import TENANT_KEY
 from curvine_tpu.rpc.frame import (
     FIXED_LEN, LEN_PREFIX, Flags, Message, error_for, response_for,
 )
@@ -160,6 +161,11 @@ class RpcServer:
         # optional MetricsRegistry: per-code dispatch latency histograms
         # (rpc.<code_name>), uniform across master and worker
         self.metrics = None
+        # optional AdmissionController (common/qos.py): tenant admission
+        # runs synchronously in the conn loop BEFORE the dispatch task
+        # is created — a throttled request never queues, never runs a
+        # handler, never touches a commit barrier (shed-before-queue)
+        self.qos = None
 
     def register(self, code: int, handler: Handler) -> None:
         self._handlers[int(code)] = handler
@@ -320,7 +326,23 @@ class RpcServer:
                                   "req_id=%d", self.name, req_id)
                     q.put_nowait(msg)
                     continue
-                t = asyncio.ensure_future(self._dispatch(msg, conn))
+                qtok = None
+                if self.qos is not None:
+                    # admission BEFORE the dispatch task exists: the
+                    # rejection reply leaves without the request ever
+                    # queueing behind admitted work (Tail-at-Scale /
+                    # DAGOR shed-at-the-door). Chunk frames above are
+                    # exempt — they belong to an already-admitted
+                    # upload stream.
+                    try:
+                        qtok = self.qos.admit_msg(code, header)
+                    except CurvineError as e:
+                        t = asyncio.ensure_future(
+                            self._send_error(conn, msg, e))
+                        pending.add(t)
+                        t.add_done_callback(pending.discard)
+                        continue
+                t = asyncio.ensure_future(self._dispatch(msg, conn, qtok))
                 pending.add(t)
                 t.add_done_callback(pending.discard)
         finally:
@@ -341,7 +363,15 @@ class RpcServer:
             except OSError:
                 pass
 
-    async def _dispatch(self, msg: Message, conn: ServerConn) -> None:
+    async def _send_error(self, conn: ServerConn, msg: Message,
+                          e: Exception) -> None:
+        try:
+            await conn.send(error_for(msg, e))
+        except Exception:  # noqa: BLE001 — conn died, nothing to do
+            pass
+
+    async def _dispatch(self, msg: Message, conn: ServerConn,
+                        qtok=None) -> None:
         handler = self._handlers.get(msg.code)
         name = _code_name(msg.code)
         token = None
@@ -355,6 +385,9 @@ class RpcServer:
         span = None
         if self.obs is not None:
             span = self.obs.span(name, parent=msg.trace)
+            tenant = msg.header.get(TENANT_KEY)
+            if tenant:
+                span.set_attr("tenant", tenant)
             span.__enter__()
         t0 = time.perf_counter()
         try:
@@ -393,6 +426,12 @@ class RpcServer:
         except Exception as e:  # noqa: BLE001 — all errors cross the wire
             if span is not None:
                 span.error(e)
+            if isinstance(e, Throttled) and self.qos is not None:
+                # the shed-before-queue contract says Throttled is only
+                # ever raised at admission, never from inside a handler
+                # after the request queued — count violations so the
+                # storm harness can assert the invariant held
+                self.qos.note_shed_after_queue()
             if not isinstance(e, CurvineError):
                 log.exception("%s handler error code=%s", self.name, msg.code)
             try:
@@ -402,9 +441,13 @@ class RpcServer:
         finally:
             if span is not None:
                 span.__exit__(None, None, None)
+            elapsed = time.perf_counter() - t0
+            if self.qos is not None:
+                # feeds the load monitor's service-time estimate (DOA
+                # drop) and decrements the tenant's inflight count
+                self.qos.release(qtok, elapsed)
             if self.metrics is not None:
-                self.metrics.observe(f"rpc.{name}",
-                                     time.perf_counter() - t0)
+                self.metrics.observe(f"rpc.{name}", elapsed)
             if token is not None:
                 self.watchdog.op_exit(token)
 

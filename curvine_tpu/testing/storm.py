@@ -722,3 +722,224 @@ async def run_storm(seed: int, **kw) -> StormReport:
     """One-call entry point: run a seeded storm and return its report
     (call ``report.assert_invariants()`` to gate on it)."""
     return await ChaosStorm(seed, **kw).run()
+
+
+# ---------------------------------------------------------------------------
+# Tenant storm: many well-behaved tenants + one abuser (docs/qos.md)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TenantStormReport:
+    """Outcome of a TenantStorm run. The headline invariant: admission
+    control keeps the abuser's overload from leaking into the victims'
+    tail — post-quiesce victim p99 within slack of the no-abuser
+    baseline, the abuser mostly THROTTLED, and nothing shed after it
+    was queued."""
+    seed: int
+    tenants: int = 0
+    baseline_p99_ms: float = 0.0
+    abuse_p99_ms: float = 0.0          # informational (during the abuse)
+    quiesce_p99_ms: float = 0.0
+    p99_slack: float = 3.0
+    p99_floor_ms: float = 25.0
+    abuser_attempts: int = 0
+    abuser_ok: int = 0
+    abuser_throttled: int = 0
+    victim_ok: int = 0
+    victim_errors: int = 0
+    victim_throttled: int = 0          # from the master's snapshot
+    shed_after_queue: int = -1
+    snapshot: dict = field(default_factory=dict)
+    elapsed_s: float = 0.0
+
+    def assert_invariants(self) -> None:
+        problems = []
+        # victim tail recovers: p99 after the abuser stops must sit
+        # within slack of the pre-abuse baseline (absolute floor keeps
+        # sub-millisecond baselines from over-triggering on loop jitter)
+        bound = max(self.baseline_p99_ms * self.p99_slack,
+                    self.baseline_p99_ms + self.p99_floor_ms)
+        if self.quiesce_p99_ms > bound:
+            problems.append(
+                f"victim p99 did not recover: quiesce "
+                f"{self.quiesce_p99_ms:.1f}ms > bound {bound:.1f}ms "
+                f"(baseline {self.baseline_p99_ms:.1f}ms)")
+        if self.abuser_attempts == 0:
+            problems.append("abuser made no attempts (harness bug)")
+        elif self.abuser_throttled < self.abuser_attempts * 0.5:
+            problems.append(
+                f"abuser absorbed too few rejections: "
+                f"{self.abuser_throttled}/{self.abuser_attempts} throttled")
+        if self.victim_throttled:
+            problems.append(
+                f"victims were throttled {self.victim_throttled}x "
+                "(quota must isolate the abuser, not punish victims)")
+        if self.shed_after_queue != 0:
+            problems.append(
+                f"shed-before-queue violated: {self.shed_after_queue} "
+                "requests rejected AFTER being queued")
+        assert not problems, (
+            f"tenant storm seed={self.seed} invariants violated: "
+            + "; ".join(problems))
+
+
+class TenantStorm:
+    """N well-behaved tenants issue steady metadata traffic against a
+    MiniCluster master while one abusive tenant hammers at ``abuse_x``
+    times its token-bucket quota with client retries disabled. Three
+    phases — baseline (victims only), abuse, quiesce (victims only) —
+    measure the victims' p99 before, during and after the attack.
+
+    The native fast-meta read plane bypasses the Python RPC header rail
+    (and therefore tenant admission), so the storm pins
+    ``client.fast_meta = False`` to route every op through the admitted
+    dispatch path — mirroring what docs/qos.md says about the exemption.
+    """
+
+    def __init__(self, seed: int, tenants: int = 20,
+                 abuser_qps: float = 40.0, abuse_x: float = 10.0,
+                 phase_s: float = 1.5, settle_s: float = 0.5,
+                 victim_interval_s: float = 0.05,
+                 p99_slack: float = 3.0,
+                 base_dir: str | None = None,
+                 overall_timeout_s: float = 60.0):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.n_tenants = max(2, tenants)
+        self.abuser_qps = abuser_qps
+        self.abuse_x = abuse_x
+        self.phase_s = phase_s
+        self.settle_s = settle_s
+        self.victim_interval_s = victim_interval_s
+        self.base_dir = base_dir
+        self.overall_timeout_s = overall_timeout_s
+        self.report = TenantStormReport(seed=seed, tenants=self.n_tenants,
+                                        p99_slack=p99_slack)
+        self._phase: str | None = None       # record only when set
+        self._lat: dict[str, list[float]] = {
+            "baseline": [], "abuse": [], "quiesce": []}
+        self._stop = False
+
+    @staticmethod
+    def _p99(samples: list[float]) -> float:
+        if not samples:
+            return 0.0
+        s = sorted(samples)
+        return s[int(0.99 * (len(s) - 1))] * 1000.0
+
+    async def _victim(self, mc: MiniCluster, c, vid: int) -> None:
+        from curvine_tpu.common.qos import tenant_scope
+        name = f"tenant{vid:02d}"
+        rng = random.Random((self.seed << 8) ^ vid)
+        with tenant_scope(name):
+            while not self._stop:
+                path = f"/tenants/{name}/f{rng.randrange(4)}"
+                t0 = time.monotonic()
+                try:
+                    await c.meta.exists(path)
+                    dt = time.monotonic() - t0
+                    phase = self._phase
+                    if phase is not None:
+                        self._lat[phase].append(dt)
+                    self.report.victim_ok += 1
+                except _EXPECTED:
+                    self.report.victim_errors += 1
+                await asyncio.sleep(self.victim_interval_s)
+
+    async def _abuser(self, mc: MiniCluster, c) -> None:
+        """Hammer at ``abuse_x`` × quota with retries DISABLED: every
+        rejection surfaces as a Throttled error the abuser absorbs —
+        the native-client analogue of the gateway's 503 SlowDown."""
+        from curvine_tpu.common.qos import tenant_scope
+        interval = 1.0 / (self.abuser_qps * self.abuse_x)
+        with tenant_scope("abuser"):
+            while not self._stop and self._phase == "abuse":
+                self.report.abuser_attempts += 1
+                try:
+                    await c.meta.exists("/tenants/abuser/f0")
+                    self.report.abuser_ok += 1
+                except err.CurvineError as e:
+                    if e.code == err.ErrorCode.THROTTLED:
+                        self.report.abuser_throttled += 1
+                except _EXPECTED:
+                    pass
+                await asyncio.sleep(interval)
+
+    async def run(self) -> TenantStormReport:
+        t_start = time.monotonic()
+        mc = MiniCluster(workers=1, base_dir=self.base_dir)
+        # route every metadata op through the admitted RPC dispatch path
+        # (the native fast-meta plane is exempt from tenant admission)
+        mc.conf.client.fast_meta = False
+        mc.conf.client.conn_retry_max = 6
+        await mc.start()
+        try:
+            await asyncio.wait_for(self._run(mc), self.overall_timeout_s)
+        finally:
+            self._stop = True
+            try:
+                await asyncio.wait_for(mc.stop(), 30.0)
+            except asyncio.TimeoutError:
+                raise AssertionError(
+                    f"tenant storm seed={self.seed}: cluster stop WEDGED; "
+                    "task stacks:\n" + _dump_task_stacks()) from None
+        self.report.elapsed_s = time.monotonic() - t_start
+        return self.report
+
+    async def _run(self, mc: MiniCluster) -> None:
+        qos = mc.master.qos
+        # the abuser gets a real quota; victims stay unlimited (their
+        # pace is self-throttled well below any sane quota) at default
+        # priority, so shedding — if it ever triggers — hits the abuser
+        # (priority 1) first
+        qos.set_quota("abuser", qps=self.abuser_qps,
+                      burst=max(4.0, self.abuser_qps / 5), priority=1)
+
+        c = mc.client()
+        await c.meta.mkdir("/tenants", create_parent=True)
+        victims = [asyncio.ensure_future(self._victim(mc, c, i))
+                   for i in range(self.n_tenants - 1)]
+        abuser_client = mc.client()
+        abuser_client.meta.retry.max_retries = 0
+        abuser_task = None
+        try:
+            # ---- phase 1: baseline (victims only) ----
+            self._phase = "baseline"
+            await asyncio.sleep(self.phase_s)
+            # ---- phase 2: abuse ----
+            self._phase = "abuse"
+            abuser_task = asyncio.ensure_future(
+                self._abuser(mc, abuser_client))
+            await asyncio.sleep(self.phase_s)
+            # ---- settle: stop the abuser, let buckets refill and the
+            # shed level decay before measuring recovery ----
+            self._phase = None
+            if abuser_task is not None:
+                await abuser_task
+                abuser_task = None
+            await asyncio.sleep(self.settle_s)
+            # ---- phase 3: quiesce (victims only) ----
+            self._phase = "quiesce"
+            await asyncio.sleep(self.phase_s)
+            self._phase = None
+        finally:
+            self._stop = True
+            if abuser_task is not None:
+                abuser_task.cancel()
+            await asyncio.gather(*victims, return_exceptions=True)
+
+        rep = self.report
+        rep.baseline_p99_ms = self._p99(self._lat["baseline"])
+        rep.abuse_p99_ms = self._p99(self._lat["abuse"])
+        rep.quiesce_p99_ms = self._p99(self._lat["quiesce"])
+        rep.snapshot = qos.snapshot()
+        rep.shed_after_queue = rep.snapshot.get("shed_after_queue", -1)
+        rep.victim_throttled = sum(
+            t.get("throttled", 0)
+            for name, t in rep.snapshot.get("tenants", {}).items()
+            if name != "abuser")
+
+
+async def run_tenant_storm(seed: int, **kw) -> TenantStormReport:
+    """One-call entry point for the abusive-tenant storm."""
+    return await TenantStorm(seed, **kw).run()

@@ -72,6 +72,7 @@ class WebServer:
         r.add_get("/api/config", self._config)
         r.add_get("/api/blocks", self._blocks)
         r.add_get("/api/shards", self._shards)
+        r.add_get("/api/tenants", self._tenants)
         # mutation plane (parity: curvine-web/src/router/load_handler.rs
         # submit_loading_task): REST load-job submission + cancel
         r.add_post("/api/load", self._submit_load)
@@ -226,6 +227,15 @@ class WebServer:
             return self._json(await self.master.shards.poll_stats())
         except Exception as e:  # noqa: BLE001 — http boundary
             return self._json({"error": str(e)})
+
+    async def _tenants(self, req):
+        """Multi-tenant admission snapshot (common/qos.py): per-tenant
+        qps/quota/inflight/throttled plus the current shed level."""
+        src = self.master or self.worker
+        qos = getattr(src, "qos", None) if src is not None else None
+        if qos is None:
+            return self._json({"enabled": False, "tenants": {}})
+        return self._json(qos.snapshot())
 
     async def _browse(self, req):
         if self.master is None:

@@ -138,6 +138,15 @@ class WorkerServer:
                                        metrics=self.metrics)
         self.rpc.obs = self.tracer
         self.rpc.metrics = self.metrics
+        # multi-tenant admission control on the data plane too: the
+        # tenant id stamped at the front door rides every hop, so a
+        # quota set once throttles READ_BLOCK/WRITE_BLOCK here the same
+        # way it throttles metadata ops on the master
+        from curvine_tpu.common.qos import AdmissionController
+        self.qos = AdmissionController.from_conf(
+            self.conf.qos, slow_op_ms=self.conf.obs.slow_op_ms,
+            metrics=self.metrics)
+        self.rpc.qos = self.qos
         if self.io_engine is not None:
             self.io_engine.metrics = self.metrics
         self.master_pool = ConnectionPool(size=2, rpc_conf=self.conf.rpc)

@@ -11,6 +11,7 @@
 #   BENCH_META=0 skips the metadata write-plane gate.
 #   BENCH_RPC=0 skips the RPC transport gate.
 #   BENCH_VERIFY=0 skips the read-verification overhead gate.
+#   BENCH_QOS=0 skips the admission-overhead gate.
 # Exit: 0 = at/above the regression gates, 1 = regression, 2 = harness error.
 
 set -u
@@ -285,6 +286,46 @@ print(f"perf_smoke: read_verify_overhead_pct={pct} ceiling={ceiling} "
 if pct > ceiling:
     print(f"perf_smoke: FAIL — read verification costs {pct}% > "
           f"{ceiling}% (always-on integrity must not tax the read path)",
+          file=sys.stderr)
+    sys.exit(1)
+print("perf_smoke: PASS")
+EOF
+    rc=$?
+    [ $rc -ne 0 ] && exit $rc
+fi
+
+if [ "${BENCH_QOS:-1}" = "0" ]; then
+    echo "perf_smoke: admission-overhead gate skipped (BENCH_QOS=0)"
+else
+    # admission-overhead gate: hot-path reads with the QoS admission
+    # plane ON (the default — enabled, unlimited buckets, tenant id on
+    # every request) must stay within qos_overhead_pct_max of admission
+    # OFF. The un-throttled admit is supposed to be a handful of float
+    # compares; this keeps it that way.
+    QOS_OUT=$(JAX_PLATFORMS=cpu timeout 150 python - <<'EOF'
+import asyncio, json, os, sys
+sys.path.insert(0, os.getcwd())
+from bench import _qos_overhead_bench
+print(json.dumps(asyncio.run(_qos_overhead_bench())))
+EOF
+)
+    rc=$?
+    if [ $rc -ne 0 ] || [ -z "$QOS_OUT" ]; then
+        echo "perf_smoke: admission-overhead microbench failed (rc=$rc)" >&2
+        exit 2
+    fi
+    echo "$QOS_OUT"
+    python - "$FLOOR_FILE" <<'EOF' "$QOS_OUT"
+import json, sys
+floor_file, result = sys.argv[1], json.loads(sys.argv[2])
+ceiling = json.load(open(floor_file))["qos_overhead_pct_max"]
+pct = result.get("qos_overhead_pct", 100.0)
+print(f"perf_smoke: qos_overhead_pct={pct} ceiling={ceiling} "
+      f"(qps off={result.get('qos_read_qps_off')} "
+      f"on={result.get('qos_read_qps_on')})")
+if pct > ceiling:
+    print(f"perf_smoke: FAIL — admission overhead {pct}% > {ceiling}% "
+          "(the un-throttled QoS hot path got too heavy)",
           file=sys.stderr)
     sys.exit(1)
 print("perf_smoke: PASS")

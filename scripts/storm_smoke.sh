@@ -1,9 +1,11 @@
 #!/bin/bash
 # Chaos-storm smoke gate (<2min): run the deterministic-seed storms —
 # including the disk-fault seeds (bitflip/EIO/ENOSPC injection, with the
-# no-corrupt-bytes-observed and quarantine-evacuation invariants) — plus
-# the deadline/breaker acceptance tests from tests/test_storm.py and
-# fail on any invariant violation. Mirrors scripts/perf_smoke.sh.
+# no-corrupt-bytes-observed and quarantine-evacuation invariants) and
+# the abusive-tenant QoS storm (victim p99 contained, abuser mostly
+# THROTTLED, shed-before-queue held) — plus the deadline/breaker
+# acceptance tests from tests/test_storm.py and fail on any invariant
+# violation. Mirrors scripts/perf_smoke.sh.
 #
 # Usage: scripts/storm_smoke.sh [project_root]
 #   STORM_RAFT_REPEAT=N   additionally run the raft election/storm tests

@@ -1,0 +1,323 @@
+"""Multi-tenant QoS unit tests (docs/qos.md): token-bucket refill math,
+the global → tenant → op-class hierarchy (with refund-on-inner-reject),
+inflight caps, DAGOR-style shed ordering, dead-on-arrival drops, the
+THROTTLED retry_after_ms wire round trip, and the RetryPolicy
+hint-vs-backoff-vs-deadline precedence."""
+
+import asyncio
+import time
+
+import pytest
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.common.conf import QosConf
+from curvine_tpu.common.qos import (
+    DEFAULT_TENANT, META, READ, TENANT_KEY, WRITE, AdmissionController,
+    TokenBucket, classify, current_tenant, set_process_tenant,
+    tenant_scope,
+)
+from curvine_tpu.rpc.client import RetryPolicy
+from curvine_tpu.rpc.codes import RpcCode
+from curvine_tpu.rpc.deadline import Deadline
+from curvine_tpu.rpc.frame import Message, error_for
+
+
+# ---------------------------------------------------------------------
+# token bucket
+# ---------------------------------------------------------------------
+
+def test_token_bucket_refill_math():
+    b = TokenBucket(rate=10.0, burst=5.0, now=0.0)
+    # burst capacity available immediately
+    for _ in range(5):
+        assert b.try_acquire(1.0, now=0.0) == 0.0
+    # empty: the wait hint is exactly tokens-deficit / rate
+    wait = b.try_acquire(1.0, now=0.0)
+    assert wait == pytest.approx(0.1)
+    # refill is linear in elapsed time: +0.05s → +0.5 tokens, still short
+    assert b.try_acquire(1.0, now=0.05) == pytest.approx(0.05)
+    # +0.1s from empty → exactly 1 token
+    assert b.try_acquire(1.0, now=0.1) == 0.0
+    # refill never exceeds burst
+    assert b.try_acquire(5.0, now=100.0) == 0.0
+    assert b.try_acquire(1.0, now=100.0) > 0.0
+
+
+def test_token_bucket_unlimited_and_refund():
+    assert TokenBucket(rate=0.0).try_acquire(1e9) == 0.0      # unlimited
+    b = TokenBucket(rate=1.0, burst=2.0, now=0.0)
+    assert b.try_acquire(2.0, now=0.0) == 0.0
+    b.refund(1.0)
+    assert b.try_acquire(1.0, now=0.0) == 0.0                 # refund back
+    b.refund(100.0)
+    assert b.tokens <= 2.0                                    # capped
+
+
+def test_token_bucket_default_burst():
+    assert TokenBucket(rate=50.0).burst == 50.0               # 1s of rate
+    assert TokenBucket(rate=0.5).burst == 1.0                 # min 1
+
+
+# ---------------------------------------------------------------------
+# admission: quotas, hierarchy, caps
+# ---------------------------------------------------------------------
+
+def _throttle_info(excinfo) -> err.Throttled:
+    e = excinfo.value
+    assert e.code == err.ErrorCode.THROTTLED
+    assert e.retryable
+    assert e.retry_after_ms is not None and e.retry_after_ms >= 1
+    return e
+
+
+def test_tenant_quota_throttles_with_hint():
+    q = AdmissionController()
+    q.set_quota("a", qps=1.0, burst=2.0)
+    q.admit("a", META)
+    q.admit("a", META)
+    with pytest.raises(err.Throttled) as ei:
+        q.admit("a", META)
+    _throttle_info(ei)
+    assert "tenant quota" in str(ei.value)
+    snap = q.snapshot()["tenants"]["a"]
+    assert snap["admitted"] == 2 and snap["throttled"] == 1
+
+
+def test_global_quota_and_refund_on_inner_reject():
+    # global allows 2; tenant "a" only 1. a's second admit must be
+    # rejected by the TENANT bucket and refund the global token — so a
+    # different tenant can still use it (hierarchical acquire must not
+    # charge for work never admitted).
+    q = AdmissionController(global_qps=2.0, global_burst=2.0)
+    q.set_quota("a", qps=1.0, burst=1.0)
+    q.admit("a", META)
+    with pytest.raises(err.Throttled):
+        q.admit("a", META)                    # tenant reject, global refund
+    q.admit("b", META)                        # the refunded global token
+    with pytest.raises(err.Throttled) as ei:
+        q.admit("b", META)                    # global now truly empty
+    assert "global quota" in str(ei.value)
+
+
+def test_op_class_share_split():
+    # meta capped at 20% of the tenant rate; reads may use the rest
+    q = AdmissionController(shares={META: 0.2, READ: 1.0, WRITE: 1.0})
+    q.set_quota("a", qps=10.0, burst=10.0)
+    q.admit("a", META)
+    q.admit("a", META)
+    with pytest.raises(err.Throttled) as ei:
+        q.admit("a", META)                    # meta sub-bucket (2) empty
+    assert "meta quota" in str(ei.value)
+    q.admit("a", READ)                        # read class unaffected
+
+
+def test_inflight_cap_bounds_queue_memory():
+    q = AdmissionController()
+    q.set_quota("a", inflight_cap=2)
+    t1 = q.admit("a", READ)
+    q.admit("a", READ)
+    with pytest.raises(err.Throttled) as ei:
+        q.admit("a", READ)
+    assert "inflight cap" in str(ei.value)
+    q.release(t1, 0.001)
+    q.release(t1, 0.001)                      # double release: idempotent
+    q.admit("a", READ)                        # slot freed exactly once
+    assert q.snapshot()["tenants"]["a"]["inflight"] == 2
+
+
+# ---------------------------------------------------------------------
+# overload shedding
+# ---------------------------------------------------------------------
+
+def test_shed_level_rejects_lowest_priority_first():
+    q = AdmissionController()
+    q.set_quota("batch", priority=1)
+    q.set_quota("online", priority=8)
+    q.shed_level = 3
+    q._last_adjust = time.monotonic()         # freeze the feedback loop
+    with pytest.raises(err.Throttled) as ei:
+        q.admit("batch", META)
+    assert "overload shed" in str(ei.value)
+    assert q.snapshot()["tenants"]["batch"]["shed"] == 1
+    q.admit("online", META)                   # above the level: admitted
+
+
+def test_shed_feedback_raises_and_decays():
+    q = AdmissionController(shed_inflight_hi=1, shed_adjust_interval_s=0.0)
+    tok = q.admit("a", META)
+    q.admit("a", META)                        # inflight 2 > hi=1
+    q.admit("a", META)                        # adjust fires: level 1
+    assert q.shed_level >= 1
+    # drain and admit again: calm → the level decays back to 0
+    for t in list(range(3)):
+        q.release(tok, 0.001)
+    q.total_inflight = 0
+    q.admit("a", META)
+    q.admit("a", META)
+    assert q.shed_level == 0
+
+
+def test_doa_drop_needs_warm_estimate():
+    q = AdmissionController(doa_margin=1.0)
+    # cold estimate: a tiny budget is still admitted (never guess-drop)
+    tok = q.admit("a", META, deadline_remaining_s=0.001)
+    q.release(tok, 0.001)
+    # warm the META estimate to ~100ms (EWMA still carries a trace of
+    # the first 1ms sample, so it converges just under 0.1)
+    for _ in range(12):
+        q.release(q.admit("a", META), 0.1)
+    assert 0.09 < q._est[META] <= 0.1
+    with pytest.raises(err.RpcTimeout) as ei:
+        q.admit("a", META, deadline_remaining_s=0.05)
+    assert "dead on arrival" in str(ei.value)
+    q.admit("a", META, deadline_remaining_s=0.5)   # ample budget: fine
+
+
+# ---------------------------------------------------------------------
+# classification + admit_msg
+# ---------------------------------------------------------------------
+
+def test_classify_op_classes_and_exemptions():
+    assert classify(RpcCode.EXISTS) == META
+    assert classify(RpcCode.FILE_STATUS) == META
+    assert classify(RpcCode.OPEN_FILE) == READ
+    assert classify(RpcCode.READ_BLOCK) == READ
+    assert classify(RpcCode.CREATE_FILE) == WRITE
+    assert classify(RpcCode.WRITE_BLOCK) == WRITE
+    # cluster-internal codes are exempt: throttling the control plane
+    # would turn congestion into outage
+    assert classify(RpcCode.WORKER_HEARTBEAT) is None
+    assert classify(RpcCode.METRICS_REPORT) is None
+
+
+def test_admit_msg_exempt_and_disabled():
+    q = AdmissionController()
+    assert q.admit_msg(int(RpcCode.METRICS_REPORT), {}) is None
+    tok = q.admit_msg(int(RpcCode.EXISTS), {TENANT_KEY: "t"})
+    assert tok is not None and tok.tenant.name == "t"
+    q.release(tok, 0.001)
+    # no tenant header → the shared default bucket
+    tok = q.admit_msg(int(RpcCode.EXISTS), {})
+    assert tok.tenant.name == DEFAULT_TENANT
+    q.enabled = False
+    assert q.admit_msg(int(RpcCode.EXISTS), {TENANT_KEY: "t"}) is None
+
+
+def test_from_conf_tenant_specs():
+    qc = QosConf(tenants=["gold:100:9", "free:5:1:8", "bad:xx",
+                          "", "plain"])
+    q = AdmissionController.from_conf(qc)
+    gold = q._tenant("gold")
+    assert gold.bucket.rate == 100.0 and gold.priority == 9
+    free = q._tenant("free")
+    assert free.bucket.rate == 5.0 and free.priority == 1
+    assert free.inflight_cap == 8
+    assert q._tenant("bad").bucket.rate == 0.0       # malformed: ignored
+    assert q._tenant("plain").bucket.rate == 0.0     # name-only spec
+
+
+# ---------------------------------------------------------------------
+# tenant identity rail
+# ---------------------------------------------------------------------
+
+def test_tenant_context_scoping():
+    set_process_tenant(None)
+    assert current_tenant() is None
+    with tenant_scope("a"):
+        assert current_tenant() == "a"
+        with tenant_scope("b"):
+            assert current_tenant() == "b"
+        assert current_tenant() == "a"
+    assert current_tenant() is None
+    try:
+        set_process_tenant("proc")
+        assert current_tenant() == "proc"
+        with tenant_scope("req"):              # contextvar wins
+            assert current_tenant() == "req"
+        assert current_tenant() == "proc"
+    finally:
+        set_process_tenant(None)
+
+
+# ---------------------------------------------------------------------
+# THROTTLED wire semantics
+# ---------------------------------------------------------------------
+
+def test_throttled_retry_after_rides_the_wire():
+    req = Message(code=int(RpcCode.EXISTS), req_id=7)
+    rep = error_for(req, err.Throttled("tenant a: quota",
+                                       retry_after_ms=123))
+    assert rep.header["retry_after_ms"] == 123
+    with pytest.raises(err.Throttled) as ei:
+        rep.check()
+    e = ei.value
+    assert e.code == err.ErrorCode.THROTTLED
+    assert e.retryable
+    assert e.retry_after_ms == 123
+    # non-throttled errors carry no hint
+    rep2 = error_for(req, err.FileNotFound("nope"))
+    assert "retry_after_ms" not in rep2.header
+
+
+# ---------------------------------------------------------------------
+# RetryPolicy: server hint vs backoff vs deadline
+# ---------------------------------------------------------------------
+
+async def _capture_delays(monkeypatch):
+    delays: list[float] = []
+    real_sleep = asyncio.sleep
+
+    async def spy(d, *a, **kw):
+        delays.append(d)
+        await real_sleep(0)
+
+    monkeypatch.setattr(asyncio, "sleep", spy)
+    return delays
+
+
+async def test_retry_policy_honors_server_hint(monkeypatch):
+    delays = await _capture_delays(monkeypatch)
+    policy = RetryPolicy(max_retries=1, base_ms=4_000, max_ms=4_000)
+    calls = []
+
+    async def throttled_once():
+        calls.append(1)
+        if len(calls) == 1:
+            raise err.Throttled("busy", retry_after_ms=200)
+        return "ok"
+
+    assert await policy.run(throttled_once) == "ok"
+    # the 200ms hint wins over the 4s exponential backoff, jittered UP
+    # (never before the server says capacity exists), never 25%+ past it
+    assert len(delays) == 1
+    assert 0.2 <= delays[0] < 0.2 * 1.25 + 1e-9
+
+
+async def test_retry_policy_backoff_without_hint(monkeypatch):
+    delays = await _capture_delays(monkeypatch)
+    policy = RetryPolicy(max_retries=1, base_ms=1_000, max_ms=1_000)
+    calls = []
+
+    async def flaky():
+        calls.append(1)
+        if len(calls) == 1:
+            raise err.RpcTimeout("nope")      # retryable, no hint
+        return "ok"
+
+    assert await policy.run(flaky) == "ok"
+    assert len(delays) == 1
+    assert 0.5 <= delays[0] <= 1.0            # jittered exponential
+
+
+async def test_retry_policy_deadline_wins_over_hint(monkeypatch):
+    delays = await _capture_delays(monkeypatch)
+    policy = RetryPolicy(max_retries=5, base_ms=10, max_ms=10)
+
+    async def always_throttled():
+        raise err.Throttled("busy", retry_after_ms=500)
+
+    # sleeping 500ms+ would outlive the 200ms budget: the error must
+    # propagate immediately instead of a doomed sleep-and-retry
+    with pytest.raises(err.Throttled):
+        await policy.run(always_throttled, deadline=Deadline(0.2))
+    assert delays == []

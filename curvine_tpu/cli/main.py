@@ -323,6 +323,31 @@ async def cmd_report(args):
                       f"{t.get('inflight', 0):>8}  "
                       f"{t.get('admitted', 0):>8}  "
                       f"{t.get('throttled', 0):>9}  {t.get('shed', 0):>4}")
+        # raft membership table (absent on single-node / raft-less
+        # masters — degrade quietly like the tables above)
+        try:
+            rs = await c.meta.raft_status()
+        except err.CurvineError:
+            return
+        if rs and rs.get("voters"):
+            print(f"Raft: term={rs.get('term', 0)} "
+                  f"leader={rs.get('leader_id', 0)} "
+                  f"commit={rs.get('commit_seq', 0)} "
+                  f"conf_ver={rs.get('conf_ver', 0)}")
+            match = rs.get("match") or {}
+            last = rs.get("last_seq", 0)
+            print("  node  role     lag  addr")
+            for role, members in (("voter", rs.get("voters") or {}),
+                                  ("learner", rs.get("learners") or {})):
+                for nid in sorted(members, key=int):
+                    if int(nid) == rs.get("leader_id"):
+                        lag = "-"
+                    elif str(nid) in match or nid in match:
+                        m = match.get(str(nid), match.get(nid, 0))
+                        lag = str(max(0, last - m))
+                    else:
+                        lag = "?"
+                    print(f"  {nid:>4}  {role:<7}  {lag:>3}  {members[nid]}")
     finally:
         await c.close()
 
@@ -348,6 +373,59 @@ async def cmd_node(args):
         print(f"worker {args.worker_id}: {WorkerState(state).name}"
               if state >= 0 else
               f"worker {args.worker_id}: intent cleared (not registered)")
+    finally:
+        await c.close()
+
+
+async def cmd_raft(args):
+    """Raft membership lifecycle: status / add / remove / transfer.
+
+    ``add`` joins the target as a *learner*; the leader auto-promotes it
+    to voter once its replication lag drops under ``raft_promote_lag``.
+    ``remove`` drops a voter or learner (the leader refuses to remove
+    itself — transfer first). ``transfer`` drains leadership to the
+    most-caught-up voter, or to an explicit node id."""
+    c = await _client(args)
+    try:
+        action = args.action
+        if action == "status":
+            rs = await c.meta.raft_status()
+            print(f"node={rs.get('node_id')} role={rs.get('role')} "
+                  f"term={rs.get('term')} leader={rs.get('leader_id')} "
+                  f"commit={rs.get('commit_seq')} "
+                  f"last={rs.get('last_seq')} "
+                  f"conf_ver={rs.get('conf_ver')}")
+            for role, members in (("voter", rs.get("voters") or {}),
+                                  ("learner", rs.get("learners") or {})):
+                for nid in sorted(members, key=int):
+                    print(f"  {role} {nid} {members[nid]}")
+            if rs.get("transferring"):
+                print("  (leadership transfer in progress)")
+            return
+        if action == "add":
+            if not args.node_id or not args.addr:
+                print("usage: cv raft add <node_id> <host:port>",
+                      file=sys.stderr)
+                raise SystemExit(2)
+            rep = await c.meta.raft_member_change(
+                "add_learner", int(args.node_id), args.addr)
+            print(f"learner {args.node_id} added "
+                  f"(conf_ver={rep.get('ver', '?')}); "
+                  f"auto-promotes when caught up")
+            return
+        if action == "remove":
+            if not args.node_id:
+                print("usage: cv raft remove <node_id>", file=sys.stderr)
+                raise SystemExit(2)
+            rep = await c.meta.raft_member_change(
+                "remove", int(args.node_id))
+            print(f"node {args.node_id} removed "
+                  f"(conf_ver={rep.get('ver', '?')})")
+            return
+        # transfer: node_id optional — leader picks the most caught-up
+        target = int(args.node_id) if args.node_id else None
+        new_leader = await c.meta.raft_transfer(target)
+        print(f"leadership transferred to node {new_leader}")
     finally:
         await c.close()
 
@@ -669,6 +747,10 @@ def build_parser() -> argparse.ArgumentParser:
         A("action", nargs="?", default="list",
           choices=["list", "decommission", "recommission"]),
         A("worker_id", nargs="?"))
+    add("raft", cmd_raft,
+        A("action", choices=["status", "add", "remove", "transfer"]),
+        A("node_id", nargs="?"),
+        A("addr", nargs="?"))
     add("mount", cmd_mount, A("cv_path"), A("ufs_path"),
         A("--auto-cache", dest="auto_cache", action="store_true"),
         A("--prop", action="append"),

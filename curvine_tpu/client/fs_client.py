@@ -106,7 +106,7 @@ class FsClient:
                 return unpack(rep.data) or {}
             except err.CurvineError as e:
                 if e.code in (err.ErrorCode.NOT_LEADER, err.ErrorCode.CONNECT):
-                    self._active = (self._active + 1) % len(self.masters)
+                    self._note_leader_hint(e)
                     # the fast plane follows the leader: rediscover it
                     self._fast_addr = None
                     self._fast_probe_after = 0.0
@@ -117,6 +117,26 @@ class FsClient:
                 # the retry policy never sleeps past the caller's budget
                 return await self.retry.run(once, deadline=deadline)
         return await self.retry.run(once, deadline=deadline)
+
+    def _note_leader_hint(self, e: err.CurvineError) -> None:
+        """NOT_LEADER redirect handling: adopt the member list the error
+        carries (the cluster may have grown/shrunk since our conf was
+        written) and jump straight to the hinted leader; with no hint,
+        fall back to round-robin rotation."""
+        members = getattr(e, "members", None)
+        if members:
+            cur = self.masters[self._active] if self.masters else None
+            self.masters = list(members)
+            self._active = (self.masters.index(cur)
+                            if cur in self.masters
+                            else self._active % len(self.masters))
+        hint = getattr(e, "leader_hint", None)
+        if hint:
+            if hint not in self.masters:
+                self.masters.append(hint)
+            self._active = self.masters.index(hint)
+            return                      # don't rotate off a fresh hint
+        self._active = (self._active + 1) % len(self.masters)
 
     # ---------------- native metadata fast path ----------------
 
@@ -301,6 +321,39 @@ class FsClient:
         """The master's admission-control snapshot (common/qos.py):
         shed level plus per-tenant qps/quota/inflight/throttled."""
         return await self.call(RpcCode.TENANT_STATS, {})
+
+    # ---------------- raft membership plane ----------------
+
+    async def raft_status(self) -> dict:
+        """RAFT_STATUS from whichever master we're pointed at — answers
+        on ANY node (role, term, leader, voters/learners, match lag)."""
+        return await self.call(RpcCode.RAFT_STATUS, {})
+
+    async def refresh_masters(self) -> list[str]:
+        """Re-learn the master list from the cluster's active raft
+        config (a node added with `cv raft add` is unknown to a conf
+        written before it joined)."""
+        st = await self.raft_status()
+        members = [a for a in (st.get("voters") or {}).values() if a]
+        if members:
+            cur = (self.masters[self._active]
+                   if self._active < len(self.masters) else None)
+            self.masters = members
+            self._active = (members.index(cur) if cur in members else 0)
+        return list(self.masters)
+
+    async def raft_member_change(self, action: str, node_id: int,
+                                 addr: str = "") -> dict:
+        """add/promote/remove a member (leader-routed; the ack means the
+        config entry committed on a quorum)."""
+        return await self.call(RpcCode.RAFT_MEMBER_CHANGE,
+                               {"action": action, "node_id": node_id,
+                                "addr": addr}, mutate=True)
+
+    async def raft_transfer(self, target: int | None = None) -> int:
+        """Graceful leader handoff; returns the new leader's node id."""
+        rep = await self.call(RpcCode.RAFT_TRANSFER, {"target": target})
+        return rep.get("target", 0)
 
     async def list_options(self, path: str, pattern: str | None = None,
                            dirs_only: bool = False, files_only: bool = False,

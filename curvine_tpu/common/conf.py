@@ -93,6 +93,19 @@ class MasterConf:
     # raft (HA); empty peers → single-node journal mode
     raft_peers: list[str] = field(default_factory=list)
     raft_node_id: int = 1
+    # membership lifecycle (master/ha.py, docs/raft.md): a learner is
+    # auto-promoted to voter once its replication lag (leader last_seq -
+    # learner match) drops below raft_promote_lag entries
+    raft_promote_lag: int = 64
+    # snapshot catch-up streams in chunks of this size (the monolithic
+    # blob could not fit under MAX_FRAME at 10M-file namespace scale)
+    raft_snapshot_chunk_mb: int = 4
+    # `cv raft transfer`: max time the leader pauses writes while
+    # draining the target before giving up and resuming
+    raft_transfer_timeout_ms: int = 5_000
+    # start this node as a non-voting learner (it joins quorum only
+    # after a PROMOTE config entry commits)
+    raft_learner: bool = False
     # time budget for one master-dispatched replication pull (submit RPC
     # + the destination's pull from the source), propagated in the RPC
     # header so the worker's peer stream is bounded by the same budget

@@ -73,6 +73,12 @@ class CurvineError(Exception):
     # carried across the wire in the error response header; the retry
     # policy prefers it over its own exponential backoff
     retry_after_ms: int | None = None
+    # NOT_LEADER redirect hints, carried the same way: the current
+    # leader's "host:port" (when known) and the active voter address
+    # list, so a client can jump straight to the leader and track
+    # membership changes without re-reading its conf
+    leader_hint: str | None = None
+    members: list | None = None
 
     def __init__(self, message: str = "", code: ErrorCode | None = None):
         super().__init__(message)

@@ -67,6 +67,11 @@ class MasterFilesystem:
         self.open_files: set[int] | None = None
         self.on_worker_lost = None  # hook: ReplicationManager
         self.on_mutation = None     # hook: RaftLite journal replication
+        # active raft membership config, set by journaled raft_conf
+        # entries (master/ha.py) and carried through snapshots so a
+        # fresh/restarted replica adopts the journaled config, not its
+        # possibly-stale boot peers
+        self.raft_conf: dict | None = None
         self.acl = None             # set by AclEnforcer (permission checks)
         # GroupCommitter (common/journal.py), installed by MasterServer:
         # when present, _log journals unflushed + stages KV writes; the
@@ -285,6 +290,8 @@ class MasterFilesystem:
                  "deco": sorted(self.workers.deco_ids)}
         if self.mounts is not None:
             state["mounts"] = self.mounts.snapshot_state()
+        if self.raft_conf is not None:
+            state["raft_conf"] = self.raft_conf
         return state
 
     def _load_snapshot(self, snap: dict) -> None:
@@ -331,6 +338,8 @@ class MasterFilesystem:
             self.store.deco_put(wid)
         if self.mounts is not None and "mounts" in snap:
             self.mounts.load_snapshot_state(snap["mounts"])
+        if snap.get("raft_conf") is not None:
+            self.raft_conf = snap["raft_conf"]
 
     def _apply(self, op: str, args: dict):
         fn = getattr(self, f"_apply_{op}", None)
@@ -340,6 +349,18 @@ class MasterFilesystem:
 
     def _apply_noop(self) -> None:
         """Term-opening no-op (raft leader turnover)."""
+
+    def _apply_raft_conf(self, ver: int = 0, voters: dict | None = None,
+                         learners: dict | None = None,
+                         action: str | None = None,
+                         target: int | None = None) -> None:
+        """Raft membership config entry (master/ha.py): the state
+        machine only RECORDS the active config (so snapshots and replay
+        carry it); RaftLite adopts it via on_mutation / _h_append /
+        raft.start()."""
+        self.raft_conf = {"ver": ver, "voters": dict(voters or {}),
+                          "learners": dict(learners or {}),
+                          "action": action, "target": target}
 
     def decommission_worker(self, worker_id: int, on: bool = True) -> None:
         """Journaled decommission intent: survives restarts/failovers

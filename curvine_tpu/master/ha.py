@@ -1,9 +1,9 @@
-"""Master HA: raft-lite journal replication.
+"""Master HA: raft-lite journal replication with a membership lifecycle.
 
-Parity: curvine-common/src/raft/ (raft_node, raft_journal, snapshot/) —
-the reference replicates master metadata through the raft crate. This is
-a compact re-implementation over our RPC fabric with the same observable
-guarantees:
+Parity: curvine-common/src/raft/ (raft_node, raft_journal, snapshot/,
+raft_group.rs) — the reference replicates master metadata through the
+raft crate. This is a compact re-implementation over our RPC fabric with
+the same observable guarantees:
 
 * leader election with persisted hard state (term + voted_for survive
   restarts, so a node cannot double-vote in the same term);
@@ -14,7 +14,24 @@ guarantees:
   entries, since applies here are not undoable);
 * commit-after-majority: client-visible acks wait until the entry's seq
   is replicated on a quorum (`wait_committed`), closing the acked-write-
-  loss window the round-1/2 design documented.
+  loss window the round-1/2 design documented;
+* journaled membership (docs/raft.md): single-server config changes
+  (ADD_LEARNER / PROMOTE / REMOVE) ride the journal as ``raft_conf``
+  entries and take effect when appended; quorum is computed from the
+  active voter set; one change may be in flight at a time; a removed
+  node refuses to start elections and peers refuse its vote requests;
+* learners: non-voting members that receive the full replication stream
+  (chunked snapshot install + log tail) but never count toward quorum;
+  the leader auto-promotes a learner once its match lag drops below
+  ``master.raft_promote_lag``, so growing the cluster never drops the
+  effective quorum;
+* chunked snapshot install: catch-up state streams as bounded, resumable
+  RAFT_SNAPSHOT_CHUNK frames with a final CRC — a namespace larger than
+  MAX_FRAME (the 10M-file scale is ~332 MB) can rejoin, which the
+  monolithic blob never could;
+* leader transfer: the leader drains its log to the chosen voter, then
+  sends TIMEOUT_NOW so the target elects immediately (bounded,
+  election-timeout-free failover for rolling restarts).
 
 The leader still applies locally before replicating (reference applies on
 commit; here applies are deterministic and a deposed leader's extra
@@ -27,6 +44,7 @@ import asyncio
 import logging
 import os
 import random
+import zlib
 
 import msgpack
 
@@ -39,15 +57,38 @@ log = logging.getLogger(__name__)
 
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
 
+_ROLE_GAUGE = {FOLLOWER: 0, CANDIDATE: 1, LEADER: 2}
+# soft byte cap per AppendEntries batch: entries with fat args (xattrs,
+# batched creates) must never push one frame past MAX_FRAME
+_BATCH_SOFT_BYTES = 8 * 1024 * 1024
+
+
+def _rough_size(obj) -> int:
+    """Cheap wire-size estimate for batch byte capping (not exact msgpack
+    accounting — it only has to be the right order of magnitude)."""
+    if isinstance(obj, (bytes, bytearray, memoryview, str)):
+        return len(obj)
+    if isinstance(obj, dict):
+        return 8 + sum(_rough_size(k) + _rough_size(v)
+                       for k, v in obj.items())
+    if isinstance(obj, (list, tuple)):
+        return 8 + sum(_rough_size(v) for v in obj)
+    return 8
+
 
 class RaftLite:
     def __init__(self, node_id: int, peers: dict[int, str], fs,
                  rpc: RpcServer, election_timeout_ms: tuple[int, int] =
                  (600, 1200), heartbeat_ms: int = 150,
                  state_dir: str | None = None,
-                 commit_timeout_s: float = 10.0):
+                 commit_timeout_s: float = 10.0,
+                 self_addr: str = "",
+                 learner: bool = False,
+                 promote_lag: int = 64,
+                 snapshot_chunk_bytes: int = 4 * 1024 * 1024,
+                 transfer_timeout_s: float = 5.0,
+                 metrics=None):
         self.node_id = node_id
-        self.peers = dict(peers)            # id -> addr (excluding self)
         self.fs = fs
         self.rpc = rpc
         self.role = FOLLOWER
@@ -57,14 +98,43 @@ class RaftLite:
         self.election_timeout = election_timeout_ms
         self.heartbeat_ms = heartbeat_ms
         self.commit_timeout_s = commit_timeout_s
+        self.promote_lag = promote_lag
+        self.snapshot_chunk_bytes = max(64 * 1024, snapshot_chunk_bytes)
+        self.transfer_timeout_s = transfer_timeout_s
+        self.metrics = metrics
         self.pool = ConnectionPool(size=1, timeout_ms=2_000)
+        # --- membership (boot config; superseded by journaled raft_conf
+        # entries the moment one exists) ---
+        # voters includes self; `peers` (the ctor arg) excludes self
+        if learner:
+            self.voters: dict[int, str] = dict(peers)
+            self.learners: dict[int, str] = {node_id: self_addr}
+        else:
+            self.voters = dict(peers)
+            self.voters[node_id] = self_addr
+            self.learners = {}
+        self.conf_ver = 0
+        self.removed = False
+        # seq of the in-flight config entry; a second change is refused
+        # until it commits (single-server-change rule)
+        self._conf_seq: int | None = None
+        self._transferring = False
         self._last_heard = 0.0
         self._bg: list[asyncio.Task] = []
+        # leader-term replication loops, torn down at step-down/reconfig
+        self._repl_tasks: list[asyncio.Task] = []
         self._repl_queues: dict[int, asyncio.Queue] = {}
-        # commit tracking (leader): follower id -> highest acked seq
+        # commit tracking (leader): member id -> highest acked seq
         self.match: dict[int, int] = {}
         self.commit_seq = 0
         self._commit_waiters: list[tuple[int, asyncio.Future]] = []
+        # in-progress chunked snapshot receive (follower side)
+        self._snap_rx: dict | None = None
+        # last adopted config, persisted beside term/voted_for: a KV-mode
+        # restart may neither replay the raft_conf entry (compacted away)
+        # nor see it in a mem snapshot, so the hard-state file is the
+        # always-there recovery path for membership
+        self._hs_conf: dict | None = None
         # persisted hard state (term, voted_for): raft_node.rs parity
         self._state_path = os.path.join(
             state_dir or (fs.journal.dir if fs.journal else "."),
@@ -74,6 +144,9 @@ class RaftLite:
         rpc.register(RpcCode.RAFT_PREVOTE, self._h_prevote)
         rpc.register(RpcCode.RAFT_APPEND, self._h_append)
         rpc.register(RpcCode.RAFT_SNAPSHOT, self._h_snapshot)
+        rpc.register(RpcCode.RAFT_SNAPSHOT_CHUNK, self._h_snapshot_chunk)
+        rpc.register(RpcCode.RAFT_TIMEOUT_NOW, self._h_timeout_now)
+        rpc.register(RpcCode.RAFT_STATUS, self._h_status)
 
     # ---------------- hard state ----------------
 
@@ -83,6 +156,7 @@ class RaftLite:
                 d = msgpack.unpackb(f.read(), raw=False)
             self.term = d.get("term", 0)
             self.voted_for = d.get("voted_for")
+            self._hs_conf = d.get("conf")
         except (FileNotFoundError, ValueError, msgpack.UnpackException):
             pass
         if self.fs.journal is not None:
@@ -94,12 +168,189 @@ class RaftLite:
         tmp = self._state_path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(msgpack.packb({"term": self.term,
-                                   "voted_for": self.voted_for}))
+                                   "voted_for": self.voted_for,
+                                   "conf": self._hs_conf}))
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._state_path)
         if self.fs.journal is not None:
             self.fs.journal.term = self.term
+
+    # ---------------- membership ----------------
+
+    @property
+    def peers(self) -> dict[int, str]:
+        """Replication/communication targets: every member — voter or
+        learner — except self. (Compat view: pre-membership code indexed
+        a static peers dict; it now tracks the live config.)"""
+        out = dict(self.voters)
+        out.update(self.learners)
+        out.pop(self.node_id, None)
+        return out
+
+    def _voter_peers(self) -> dict[int, str]:
+        return {pid: a for pid, a in self.voters.items()
+                if pid != self.node_id}
+
+    def _addr_of(self, nid: int | None) -> str:
+        if nid is None:
+            return ""
+        return self.voters.get(nid) or self.learners.get(nid) or ""
+
+    def _adopt_config(self, cfg: dict | None) -> None:
+        """Make a journaled ``raft_conf`` entry the active config.
+        Called when the leader appends one (on_mutation), when a follower
+        applies one (_h_append), after a snapshot install, and at boot
+        from the recovered ``fs.raft_conf``. The config takes effect when
+        APPENDED, not when committed (raft single-server-change rule)."""
+        if not cfg:
+            return
+        ver = int(cfg.get("ver", 0))
+        if ver < self.conf_ver:
+            return
+        self.conf_ver = ver
+        self.voters = {int(k): v for k, v in (cfg.get("voters") or {}).items()}
+        self.learners = {int(k): v
+                         for k, v in (cfg.get("learners") or {}).items()}
+        self._hs_conf = {"ver": ver,
+                         "voters": {str(k): v
+                                    for k, v in self.voters.items()},
+                         "learners": {str(k): v
+                                      for k, v in self.learners.items()}}
+        self._save_hard_state()     # membership must survive restarts too
+        if self.node_id not in self.voters and \
+                self.node_id not in self.learners:
+            if not self.removed:
+                log.info("node %d: removed from the cluster config (ver %d)",
+                         self.node_id, ver)
+            self.removed = True
+            if self.role == LEADER:
+                self._step_down(self.term)
+            self.role = FOLLOWER
+        else:
+            self.removed = False
+        if self.role == LEADER:
+            self._reconcile_replication()
+            self._advance_commit()
+
+    def _reconcile_replication(self) -> None:
+        """Leader: align per-member replication loops with the active
+        config — spawn queues/loops for new members, retire loops for
+        removed ones (their loop notices its queue was unhooked)."""
+        targets = self.peers
+        for pid, addr in targets.items():
+            if pid not in self._repl_queues:
+                self._repl_queues[pid] = asyncio.Queue()
+                self.match.setdefault(pid, 0)
+                self._repl_tasks.append(asyncio.ensure_future(
+                    self._replicate_loop(pid, addr)))
+        for pid in list(self._repl_queues):
+            if pid not in targets:
+                # the removal config entry was queued for this member
+                # just before adoption — keep its loop hooked for a few
+                # heartbeats so the farewell append is actually sent and
+                # the removed node learns to stand down; its ack can no
+                # longer move commit (it left the voter set already)
+                self._repl_tasks.append(asyncio.ensure_future(
+                    self._retire_member(pid, self._repl_queues[pid])))
+        self._repl_tasks = [t for t in self._repl_tasks if not t.done()]
+
+    async def _retire_member(self, pid: int, q: asyncio.Queue) -> None:
+        await asyncio.sleep(self.heartbeat_ms * 4 / 1000)
+        if self._repl_queues.get(pid) is q and pid not in self.peers:
+            self._repl_queues.pop(pid, None)
+            self.match.pop(pid, None)
+
+    def propose_member_change(self, action: str, target_id: int,
+                              addr: str = "") -> dict:
+        """Leader-only: append a single-server config change to the
+        journal. One change at a time: a proposal while the previous
+        config entry is uncommitted is refused (retryable IN_PROGRESS)."""
+        self.check_leader()
+        if self._conf_seq is not None and self._conf_seq > self.commit_seq:
+            raise err.CapacityPending(
+                "a membership change is already in flight "
+                f"(seq {self._conf_seq} > commit {self.commit_seq})")
+        action = str(action).lower()
+        target_id = int(target_id)
+        voters, learners = dict(self.voters), dict(self.learners)
+        if action in ("add", "add_learner"):
+            if not addr:
+                raise err.InvalidArgument(
+                    "add requires the new node's host:port")
+            if target_id in voters or target_id in learners:
+                raise err.InvalidArgument(
+                    f"node {target_id} is already a member")
+            learners[target_id] = addr
+            action = "add_learner"
+        elif action == "promote":
+            if target_id not in learners:
+                raise err.InvalidArgument(
+                    f"node {target_id} is not a learner")
+            voters[target_id] = learners.pop(target_id)
+        elif action == "remove":
+            if target_id == self.node_id:
+                raise err.InvalidArgument(
+                    "cannot remove the leader; transfer leadership first")
+            if target_id in voters:
+                voters.pop(target_id)
+            elif target_id in learners:
+                learners.pop(target_id)
+            else:
+                raise err.InvalidArgument(
+                    f"node {target_id} is not a member")
+        else:
+            raise err.InvalidArgument(
+                f"unknown membership action {action!r}")
+        args = {"ver": self.conf_ver + 1,
+                "voters": {str(k): v for k, v in voters.items()},
+                "learners": {str(k): v for k, v in learners.items()},
+                "action": action, "target": target_id}
+        log.info("node %d: proposing %s of node %d (conf ver %d -> %d)",
+                 self.node_id, action, target_id, self.conf_ver,
+                 args["ver"])
+        self.fs._log("raft_conf", args)
+        self._conf_seq = self.last_seq()
+        if self.metrics is not None:
+            self.metrics.inc("raft.member_changes")
+        return args
+
+    async def _membership_loop(self) -> None:
+        """Metrics tick + learner auto-promotion: once a learner's match
+        lag drops below promote_lag it is proposed as a voter — by then
+        promoting it cannot stall the cluster behind a cold replica."""
+        while True:
+            await asyncio.sleep(max(self.heartbeat_ms * 2, 40) / 1000)
+            self._metrics_tick()
+            if (self.role != LEADER or not self.learners
+                    or self._transferring):
+                continue
+            if self._conf_seq is not None and \
+                    self._conf_seq > self.commit_seq:
+                continue
+            for pid in sorted(self.learners):
+                m = self.match.get(pid, 0)
+                if m > 0 and self.last_seq() - m <= self.promote_lag:
+                    try:
+                        self.propose_member_change("promote", pid)
+                    except err.CurvineError as e:
+                        log.debug("auto-promote of %d refused: %s", pid, e)
+                    break
+
+    def _metrics_tick(self) -> None:
+        m = self.metrics
+        if m is None:
+            return
+        m.gauge("raft.role", _ROLE_GAUGE.get(self.role, 0))
+        m.gauge("raft.term", self.term)
+        m.gauge("raft.commit_seq", self.commit_seq)
+        m.gauge("raft.conf_ver", self.conf_ver)
+        m.gauge("raft.voters", len(self.voters))
+        m.gauge("raft.learners", len(self.learners))
+        if self.role == LEADER:
+            last = self.last_seq()
+            for pid, mseq in self.match.items():
+                m.gauge(f"raft.match_lag.{pid}", max(0, last - mseq))
 
     # ---------------- lifecycle ----------------
 
@@ -109,7 +360,7 @@ class RaftLite:
 
     @property
     def quorum(self) -> int:
-        return (len(self.peers) + 1) // 2 + 1
+        return len(self.voters) // 2 + 1
 
     def last_seq(self) -> int:
         return self.fs.journal.seq if self.fs.journal else 0
@@ -119,7 +370,13 @@ class RaftLite:
 
     async def start(self) -> None:
         self._touch()
+        # a journaled config recovered from the hard-state file or from
+        # snapshot/WAL replay overrides the boot config (fs.recover()
+        # ran before us); ver ordering picks the newest
+        self._adopt_config(self._hs_conf)
+        self._adopt_config(getattr(self.fs, "raft_conf", None))
         self._bg.append(asyncio.ensure_future(self._election_loop()))
+        self._bg.append(asyncio.ensure_future(self._membership_loop()))
 
     async def stop(self) -> None:
         # Demote BEFORE cancelling: asyncio.wait_for swallows a
@@ -130,8 +387,9 @@ class RaftLite:
         # survivors. The role flip ends the `while self.role == LEADER`
         # loops regardless, and awaiting the tasks proves they exited.
         self.role = FOLLOWER
-        tasks = list(self._bg)
+        tasks = list(self._bg) + list(self._repl_tasks)
         self._bg.clear()
+        self._repl_tasks.clear()
         for t in tasks:
             t.cancel()
         for t in tasks:
@@ -153,14 +411,16 @@ class RaftLite:
             await asyncio.sleep(timeout / 4)
             if self.role == LEADER:
                 continue
+            if self.removed or self.node_id not in self.voters:
+                continue        # learners/removed nodes never elect
             now = asyncio.get_event_loop().time()
             if now - self._last_heard < timeout:
                 continue
             await self._run_election()
 
     async def _run_prevote(self) -> bool:
-        """Pre-vote round (raft §9.6): ask peers whether they WOULD grant
-        a vote for term+1, without bumping our term or persisting
+        """Pre-vote round (raft §9.6): ask voters whether they WOULD
+        grant a vote for term+1, without bumping our term or persisting
         anything. Peers that heard from a live leader recently refuse, so
         a partitioned node retrying elections forever keeps its term
         frozen — when the partition heals it rejoins as a follower
@@ -181,7 +441,7 @@ class RaftLite:
 
         votes = 1                         # our own
         tasks = [asyncio.ensure_future(ask(addr))
-                 for addr in self.peers.values()]
+                 for addr in self._voter_peers().values()]
         try:
             for fut in asyncio.as_completed(tasks):
                 if await fut:
@@ -193,8 +453,14 @@ class RaftLite:
                 t.cancel()
         return votes >= self.quorum
 
-    async def _run_election(self) -> None:
-        if self.peers and not await self._run_prevote():
+    async def _run_election(self, force: bool = False) -> None:
+        if self.removed or self.node_id not in self.voters:
+            return
+        # TIMEOUT_NOW (leader transfer) skips pre-vote: the live leader
+        # asked us to depose it, so "heard from a leader recently" must
+        # not veto the election
+        if (not force and self._voter_peers()
+                and not await self._run_prevote()):
             log.debug("node %d: pre-vote failed (term %d stays)",
                       self.node_id, self.term)
             return
@@ -204,6 +470,8 @@ class RaftLite:
         self._save_hard_state()
         self.leader_id = None
         votes = 1
+        if self.metrics is not None:
+            self.metrics.inc("raft.elections")
         log.info("node %d: starting election term %d (last=%d/t%d)",
                  self.node_id, self.term, self.last_seq(), self.last_term())
 
@@ -226,7 +494,7 @@ class RaftLite:
         # first and elections would live-lock).
         term_at_start = self.term
         tasks = [asyncio.ensure_future(ask(pid, addr))
-                 for pid, addr in self.peers.items()]
+                 for pid, addr in self._voter_peers().items()]
         try:
             for fut in asyncio.as_completed(tasks):
                 granted = await fut
@@ -251,24 +519,34 @@ class RaftLite:
             self._save_hard_state()
         if self.role == LEADER:
             log.info("node %d: stepping down in term %d", self.node_id, term)
-            for t in self._bg[1:]:
+            for t in self._repl_tasks:
                 t.cancel()
-            del self._bg[1:]
+            self._repl_tasks.clear()
+            self._repl_queues = {}
+            self.match = {}
+            self._conf_seq = None
             self._fail_waiters(err.NotLeader("deposed"))
         self.role = FOLLOWER
         self._touch()
 
     async def _become_leader(self) -> None:
-        log.info("node %d: leader for term %d", self.node_id, self.term)
+        log.info("node %d: leader for term %d (voters=%s learners=%s)",
+                 self.node_id, self.term, sorted(self.voters),
+                 sorted(self.learners))
         self.role = LEADER
         self.leader_id = self.node_id
-        self._repl_queues = {pid: asyncio.Queue() for pid in self.peers}
-        self.match = {pid: 0 for pid in self.peers}
-        self.commit_seq = self.last_seq() if not self.peers else 0
-        for pid, addr in self.peers.items():
-            self._bg.append(asyncio.ensure_future(
+        self._conf_seq = None
+        for t in self._repl_tasks:
+            t.cancel()
+        self._repl_tasks = []
+        targets = self.peers
+        self._repl_queues = {pid: asyncio.Queue() for pid in targets}
+        self.match = {pid: 0 for pid in targets}
+        self.commit_seq = self.last_seq() if not targets else 0
+        for pid, addr in targets.items():
+            self._repl_tasks.append(asyncio.ensure_future(
                 self._replicate_loop(pid, addr)))
-        if self.peers and self.fs.journal is not None:
+        if targets and self.fs.journal is not None:
             # term-opening no-op (raft §5.4.2): gives the new term an entry
             # that CAN be committed by counting, which transitively commits
             # every prior-term entry beneath it
@@ -280,9 +558,13 @@ class RaftLite:
     # ---------------- commit tracking (leader) ----------------
 
     def _advance_commit(self) -> None:
-        acked = sorted([self.last_seq()] + list(self.match.values()),
+        # only VOTERS count toward commit; learners replicate but their
+        # acks can never move the commit point
+        acked = sorted([self.last_seq()] +
+                       [self.match.get(pid, 0)
+                        for pid in self._voter_peers()],
                        reverse=True)
-        new_commit = acked[self.quorum - 1]
+        new_commit = acked[min(self.quorum, len(acked)) - 1]
         # Raft commit restriction: only entries of the CURRENT term may be
         # committed by replica counting (figure-8 unsafety otherwise). The
         # no-op appended at _become_leader makes this reachable right away;
@@ -295,9 +577,10 @@ class RaftLite:
             self.commit_seq = new_commit
             still = []
             for seq, fut in self._commit_waiters:
+                if fut.done():
+                    continue        # timed-out/cancelled waiter: prune
                 if seq <= self.commit_seq:
-                    if not fut.done():
-                        fut.set_result(True)
+                    fut.set_result(True)
                 else:
                     still.append((seq, fut))
             self._commit_waiters = still
@@ -322,16 +605,24 @@ class RaftLite:
         if seq <= self.commit_seq:
             return
         fut = asyncio.get_event_loop().create_future()
-        self._commit_waiters.append((seq, fut))
-        wait_s = self.commit_timeout_s
-        if deadline is not None:
-            wait_s = deadline.cap(wait_s)
+        waiter = (seq, fut)
+        self._commit_waiters.append(waiter)
         try:
-            await asyncio.wait_for(fut, wait_s)
+            await asyncio.wait_for(fut, (deadline.cap(self.commit_timeout_s)
+                                         if deadline is not None
+                                         else self.commit_timeout_s))
         except asyncio.TimeoutError:
             raise err.RpcTimeout(
                 f"seq {seq} not committed on a quorum within "
-                f"{wait_s:.1f}s") from None
+                f"{self.commit_timeout_s:.1f}s") from None
+        finally:
+            # a timed-out or cancelled waiter must not linger until its
+            # seq commits (or forever, on a deposed leader) — wait_for
+            # leaves the future done (cancelled) in both cases
+            try:
+                self._commit_waiters.remove(waiter)
+            except ValueError:
+                pass                # already released by _advance_commit
 
     # ---------------- replication (leader) ----------------
 
@@ -342,20 +633,37 @@ class RaftLite:
             return
         for q in self._repl_queues.values():
             q.put_nowait((seq, op, args, term))
+        if op == "raft_conf":
+            # the new config takes effect when APPENDED (queued above so
+            # members — including one being removed — still receive it)
+            self._adopt_config(args)
+        if len(self.voters) <= 1:
+            # sole voter (possibly with learners): quorum is self
+            self._advance_commit()
 
     async def _replicate_loop(self, pid: int, addr: str) -> None:
-        """Per-follower: heartbeats + journal entry stream + catch-up."""
-        while self.role == LEADER:
+        """Per-follower/learner: heartbeats + journal entry stream +
+        catch-up. Exits when deposed or when the member leaves the
+        active config (its queue is unhooked by _reconcile_replication)."""
+        q = self._repl_queues.get(pid)
+        if q is None:
+            return
+        while self.role == LEADER and self._repl_queues.get(pid) is q:
             batch: list = []
-            q = self._repl_queues[pid]
             try:
                 entry = await asyncio.wait_for(
                     q.get(), self.heartbeat_ms / 1000)
                 batch.append(entry)
-                while not q.empty() and len(batch) < 256:
-                    batch.append(q.get_nowait())
+                size = _rough_size(entry)
+                while (not q.empty() and len(batch) < 256
+                       and size < _BATCH_SOFT_BYTES):
+                    nxt = q.get_nowait()
+                    batch.append(nxt)
+                    size += _rough_size(nxt)
             except asyncio.TimeoutError:
                 pass          # heartbeat
+            if self.role != LEADER or self._repl_queues.get(pid) is not q:
+                return
             try:
                 conn = await self.pool.get(addr)
                 prev_seq = batch[0][0] - 1 if batch else self.last_seq()
@@ -382,7 +690,7 @@ class RaftLite:
                     # divergent/lagging log: its applied_seq must NOT
                     # count toward commit (same seq, different history)
                     await self._send_snapshot(pid, addr)
-                else:
+                elif pid in self.match:
                     self.match[pid] = max(self.match.get(pid, 0),
                                           body.get("applied_seq", 0))
                     self._advance_commit()
@@ -401,18 +709,104 @@ class RaftLite:
                 await asyncio.sleep(0.2)
 
     async def _send_snapshot(self, pid: int, addr: str) -> None:
+        """Chunked snapshot install: the state streams as bounded
+        RAFT_SNAPSHOT_CHUNK frames (resumable — the follower replies how
+        many chunks it holds, the leader continues from there) with a
+        whole-blob CRC verified before install. A namespace bigger than
+        MAX_FRAME can therefore still catch a follower up, which the
+        monolithic RAFT_SNAPSHOT blob never could."""
         state = self.fs._snapshot_state()
+        seq, lterm = self.last_seq(), self.last_term()
+        blob = msgpack.packb({"state": state}, use_bin_type=True)
+        crc = zlib.crc32(blob)
+        csize = self.snapshot_chunk_bytes
+        total = max(1, (len(blob) + csize - 1) // csize)
+        # deterministic stream id: a retransmit after a leader blip
+        # resumes the same stream instead of restarting from chunk 0
+        sid = f"{self.node_id}.{self.term}.{seq}"
         conn = await self.pool.get(addr)
-        rep = await conn.call(RpcCode.RAFT_SNAPSHOT, data=msgpack.packb({
-            "term": self.term, "leader": self.node_id,
-            "seq": self.last_seq(), "last_term": self.last_term(),
-            "state": state}, use_bin_type=True),
-            timeout=30.0)
-        body = unpack(rep.data) or {}
-        self.match[pid] = max(self.match.get(pid, 0),
-                              body.get("applied_seq", 0))
-        self._advance_commit()
-        log.info("snapshot (seq=%d) sent to %s", self.last_seq(), addr)
+        i, applied, stalls = 0, 0, 0
+        while i < total:
+            rep = await conn.call(
+                RpcCode.RAFT_SNAPSHOT_CHUNK, data=msgpack.packb({
+                    "term": self.term, "leader": self.node_id, "sid": sid,
+                    "seq": seq, "last_term": lterm, "idx": i,
+                    "total": total, "crc": crc,
+                    "data": blob[i * csize:(i + 1) * csize]},
+                    use_bin_type=True), timeout=10.0)
+            body = unpack(rep.data) or {}
+            if body.get("term", 0) > self.term:
+                self._step_down(body["term"])
+                return
+            if self.metrics is not None:
+                self.metrics.inc("raft.snapshot_chunks_sent")
+            have = int(body.get("have", i + 1))
+            applied = max(applied, int(body.get("applied_seq", 0)))
+            if have <= i:
+                # follower restarted (crc mismatch / new stream) or is
+                # rewinding us; bounded so a broken peer can't spin here
+                stalls += 1
+                if stalls > 3:
+                    raise err.AbnormalData(
+                        f"snapshot stream to node {pid} not progressing "
+                        f"(chunk {i}, follower has {have})")
+                i = max(0, have)
+                continue
+            i = have
+        if pid in self.match:
+            self.match[pid] = max(self.match.get(pid, 0), applied)
+            self._advance_commit()
+        log.info("snapshot (seq=%d, %d chunk(s), %.1f MiB) sent to %s",
+                 seq, total, len(blob) / 1048576, addr)
+
+    # ---------------- leader transfer ----------------
+
+    async def transfer_leadership(self, target: int | None = None) -> int:
+        """Graceful handoff (`cv raft transfer`): pause new writes, drain
+        the log to the target voter, then send TIMEOUT_NOW so it elects
+        immediately — bounded failover with no election-timeout gap."""
+        self.check_leader()
+        candidates = self._voter_peers()
+        if not candidates:
+            raise err.InvalidArgument("no other voter to transfer to")
+        if target is None:
+            # most-caught-up voter
+            target = max(candidates,
+                         key=lambda pid: self.match.get(pid, 0))
+        target = int(target)
+        if target not in candidates:
+            raise err.InvalidArgument(
+                f"node {target} is not a transferable voter")
+        addr = candidates[target]
+        loop = asyncio.get_event_loop()
+        give_up = loop.time() + self.transfer_timeout_s
+        log.info("node %d: transferring leadership to %d (%s)",
+                 self.node_id, target, addr)
+        self._transferring = True
+        try:
+            while self.match.get(target, 0) < self.last_seq():
+                if self.role != LEADER:
+                    raise err.NotLeader("deposed during transfer")
+                if loop.time() > give_up:
+                    raise err.RpcTimeout(
+                        f"transfer: node {target} did not catch up within "
+                        f"{self.transfer_timeout_s:.1f}s")
+                await asyncio.sleep(0.01)
+            conn = await self.pool.get(addr)
+            await conn.call(RpcCode.RAFT_TIMEOUT_NOW, data=pack({
+                "term": self.term, "leader": self.node_id,
+                "target": target}), timeout=2.0)
+            while self.role == LEADER:
+                if loop.time() > give_up:
+                    raise err.RpcTimeout(
+                        f"transfer: node {target} did not take over within "
+                        f"{self.transfer_timeout_s:.1f}s")
+                await asyncio.sleep(0.01)
+        finally:
+            self._transferring = False
+        if self.metrics is not None:
+            self.metrics.inc("raft.leader_transfers")
+        return target
 
     # ---------------- handlers (follower) ----------------
 
@@ -423,6 +817,7 @@ class RaftLite:
         if term > self.term:
             self._step_down(term)
         granted = (term >= self.term
+                   and candidate in self.voters   # removed/learner: refuse
                    and self.voted_for in (None, candidate)
                    and cand_log >= (self.last_term(), self.last_seq()))
         if granted:
@@ -444,6 +839,7 @@ class RaftLite:
             (self.election_timeout[0] / 1000)
         granted = (self.role != LEADER          # a live leader never grants
                    and not heard_recently
+                   and q.get("candidate") in self.voters
                    and q.get("term", 0) > self.term
                    and cand_log >= (self.last_term(), self.last_seq()))
         return {}, pack({"granted": granted, "term": self.term})
@@ -487,6 +883,12 @@ class RaftLite:
             nxt += 1
         if batch:
             self.fs.apply_replicated_batch(batch)
+            for _seq, op, cargs, _eterm in batch:
+                if op == "raft_conf":
+                    # effective when appended — also on followers (this
+                    # is how a removed node learns to stand down and a
+                    # promoted learner learns it may elect)
+                    self._adopt_config(cargs)
         # log-matching check: same head seq must mean same head term; a
         # follower that diverged (e.g. deposed leader with extra applied
         # entries, or a different term at the same seq) takes a snapshot
@@ -502,21 +904,142 @@ class RaftLite:
         return {}, pack({"term": self.term, "applied_seq": self.last_seq(),
                          "need_snapshot": need_snapshot})
 
+    def _snapshot_is_stale(self, snap_term: int, snap_seq: int) -> bool:
+        """True when our log is already at/past the snapshot point — a
+        delayed retransmit or duplicate install must be ACKED without
+        REPLACING newer state (same up-to-date rule the vote check uses)."""
+        return (self.last_term(), self.last_seq()) >= (snap_term, snap_seq)
+
     async def _h_snapshot(self, msg: Message, conn: ServerConn):
+        """Legacy monolithic install (pre-chunking peers); new leaders
+        send RAFT_SNAPSHOT_CHUNK streams instead."""
         q = msgpack.unpackb(bytes(msg.data), raw=False, strict_map_key=False)
         if q["term"] < self.term:
             return {}, pack({"term": self.term})
         self._touch()
+        if self._snapshot_is_stale(q.get("last_term", 0), q["seq"]):
+            return {}, pack({"term": self.term,
+                             "applied_seq": self.last_seq(),
+                             "skipped": True})
         self.fs.install_snapshot(q["state"], q["seq"],
                                  q.get("last_term", 0))
+        self._adopt_config(getattr(self.fs, "raft_conf", None))
+        if self.metrics is not None:
+            self.metrics.inc("raft.snapshot_installs")
         log.info("node %d: installed snapshot at seq %d", self.node_id,
                  q["seq"])
         return {}, pack({"term": self.term, "applied_seq": self.last_seq()})
 
+    async def _h_snapshot_chunk(self, msg: Message, conn: ServerConn):
+        """One bounded piece of a snapshot stream. Replies ``have`` (how
+        many chunks we hold) so the leader can resume/rewind; the final
+        chunk triggers CRC verification + install. Stale streams — our
+        log already at/past the snapshot point — are acked as complete
+        without installing."""
+        q = msgpack.unpackb(bytes(msg.data), raw=False, strict_map_key=False)
+        if q["term"] < self.term:
+            return {}, pack({"term": self.term, "have": 0,
+                             "applied_seq": self.last_seq()})
+        if q["term"] > self.term or self.role not in (FOLLOWER,):
+            self._step_down(q["term"])
+        self.leader_id = q["leader"]
+        self._touch()
+        total = int(q["total"])
+        if self._snapshot_is_stale(q.get("last_term", 0), q["seq"]):
+            self._snap_rx = None
+            return {}, pack({"term": self.term, "have": total,
+                             "applied_seq": self.last_seq(),
+                             "skipped": True})
+        rx = self._snap_rx
+        if rx is None or rx["sid"] != q["sid"]:
+            rx = self._snap_rx = {"sid": q["sid"], "parts": [],
+                                  "total": total}
+        idx = int(q["idx"])
+        if idx == len(rx["parts"]):
+            rx["parts"].append(bytes(q["data"]))
+        have = len(rx["parts"])
+        if have < rx["total"]:
+            return {}, pack({"term": self.term, "have": have,
+                             "applied_seq": self.last_seq()})
+        blob = b"".join(rx["parts"])
+        self._snap_rx = None
+        if zlib.crc32(blob) != q.get("crc", 0):
+            log.warning("node %d: snapshot stream %s failed CRC, "
+                        "restarting", self.node_id, q["sid"])
+            return {}, pack({"term": self.term, "have": 0,
+                             "applied_seq": self.last_seq()})
+        body = msgpack.unpackb(blob, raw=False, strict_map_key=False)
+        self.fs.install_snapshot(body["state"], q["seq"],
+                                 q.get("last_term", 0))
+        self._adopt_config(getattr(self.fs, "raft_conf", None))
+        if self.metrics is not None:
+            self.metrics.inc("raft.snapshot_installs")
+        log.info("node %d: installed chunked snapshot at seq %d "
+                 "(%d chunks, %.1f MiB)", self.node_id, q["seq"], have,
+                 len(blob) / 1048576)
+        return {}, pack({"term": self.term, "have": have,
+                         "applied_seq": self.last_seq()})
+
+    async def _h_timeout_now(self, msg: Message, conn: ServerConn):
+        """Leader-transfer trigger: elect immediately, skipping pre-vote
+        (the live leader itself asked to be deposed)."""
+        q = unpack(msg.data) or {}
+        accepted = (q.get("term", 0) >= self.term
+                    and self.node_id in self.voters
+                    and not self.removed
+                    and self.role != LEADER)
+        if accepted:
+            self._touch()
+            if self.metrics is not None:
+                self.metrics.inc("raft.timeout_now")
+            self._bg = [t for t in self._bg if not t.done()]
+            self._bg.append(asyncio.ensure_future(
+                self._run_election(force=True)))
+        return {}, pack({"accepted": accepted, "term": self.term})
+
+    async def _h_status(self, msg: Message, conn: ServerConn):
+        """RAFT_STATUS: answers on ANY node (followers included) — the
+        member-discovery RPC for clients, `cv raft status` and /api/raft."""
+        return {}, pack(self.status())
+
+    def status(self) -> dict:
+        inflight = (self._conf_seq is not None
+                    and self._conf_seq > self.commit_seq)
+        return {
+            "node_id": self.node_id,
+            "role": self.role,
+            "term": self.term,
+            "leader_id": self.leader_id,
+            "leader_addr": self._addr_of(self.leader_id),
+            "commit_seq": self.commit_seq,
+            "last_seq": self.last_seq(),
+            "conf_ver": self.conf_ver,
+            "voters": {str(k): self.voters[k] for k in sorted(self.voters)},
+            "learners": {str(k): self.learners[k]
+                         for k in sorted(self.learners)},
+            "match": ({str(k): v for k, v in sorted(self.match.items())}
+                      if self.role == LEADER else {}),
+            "removed": self.removed,
+            "transferring": self._transferring,
+            "inflight_change": bool(inflight),
+        }
+
     # ---------------- client gate ----------------
 
     def check_leader(self) -> None:
-        if self.role != LEADER:
-            raise err.NotLeader(
+        if self.role == LEADER and not self._transferring:
+            return
+        if self.role == LEADER:
+            e = err.NotLeader(
+                f"node {self.node_id}: leadership transfer in progress")
+        else:
+            e = err.NotLeader(
                 f"node {self.node_id} is {self.role}; "
                 f"leader is {self.leader_id}")
+            hint = self._addr_of(self.leader_id)
+            if hint:
+                e.leader_hint = hint
+        members = [a for a in self.voters.values() if a]
+        if members:
+            e.members = members
+        raise e

@@ -134,7 +134,17 @@ class MasterServer:
             from curvine_tpu.master.ha import RaftLite
             peers = {i + 1: addr for i, addr in enumerate(mc.raft_peers)
                      if i + 1 != mc.raft_node_id}
-            self.raft = RaftLite(mc.raft_node_id, peers, self.fs, self.rpc)
+            if 0 < mc.raft_node_id <= len(mc.raft_peers):
+                self_addr = mc.raft_peers[mc.raft_node_id - 1]
+            else:
+                self_addr = f"{mc.hostname}:{mc.rpc_port}"
+            self.raft = RaftLite(
+                mc.raft_node_id, peers, self.fs, self.rpc,
+                self_addr=self_addr, learner=mc.raft_learner,
+                promote_lag=mc.raft_promote_lag,
+                snapshot_chunk_bytes=mc.raft_snapshot_chunk_mb * 1024 * 1024,
+                transfer_timeout_s=mc.raft_transfer_timeout_ms / 1000,
+                metrics=self.metrics)
             self.fs.on_mutation = self.raft.on_mutation
         self.shards = None
         if self.sharded:
@@ -360,6 +370,14 @@ class MasterServer:
         r(C.SHARD_STATS, self._h(self._shard_stats))
         r(C.SHARD_TABLE, self._h(self._shard_table))
         r(C.TENANT_STATS, self._h(self._tenant_stats))
+        # raft membership admin plane (docs/raft.md). MEMBER_CHANGE rides
+        # the mutate path: leader gate + journaled config entry + commit
+        # barrier (the RPC acks once the change is committed). TRANSFER
+        # does its own leader gate and journals nothing. RAFT_STATUS is
+        # registered by RaftLite itself so ANY node answers it.
+        r(C.RAFT_MEMBER_CHANGE, self._h(self._raft_member_change,
+                                        mutate=True))
+        r(C.RAFT_TRANSFER, self._h(self._raft_transfer))
 
     def _register_shard_routes(self) -> None:
         """meta_shards>1: this endpoint is a thin router. Namespace
@@ -762,6 +780,24 @@ class MasterServer:
 
     def _tenant_stats(self, q):
         return self.qos.snapshot()
+
+    def _raft_member_change(self, q):
+        """cv raft add/remove (+ the auto-promote path when driven by
+        hand): journal a single-server membership change. The mutate
+        wrapper's commit barrier makes the ack mean 'config committed'."""
+        if self.raft is None:
+            from curvine_tpu.common import errors as cerr
+            raise cerr.Unsupported("raft is not enabled on this master")
+        return self.raft.propose_member_change(
+            q.get("action", ""), q.get("node_id", 0), q.get("addr", ""))
+
+    async def _raft_transfer(self, q):
+        """cv raft transfer: drain to the target voter + TIMEOUT_NOW."""
+        if self.raft is None:
+            from curvine_tpu.common import errors as cerr
+            raise cerr.Unsupported("raft is not enabled on this master")
+        target = await self.raft.transfer_leadership(q.get("target"))
+        return {"target": target}
 
     def _set_attr(self, q):
         opts = SetAttrOpts.from_wire(q.get("opts", {}))

@@ -112,6 +112,17 @@ class RpcCode(enum.IntEnum):
     # probes for electability WITHOUT bumping its term, so a partitioned
     # node rejoining cannot depose a healthy leader with inflated terms
     RAFT_PREVOTE = 93
+    # membership lifecycle (docs/raft.md). SNAPSHOT_CHUNK streams the
+    # state in bounded, resumable pieces with a final CRC (RAFT_SNAPSHOT
+    # remains the legacy monolithic path for states under one chunk);
+    # TIMEOUT_NOW is the leader-transfer trigger (§3.10: target skips
+    # pre-vote and elects immediately); STATUS answers on any node;
+    # MEMBER_CHANGE/TRANSFER are the leader-side admin entry points.
+    RAFT_SNAPSHOT_CHUNK = 94
+    RAFT_TIMEOUT_NOW = 95
+    RAFT_STATUS = 96
+    RAFT_MEMBER_CHANGE = 97
+    RAFT_TRANSFER = 98
 
     # TPU extensions
     HBM_PIN = 100        # pin a cached block into the HBM tier

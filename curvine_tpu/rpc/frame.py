@@ -90,6 +90,13 @@ class Message:
                 # server-supplied backoff hint (THROTTLED): the retry
                 # policy prefers it over its own exponential backoff
                 e.retry_after_ms = int(ra)
+            hint = self.header.get("leader_hint")
+            if hint:
+                # NOT_LEADER redirect: where the current leader lives
+                e.leader_hint = str(hint)
+            members = self.header.get("members")
+            if members:
+                e.members = list(members)
             raise e
         return self
 
@@ -162,6 +169,12 @@ def error_for(req: Message, err: Exception) -> Message:
     ra = getattr(err, "retry_after_ms", None)
     if ra is not None:
         header["retry_after_ms"] = int(ra)
+    hint = getattr(err, "leader_hint", None)
+    if hint:
+        header["leader_hint"] = str(hint)
+    members = getattr(err, "members", None)
+    if members:
+        header["members"] = list(members)
     return Message(code=req.code, req_id=req.req_id, status=STATUS_ERROR,
                    flags=Flags.RESPONSE | Flags.EOF, header=header)
 

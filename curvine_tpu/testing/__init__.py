@@ -1,4 +1,5 @@
-from curvine_tpu.testing.cluster import MiniCluster
+from curvine_tpu.testing.cluster import MiniCluster, MiniRaftCluster
 from curvine_tpu.testing.storm import ChaosStorm, StormReport, run_storm
 
-__all__ = ["MiniCluster", "ChaosStorm", "StormReport", "run_storm"]
+__all__ = ["MiniCluster", "MiniRaftCluster", "ChaosStorm", "StormReport",
+           "run_storm"]

@@ -9,6 +9,7 @@ from __future__ import annotations
 import asyncio
 import copy
 import os
+import shutil
 import tempfile
 
 from curvine_tpu.common.conf import ClusterConf, TierConf
@@ -115,6 +116,189 @@ class MiniCluster:
             self.master = None
 
     async def __aenter__(self) -> "MiniCluster":
+        return await self.start()
+
+    async def __aexit__(self, et, ev, tb) -> None:
+        await self.stop()
+
+
+class MiniRaftCluster:
+    """N raft masters (no workers) plus pre-allocated spare ports for
+    membership-lifecycle tests: add-learner → auto-promote → transfer →
+    remove, with kill/restart of individual nodes. Shared by
+    tests/test_raft.py and testing/storm.py membership storms so storm
+    events and the e2e lifecycle test drive the exact same helpers."""
+
+    def __init__(self, n: int = 3, base_dir: str | None = None,
+                 spares: int = 2, election_timeout=(150, 300),
+                 heartbeat_ms: int = 50, promote_lag: int = 64,
+                 snapshot_chunk_mb: int = 4):
+        self.n = n
+        self.spares = spares
+        self.base_dir = base_dir or tempfile.mkdtemp(prefix="curvine-raft-")
+        self.election_timeout = election_timeout
+        self.heartbeat_ms = heartbeat_ms
+        self.promote_lag = promote_lag
+        self.snapshot_chunk_mb = snapshot_chunk_mb
+        # ports for initial voters AND future learners, allocated up
+        # front so every node's address is known before it exists
+        self._probe_ports()
+        self.masters: dict[int, MasterServer] = {}   # node_id -> live server
+        self.confs: dict[int, ClusterConf] = {}
+        self._next_id = n + 1
+        self._clients: list[CurvineClient] = []
+
+    def _conf_for(self, node_id: int, learner: bool = False) -> ClusterConf:
+        conf = ClusterConf()
+        conf.master.hostname = "127.0.0.1"
+        conf.master.rpc_port = self.ports[node_id - 1]
+        conf.master.journal_dir = os.path.join(self.base_dir,
+                                               f"j{node_id - 1}")
+        # a learner's peer list includes itself at its own slot so
+        # RaftLite knows self_addr; voters come from the config entry
+        conf.master.raft_peers = self.addrs[:max(self.n, node_id)]
+        conf.master.raft_node_id = node_id
+        conf.master.raft_learner = learner
+        conf.master.raft_promote_lag = self.promote_lag
+        conf.master.raft_snapshot_chunk_mb = self.snapshot_chunk_mb
+        conf.client.master_addrs = self.addrs[:self.n]
+        return conf
+
+    async def _start_node(self, node_id: int,
+                          learner: bool = False) -> MasterServer:
+        conf = self.confs.get(node_id) or self._conf_for(node_id, learner)
+        self.confs[node_id] = conf
+        m = MasterServer(conf)
+        m.raft.election_timeout = self.election_timeout
+        m.raft.heartbeat_ms = self.heartbeat_ms
+        await m.start()
+        self.masters[node_id] = m
+        return m
+
+    def _probe_ports(self) -> None:
+        import socket
+        socks = []
+        for _ in range(self.n + self.spares):
+            s = socket.socket()
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        self.addrs = [f"127.0.0.1:{s.getsockname()[1]}" for s in socks]
+        self.ports = [s.getsockname()[1] for s in socks]
+        for s in socks:
+            s.close()
+
+    async def start(self) -> "MiniRaftCluster":
+        # probe-then-close port allocation races with ephemeral ports
+        # handed to concurrent outbound connects; before any node holds
+        # state we can simply re-probe everything and try again
+        import errno
+        for attempt in range(3):
+            try:
+                for nid in range(1, self.n + 1):
+                    await self._start_node(nid)
+                return self
+            except OSError as e:
+                if e.errno != errno.EADDRINUSE or attempt == 2:
+                    raise
+                await self.stop()
+                self.confs.clear()
+                for nid in range(1, self.n + 1):
+                    shutil.rmtree(os.path.join(self.base_dir,
+                                               f"j{nid - 1}"),
+                                  ignore_errors=True)
+                self._probe_ports()
+        return self
+
+    def leader(self) -> MasterServer | None:
+        from curvine_tpu.master.ha import LEADER
+        leaders = [m for m in self.masters.values()
+                   if m.raft is not None and m.raft.role == LEADER]
+        return leaders[0] if len(leaders) == 1 else None
+
+    async def wait_leader(self, timeout: float = 10.0) -> MasterServer:
+        async def wait():
+            while True:
+                l = self.leader()
+                if l is not None:
+                    return l
+                await asyncio.sleep(0.05)
+        return await asyncio.wait_for(wait(), timeout)
+
+    def client(self, **client_overrides) -> CurvineClient:
+        conf = ClusterConf()
+        conf.client.master_addrs = list(self.addrs[:self.n])
+        conf.client.conn_retry_max = 10
+        conf.client.conn_retry_base_ms = 100
+        conf.client.rpc_timeout_ms = 5_000
+        for k, v in client_overrides.items():
+            setattr(conf.client, k, v)
+        c = CurvineClient(conf)
+        self._clients.append(c)
+        return c
+
+    async def _admin(self) -> CurvineClient:
+        if not self._clients:
+            self.client()
+        return self._clients[0]
+
+    async def add_learner(self) -> int:
+        """Start the next spare as a learner and journal ADD_LEARNER on
+        the leader. Returns the new node id; promotion to voter happens
+        automatically once its match lag drops under promote_lag."""
+        node_id = self._next_id
+        if node_id > len(self.addrs):
+            raise RuntimeError("no spare ports left for a new learner")
+        self._next_id += 1
+        await self._start_node(node_id, learner=True)
+        c = await self._admin()
+        await c.meta.raft_member_change("add_learner", node_id,
+                                        self.addrs[node_id - 1])
+        return node_id
+
+    async def wait_promoted(self, node_id: int,
+                            timeout: float = 30.0) -> None:
+        """Wait until every live node sees node_id as a voter."""
+        async def wait():
+            while True:
+                live = [m for m in self.masters.values()
+                        if m.rpc._server is not None]
+                if live and all(node_id in m.raft.voters for m in live):
+                    return
+                await asyncio.sleep(0.05)
+        await asyncio.wait_for(wait(), timeout)
+
+    async def remove_node(self, node_id: int, stop: bool = True) -> None:
+        c = await self._admin()
+        await c.meta.raft_member_change("remove", node_id)
+        if stop and node_id in self.masters:
+            m = self.masters.pop(node_id)
+            if m.rpc._server is not None:
+                await m.stop()
+
+    async def transfer(self, target: int | None = None) -> int:
+        c = await self._admin()
+        return await c.meta.raft_transfer(target)
+
+    async def kill(self, node_id: int) -> None:
+        m = self.masters.pop(node_id, None)
+        if m is not None and m.rpc._server is not None:
+            await m.stop()
+
+    async def restart(self, node_id: int) -> MasterServer:
+        await self.kill(node_id)
+        return await self._start_node(node_id)
+
+    async def stop(self) -> None:
+        for c in self._clients:
+            await c.close()
+        self._clients.clear()
+        for m in list(self.masters.values()):
+            if m.rpc._server is not None:
+                await m.stop()
+        self.masters.clear()
+
+    async def __aenter__(self) -> "MiniRaftCluster":
         return await self.start()
 
     async def __aexit__(self, et, ev, tb) -> None:

@@ -943,3 +943,243 @@ class TenantStorm:
 async def run_tenant_storm(seed: int, **kw) -> TenantStormReport:
     """One-call entry point for the abusive-tenant storm."""
     return await TenantStorm(seed, **kw).run()
+
+
+# ---------------------------------------------------------------------------
+# Membership storm: config churn under writes (docs/raft.md)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class MembershipStormReport:
+    """Outcome of a MembershipStorm run. Headline invariants: at most
+    one leader per term across every sample, every ACKED write survives
+    the churn, a removed node is never observed leading after its
+    removal was acknowledged, and the cluster converges on a leader
+    once the storm quiesces."""
+    seed: int
+    events: list[dict] = field(default_factory=list)
+    acked: int = 0
+    lost: list[str] = field(default_factory=list)
+    multi_leader_terms: list[int] = field(default_factory=list)
+    removed_leader_violations: list[str] = field(default_factory=list)
+    samples: int = 0
+    final_voters: int = 0
+    final_conf_ver: int = 0
+    converged: bool = False
+    elapsed_s: float = 0.0
+
+    def assert_invariants(self) -> None:
+        problems = []
+        if self.multi_leader_terms:
+            problems.append(
+                f"terms with >1 leader: {self.multi_leader_terms}")
+        if self.lost:
+            problems.append(f"ACKED writes lost: {self.lost[:5]}"
+                            + ("..." if len(self.lost) > 5 else ""))
+        if self.removed_leader_violations:
+            problems.append("removed node observed leading: "
+                            + "; ".join(self.removed_leader_violations))
+        if not self.converged:
+            problems.append("no single leader after quiesce")
+        if self.acked == 0:
+            problems.append("no writes were acked (harness bug)")
+        assert not problems, (
+            f"membership storm seed={self.seed} invariants violated: "
+            + "; ".join(problems))
+
+
+class MembershipStorm:
+    """Seeded membership churn over a MiniRaftCluster while a writer
+    streams mutations: add-learner (with chunked snapshot catch-up +
+    auto-promotion), voter removal, leader transfer, and leader
+    kill/restart. Event guards never schedule a change that would drop
+    the cluster below quorum on its own — the point is to prove the
+    config-change machinery itself never loses availability or acked
+    data, not to prove that a majorityless cluster stalls (it must,
+    and the ChaosStorm covers crash-quorum loss)."""
+
+    def __init__(self, seed: int, n: int = 3, events: int = 8,
+                 event_interval_s: float = 0.4,
+                 base_dir: str | None = None,
+                 overall_timeout_s: float = 90.0):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.n = n
+        self.n_events = events
+        self.event_interval_s = event_interval_s
+        self.base_dir = base_dir
+        self.overall_timeout_s = overall_timeout_s
+        self.report = MembershipStormReport(seed=seed)
+        self._stop = False
+        self._acked: list[str] = []
+        self._killed: list[int] = []
+        self._removed: dict[int, float] = {}     # node_id -> remove ack t
+        self._leaders_by_term: dict[int, set[int]] = {}
+
+    async def _writer(self, c) -> None:
+        i = 0
+        while not self._stop:
+            path = f"/mstorm/d{i:04d}"
+            try:
+                await c.meta.mkdir(path)
+                self._acked.append(path)
+            except _EXPECTED:
+                pass                 # unacked: allowed to be lost
+            i += 1
+            await asyncio.sleep(0.02)
+
+    async def _monitor(self, cluster) -> None:
+        """Sample every live node's raft view ~40x/s: per-term leader
+        sets (raft safety: |set| must stay 1) and removed-node roles."""
+        from curvine_tpu.master.ha import LEADER
+        while not self._stop:
+            now = time.monotonic()
+            for nid, m in list(cluster.masters.items()):
+                try:
+                    if m.rpc._server is None or m.raft is None:
+                        continue
+                    r = m.raft
+                    if r.role != LEADER:
+                        continue
+                    self.report.samples += 1
+                    self._leaders_by_term.setdefault(r.term, set()).add(nid)
+                    t_rm = self._removed.get(nid)
+                    # small grace: the REMOVE ack races the node's own
+                    # config adoption by at most one append round-trip
+                    if t_rm is not None and now - t_rm > 0.5:
+                        self.report.removed_leader_violations.append(
+                            f"node {nid} led term {r.term} "
+                            f"{now - t_rm:.2f}s after removal")
+                except _EXPECTED:
+                    pass             # node stopping under the sampler
+            await asyncio.sleep(0.025)
+
+    def _pick_event(self, cluster) -> str | None:
+        leader = cluster.leader()
+        if leader is None:
+            return None              # mid-election: skip this tick
+        voters = dict(leader.raft.voters)
+        live_voters = [v for v in voters
+                       if v in cluster.masters and v not in self._killed]
+        choices = []
+        if cluster._next_id <= len(cluster.addrs) and not self._killed:
+            choices.append("add")
+        removable = [v for v in voters
+                     if v != leader.raft.node_id
+                     and v not in self._killed and v not in self._removed]
+        if len(voters) >= 4 and removable:
+            choices.append("remove")
+        if len(live_voters) >= 2:
+            choices.append("transfer")
+        # killing the leader must leave a quorum of live voters
+        if not self._killed and \
+                len(live_voters) - 1 >= len(voters) // 2 + 1:
+            choices.append("kill_leader")
+        if self._killed:
+            choices.append("restart")
+        return self.rng.choice(choices) if choices else None
+
+    async def _apply_event(self, cluster, action: str) -> dict:
+        ev = {"action": action, "ok": True}
+        leader = cluster.leader()
+        if action == "add":
+            nid = await cluster.add_learner()
+            ev["node"] = nid
+        elif action == "remove":
+            voters = dict(leader.raft.voters)
+            cands = sorted(v for v in voters
+                           if v != leader.raft.node_id
+                           and v not in self._killed
+                           and v not in self._removed)
+            target = self.rng.choice(cands)
+            # keep the removed node RUNNING: the invariant is that it
+            # never wins another election, not that a dead node is quiet
+            await cluster.remove_node(target, stop=False)
+            self._removed[target] = time.monotonic()
+            ev["node"] = target
+        elif action == "transfer":
+            ev["node"] = await cluster.transfer()
+        elif action == "kill_leader":
+            nid = leader.raft.node_id
+            await cluster.kill(nid)
+            self._killed.append(nid)
+            ev["node"] = nid
+        elif action == "restart":
+            nid = self._killed.pop(0)
+            await cluster.restart(nid)
+            ev["node"] = nid
+        return ev
+
+    async def run(self) -> MembershipStormReport:
+        from curvine_tpu.testing.cluster import MiniRaftCluster
+        t_start = time.monotonic()
+        cluster = MiniRaftCluster(n=self.n, base_dir=self.base_dir)
+        await cluster.start()
+        try:
+            await asyncio.wait_for(self._run(cluster),
+                                   self.overall_timeout_s)
+        finally:
+            self._stop = True
+            try:
+                await asyncio.wait_for(cluster.stop(), 30.0)
+            except asyncio.TimeoutError:
+                raise AssertionError(
+                    f"membership storm seed={self.seed}: cluster stop "
+                    "WEDGED; task stacks:\n"
+                    + _dump_task_stacks()) from None
+        self.report.elapsed_s = time.monotonic() - t_start
+        return self.report
+
+    async def _run(self, cluster) -> None:
+        await cluster.wait_leader()
+        c = cluster.client()
+        writer = asyncio.ensure_future(self._writer(c))
+        monitor = asyncio.ensure_future(self._monitor(cluster))
+        try:
+            for _ in range(self.n_events):
+                await asyncio.sleep(self.event_interval_s)
+                action = self._pick_event(cluster)
+                if action is None:
+                    self.report.events.append({"action": "skip-no-leader"})
+                    continue
+                try:
+                    self.report.events.append(
+                        await self._apply_event(cluster, action))
+                except _EXPECTED as e:
+                    # a change refused mid-churn (in-flight config, a
+                    # NOT_LEADER race, transfer timeout) is expected —
+                    # recorded, never fatal
+                    self.report.events.append(
+                        {"action": action, "ok": False, "error": str(e)})
+            # ---- quiesce: heal, converge, verify ----
+            for nid in list(self._killed):
+                await cluster.restart(nid)
+                self._killed.remove(nid)
+            leader = await cluster.wait_leader(15.0)
+            self._stop = True
+            await asyncio.gather(writer, monitor,
+                                 return_exceptions=True)
+            # a fresh end-to-end mutation proves the post-churn config
+            # still commits (and barriers behind everything acked)
+            await c.meta.mkdir("/mstorm/final")
+            leader = await cluster.wait_leader(15.0)
+            self.report.converged = True
+            self.report.acked = len(self._acked)
+            self.report.lost = [
+                p for p in self._acked
+                if leader.fs.tree.resolve(p) is None]
+            self.report.multi_leader_terms = sorted(
+                t for t, s in self._leaders_by_term.items() if len(s) > 1)
+            self.report.final_voters = len(leader.raft.voters)
+            self.report.final_conf_ver = leader.raft.conf_ver
+        finally:
+            self._stop = True
+            for t in (writer, monitor):
+                if not t.done():
+                    t.cancel()
+            await asyncio.gather(writer, monitor, return_exceptions=True)
+
+
+async def run_membership_storm(seed: int, **kw) -> MembershipStormReport:
+    """One-call entry point for the raft membership-churn storm."""
+    return await MembershipStorm(seed, **kw).run()

@@ -73,6 +73,7 @@ class WebServer:
         r.add_get("/api/blocks", self._blocks)
         r.add_get("/api/shards", self._shards)
         r.add_get("/api/tenants", self._tenants)
+        r.add_get("/api/raft", self._raft)
         # mutation plane (parity: curvine-web/src/router/load_handler.rs
         # submit_loading_task): REST load-job submission + cancel
         r.add_post("/api/load", self._submit_load)
@@ -236,6 +237,15 @@ class WebServer:
         if qos is None:
             return self._json({"enabled": False, "tenants": {}})
         return self._json(qos.snapshot())
+
+    async def _raft(self, req):
+        """Raft membership view (master/ha.py): role, term, voters,
+        learners and — on the leader — per-peer match progress."""
+        raft = getattr(self.master, "raft", None) \
+            if self.master is not None else None
+        if raft is None:
+            return self._json({"enabled": False})
+        return self._json({"enabled": True, **raft.status()})
 
     async def _browse(self, req):
         if self.master is None:

@@ -1,11 +1,13 @@
 #!/bin/bash
 # Chaos-storm smoke gate (<2min): run the deterministic-seed storms —
 # including the disk-fault seeds (bitflip/EIO/ENOSPC injection, with the
-# no-corrupt-bytes-observed and quarantine-evacuation invariants) and
-# the abusive-tenant QoS storm (victim p99 contained, abuser mostly
-# THROTTLED, shed-before-queue held) — plus the deadline/breaker
-# acceptance tests from tests/test_storm.py and fail on any invariant
-# violation. Mirrors scripts/perf_smoke.sh.
+# no-corrupt-bytes-observed and quarantine-evacuation invariants), the
+# abusive-tenant QoS storm (victim p99 contained, abuser mostly
+# THROTTLED, shed-before-queue held), and the raft membership-churn
+# seeds (add-learner/remove/transfer/leader-kill under writes: ≤1
+# leader per term, zero acked-write loss, removed node never leads) —
+# plus the deadline/breaker acceptance tests from tests/test_storm.py
+# and fail on any invariant violation. Mirrors scripts/perf_smoke.sh.
 #
 # Usage: scripts/storm_smoke.sh [project_root]
 #   STORM_RAFT_REPEAT=N   additionally run the raft election/storm tests
@@ -38,7 +40,7 @@ if [ "${STORM_RAFT_REPEAT:-0}" -gt 1 ]; then
     timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest -q \
         -p no:cacheprovider -p no:xdist -p no:randomly \
         --repeat "$STORM_RAFT_REPEAT" \
-        tests/test_raft.py -k "storm or prevote or failover"
+        tests/test_raft.py -k "storm or prevote or failover or membership"
     rc=$?
     if [ $rc -ne 0 ]; then
         echo "storm_smoke: FAIL — raft storm repeat found a flake (rc=$rc)" >&2

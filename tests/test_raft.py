@@ -17,35 +17,48 @@ MB = 1024 * 1024
 
 
 async def _make_ha_cluster(tmp_path, n=3):
-    """n masters with raft; ports pre-allocated."""
+    """n masters with raft; ports pre-allocated.
+
+    Probe-then-close port allocation races with ephemeral ports handed
+    to concurrent outbound connects, so a bind collision retries the
+    whole cluster with fresh ports (fresh journal dirs too — a partial
+    first attempt may already have written hard state for old peers)."""
+    import errno
     import socket
-    ports = []
-    socks = []
-    for _ in range(n):
-        s = socket.socket()
-        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        s.bind(("127.0.0.1", 0))
-        ports.append(s.getsockname()[1])
-        socks.append(s)
-    for s in socks:
-        s.close()
-    addrs = [f"127.0.0.1:{p}" for p in ports]
-    masters = []
-    for i in range(n):
-        conf = ClusterConf()
-        conf.master.hostname = "127.0.0.1"
-        conf.master.rpc_port = ports[i]
-        conf.master.journal_dir = str(tmp_path / f"j{i}")
-        conf.master.raft_peers = addrs
-        conf.master.raft_node_id = i + 1
-        conf.client.master_addrs = addrs
-        m = MasterServer(conf)
-        # fast elections for tests
-        m.raft.election_timeout = (150, 300)
-        m.raft.heartbeat_ms = 50
-        await m.start()
-        masters.append(m)
-    return masters, addrs
+    for attempt in range(3):
+        ports = []
+        socks = []
+        for _ in range(n):
+            s = socket.socket()
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+            socks.append(s)
+        for s in socks:
+            s.close()
+        addrs = [f"127.0.0.1:{p}" for p in ports]
+        masters = []
+        try:
+            for i in range(n):
+                conf = ClusterConf()
+                conf.master.hostname = "127.0.0.1"
+                conf.master.rpc_port = ports[i]
+                conf.master.journal_dir = str(tmp_path / f"a{attempt}-j{i}")
+                conf.master.raft_peers = addrs
+                conf.master.raft_node_id = i + 1
+                conf.client.master_addrs = addrs
+                m = MasterServer(conf)
+                # fast elections for tests
+                m.raft.election_timeout = (150, 300)
+                m.raft.heartbeat_ms = 50
+                await m.start()
+                masters.append(m)
+            return masters, addrs
+        except OSError as e:
+            if e.errno != errno.EADDRINUSE or attempt == 2:
+                raise
+            for m in masters:
+                await m.stop()
 
 
 async def _wait_leader(masters, timeout=10.0):
@@ -287,7 +300,7 @@ async def test_hard_state_survives_restart(tmp_path):
         # simulate restart: a fresh RaftLite over the same state dir
         from curvine_tpu.master.ha import RaftLite
         reloaded = RaftLite(99, {}, follower.fs, follower.rpc,
-                            state_dir=str(tmp_path / f"j{masters.index(follower)}"))
+                            state_dir=follower.conf.master.journal_dir)
         assert reloaded.term == term
         assert reloaded.voted_for == voted
     finally:
@@ -383,6 +396,309 @@ async def test_workers_heartbeat_all_masters(tmp_path):
     finally:
         if worker is not None:
             await worker.stop()
+        for m in masters:
+            if m.rpc._server is not None:
+                await m.stop()
+
+
+# ---------------------------------------------------------------------
+# membership lifecycle (docs/raft.md): learner -> promote -> transfer ->
+# remove, chunked snapshot install, hard-state voting, waiter hygiene
+# ---------------------------------------------------------------------
+
+async def test_membership_lifecycle_e2e(tmp_path):
+    """The full lifecycle under concurrent writes: grow 3 -> 5 voters
+    through the learner path (chunked snapshot + log tail + auto
+    promotion), transfer leadership off the original leader, remove it —
+    with ZERO acked-write loss and the removed node refused votes."""
+    from curvine_tpu.rpc.frame import Message, pack, unpack
+    from curvine_tpu.testing.cluster import MiniRaftCluster
+    cluster = MiniRaftCluster(n=3, spares=2, base_dir=str(tmp_path))
+    await cluster.start()
+    try:
+        leader = await cluster.wait_leader()
+        old_leader_id = leader.raft.node_id
+        c = cluster.client()
+        acked: list[int] = []
+        stop = {"v": False}
+
+        async def writer():
+            i = 0
+            while not stop["v"]:
+                try:
+                    await c.meta.mkdir(f"/life/d{i:04d}")
+                    acked.append(i)
+                except Exception:
+                    pass            # unacked: allowed to be lost
+                i += 1
+                await asyncio.sleep(0.01)
+
+        wtask = asyncio.ensure_future(writer())
+        while len(acked) < 10:
+            await asyncio.sleep(0.01)
+        # ---- grow 3 -> 5: each node joins as a LEARNER and is
+        # auto-promoted once its match lag drops under promote_lag ----
+        n4 = await cluster.add_learner()
+        await cluster.wait_promoted(n4)
+        n5 = await cluster.add_learner()
+        await cluster.wait_promoted(n5)
+        leader = await cluster.wait_leader()
+        assert len(leader.raft.voters) == 5
+        assert not leader.raft.learners
+        # ---- graceful handoff, then remove the original leader ----
+        new_leader_id = await cluster.transfer()
+        assert new_leader_id != old_leader_id
+
+        async def took_over():
+            while True:
+                l = cluster.leader()
+                if l is not None and l.raft.node_id == new_leader_id:
+                    return l
+                await asyncio.sleep(0.02)
+        await asyncio.wait_for(took_over(), 10)
+        # keep the removed node RUNNING: it must stand down by itself
+        await cluster.remove_node(old_leader_id, stop=False)
+        removed = cluster.masters[old_leader_id]
+
+        async def saw_removal():
+            while not removed.raft.removed:
+                await asyncio.sleep(0.02)
+        await asyncio.wait_for(saw_removal(), 10)
+        stop["v"] = True
+        await wtask
+
+        leader = await cluster.wait_leader()
+        assert old_leader_id not in leader.raft.voters
+        assert len(leader.raft.voters) == 4
+        # zero acked-write loss through the whole churn
+        missing = [i for i in acked
+                   if leader.fs.tree.resolve(f"/life/d{i:04d}") is None]
+        assert not missing, f"ACKED writes lost: {missing[:5]}"
+        # peers refuse the removed node's votes even with a perfect log
+        voter = next(m for nid, m in cluster.masters.items()
+                     if nid != old_leader_id
+                     and m.raft.role != LEADER)
+        msg = Message(data=pack({"term": voter.raft.term + 1,
+                                 "candidate": old_leader_id,
+                                 "last_seq": 10**9, "last_term": 10**9}))
+        _, rep = await voter.raft._h_vote(msg, None)
+        assert not unpack(rep)["granted"], \
+            "a voter granted a removed node's vote request"
+    finally:
+        await cluster.stop()
+
+
+async def test_chunked_snapshot_install_over_max_frame(tmp_path):
+    """A namespace bigger than MAX_FRAME must still catch a follower up:
+    the state streams as bounded RAFT_SNAPSHOT_CHUNK frames (the
+    monolithic blob could never fit one frame)."""
+    import msgpack as _mp
+    from curvine_tpu.common.types import SetAttrOpts
+    from curvine_tpu.rpc.frame import MAX_FRAME
+    from curvine_tpu.testing.cluster import MiniRaftCluster
+    cluster = MiniRaftCluster(n=3, spares=0, base_dir=str(tmp_path))
+    await cluster.start()
+    try:
+        leader = await cluster.wait_leader()
+        c = cluster.client()
+        await c.meta.mkdir("/fat")
+        victim = next(nid for nid in cluster.masters
+                      if nid != leader.raft.node_id)
+        await cluster.kill(victim)
+        # fatten the namespace past one frame while the victim is down
+        pad = "x" * (8 * MB)
+        for i in range(9):
+            await c.meta.create_file(f"/fat/f{i}")
+            await c.meta.set_attr(f"/fat/f{i}",
+                                  SetAttrOpts(add_x_attr={"pad": pad}))
+        blob = _mp.packb({"state": leader.fs._snapshot_state()},
+                         use_bin_type=True)
+        assert len(blob) > MAX_FRAME, \
+            f"test state too small to exercise chunking: {len(blob)}"
+        # hand leadership to the live follower: its FRESH replicate loop
+        # has nothing queued for the victim, so catch-up must go through
+        # the snapshot path — which now has to chunk
+        new_leader_id = await cluster.transfer()
+        new_leader = cluster.masters[new_leader_id]
+        await cluster.restart(victim)
+
+        async def caught_up():
+            while True:
+                m = cluster.masters.get(victim)
+                if m is not None:
+                    node = m.fs.tree.resolve("/fat/f8")
+                    if node is not None and len(
+                            node.x_attr.get("pad", "")) == 8 * MB:
+                        return
+                await asyncio.sleep(0.1)
+        await asyncio.wait_for(caught_up(), 60)
+        sent = new_leader.metrics.counters.get(
+            "raft.snapshot_chunks_sent", 0)
+        installs = cluster.masters[victim].metrics.counters.get(
+            "raft.snapshot_installs", 0)
+        assert sent > 1, f"snapshot was not chunked ({sent} chunk sends)"
+        assert installs >= 1, "follower never installed the stream"
+    finally:
+        await cluster.stop()
+
+
+async def test_stale_snapshot_install_is_skipped(tmp_path):
+    """A delayed retransmit / duplicate snapshot whose point is at or
+    behind the follower's log must be ACKED without installing — it
+    used to REPLACE newer state wholesale."""
+    import msgpack as _mp
+    from curvine_tpu.rpc.frame import Message, unpack as _unpack
+    masters, addrs = await _make_ha_cluster(tmp_path)
+    try:
+        leader = await _wait_leader(masters)
+        conf = ClusterConf()
+        conf.client.master_addrs = addrs
+        c = CurvineClient(conf)
+        await c.meta.mkdir("/keep/me")
+
+        follower = next(m for m in masters if m is not leader)
+
+        async def wait_repl():
+            while follower.fs.tree.resolve("/keep/me") is None:
+                await asyncio.sleep(0.05)
+        await asyncio.wait_for(wait_repl(), 10)
+
+        r = follower.raft
+        stale = {"term": r.term, "leader": leader.raft.node_id,
+                 "seq": max(0, r.last_seq() - 1),
+                 "last_term": r.last_term(),
+                 "state": {"bogus": True}}
+        # legacy monolithic path
+        _, rep = await r._h_snapshot(
+            Message(data=_mp.packb(stale, use_bin_type=True)), None)
+        body = _unpack(rep)
+        assert body.get("skipped"), "stale monolithic install not skipped"
+        assert follower.fs.tree.resolve("/keep/me") is not None
+        # chunked path: same stale point, single chunk
+        stale_chunk = dict(stale, sid="9.9.9", idx=0, total=1, crc=0,
+                           data=b"x")
+        _, rep = await r._h_snapshot_chunk(
+            Message(data=_mp.packb(stale_chunk, use_bin_type=True)), None)
+        body = _unpack(rep)
+        assert body.get("skipped"), "stale chunked install not skipped"
+        assert follower.fs.tree.resolve("/keep/me") is not None
+        await c.close()
+    finally:
+        for m in masters:
+            if m.rpc._server is not None:
+                await m.stop()
+
+
+async def test_restart_mid_election_no_double_vote(tmp_path):
+    """Hard-state durability satellite: a node that granted a vote and
+    restarted MID-ELECTION must refuse a different candidate in the
+    same term (the fsync'd voted_for is what makes >1-leader-per-term
+    impossible)."""
+    from curvine_tpu.master.ha import RaftLite
+    from curvine_tpu.rpc.frame import Message
+    from curvine_tpu.rpc.frame import pack as _pack, unpack as _unpack
+    masters, addrs = await _make_ha_cluster(tmp_path)
+    try:
+        leader = await _wait_leader(masters)
+        follower = next(m for m in masters if m is not leader)
+        others = [m for m in masters if m is not follower]
+        r = follower.raft
+        term = r.term + 10
+        cand_a = others[0].raft.node_id
+        cand_b = others[1].raft.node_id
+        vote = lambda raft, cand: raft._h_vote(Message(data=_pack(
+            {"term": term, "candidate": cand,
+             "last_seq": 10**9, "last_term": 10**9})), None)
+        _, rep = await vote(r, cand_a)
+        assert _unpack(rep)["granted"]
+        # crash + restart mid-election: fresh RaftLite, same state dir
+        state_dir = follower.conf.master.journal_dir
+        peers = {m.raft.node_id: "" for m in others}
+        reloaded = RaftLite(r.node_id, peers, follower.fs, follower.rpc,
+                            state_dir=state_dir)
+        assert reloaded.term == term
+        assert reloaded.voted_for == cand_a
+        # a DIFFERENT candidate in the same term: refused
+        _, rep = await vote(reloaded, cand_b)
+        assert not _unpack(rep)["granted"], \
+            "restarted node double-voted in one term"
+        # the SAME candidate retrying (its request ack was lost): granted
+        _, rep = await vote(reloaded, cand_a)
+        assert _unpack(rep)["granted"]
+    finally:
+        for m in masters:
+            if m.rpc._server is not None:
+                await m.stop()
+
+
+async def test_election_under_packet_drop(tmp_path):
+    """Hard-state durability satellite: with ~30% of every raft message
+    dropped on all nodes, an election still converges and no term ever
+    sees two leaders (vote persistence + quorum intersection)."""
+    from curvine_tpu.fault.runtime import FaultInjector, FaultSpec
+    masters, addrs = await _make_ha_cluster(tmp_path)
+    injs = []
+    try:
+        leader = await _wait_leader(masters)
+        for m in masters:
+            inj = FaultInjector()
+            inj.install(m.rpc)
+            inj.install_client(m.raft.pool)
+            inj.add(FaultSpec(kind="drop", target="*", probability=0.3))
+            injs.append((inj, m))
+        await leader.stop()
+        survivors = [m for m in masters if m is not leader]
+        leaders_by_term: dict[int, set[int]] = {}
+
+        async def sample():
+            while True:
+                for m in survivors:
+                    if m.raft.role == LEADER:
+                        leaders_by_term.setdefault(
+                            m.raft.term, set()).add(m.raft.node_id)
+                await asyncio.sleep(0.01)
+
+        stask = asyncio.ensure_future(sample())
+        try:
+            await _wait_leader(survivors, timeout=30)
+        finally:
+            stask.cancel()
+        multi = {t: s for t, s in leaders_by_term.items() if len(s) > 1}
+        assert not multi, f"terms with two leaders under drops: {multi}"
+    finally:
+        for inj, m in injs:
+            inj.clear()
+            inj.uninstall(m.rpc)
+            if m.raft is not None:
+                inj.uninstall_client(m.raft.pool)
+        for m in masters:
+            if m.rpc._server is not None:
+                await m.stop()
+
+
+async def test_commit_waiters_do_not_leak(tmp_path):
+    """wait_committed satellite: released waiters leave the list, and a
+    TIMED-OUT waiter is pruned even though its seq never commits (the
+    leak: every timeout used to strand one (seq, future) forever)."""
+    import pytest as _pytest
+    from curvine_tpu.common import errors as cerr
+    masters, addrs = await _make_ha_cluster(tmp_path)
+    try:
+        leader = await _wait_leader(masters)
+        conf = ClusterConf()
+        conf.client.master_addrs = addrs
+        c = CurvineClient(conf)
+        for i in range(10):
+            await c.meta.mkdir(f"/wl/d{i}")
+        assert leader.raft._commit_waiters == []
+        leader.raft.commit_timeout_s = 0.05
+        with _pytest.raises(cerr.RpcTimeout):
+            await leader.raft.wait_committed(
+                leader.raft.last_seq() + 1000)
+        assert leader.raft._commit_waiters == [], \
+            "timed-out waiter leaked"
+        await c.close()
+    finally:
         for m in masters:
             if m.rpc._server is not None:
                 await m.stop()

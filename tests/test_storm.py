@@ -95,6 +95,25 @@ async def test_storm_trace_probe(tmp_path):
         "wedged replica attempt left no error span"
 
 
+MEMBERSHIP_SEEDS = [21, 22]
+
+
+@pytest.mark.parametrize("seed", MEMBERSHIP_SEEDS)
+async def test_membership_storm_deterministic(seed, tmp_path):
+    """Raft membership churn (docs/raft.md): seeded add-learner /
+    remove / transfer / leader-kill events under a write stream.
+    Invariants: at most one leader per term across every sample, zero
+    acked-write loss, a removed node never observed leading, and the
+    cluster converges once the churn stops."""
+    from curvine_tpu.testing.storm import MembershipStorm
+    storm = MembershipStorm(seed, events=6, event_interval_s=0.35,
+                            base_dir=str(tmp_path))
+    report = await storm.run()
+    report.assert_invariants()
+    assert any(e.get("ok") for e in report.events), \
+        "no membership event applied cleanly — the schedule had no content"
+
+
 async def test_tenant_storm_abuser_contained(tmp_path):
     """Multi-tenant admission (docs/qos.md): 20 victims + 1 abuser
     hammering at 10× its token-bucket quota with retries disabled. The

@@ -580,6 +580,77 @@ async def _shard_smoke(shards: int = 2, n_create: int = 6_000,
     return out
 
 
+async def _read_plane_smoke(n_files: int = 32, stat_ops: int = 3_000,
+                            open_iters: int = 300) -> dict:
+    """Read fan-out plane gate for scripts/perf_smoke.sh: the stat →
+    open → read ladder with the client metadata lease cache OFF vs ON
+    (docs/read-plane.md). meta_stat_qps drives serial stats through a
+    cache-disabled client — every call crosses the RPC wire;
+    meta_stat_cached_qps runs the same serial loop on a default client
+    whose entries are lease-warm, so hot stats are local memory. The
+    acceptance bar is cached >= 10x uncached: the cache exists to take
+    the wire out of the hot stat path, anything under that means it
+    doesn't. open_read_p99_ms times the full open + pread(4 KiB) +
+    close ladder on the warm client (short-circuit read, stat served
+    from cache). Returns {meta_stat_qps, meta_stat_cached_qps,
+    meta_cache_speedup, open_read_p99_ms}."""
+    import copy
+    import shutil
+    from curvine_tpu.client import CurvineClient
+    from curvine_tpu.testing import MiniCluster
+
+    base = os.path.join(_pick_shm_dir(), f"curvine-readplane-{os.getpid()}")
+    out: dict = {}
+    try:
+        async with MiniCluster(workers=1, base_dir=base,
+                               journal=False) as mc:
+            c = mc.client()
+            paths = [f"/rp/f{i:03d}" for i in range(n_files)]
+            await c.meta.mkdir("/rp")
+            for p in paths:
+                await c.write_all(p, b"\xab" * 4096)
+            conf_off = copy.deepcopy(mc.conf)
+            conf_off.client.meta_cache = False
+            c_off = CurvineClient(conf_off)
+
+            async def stat_qps(client, ops: int) -> float:
+                for p in paths:          # warm conns + lease + entries
+                    await client.meta.file_status(p)
+                t0 = time.perf_counter()
+                for j in range(ops):
+                    await client.meta.file_status(paths[j % n_files])
+                return ops / (time.perf_counter() - t0)
+
+            # the uncached side runs fewer ops: every one is a full
+            # round trip, and the figure converges in a few hundred
+            out["meta_stat_qps"] = round(
+                await stat_qps(c_off, max(200, stat_ops // 4)), 1)
+            await c_off.close()
+            out["meta_stat_cached_qps"] = round(
+                await stat_qps(c, stat_ops), 1)
+            out["meta_cache_speedup"] = round(
+                out["meta_stat_cached_qps"]
+                / max(out["meta_stat_qps"], 1e-9), 1)
+
+            lat = []
+            for _ in range(8):                               # warm
+                r = await c.open(paths[0])
+                await r.pread(0, 4096)
+                await r.close()
+            for i in range(open_iters):
+                t0 = time.perf_counter()
+                r = await c.open(paths[i % n_files])
+                await r.pread(0, 4096)
+                await r.close()
+                lat.append(time.perf_counter() - t0)
+            lat.sort()
+            out["open_read_p99_ms"] = round(
+                lat[int(0.99 * len(lat)) - 1] * 1000, 3)
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    return out
+
+
 async def run_bench(total_mb: int = 256, block_mb: int = 64,
                     latency_block_mb: int = 1, latency_iters: int = 200):
     import jax
@@ -964,6 +1035,10 @@ async def run_bench(total_mb: int = 256, block_mb: int = 64,
         results["meta_create_shard_qps"] = rs[-1]["meta_create_shard_qps"]
         results["shard_backend"] = rs[-1]["shard_backend"]
         results["shard_cpus"] = rs[-1]["cpus"]
+
+    # ---- read fan-out plane: stat/open/read ladder, lease cache
+    # off vs warm (docs/read-plane.md) ----
+    results.update(await _read_plane_smoke())
     return results
 
 
@@ -1317,6 +1392,12 @@ def main(argv: list[str] | None = None):
             "meta_create_shard_curve", {}),
         "shard_backend": results.get("shard_backend", "none"),
         "shard_cpus": results.get("shard_cpus", os.cpu_count() or 1),
+        "meta_stat_qps": round(results.get("meta_stat_qps", 0), 1),
+        "meta_stat_cached_qps": round(
+            results.get("meta_stat_cached_qps", 0), 1),
+        "meta_cache_speedup": round(
+            results.get("meta_cache_speedup", 0), 1),
+        "open_read_p99_ms": round(results.get("open_read_p99_ms", 0), 3),
         "rpc_rtt_us": round(results.get("rpc_rtt_us", 0), 1),
         "rpc_pipelined_qps": round(results.get("rpc_pipelined_qps", 0), 1),
         "loop_impl": results.get("loop_impl", "asyncio"),

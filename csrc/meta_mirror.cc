@@ -127,6 +127,32 @@ void encode_status(std::string& o, const Rec& r, const std::string& path) {
   pack_str(o, "nlink");          pack_int(o, r.nlink);
 }
 
+// zlib-compatible CRC-32 (IEEE): MUST match Python's zlib.crc32 so the
+// fleet routing below picks the same member that master/sharding.py
+// shard_of() picks for the Python port.
+uint32_t crc32_ieee(const char* data, size_t n) {
+  static uint32_t table[256];
+  static std::once_flag once;
+  std::call_once(once, [] {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+  });
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++)
+    c = table[(c ^ static_cast<uint8_t>(data[i])) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+std::string parent_of_path(const std::string& p) {
+  auto pos = p.rfind('/');
+  if (pos == std::string::npos || pos == 0) return "/";
+  return p.substr(0, pos);
+}
+
 // The Python port normalizes every request path (scheme strip, "..",
 // "//", trailing "/") before resolving AND echoes the normalized path
 // in the reply. The mirror serves only already-canonical paths — for
@@ -176,6 +202,14 @@ struct Mirror {
   // mount cv_paths: listings that intersect a mount merge UFS entries on
   // the Python port, so the mirror must not answer them
   std::vector<std::string> mounts;
+
+  // Sharded namespace (master/sharding.py): the ROUTER's front mirror
+  // serves the fast port but holds no files itself — requests route to
+  // the owning shard's mirror by the same partition function the Python
+  // router uses. Members are attached (mm_fleet_attach) before serve()
+  // and outlive this mirror's serve threads (the router stops the front
+  // plane before the shard fleet), so the vector is read lock-free.
+  std::vector<Mirror*> fleet;
 
   bool acl_enabled = true;
   std::string superuser = "root", supergroup = "supergroup";
@@ -435,14 +469,36 @@ struct Mirror {
       reply_error(fd, req, kErrFastMiss, "fast-miss");
       return;
     }
+    // fleet routing: all direct entries of a directory co-locate on
+    // crc32(dir) % n, so a LIST routes by the listed path and a
+    // stat/exists by its parent — exactly shard_of() on the Python
+    // side. Directory skeletons exist on every member, so any routing
+    // answers dirs; a wrong-member file lookup MISSes and falls back.
+    Mirror* t = this;
+    if (!fleet.empty()) {
+      const std::string& key =
+          req.code == kListStatus ? path : parent_of_path(path);
+      t = fleet[crc32_ieee(key.data(), key.size()) % fleet.size()];
+    }
     std::string denied_sub, denied_perm = "traverse (x)";
     std::string body;
     Res r;
     if (req.code == kListStatus) {
-      r = list_statuses(path, user, groups, body, denied_sub, denied_perm);
+      if (t != this) {
+        // members hold no mount table: the FRONT's mounts gate
+        // UFS-merged listings back to the Python port
+        std::shared_lock<std::shared_mutex> lk(mu);
+        if (mounts_intersect(path)) {
+          fallbacks++;
+          reply_error(fd, req, kErrFastMiss, "fast-miss");
+          return;
+        }
+      }
+      r = t->list_statuses(path, user, groups, body, denied_sub,
+                           denied_perm);
     } else {
       Rec rec;
-      r = resolve(path, user, groups, rec, denied_sub);
+      r = t->resolve(path, user, groups, rec, denied_sub);
       if (r == Res::OK) {
         if (req.code == kExists) {
           mp_map(body, 1);
@@ -458,6 +514,7 @@ struct Mirror {
     switch (r) {
       case Res::OK:
         served++;
+        if (t != this) t->served++;        // per-shard hit counter
         reply(fd, req, 0, Value(), body);
         return;
       case Res::DENIED:
@@ -660,6 +717,14 @@ void mm_child_remove(void* h, int64_t parent_id, const char* name) {
   std::unique_lock<std::shared_mutex> lk(m->mu);
   auto it = m->dents.find(parent_id);
   if (it != m->dents.end()) it->second.erase(name);
+}
+
+// Attach a shard member's mirror to a front mirror. MUST be called
+// before mm_serve on the front (serve threads read `fleet` unlocked),
+// and the front must be mm_stop'd before any member is freed.
+void mm_fleet_attach(void* front, void* member) {
+  static_cast<Mirror*>(front)->fleet.push_back(
+      static_cast<Mirror*>(member));
 }
 
 int mm_serve(void* h, const char* host, int port) {

@@ -287,10 +287,33 @@ async def cmd_report(args):
             print(f"  LONG-HELD lock {l['path']} by {l['owner']} "
                   f"for {l['age_s']}s")
         # sharded-namespace table (empty / absent on unsharded masters)
+        # + the read fan-out plane rollup riding the same RPC
         try:
-            rows = await c.meta.shard_table()
+            rp = await c.meta.read_plane_stats()
         except err.CurvineError:
             return
+        mcache = rp.get("meta_cache") or {}
+        hits, misses = mcache.get("hits", 0), mcache.get("misses", 0)
+        if hits + misses:
+            print(f"Meta cache: {hits / (hits + misses) * 100:.1f}% hit "
+                  f"rate ({int(hits)}/{int(hits + misses)} lookups)  "
+                  f"invalidations: {int(mcache.get('invalidations', 0))}")
+        ls = rp.get("leases")
+        if ls:
+            print(f"Read leases: {ls.get('dirs', 0)} dirs  "
+                  f"{ls.get('holders', 0)} holders  "
+                  f"pushes: {ls.get('pushes', 0)} "
+                  f"({ls.get('push_errors', 0)} errors)  "
+                  f"ttl: {ls.get('ttl_ms', 0)} ms")
+        fm = rp.get("fastmeta")
+        if fm:
+            line = (f"Fast meta: served: {fm.get('served', 0)}  "
+                    f"fallbacks: {fm.get('fallbacks', 0)}")
+            if fm.get("shard_hits"):
+                line += "  shard hits: " + "/".join(
+                    str(h) for h in fm["shard_hits"])
+            print(line)
+        rows = rp.get("shards") or []
         if rows:
             print(f"Namespace shards: {len(rows)}")
             print("  shard  state        qps   inodes   blocks  "

@@ -17,6 +17,7 @@ from curvine_tpu.common.types import (
     CommitBlock, FileBlocks, FileStatus, JobInfo, LocatedBlock, MasterInfo,
     MountInfo, SetAttrOpts,
 )
+from curvine_tpu.client.meta_cache import MISS, MetaCache, parent_dir
 from curvine_tpu.rpc import RpcCode
 from curvine_tpu.rpc.client import Connection, ConnectionPool, RetryPolicy
 from curvine_tpu.rpc.frame import pack, unpack
@@ -82,6 +83,34 @@ class FsClient:
         self._fast_enabled = cc.fast_meta
         self._fast_addr: str | None = None
         self._fast_probe_after = 0.0     # monotonic; throttles rediscovery
+        # metadata lease cache (client/meta_cache.py): consulted before
+        # either port; the master pushes META_INVALIDATE frames over
+        # this pool's already-open conns, delivered via _on_push
+        self.cache: MetaCache | None = None
+        if cc.meta_cache:
+            self.cache = MetaCache(entries=cc.meta_cache_entries)
+            self.pool.set_push_handler(self._on_push)
+
+    def _on_push(self, msg) -> None:
+        """Unsolicited master frame on a pooled conn. Read-loop context:
+        must not block. Epoch changes flush (master restarted — leases
+        are soft state); paths sweep subtrees (rename/recursive delete
+        push only the top path)."""
+        if self.cache is None or msg.code != RpcCode.META_INVALIDATE:
+            return
+        body = unpack(msg.data) or {}
+        self.cache.note_epoch(body.get("epoch"))
+        self.cache.invalidate(body.get("paths") or (), subtree=True)
+
+    def _inval(self, *paths: str, subtree: bool = False) -> None:
+        """Local mutation succeeded: drop our own cached entries for the
+        touched paths (read-your-writes on the writing client)."""
+        if self.cache is not None:
+            self.cache.invalidate([p for p in paths if p], subtree=subtree)
+
+    def _cache_put(self, path: str, st) -> None:
+        if self.cache is not None:
+            self.cache.put("stat", path, st)
 
     async def close(self) -> None:
         await self.pool.close()
@@ -184,7 +213,10 @@ class FsClient:
         rep = await self.call(RpcCode.MKDIR,
                               {"path": path, "create_parent": create_parent,
                                **kw}, mutate=True)
-        return FileStatus.from_wire(rep["status"])
+        st = FileStatus.from_wire(rep["status"])
+        self._inval(path)
+        self._cache_put(path, st)
+        return st
 
     async def create_file(self, path: str, overwrite: bool = False,
                           **kw) -> FileStatus:
@@ -193,35 +225,98 @@ class FsClient:
                "block_size": kw.pop("block_size", self.conf.client.block_size),
                "client_name": self.client_id, **kw}
         rep = await self.call(RpcCode.CREATE_FILE, req, mutate=True)
-        return FileStatus.from_wire(rep["status"])
+        st = FileStatus.from_wire(rep["status"])
+        self._inval(path)
+        self._cache_put(path, st)
+        return st
 
     async def append_file(self, path: str) -> FileBlocks:
         rep = await self.call(RpcCode.APPEND_FILE,
                               {"path": path, "client_name": self.client_id},
                               mutate=True)
+        self._inval(path)
         return FileBlocks.from_wire(rep["file_blocks"])
 
     async def exists(self, path: str) -> bool:
+        if self.cache is not None:
+            v = self.cache.get("stat", path)
+            if v is not MISS:
+                return v is not None
+            try:
+                # the stat flow populates the cache, negatives included
+                await self.file_status(path)
+                return True
+            except err.FileNotFound:
+                return False
         rep = await self._fast_call(RpcCode.EXISTS, {"path": path})
         if rep is not None:
             return rep["exists"]
         return (await self.call(RpcCode.EXISTS, {"path": path}))["exists"]
 
     async def file_status(self, path: str) -> FileStatus:
-        rep = await self._fast_call(RpcCode.FILE_STATUS, {"path": path})
-        if rep is None:
-            rep = await self.call(RpcCode.FILE_STATUS, {"path": path})
-        return FileStatus.from_wire(rep["status"])
+        mc = self.cache
+        if mc is None:
+            rep = await self._fast_call(RpcCode.FILE_STATUS, {"path": path})
+            if rep is None:
+                rep = await self.call(RpcCode.FILE_STATUS, {"path": path})
+            return FileStatus.from_wire(rep["status"])
+        v = mc.get("stat", path)
+        if v is not MISS:
+            if v is None:
+                raise err.FileNotFound(path)
+            return v
+        d = parent_dir(path)
+        if mc.lease_ok(d):
+            # the directory lease is warm (the master knows to push us
+            # invalidations): misses may ride the native fast plane
+            rep = await self._fast_call(RpcCode.FILE_STATUS, {"path": path})
+            if rep is not None:
+                st = FileStatus.from_wire(rep["status"])
+                mc.put("stat", path, st)
+                return st
+        try:
+            rep = await self.call(RpcCode.FILE_STATUS,
+                                  {"path": path, "lease": True})
+        except err.FileNotFound:
+            # the master registers leases on misses too: cache the
+            # negative so repeat stats of absent paths stay local
+            mc.note_dir(d)
+            mc.put("stat", path, None)
+            raise
+        tok = rep.get("lease")
+        if tok:
+            mc.note_lease(tok, d)
+        st = FileStatus.from_wire(rep["status"])
+        mc.put("stat", path, st)
+        return st
 
     async def list_status(self, path: str) -> list[FileStatus]:
-        rep = await self._fast_call(RpcCode.LIST_STATUS, {"path": path})
+        mc = self.cache
+        if mc is None:
+            rep = await self._fast_call(RpcCode.LIST_STATUS, {"path": path})
+            if rep is None:
+                rep = await self.call(RpcCode.LIST_STATUS, {"path": path})
+            return [FileStatus.from_wire(s) for s in rep["statuses"]]
+        v = mc.get("list", path)
+        if v is not MISS:
+            return list(v)
+        rep = None
+        if mc.lease_ok(path):
+            rep = await self._fast_call(RpcCode.LIST_STATUS, {"path": path})
         if rep is None:
-            rep = await self.call(RpcCode.LIST_STATUS, {"path": path})
-        return [FileStatus.from_wire(s) for s in rep["statuses"]]
+            rep = await self.call(RpcCode.LIST_STATUS,
+                                  {"path": path, "lease": True})
+            tok = rep.get("lease")
+            if tok:
+                mc.note_lease(tok, path)
+        sts = [FileStatus.from_wire(s) for s in rep["statuses"]]
+        mc.put("list", path, sts)
+        return list(sts)
 
     async def delete(self, path: str, recursive: bool = False) -> None:
         await self.call(RpcCode.DELETE,
                         {"path": path, "recursive": recursive}, mutate=True)
+        self._inval(path, subtree=recursive)
 
     async def meta_batch(self, requests: list[dict]) -> list[dict]:
         """Batched metadata mutations in ONE round trip. Each request is
@@ -238,35 +333,46 @@ class FsClient:
             reqs.append(r)
         rep = await self.call(RpcCode.META_BATCH, {"requests": reqs},
                               mutate=True)
+        self._inval(*[r.get("path", "") for r in reqs], subtree=True)
         return rep["responses"]
 
     async def rename(self, src: str, dst: str) -> bool:
         rep = await self.call(RpcCode.RENAME, {"src": src, "dst": dst},
                               mutate=True)
+        self._inval(src, dst, subtree=True)
         return rep["result"]
 
     async def set_attr(self, path: str, opts: SetAttrOpts) -> None:
         await self.call(RpcCode.SET_ATTR,
                         {"path": path, "opts": opts.to_wire()}, mutate=True)
+        self._inval(path, subtree=True)   # recursive mode/ttl sweeps
 
     async def symlink(self, target: str, link: str) -> FileStatus:
         rep = await self.call(RpcCode.SYMLINK,
                               {"target": target, "link": link}, mutate=True)
-        return FileStatus.from_wire(rep["status"])
+        st = FileStatus.from_wire(rep["status"])
+        self._inval(link)
+        self._cache_put(link, st)
+        return st
 
     async def link(self, src: str, dst: str) -> FileStatus:
         rep = await self.call(RpcCode.LINK, {"src": src, "dst": dst},
                               mutate=True)
-        return FileStatus.from_wire(rep["status"])
+        st = FileStatus.from_wire(rep["status"])
+        self._inval(src, dst)
+        self._cache_put(dst, st)
+        return st
 
     async def resize_file(self, path: str, new_len: int) -> None:
         await self.call(RpcCode.RESIZE_FILE,
                         {"path": path, "len": new_len}, mutate=True)
+        self._inval(path)
 
     async def free(self, path: str, recursive: bool = False) -> int:
         rep = await self.call(RpcCode.FREE,
                               {"path": path, "recursive": recursive},
                               mutate=True)
+        self._inval(path, subtree=recursive)
         return rep.get("freed", 0)
 
     # ---------------- block API ----------------
@@ -292,6 +398,7 @@ class FsClient:
             "commit_blocks": [c.to_wire() for c in commit_blocks or []],
             "client_name": self.client_id, "only_flush": only_flush},
             mutate=True)
+        self._inval(path)
         return rep["result"]
 
     async def get_block_locations(self, path: str,
@@ -316,6 +423,13 @@ class FsClient:
         depth, qps."""
         rep = await self.call(RpcCode.SHARD_TABLE, {})
         return rep.get("shards", [])
+
+    async def read_plane_stats(self) -> dict:
+        """The full SHARD_TABLE reply: {"shards", "leases"?,
+        "meta_cache"?, "fastmeta"?} — shard rows plus the read
+        fan-out plane's rollup (docs/read-plane.md). `cv report`
+        uses this so one RPC feeds both tables."""
+        return await self.call(RpcCode.SHARD_TABLE, {})
 
     async def tenant_stats(self) -> dict:
         """The master's admission-control snapshot (common/qos.py):
@@ -428,10 +542,12 @@ class FsClient:
             "ttl_action": ttl_action, "storage_type": storage_type,
             "block_size": block_size, "replicas": replicas,
             "access_mode": access_mode}, mutate=True)
+        self._inval(cv_path, subtree=True)
         return MountInfo.from_wire(rep["mount"])
 
     async def umount(self, cv_path: str) -> None:
         await self.call(RpcCode.UNMOUNT, {"cv_path": cv_path}, mutate=True)
+        self._inval(cv_path, subtree=True)
 
     async def update_mount(self, cv_path: str,
                            properties: dict | None = None,

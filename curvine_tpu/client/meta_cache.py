@@ -1,0 +1,155 @@
+"""Client metadata lease cache (docs/read-plane.md).
+
+HDFS/Alluxio-style client stat/list caching with NFS-style leases: a
+bounded LRU of positive AND negative entries, each valid for the
+master-granted lease TTL or until one of three things drops it first —
+
+  * a META_INVALIDATE push from the master (rename/delete/resize/
+    TTL-expiry touched the path) over the already-open connection,
+  * a local mutation through the same FsClient (read-your-writes), or
+  * a lease-epoch change (the master restarted: leases are soft state,
+    so a new epoch implicitly revokes everything we hold).
+
+The master only tracks lease holders per PARENT DIRECTORY and only for
+entries acquired through the Python port (`"lease": True` reads), so
+the client sends the FIRST miss per directory there to register, then
+rides the native fast plane while the directory lease is warm. Entries
+cached off fast-path answers carry no token; they reuse the last
+granted ttl/epoch and are bounded by TTL alone.
+
+Cross-client staleness is therefore bounded by master.meta_lease_ms in
+the worst case (push lost / fast-path-only client), and is usually one
+push RTT. The writing client is never stale."""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+
+MISS = object()          # sentinel: "not cached" (None means ENOENT)
+
+
+def parent_dir(path: str) -> str:
+    return path.rsplit("/", 1)[0] or "/"
+
+
+class MetaCache:
+    """Bounded LRU of ("stat"|"list", path) → value entries.
+
+    Values: FileStatus (positive stat), None (negative stat / ENOENT),
+    or list[FileStatus] (listing). Not thread-safe; lives on one event
+    loop like the FsClient that owns it."""
+
+    def __init__(self, entries: int = 4096,
+                 counters: dict[str, float] | None = None):
+        self.entries = max(1, entries)
+        # shared with CurvineClient.counters so METRICS_REPORT ships
+        # hit rates to the master's /metrics (client.meta_cache.*)
+        self.counters = counters if counters is not None else {}
+        self._map: OrderedDict[tuple[str, str], tuple[object, float]] = \
+            OrderedDict()
+        # lease state from the last granted token: ttl 0 = no lease yet
+        # (nothing is cached until the master has told us how long it
+        # is willing to let us believe an answer)
+        self.ttl_ms: int = 0
+        self.epoch: int | None = None
+        # per-directory lease expiry: while warm, misses under the dir
+        # may ride the fast plane; cold dirs re-register on the Python
+        # port so the master knows whom to push invalidations to
+        self._dirs: OrderedDict[str, float] = OrderedDict()
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        k = "meta_cache." + key
+        self.counters[k] = self.counters.get(k, 0) + n
+
+    # ---------------- lease state ----------------
+
+    def note_lease(self, token: dict, dir_path: str) -> None:
+        """Adopt a granted lease token ({"ttl_ms", "epoch"}); an epoch
+        change means the master restarted — flush everything."""
+        self.note_epoch(token.get("epoch"))
+        ttl = int(token.get("ttl_ms") or 0)
+        if ttl > 0:
+            self.ttl_ms = ttl
+        self.note_dir(dir_path)
+
+    def note_epoch(self, epoch) -> None:
+        if epoch is None:
+            return
+        if self.epoch is not None and epoch != self.epoch:
+            self.flush()
+        self.epoch = epoch
+
+    def note_dir(self, dir_path: str) -> None:
+        """The master registered our conn for this directory (it does so
+        for every `"lease": True` read, hits AND misses)."""
+        if self.ttl_ms <= 0:
+            return
+        self._dirs[dir_path] = time.monotonic() + self.ttl_ms / 1000
+        self._dirs.move_to_end(dir_path)
+        while len(self._dirs) > self.entries:
+            self._dirs.popitem(last=False)
+
+    def lease_ok(self, dir_path: str) -> bool:
+        exp = self._dirs.get(dir_path)
+        return exp is not None and time.monotonic() < exp
+
+    # ---------------- entries ----------------
+
+    def get(self, kind: str, path: str):
+        """Cached value or MISS. Expired entries count as misses."""
+        key = (kind, path)
+        ent = self._map.get(key)
+        if ent is not None:
+            value, exp = ent
+            if time.monotonic() < exp:
+                self._map.move_to_end(key)
+                self._bump("hits")
+                return value
+            del self._map[key]
+        self._bump("misses")
+        return MISS
+
+    def put(self, kind: str, path: str, value) -> None:
+        if self.ttl_ms <= 0:
+            return                       # no lease granted yet
+        self._map[(kind, path)] = (value, time.monotonic()
+                                   + self.ttl_ms / 1000)
+        self._map.move_to_end((kind, path))
+        while len(self._map) > self.entries:
+            self._map.popitem(last=False)
+            self._bump("evictions")
+
+    # ---------------- invalidation ----------------
+
+    def invalidate(self, paths, subtree: bool = False) -> None:
+        """Drop each path's stat + list entries and its parent's list
+        entry (a created/removed child changes the parent's listing).
+        subtree=True also sweeps everything under the paths (rename,
+        recursive delete: the master pushes only the top path)."""
+        dropped = 0
+        for p in paths:
+            for key in (("stat", p), ("list", p),
+                        ("list", parent_dir(p))):
+                if self._map.pop(key, None) is not None:
+                    dropped += 1
+        if subtree:
+            pre = tuple(p.rstrip("/") + "/" for p in paths)
+            for key in [k for k in self._map
+                        if k[1].startswith(pre)]:
+                del self._map[key]
+                dropped += 1
+        if dropped:
+            self._bump("invalidations", dropped)
+
+    def flush(self) -> None:
+        """Full revoke (lease-epoch change): every entry AND every
+        directory lease goes; the next miss re-registers."""
+        n = len(self._map)
+        self._map.clear()
+        self._dirs.clear()
+        if n:
+            self._bump("invalidations", n)
+
+    def __len__(self) -> int:
+        return len(self._map)

@@ -239,6 +239,25 @@ class FsReader:
 
     # ---------------- short-circuit ----------------
 
+    # short-circuit probe cache cap: entries (including negative "not
+    # local" answers) are FIFO-evicted past this, so a block that moved
+    # since its probe is re-probed eventually even if no read fails
+    _SC_CACHE_CAP = 256
+
+    def _drop_local(self, bid: int) -> None:
+        """Forget every cached short-circuit handle for a block: the
+        probe result went stale (block evicted/evacuated/truncated under
+        PR 8 healing). The next read re-probes or goes remote."""
+        self._local_paths.pop(bid, None)
+        self._local_offs.pop(bid, None)
+        self._local_expiry.pop(bid, None)
+        cached = self._local_fds.pop(bid, None)
+        if cached is not None:
+            try:
+                os.close(cached[0])
+            except OSError:
+                pass
+
     async def _local_path(self, lb: LocatedBlock) -> str | None:
         """Resolve the on-disk path for a co-located block (cached)."""
         bid = lb.block.id
@@ -280,6 +299,8 @@ class FsReader:
                                 sent_at + lease / 1000
                 except err.CurvineError as e:
                     log.debug("short-circuit probe failed for %d: %s", bid, e)
+        while len(self._local_paths) >= self._SC_CACHE_CAP:
+            self._drop_local(next(iter(self._local_paths)))
         self._local_paths[bid] = path
         return path
 
@@ -475,11 +496,15 @@ class FsReader:
                         and got == lb.block.len \
                         and not self._sc_verify_ok(lb, view[:got]):
                     fd = None     # bad local bytes: re-read remotely
+                elif got < seg:
+                    # short local read: the block file shrank or moved
+                    # under us (eviction, healing evacuation) — drop the
+                    # stale path/fd and re-read this segment remotely
+                    self._drop_local(lb.block.id)
+                    fd = None
                 else:
                     self._note_sc_read(lb.block.id, got)
-                    filled += max(0, got)
-                    if got < seg:
-                        break
+                    filled += got
             if fd is None:
                 # remote: stream chunks straight into the output buffer
                 got = await self._readinto_remote(
@@ -695,8 +720,7 @@ class FsReader:
         try:
             fd = os.open(path, os.O_RDONLY)
         except OSError:
-            self._local_paths.pop(block_id, None)
-            self._local_offs.pop(block_id, None)
+            self._drop_local(block_id)
             return None
         self._local_fds[block_id] = (fd, path)
         return fd
@@ -733,6 +757,9 @@ class FsReader:
         base = self._local_offs.get(lb.block.id, 0)
         got = os.preadv(fd, [memoryview(buf)], base + block_off)
         if got != n:
+            # stale probe (block shrank/moved): drop the cached handles
+            # so the caller's fallback path re-probes instead of looping
+            self._drop_local(lb.block.id)
             return None
         if self.verify and block_off == 0 and n == lb.block.len \
                 and not self._sc_verify_ok(lb, buf):
@@ -761,6 +788,9 @@ class FsReader:
                     and len(data) == lb.block.len \
                     and not self._sc_verify_ok(lb, data):
                 pass        # bad local bytes: fall through to remote
+            elif len(data) < n:
+                # stale probe (block shrank/moved): drop and go remote
+                self._drop_local(lb.block.id)
             else:
                 self._note_sc_read(lb.block.id, len(data))
                 return data

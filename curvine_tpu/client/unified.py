@@ -65,6 +65,10 @@ class CurvineClient:
         self.counters: dict[str, float] = {}
         self._reported: dict[str, float] = {}
         self._metrics_task = None
+        # meta lease cache hit/miss/invalidation counters ride the same
+        # METRICS_REPORT flush (master shows them as client.meta_cache.*)
+        if self.meta.cache is not None:
+            self.meta.cache.counters = self.counters
 
     async def close(self) -> None:
         if self._metrics_task is not None:

@@ -66,6 +66,14 @@ class MasterConf:
     # EXISTS served by C++ threads on a separate fast port; 0 = ephemeral
     fast_meta: bool = True
     fast_port: int = 0
+    # client metadata read leases (master/read_leases.py): stat/list
+    # answers carry a lease {ttl_ms, epoch}; the master remembers which
+    # client conns hold leases per PARENT DIRECTORY (coarse, capped at
+    # meta_lease_dirs dirs LRU) and pushes META_INVALIDATE over the open
+    # conn on rename/delete/resize/TTL-expiry. Leases are soft state: a
+    # restart mints a new epoch, which clients treat as revoke-all.
+    meta_lease_ms: int = 3_000
+    meta_lease_dirs: int = 4_096
     # audit/metrics
     audit_log: bool = False
     # dir watchdog (parity: fs_dir_watchdog.rs): namespace ops / path
@@ -234,6 +242,13 @@ class ClientConf:
     read_verify: bool = True
     # route stat/exists to the master's native fast port when advertised
     fast_meta: bool = True
+    # client metadata lease cache (client/meta_cache.py): bounded LRU of
+    # positive AND negative stat/list entries, valid for the master-
+    # granted lease TTL or until a META_INVALIDATE push / local write
+    # drops them. Read-your-writes holds on the writing client; cross-
+    # client staleness is bounded by master.meta_lease_ms.
+    meta_cache: bool = True
+    meta_cache_entries: int = 4_096
 
 
 @dataclass

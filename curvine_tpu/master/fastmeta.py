@@ -82,6 +82,7 @@ def _load():
     lib.mm_mount_remove.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.mm_serve.restype = ctypes.c_int
     lib.mm_serve.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.mm_fleet_attach.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
     lib.mm_set_serving.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.mm_counter.restype = ctypes.c_ulonglong
     lib.mm_counter.argtypes = [ctypes.c_void_p, ctypes.c_int]
@@ -122,11 +123,21 @@ class FastMeta:
         self._h = lib.mm_new(1 if acl_enabled else 0, superuser.encode(),
                              supergroup.encode())
         self.port: int | None = None
+        # sharded fleet (router front only): member mirrors this front
+        # routes to, in shard order — see fleet_attach
+        self.members: list["FastMeta"] = []
 
     def close(self) -> None:
         if self._h:
             self._lib.mm_free(self._h)
             self._h = None
+
+    def stop_serving(self) -> None:
+        """Join the native serve threads without freeing the mirror.
+        A sharded router MUST call this before stopping the shard fleet:
+        the front's threads read the member mirrors' memory."""
+        if self._h:
+            self._lib.mm_stop(self._h)
 
     # ---- mirror maintenance (single writer: the master actor loop) ----
     # Every method no-ops after close(): the MirroredStore wrapper keeps
@@ -183,6 +194,15 @@ class FastMeta:
         for wire in store.iter_mounts():
             self.mount_add(wire["cv_path"])
 
+    def fleet_attach(self, member: "FastMeta") -> None:
+        """Sharded namespace: route this (router front) mirror's reads
+        to `member`'s data by crc32(parent) % n — the same partition
+        function the Python router uses (master/sharding.py shard_of).
+        Attach every member BEFORE serve(); members must outlive this
+        mirror's serve threads (stop_serving before the fleet stops)."""
+        self._lib.mm_fleet_attach(self._h, member._h)
+        self.members.append(member)
+
     # ---- serving control ----
 
     def serve(self, host: str, port: int = 0) -> int:
@@ -196,10 +216,16 @@ class FastMeta:
         self._lib.mm_set_serving(self._h, 1 if on else 0)
 
     def counters(self) -> dict:
-        return {"inodes": self._lib.mm_counter(self._h, 0),
-                "served": self._lib.mm_counter(self._h, 1),
-                "fallbacks": self._lib.mm_counter(self._h, 2),
-                "denied": self._lib.mm_counter(self._h, 3)}
+        out = {"inodes": self._lib.mm_counter(self._h, 0),
+               "served": self._lib.mm_counter(self._h, 1),
+               "fallbacks": self._lib.mm_counter(self._h, 2),
+               "denied": self._lib.mm_counter(self._h, 3)}
+        if self.members:
+            # per-shard fast hits: the front bumps the owning member's
+            # served counter on every routed answer
+            out["shard_hits"] = [int(m._lib.mm_counter(m._h, 1))
+                                 for m in self.members]
+        return out
 
 
 class MirroredStore:

@@ -1,0 +1,116 @@
+"""Master-side read lease tracking + invalidation push (docs/read-plane.md).
+
+The client metadata cache (client/meta_cache.py) is only as fresh as
+the master makes it. This module is the master half of the contract:
+
+  * every Python-port stat/list carrying `"lease": True` registers the
+    calling CONNECTION as a lease holder on the entry's parent
+    directory (coarse-grained on purpose — per-path tracking for
+    millions of clients would dwarf the namespace itself), capped both
+    in directories (LRU) and holders per directory;
+  * every successful mutation pushes `META_INVALIDATE {paths, epoch}`
+    over the holders' already-open connections — the same frame the
+    future FUSE inval_entry/inval_inode notify plane will consume;
+  * leases are SOFT state: nothing is journaled, nothing survives a
+    restart. A new process mints a new epoch; clients flush everything
+    they hold the moment they see it. Lost pushes are safe too — every
+    cached entry also expires after ttl_ms.
+
+Pushes are fire-and-forget REQUEST frames with req_id=0 (no client
+waiter, no response): a dead connection costs one failed send, pruned
+lazily on the next touch of its directory."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from collections import OrderedDict
+
+from curvine_tpu.rpc.codes import RpcCode
+from curvine_tpu.rpc.frame import Message, pack
+
+log = logging.getLogger(__name__)
+
+
+def parent_dir(path: str) -> str:
+    return path.rsplit("/", 1)[0] or "/"
+
+
+class ReadLeaseManager:
+    """Who (which conns) may be caching entries under which directory."""
+
+    def __init__(self, ttl_ms: int = 3_000, max_dirs: int = 4_096,
+                 max_holders: int = 1_024):
+        self.ttl_ms = ttl_ms
+        self.max_dirs = max(1, max_dirs)
+        self.max_holders = max(1, max_holders)
+        # epoch: any value that cannot repeat across restarts
+        self.epoch = time.time_ns()
+        # dir → {conn: lease expiry (monotonic)}
+        self._dirs: OrderedDict[str, dict] = OrderedDict()
+        self.granted = 0
+        self.pushes = 0
+        self.push_errors = 0
+
+    def token(self) -> dict:
+        """The lease stamped into granted read replies."""
+        return {"ttl_ms": self.ttl_ms, "epoch": self.epoch}
+
+    def grant(self, conn, dir_path: str) -> None:
+        holders = self._dirs.get(dir_path)
+        if holders is None:
+            holders = self._dirs[dir_path] = {}
+            while len(self._dirs) > self.max_dirs:
+                self._dirs.popitem(last=False)
+        self._dirs.move_to_end(dir_path)
+        holders[conn] = time.monotonic() + self.ttl_ms / 1000
+        self.granted += 1
+        if len(holders) > self.max_holders:
+            self._prune(dir_path, holders)
+            while len(holders) > self.max_holders:
+                holders.pop(next(iter(holders)))
+
+    def _prune(self, dir_path: str, holders: dict) -> None:
+        now = time.monotonic()
+        for c in [c for c, exp in holders.items()
+                  if exp <= now or getattr(c, "closed", False)]:
+            holders.pop(c, None)
+        if not holders:
+            self._dirs.pop(dir_path, None)
+
+    def invalidate(self, paths) -> None:
+        """Mutation landed on `paths`: push to every live holder of an
+        affected directory (each path's parent, and the path itself —
+        a dir's own holders cache listings of it)."""
+        paths = [p for p in paths if p]
+        if not paths or not self._dirs:
+            return
+        conns = set()
+        for p in paths:
+            for d in {p, parent_dir(p)}:
+                holders = self._dirs.get(d)
+                if holders is None:
+                    continue
+                self._prune(d, holders)
+                conns.update(holders)
+        if not conns:
+            return
+        data = pack({"paths": paths, "epoch": self.epoch})
+        for c in conns:
+            asyncio.ensure_future(self._push(c, data))
+
+    async def _push(self, conn, data: bytes) -> None:
+        try:
+            await conn.send(Message(code=int(RpcCode.META_INVALIDATE),
+                                    req_id=0, data=data))
+            self.pushes += 1
+        except Exception:   # noqa: BLE001 — conn died; TTL covers it
+            self.push_errors += 1
+
+    def stats(self) -> dict:
+        holders = sum(len(h) for h in self._dirs.values())
+        return {"epoch": self.epoch, "ttl_ms": self.ttl_ms,
+                "dirs": len(self._dirs), "holders": holders,
+                "granted": self.granted, "pushes": self.pushes,
+                "push_errors": self.push_errors}

@@ -57,11 +57,17 @@ class MasterServer:
                                 engine=mc.meta_engine)
         # native metadata read plane: mirror every committed namespace
         # mutation into C++ and serve stat/exists from native threads.
-        # Never on the shard ROUTER: its local store holds no files
-        # (mutations route to the shard fleet), so the mirror would
-        # serve empty stat/list answers that bypass the shards.
+        # Three shapes (docs/read-plane.md):
+        #   * single master — mirror its own store, serve the fast port;
+        #   * shard ACTOR — mirror its partition, never bind a port (the
+        #     router fronts the fleet via mm_fleet_attach);
+        #   * inproc ROUTER — a front mirror holding only the mount
+        #     table; reads route to the attached shard mirrors by
+        #     crc32(parent) % n. The process backend keeps the front
+        #     disabled: member mirrors live in child address spaces.
         self.fastmeta = None
-        if mc.fast_meta and not self.sharded:
+        if mc.fast_meta and (not self.sharded
+                             or mc.shard_backend == "inproc"):
             from curvine_tpu.master import fastmeta
             if fastmeta.available():
                 if store is None:
@@ -93,6 +99,17 @@ class MasterServer:
             self.fs, pull_budget_ms=mc.replication_pull_budget_ms)
         self.fs.on_worker_lost = self.replication.on_worker_lost
         self.ttl = TtlManager(self.fs, check_ms=mc.ttl_check_ms)
+        # client read leases (master/read_leases.py): only on endpoints
+        # that hold CLIENT connections — the router when sharded, the
+        # master otherwise. Shard actors see only router conns; their
+        # TTL expiries are relayed to the router's manager instead.
+        self.leases = None
+        if shard_id is None:
+            from curvine_tpu.master.read_leases import ReadLeaseManager
+            self.leases = ReadLeaseManager(ttl_ms=mc.meta_lease_ms,
+                                           max_dirs=mc.meta_lease_dirs)
+            self.ttl.on_expire = \
+                lambda path: self.leases.invalidate([path])
         from curvine_tpu.master.quota import QuotaManager
         self.quota = QuotaManager(self.fs)
         from curvine_tpu.master.locks import LockManager
@@ -202,7 +219,11 @@ class MasterServer:
         gate = self._is_leader
         self.executor.submit_periodic("heartbeat-check",
                                       self._heartbeat_tick, interval)
-        if self.fastmeta is not None:
+        if self.fastmeta is not None and self.shard_id is not None:
+            # shard actor: keep the mirror warm for the router's front
+            # plane, but never bind a fast port of its own
+            self.fastmeta.load_from_store(self.fs.store)
+        elif self.fastmeta is not None:
             # bulk load AFTER recover (KV cold starts never replay old
             # inodes through the store wrapper), then keep serving in
             # lockstep with leadership. The plane is best-effort: a bind
@@ -295,6 +316,10 @@ class MasterServer:
         self._bg.clear()
         await self.rpc.stop()
         if self.shards is not None:
+            if self.fastmeta is not None:
+                # join the front's native serve threads BEFORE freeing
+                # the member mirrors they read from
+                self.fastmeta.stop_serving()
             await self.shards.stop()
         await self._obs_pool.close()
         try:
@@ -391,7 +416,10 @@ class MasterServer:
         r = self.rpc.register
         C = RpcCode
 
-        def wrap(fn, cache: bool = False):
+        def wrap(fn, cache: bool = False, inval=None):
+            # inval: the mutation code whose touched paths must be
+            # lease-invalidated after the owning shard acks (the router
+            # holds the client conns, so pushes originate here)
             async def handler(msg: Message, conn: ServerConn):
                 req = self._norm_req(unpack(msg.data) or {})
                 if cache:
@@ -401,13 +429,25 @@ class MasterServer:
                         if hit is not None:
                             return {}, hit
                         data = pack(await fn(req, msg))
+                        if inval is not None:
+                            self._lease_invalidate(inval, req)
                         self.retry_cache.put(key, data)
                         return {}, data
-                return {}, pack(await fn(req, msg))
+                leased = inval is None and self._lease_grant(msg, req, conn)
+                out = await fn(req, msg)
+                if inval is not None:
+                    self._lease_invalidate(inval, req)
+                elif leased and isinstance(out, dict):
+                    out["lease"] = self.leases.token()
+                return {}, pack(out)
             return handler
 
         def fwd(code):
-            return wrap(lambda q, m, c=code: sh.r_forward(c, q, m))
+            mutates = code in (C.CREATE_FILE, C.APPEND_FILE,
+                               C.COMPLETE_FILE, C.RESIZE_FILE,
+                               C.SYMLINK, C.MKDIR)
+            return wrap(lambda q, m, c=code: sh.r_forward(c, q, m),
+                        inval=code if mutates else None)
 
         for code in (C.CREATE_FILE, C.OPEN_FILE, C.APPEND_FILE,
                      C.ADD_BLOCK, C.COMPLETE_FILE, C.GET_BLOCK_LOCATIONS,
@@ -418,14 +458,16 @@ class MasterServer:
         r(C.LIST_STATUS, wrap(sh.r_list_status))
         r(C.LIST_OPTIONS, wrap(sh.r_list_options))
         r(C.CONTENT_SUMMARY, wrap(sh.r_content_summary))
-        r(C.SET_ATTR, wrap(sh.r_set_attr))
-        r(C.FREE, wrap(sh.r_free))
-        r(C.DELETE, wrap(sh.r_delete))
-        r(C.RENAME, wrap(sh.r_rename, cache=True))
-        r(C.LINK, wrap(sh.r_link, cache=True))
+        r(C.SET_ATTR, wrap(sh.r_set_attr, inval=C.SET_ATTR))
+        r(C.FREE, wrap(sh.r_free, inval=C.FREE))
+        r(C.DELETE, wrap(sh.r_delete, inval=C.DELETE))
+        r(C.RENAME, wrap(sh.r_rename, cache=True, inval=C.RENAME))
+        r(C.LINK, wrap(sh.r_link, cache=True, inval=C.LINK))
         for code in (C.CREATE_FILES_BATCH, C.ADD_BLOCKS_BATCH,
                      C.COMPLETE_FILES_BATCH, C.META_BATCH):
-            r(code, wrap(lambda q, m, c=code: sh.r_batch(c, q, m)))
+            r(code, wrap(lambda q, m, c=code: sh.r_batch(c, q, m),
+                         inval=code if code != C.ADD_BLOCKS_BATCH
+                         else None))
         r(C.WORKER_HEARTBEAT, wrap(
             lambda q, m: sh.r_worker_heartbeat(q, m,
                                                self._worker_heartbeat)))
@@ -472,15 +514,77 @@ class MasterServer:
                     rep = await call(req)
                     await self._group_barrier()
                     await self._commit_barrier(msg.deadline)
+                    self._lease_invalidate(msg.code, req)
                     data = pack(rep)
                     self.retry_cache.put(key, data)
                     return {}, data
+            leased = not mutate and self._lease_grant(msg, req, conn)
             rep = await call(req)
             if mutate:
                 await self._group_barrier()
                 await self._commit_barrier(msg.deadline)
+                self._lease_invalidate(msg.code, req)
+            elif leased and isinstance(rep, dict):
+                rep["lease"] = self.leases.token()
             return {}, pack(rep)
         return handler
+
+    # reads that may carry `"lease": True` → register the conn as a
+    # cache holder on the entry's parent directory (the listed dir
+    # itself for LIST_STATUS) and stamp the token into the reply
+    _LEASED_READS = frozenset({int(RpcCode.FILE_STATUS),
+                               int(RpcCode.EXISTS),
+                               int(RpcCode.LIST_STATUS)})
+    # mutation code → request keys naming the namespace paths it touched
+    _INVAL_KEYS = {
+        int(RpcCode.MKDIR): ("path",),
+        int(RpcCode.CREATE_FILE): ("path",),
+        int(RpcCode.DELETE): ("path",),
+        int(RpcCode.APPEND_FILE): ("path",),
+        int(RpcCode.COMPLETE_FILE): ("path",),
+        int(RpcCode.RENAME): ("src", "dst"),
+        int(RpcCode.SET_ATTR): ("path",),
+        int(RpcCode.SYMLINK): ("link",),
+        int(RpcCode.LINK): ("src", "dst"),
+        int(RpcCode.RESIZE_FILE): ("path",),
+        int(RpcCode.FREE): ("path",),
+        int(RpcCode.MOUNT): ("cv_path",),
+        int(RpcCode.UNMOUNT): ("cv_path",),
+        int(RpcCode.UPDATE_MOUNT): ("cv_path",),
+    }
+    _INVAL_BATCHES = frozenset({int(RpcCode.META_BATCH),
+                                int(RpcCode.CREATE_FILES_BATCH),
+                                int(RpcCode.COMPLETE_FILES_BATCH)})
+
+    def _lease_grant(self, msg: Message, req: dict, conn) -> bool:
+        """Register `conn` as a lease holder for a `"lease": True` read.
+        Granted BEFORE the handler runs so ENOENT answers are leased too
+        (the client caches negatives; a later create must push)."""
+        if (self.leases is None or not req.get("lease")
+                or int(msg.code) not in self._LEASED_READS
+                or not isinstance(req.get("path"), str)):
+            return False
+        from curvine_tpu.master.read_leases import parent_dir
+        p = req["path"]
+        self.leases.grant(conn, p if int(msg.code) ==
+                          int(RpcCode.LIST_STATUS) else parent_dir(p))
+        return True
+
+    def _lease_invalidate(self, code: int, req: dict) -> None:
+        """A mutation landed: push META_INVALIDATE for the paths it
+        touched to every conn holding a lease on an affected dir."""
+        if self.leases is None:
+            return
+        code = int(code)
+        if code in self._INVAL_BATCHES:
+            paths = [r.get("path") for r in req.get("requests") or ()
+                     if isinstance(r, dict)]
+        else:
+            keys = self._INVAL_KEYS.get(code)
+            if not keys:
+                return
+            paths = [req.get(k) for k in keys]
+        self.leases.invalidate([p for p in paths if isinstance(p, str)])
 
     async def _group_barrier(self) -> None:
         """Group-commit rule: a mutation is acked only after the journal
@@ -774,9 +878,24 @@ class MasterServer:
                 "uptime_ms": now_ms() - fs.start_ms}
 
     async def _shard_table(self, q):
-        if self.shards is None:
-            return {"shards": []}
-        return {"shards": await self.shards.poll_stats()}
+        """Shard rows plus the read fan-out plane's rollup: lease-
+        manager state, aggregated client.meta_cache.* counters pushed
+        via METRICS_REPORT, and native fast-meta counters. One RPC
+        feeds both the shard table and the read-plane rows of
+        `cv report` (docs/read-plane.md)."""
+        out: dict = {"shards": []}
+        if self.shards is not None:
+            out["shards"] = await self.shards.poll_stats()
+        if self.leases is not None:
+            out["leases"] = self.leases.stats()
+        pre = "client.meta_cache."
+        cache = {k[len(pre):]: v for k, v in self.metrics.counters.items()
+                 if k.startswith(pre)}
+        if cache:
+            out["meta_cache"] = cache
+        if self.fastmeta is not None:
+            out["fastmeta"] = self.fastmeta.counters()
+        return out
 
     def _tenant_stats(self, q):
         return self.qos.snapshot()

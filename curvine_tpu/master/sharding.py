@@ -76,15 +76,19 @@ def parent_of(path: str) -> str:
 
 def derive_shard_conf(conf, idx: int):
     """A shard child's ClusterConf: own journal/meta dirs under the
-    router's, ephemeral port, no raft, no nested sharding, no native
-    read mirror (the router fronts all reads)."""
+    router's, ephemeral port, no raft, no nested sharding. Inproc
+    shards keep their native read mirror (built but never served —
+    the router's FRONT mirror routes to them via mm_fleet_attach);
+    process-backend children would maintain a mirror nothing can
+    reach, so theirs is disabled."""
     sc = copy.deepcopy(conf)
     mc = sc.master
     base = mc.journal_dir.rstrip("/")
     mc.journal_dir = f"{base}/shard{idx}"
     mc.meta_dir = (mc.meta_dir.rstrip("/") or base + "-meta") + f"/shard{idx}"
     mc.rpc_port = 0
-    mc.fast_meta = False
+    if mc.shard_backend != "inproc":
+        mc.fast_meta = False
     mc.raft_peers = []
     mc.meta_shards = 1
     return sc
@@ -193,7 +197,28 @@ class ShardRouter:
                                  journal=self.journal,
                                  shard_id=i, shard_count=self.n)
                 await s.start()
+                if self.master.leases is not None:
+                    # shard-side TTL reclaim pushes META_INVALIDATE
+                    # through the ROUTER's lease plane (clients hold
+                    # leases on router conns, not shard conns). The
+                    # process backend can't reach it — there the lease
+                    # TTL alone bounds staleness.
+                    s.ttl.on_expire = \
+                        lambda path: self.master.leases.invalidate([path])
                 self.shards.append(_InprocShard(i, s))
+            front = self.master.fastmeta
+            if front is not None:
+                members = [s.server.fastmeta for s in self.shards]
+                if all(m is not None for m in members):
+                    # the fast-port front answers from the shard fleet's
+                    # mirrors; MasterServer.start serves it AFTER this
+                    for m in members:
+                        front.fleet_attach(m)
+                else:
+                    # a member failed to build: the front would serve
+                    # holes — disable the whole plane instead
+                    front.close()
+                    self.master.fastmeta = None
         else:
             import multiprocessing
             ctx = multiprocessing.get_context("spawn")

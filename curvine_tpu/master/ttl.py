@@ -49,6 +49,10 @@ class TtlManager:
         self.check_ms = check_ms
         self.buckets = TtlBuckets(bucket_ms)
         self._indexed: dict[int, int] = {}   # inode id -> expire_ms
+        # called with the path after a TTL action lands: the read-lease
+        # plane pushes META_INVALIDATE so clients drop cached entries
+        # for expired files without waiting out their lease
+        self.on_expire = None
 
     def index(self, inode_id: int, mtime: int, ttl_ms: int) -> None:
         old = self._indexed.pop(inode_id, None)
@@ -126,6 +130,11 @@ class TtlManager:
                 elif sp.ttl_action == TtlAction.FREE:
                     self.fs.free(path, recursive=True)
                 acted += 1
+                if self.on_expire is not None:
+                    try:
+                        self.on_expire(path)
+                    except Exception:   # noqa: BLE001 — push best-effort
+                        log.exception("ttl on_expire hook for %s", path)
                 log.info("ttl %s applied to %s", sp.ttl_action.name, path)
             except err.CurvineError as e:
                 log.warning("ttl action on %s failed: %s", path, e)

@@ -65,6 +65,10 @@ class Connection:
         # raise (error), or return False to swallow the send — the caller
         # then times out exactly as if the request was lost on the wire.
         self.fault_hook = None
+        # server-push receiver: unsolicited REQUEST frames (no waiter,
+        # e.g. META_INVALIDATE with req_id=0) land here synchronously on
+        # the read loop; handlers must be non-blocking
+        self.on_push = None
 
     async def connect(self) -> "Connection":
         host, port = self.addr.rsplit(":", 1)
@@ -142,6 +146,12 @@ class Connection:
                     if not (sink is not None and msg.is_chunk
                             and status == 0):
                         q.put_nowait(msg)
+                elif self.on_push is not None and not msg.is_response:
+                    # unsolicited server push (lease invalidation rail)
+                    try:
+                        self.on_push(msg)
+                    except Exception:   # noqa: BLE001 — push must not
+                        log.exception("push handler %s", self.addr)
                 else:
                     log.debug("drop orphan frame req_id=%d", req_id)
         except (ConnectionResetError, OSError):
@@ -379,6 +389,9 @@ class ConnectionPool:
         # client-side fault hook, inherited by every dialed Connection
         # (FaultInjector.install_client); see Connection.fault_hook
         self.fault_hook = None
+        # server-push receiver, inherited the same way (meta lease cache
+        # invalidation); see Connection.on_push
+        self.push_handler = None
 
     def set_fault_hook(self, hook) -> None:
         """Install/remove the client fault hook on this pool AND every
@@ -387,6 +400,14 @@ class ConnectionPool:
         for conns in self._conns.values():
             for c in conns:
                 c.fault_hook = hook
+
+    def set_push_handler(self, handler) -> None:
+        """Install/remove the server-push receiver on this pool AND
+        every already-dialed connection (new dials inherit it)."""
+        self.push_handler = handler
+        for conns in self._conns.values():
+            for c in conns:
+                c.on_push = handler
 
     async def get(self, addr: str) -> Connection:
         async with self._lock:
@@ -421,6 +442,7 @@ class ConnectionPool:
                                   rpc_conf=self.rpc_conf,
                                   metrics=self.metrics)
                 conn.fault_hook = self.fault_hook
+                conn.on_push = self.push_handler
                 return await conn.connect()
             except ConnectError as e:
                 last = e

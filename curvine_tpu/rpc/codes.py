@@ -72,6 +72,12 @@ class RpcCode(enum.IntEnum):
     # process's ring buffer; the master additionally fans the request
     # out to workers when asked to collect (web /api/trace, `cv trace`)
     GET_SPANS = 62
+    # metadata lease invalidation push (master → client, req_id=0, no
+    # response expected): `{"paths": [...], "epoch": e}` over the
+    # already-open client connection on rename/delete/resize/TTL-expiry.
+    # The future FUSE inval_entry/inval_inode notify plane consumes the
+    # SAME message — docs/read-plane.md.
+    META_INVALIDATE = 63
 
     # sharded namespace plane (master/sharding.py). SHARD_TX drives the
     # cross-shard two-phase protocol on a participant shard

@@ -38,10 +38,11 @@ class MiniCluster:
         self.conf.master.heartbeat_check_ms = 200
         if shards > 1:
             # sharded namespace: defaults to the inproc backend (shard
-            # servers share this loop — same wire path, no processes)
+            # servers share this loop — same wire path, no processes).
+            # fast_meta stays at its default: the inproc router fronts
+            # the shard mirrors natively (mm_fleet_attach)
             self.conf.master.meta_shards = shards
             self.conf.master.shard_backend = shard_backend
-            self.conf.master.fast_meta = False
         self.conf.client.block_size = block_size
         self.journal = journal
         self.tier_capacity = tier_capacity

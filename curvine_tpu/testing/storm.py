@@ -86,6 +86,11 @@ class StormReport:
     leaked_tasks: list[str] = field(default_factory=list)
     degraded_read_s: float | None = None
     degraded_read_bound_s: float | None = None
+    # stale-stat probe (stale_probe=True): seconds a lease-cached stat
+    # stayed stale after a master restart + remote mutation, and the
+    # contract bound it must land under (lease TTL + push slack)
+    stale_stat_s: float | None = None
+    stale_stat_bound_s: float | None = None
     # observability probe (trace_probe=True): violations collected here
     trace_problems: list[str] = field(default_factory=list)
     trace_span_count: int = 0
@@ -112,6 +117,12 @@ class StormReport:
             return True
         return self.degraded_read_s < self.degraded_read_bound_s
 
+    @property
+    def stale_stat_bounded(self) -> bool:
+        if self.stale_stat_s is None:
+            return True
+        return self.stale_stat_s < self.stale_stat_bound_s
+
     def assert_invariants(self) -> None:
         problems = []
         if self.integrity_errors:
@@ -125,6 +136,11 @@ class StormReport:
             problems.append(
                 f"degraded read took {self.degraded_read_s:.2f}s "
                 f">= bound {self.degraded_read_bound_s:.2f}s")
+        if not self.stale_stat_bounded:
+            problems.append(
+                f"lease-cached stat stayed stale {self.stale_stat_s:.2f}s "
+                f">= bound {self.stale_stat_bound_s:.2f}s after master "
+                "restart")
         if self.trace_problems:
             problems.append(f"trace: {self.trace_problems}")
         if not self.evacuation_converged:
@@ -150,6 +166,7 @@ class ChaosStorm:
                  converge_timeout_s: float = 25.0,
                  master_restarts: bool = True,
                  degraded_probe: bool = True,
+                 stale_probe: bool = False,
                  trace_probe: bool = False,
                  disk_faults: bool = False,
                  base_dir: str | None = None,
@@ -168,6 +185,7 @@ class ChaosStorm:
         self.converge_timeout_s = converge_timeout_s
         self.master_restarts = master_restarts
         self.degraded_probe = degraded_probe
+        self.stale_probe = stale_probe
         self.trace_probe = trace_probe
         self.disk_faults = disk_faults
         self.base_dir = base_dir
@@ -554,6 +572,49 @@ class ChaosStorm:
         finally:
             inj.remove(fid)
 
+    async def _probe_stale_stat(self, mc: MiniCluster) -> None:
+        """Read fan-out plane staleness probe (docs/read-plane.md): an
+        observer client warms its lease cache, the master restarts (the
+        holder table is soft state — gone, and a fresh lease epoch is
+        minted), then ANOTHER client deletes one of the cached paths.
+        No push can reach the observer (the restarted master never knew
+        it), so only the entry TTL / epoch flush bounds its staleness:
+        the observer must stop seeing the deleted path within lease TTL
+        + slack. Serving the stale positive past that bound breaks the
+        bounded-staleness contract the cache is allowed to exist by."""
+        obs, mut = mc.client(), mc.client()
+        if obs.meta.cache is None:
+            return
+        keep, gone = "/storm/stale/keep", "/storm/stale/gone"
+        await mut.meta.mkdir("/storm/stale")
+        await mut.meta.create_file(keep)
+        await mut.meta.create_file(gone)
+        # warm the observer's cache under lease
+        assert await obs.meta.exists(keep)
+        assert await obs.meta.exists(gone)
+
+        await mc.restart_master()
+        self._minj.install(mc.master.rpc)
+        self._tune_master(mc)
+        await mut.meta.delete(gone)        # mutation the observer can't
+        #                                    be pushed about
+        bound = mc.conf.master.meta_lease_ms / 1000 + 2.0
+        t0 = time.monotonic()
+        # measure PAST the bound so a violation reports by how much
+        deadline = t0 + bound + 3.0
+        while await obs.meta.exists(gone):
+            if time.monotonic() >= deadline:
+                break
+            await asyncio.sleep(0.05)
+        self.report.stale_stat_s = time.monotonic() - t0
+        self.report.stale_stat_bound_s = bound
+        # the epoch flush must not have broken correct reads
+        if not await obs.meta.exists(keep):
+            self.report.integrity_errors.append(
+                "stale-stat probe: surviving file vanished from the "
+                "observer after the lease-epoch flush")
+        await mc.await_workers(self.n_workers, timeout=15.0)
+
     async def _probe_traced_failover(self, mc: MiniCluster) -> None:
         """Observability invariants under chaos (docs/observability.md):
 
@@ -660,6 +721,8 @@ class ChaosStorm:
             for c in self._client_counters)
         if self.degraded_probe:
             await self._probe_degraded_read(mc)
+        if self.stale_probe:
+            await self._probe_stale_stat(mc)
         if self.trace_probe:
             await self._probe_traced_failover(mc)
 

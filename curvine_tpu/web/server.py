@@ -142,8 +142,14 @@ class WebServer:
             src.metrics.gauge("trace.spans_stored", len(tracer.store))
         if (self.master is not None
                 and getattr(self.master, "fastmeta", None) is not None):
-            # native read plane counters ride the same scrape
+            # native read plane counters ride the same scrape;
+            # shard_hits is per-member — expand to indexed gauges
+            # plus a fleet total
             for k, v in self.master.fastmeta.counters().items():
+                if isinstance(v, list):
+                    for i, h in enumerate(v):
+                        self.master.metrics.gauge(f"fastmeta.{k}.{i}", h)
+                    v = sum(v)
                 self.master.metrics.gauge(f"fastmeta.{k}", v)
         text = src.metrics.prometheus_text() if src else ""
         return web.Response(text=text, content_type="text/plain")
@@ -219,15 +225,21 @@ class WebServer:
             return self._json({"error": str(e)})
 
     async def _shards(self, req):
-        """Sharded-namespace table: one row per metadata shard (empty
-        list on an unsharded master)."""
-        if self.master is None or getattr(
-                self.master, "shards", None) is None:
-            return self._json([])
-        try:
-            return self._json(await self.master.shards.poll_stats())
-        except Exception as e:  # noqa: BLE001 — http boundary
-            return self._json({"error": str(e)})
+        """Sharded-namespace table plus the read-lease plane's state:
+        {"shards": [...], "leases": {...}|null}. shards is empty on an
+        unsharded master; leases is null when the push rail is off
+        (follower / shard actor)."""
+        if self.master is None:
+            return self._json({"shards": [], "leases": None})
+        leases = getattr(self.master, "leases", None)
+        out = {"shards": [],
+               "leases": leases.stats() if leases is not None else None}
+        if getattr(self.master, "shards", None) is not None:
+            try:
+                out["shards"] = await self.master.shards.poll_stats()
+            except Exception as e:  # noqa: BLE001 — http boundary
+                out["error"] = str(e)
+        return self._json(out)
 
     async def _tenants(self, req):
         """Multi-tenant admission snapshot (common/qos.py): per-tenant

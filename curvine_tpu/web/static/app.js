@@ -265,13 +265,20 @@ async function jobs() {
   });
 }
 
-/* shards view: per-shard namespace plane rows (sharded masters only) */
+/* shards view: per-shard namespace plane rows plus the read-lease
+   plane's state (client meta-cache push rail) */
 async function shards() {
-  const rows = await api("/api/shards");
-  if (rows.error) { view.innerHTML = `<div class="empty">${esc(rows.error)}</div>`; return; }
+  const d = await api("/api/shards");
+  if (d.error && !d.shards) { view.innerHTML = `<div class="empty">${esc(d.error)}</div>`; return; }
+  const rows = d.shards || [];
+  const ls = d.leases;
+  const leases = ls ? `<h2>Read leases</h2><p>
+    ${ls.dirs} dirs · ${ls.holders} holders · ${ls.granted} granted ·
+    ${ls.pushes} pushes (${ls.push_errors} errors) ·
+    ttl ${ls.ttl_ms} ms · epoch ${ls.epoch}</p>` : "";
   if (!rows.length) {
     view.innerHTML = `<h2>Namespace shards</h2>
-      <div class="empty">unsharded master (master.meta_shards = 1)</div>`;
+      <div class="empty">unsharded master (master.meta_shards = 1)</div>` + leases;
     return;
   }
   const tr = rows.map(r => `<tr><td>${r.shard}</td>
@@ -283,7 +290,7 @@ async function shards() {
     <td>${r.queue_depth ?? ""}</td></tr>`).join("");
   view.innerHTML = `<h2>Namespace shards</h2><table>
     <tr><th>shard</th><th>addr</th><th>state</th><th>qps</th><th>inodes</th>
-    <th>blocks</th><th>journal seq</th><th>queue depth</th></tr>${tr}</table>`;
+    <th>blocks</th><th>journal seq</th><th>queue depth</th></tr>${tr}</table>` + leases;
 }
 
 /* blocks view: file → block map with locations

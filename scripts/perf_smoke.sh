@@ -9,6 +9,7 @@
 #   BENCH_ANN=0 skips the ANN gate (direct-IO only).
 #   BENCH_TRACE=0 skips the tracing-overhead gate.
 #   BENCH_META=0 skips the metadata write-plane gate.
+#   BENCH_READPLANE=0 skips the read-plane (stat ladder) gate.
 #   BENCH_RPC=0 skips the RPC transport gate.
 #   BENCH_VERIFY=0 skips the read-verification overhead gate.
 #   BENCH_QOS=0 skips the admission-overhead gate.
@@ -210,6 +211,67 @@ if rtt > ceiling:
 if qps < qps_gate:
     print(f"perf_smoke: FAIL — rpc_pipelined_qps {qps} < {qps_gate:.1f} "
           f"(floor {qps_floor} - 30%)", file=sys.stderr)
+    sys.exit(1)
+print("perf_smoke: PASS")
+EOF
+    rc=$?
+    [ $rc -ne 0 ] && exit $rc
+fi
+
+if [ "${BENCH_READPLANE:-1}" = "0" ]; then
+    echo "perf_smoke: read-plane gate skipped (BENCH_READPLANE=0)"
+else
+    # read fan-out plane gate: serial RPC stats vs lease-warm cached
+    # stats plus the open+pread ladder tail. The speedup ratio is an
+    # ABSOLUTE floor (the cache must take the wire out of the hot stat
+    # path — see docs/read-plane.md); the QPS floors get 30% slack and
+    # the p99 ceiling is absolute.
+    RP_OUT=$(JAX_PLATFORMS=cpu timeout 150 python - <<'EOF'
+import asyncio, json, os, sys
+sys.path.insert(0, os.getcwd())
+from bench import _read_plane_smoke
+print(json.dumps(asyncio.run(_read_plane_smoke())))
+EOF
+)
+    rc=$?
+    if [ $rc -ne 0 ] || [ -z "$RP_OUT" ]; then
+        echo "perf_smoke: read-plane microbench failed (rc=$rc)" >&2
+        exit 2
+    fi
+    echo "$RP_OUT"
+    python - "$FLOOR_FILE" <<'EOF' "$RP_OUT"
+import json, sys
+floor_file, result = sys.argv[1], json.loads(sys.argv[2])
+floors = json.load(open(floor_file))
+stat = result.get("meta_stat_qps", 0.0)
+cached = result.get("meta_stat_cached_qps", 0.0)
+speedup = result.get("meta_cache_speedup", 0.0)
+p99 = result.get("open_read_p99_ms", 1e9)
+stat_gate = floors["meta_stat_qps"] * 0.7       # >30% regression fails
+cached_gate = floors["meta_stat_cached_qps"] * 0.7
+print(f"perf_smoke: meta_stat_qps={stat} gate={stat_gate:.0f} "
+      f"meta_stat_cached_qps={cached} gate={cached_gate:.0f} "
+      f"speedup={speedup} floor={floors['meta_cache_speedup_min']} "
+      f"open_read_p99_ms={p99} ceiling={floors['open_read_p99_ms_max']}")
+if stat < stat_gate:
+    print(f"perf_smoke: FAIL — meta_stat_qps {stat} < {stat_gate:.0f} "
+          f"(floor {floors['meta_stat_qps']} - 30%)", file=sys.stderr)
+    sys.exit(1)
+if cached < cached_gate:
+    print(f"perf_smoke: FAIL — meta_stat_cached_qps {cached} < "
+          f"{cached_gate:.0f} (floor {floors['meta_stat_cached_qps']} "
+          "- 30%)", file=sys.stderr)
+    sys.exit(1)
+if speedup < floors["meta_cache_speedup_min"]:
+    print(f"perf_smoke: FAIL — meta_cache_speedup {speedup}x < "
+          f"{floors['meta_cache_speedup_min']}x (absolute floor: the "
+          "lease cache must beat the wire by an order of magnitude)",
+          file=sys.stderr)
+    sys.exit(1)
+if p99 > floors["open_read_p99_ms_max"]:
+    print(f"perf_smoke: FAIL — open_read_p99_ms {p99} > "
+          f"{floors['open_read_p99_ms_max']} (warm open+read tail "
+          "regressed)", file=sys.stderr)
     sys.exit(1)
 print("perf_smoke: PASS")
 EOF

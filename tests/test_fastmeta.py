@@ -83,23 +83,32 @@ async def test_fast_list_wire_identical_to_python_port():
 
 async def test_fast_list_mounted_paths_fall_back(tmp_path):
     """Listings that intersect a mount merge UFS entries — the mirror
-    must decline them (before AND after the mount exists)."""
+    must decline them (before AND after the mount exists). The client
+    read ladder is cache → fast port → Python port, and only a warm
+    directory lease sends a miss to the fast port — so each probe
+    lists once to bootstrap the lease, drops the local copy, and
+    lists again to actually reach the native plane."""
     async with MiniCluster(workers=1) as mc:
         c = mc.client()
         (tmp_path / "u.bin").write_bytes(b"z" * 9)
         await c.meta.mkdir("/plain")
         await c.meta.mount("/m/pt", f"file://{tmp_path}")
+
+        async def relist(path):
+            await c.meta.list_status(path)       # lease bootstrap
+            c.meta.cache.invalidate([path])      # drop copy, keep lease
+            return [s.name for s in await c.meta.list_status(path)]
+
         fb0 = mc.master.fastmeta.counters()["fallbacks"]
         # inside the mount: uncached UFS object must appear
-        names = [s.name for s in await c.meta.list_status("/m/pt")]
-        assert "u.bin" in names
+        assert "u.bin" in await relist("/m/pt")
         # ancestor of the mount: must also fall back (mount point dirs
         # ride the cache namespace, but Python owns the merge semantics)
-        await c.meta.list_status("/m")
+        await relist("/m")
         assert mc.master.fastmeta.counters()["fallbacks"] > fb0
         # unrelated dir still serves fast
         s0 = mc.master.fastmeta.counters()["served"]
-        await c.meta.list_status("/plain")
+        await relist("/plain")
         assert mc.master.fastmeta.counters()["served"] > s0
         await c.close()
 
@@ -196,11 +205,63 @@ async def test_fast_survives_master_restart():
         await mc.restart_master()
         c2 = mc.client()
         served0 = mc.master.fastmeta.counters()["served"]
-        st = await c2.meta.file_status("/boot/deep")
+        st = await c2.meta.file_status("/boot/deep")   # lease bootstrap
+        assert st.is_dir
+        c2.meta.cache.invalidate(["/boot/deep"])       # keep the lease
+        st = await c2.meta.file_status("/boot/deep")   # rides fast port
         assert st.is_dir
         assert mc.master.fastmeta.counters()["served"] > served0
         await c.close()
         await c2.close()
+
+
+async def test_fast_sharded_fleet_serves_from_members():
+    """meta_shards=2 (inproc backend): the router's fast port answers
+    from the shard fleet's mirrors, routed by the same crc32(parent)
+    partition as the Python router — so stats and file-only listings
+    are wire-identical to the routed Python port, and hits land on the
+    owning member. Directory inodes exist independently on every shard
+    (striped ids, own mtimes), so dir-bearing listings assert only on
+    the entry NAME set — same weak consistency the Python merge has."""
+    from curvine_tpu.master.sharding import shard_of
+    async with MiniCluster(workers=0, shards=2) as mc:
+        c = mc.client()
+        d0 = d1 = None
+        for i in range(256):
+            d = f"/fs{i}"
+            s = shard_of(f"{d}/x", 2)
+            if s == 0 and d0 is None:
+                d0 = d
+            elif s == 1 and d1 is None:
+                d1 = d
+            if d0 and d1:
+                break
+        for d in (d0, d1):
+            await c.meta.mkdir(d)
+            await c.meta.create_file(f"{d}/f")
+            await c.meta.complete_file(f"{d}/f", 0)
+        host = mc.master.addr.rsplit(":", 1)[0]
+        fast = f"{host}:{mc.master.fastmeta.port}"
+        # stats route to one member on both ports: exact wire parity
+        for path in (f"{d0}/f", f"{d1}/f", d0, d1, "/"):
+            slow = await _raw_status(c, mc.master.addr, path)
+            quick = await _raw_status(c, fast, path)
+            assert quick == slow, f"wire divergence for {path}"
+        # file-only listings co-locate on the owner: exact parity
+        for path in (d0, d1):
+            slow = await _raw_list(c, mc.master.addr, path)
+            quick = await _raw_list(c, fast, path)
+            assert quick == slow, f"list divergence for {path}"
+        # dir-bearing listing: name-set parity
+        slow = {s["name"] for s in await _raw_list(c, mc.master.addr, "/")}
+        quick = {s["name"] for s in await _raw_list(c, fast, "/")}
+        assert quick == slow
+        hits = mc.master.fastmeta.counters()["shard_hits"]
+        assert len(hits) == 2 and all(h > 0 for h in hits)
+        # absent file: clean FAST_MISS so the client falls back
+        with pytest.raises(err.FastMiss):
+            await _raw_status(c, fast, f"{d0}/nope")
+        await c.close()
 
 
 async def test_fast_gating_tracks_leadership(tmp_path):

@@ -1,0 +1,208 @@
+"""Client metadata lease cache consistency (docs/read-plane.md).
+
+Unit coverage of client/meta_cache.py (LRU bounds, negative entries,
+lease adoption, epoch flush, subtree invalidation) plus the cross-client
+contracts the cache must honor against a live master:
+
+  * read-your-writes — the WRITING client is never stale, immediately;
+  * negative-entry vs create — a cached ENOENT must be revoked by the
+    master's META_INVALIDATE push when another client creates the path;
+  * rename/delete invalidation — cached positives must drop within the
+    staleness bound (one push RTT normally, the lease TTL worst-case).
+
+Cross-client assertions POLL with a deadline past the lease TTL: the
+push lands asynchronously, so instant visibility is not the contract —
+bounded visibility is, and staleness past the bound is a bug."""
+
+import asyncio
+import time
+
+from curvine_tpu.client.meta_cache import MISS, MetaCache
+from curvine_tpu.testing import MiniCluster
+
+TOKEN = {"ttl_ms": 3_000, "epoch": 17}
+
+
+async def _until(pred, timeout: float = 4.0) -> bool:
+    """Poll an async predicate until true or the staleness bound (lease
+    TTL 3s + push slack) passes."""
+    deadline = time.monotonic() + timeout
+    while not await pred():
+        if time.monotonic() >= deadline:
+            return False
+        await asyncio.sleep(0.02)
+    return True
+
+
+# ---------------------------------------------------------------------------
+# unit: cache mechanics
+
+
+def test_cache_caches_nothing_before_lease():
+    """Until the master grants a TTL, every put is a no-op: the client
+    must not invent its own staleness bound."""
+    cache = MetaCache()
+    cache.put("stat", "/a", "st")
+    assert cache.get("stat", "/a") is MISS
+    cache.note_lease(TOKEN, "/")
+    cache.put("stat", "/a", "st")
+    assert cache.get("stat", "/a") == "st"
+
+
+def test_cache_negative_entries_and_counters():
+    cache = MetaCache()
+    cache.note_lease(TOKEN, "/d")
+    cache.put("stat", "/d/missing", None)      # cached ENOENT
+    assert cache.get("stat", "/d/missing") is None
+    assert cache.get("stat", "/d/other") is MISS
+    assert cache.counters["meta_cache.hits"] == 1
+    assert cache.counters["meta_cache.misses"] == 1
+
+
+def test_cache_lru_bound_evicts_oldest():
+    cache = MetaCache(entries=2)
+    cache.note_lease(TOKEN, "/")
+    cache.put("stat", "/a", 1)
+    cache.put("stat", "/b", 2)
+    cache.put("stat", "/c", 3)
+    assert cache.get("stat", "/a") is MISS
+    assert cache.get("stat", "/b") == 2
+    assert cache.get("stat", "/c") == 3
+    assert cache.counters["meta_cache.evictions"] == 1
+
+
+def test_cache_invalidate_drops_entry_and_parent_listing():
+    cache = MetaCache()
+    cache.note_lease(TOKEN, "/d")
+    cache.put("stat", "/d/f", "st")
+    cache.put("list", "/d", ["f"])
+    cache.invalidate(["/d/f"])
+    assert cache.get("stat", "/d/f") is MISS
+    assert cache.get("list", "/d") is MISS      # child changed → listing
+
+
+def test_cache_invalidate_subtree_sweeps_descendants():
+    """Rename/recursive delete push only the TOP path; everything the
+    client cached underneath must go with it."""
+    cache = MetaCache()
+    cache.note_lease(TOKEN, "/d")
+    cache.put("stat", "/d/sub/deep", "st")
+    cache.put("list", "/d/sub", ["deep"])
+    cache.put("stat", "/dx", "kept")            # sibling, no slash match
+    cache.invalidate(["/d"], subtree=True)
+    assert cache.get("stat", "/d/sub/deep") is MISS
+    assert cache.get("list", "/d/sub") is MISS
+    assert cache.get("stat", "/dx") == "kept"
+
+
+def test_cache_epoch_change_flushes_everything():
+    """A new lease epoch means the master restarted and its holder table
+    is gone: every entry AND every warm directory lease must drop."""
+    cache = MetaCache()
+    cache.note_lease(TOKEN, "/d")
+    cache.put("stat", "/d/f", "st")
+    assert cache.lease_ok("/d")
+    cache.note_epoch(TOKEN["epoch"] + 1)
+    assert cache.get("stat", "/d/f") is MISS
+    assert not cache.lease_ok("/d")
+
+
+# ---------------------------------------------------------------------------
+# integration: consistency contracts against a live master
+
+
+async def test_read_your_writes_is_immediate():
+    """The writing client is NEVER stale — write-through invalidation is
+    synchronous with the mutation ack, so there is no poll here."""
+    async with MiniCluster(workers=0) as mc:
+        c = mc.client()
+        await c.meta.mkdir("/ryw")
+        await c.meta.create_file("/ryw/f")
+        assert await c.meta.exists("/ryw/f")
+        hits0 = c.meta.cache.counters.get("meta_cache.hits", 0)
+        assert await c.meta.exists("/ryw/f")       # served locally
+        assert c.meta.cache.counters["meta_cache.hits"] > hits0
+
+        await c.meta.delete("/ryw/f")
+        assert not await c.meta.exists("/ryw/f")   # immediately gone
+        await c.meta.create_file("/ryw/f")
+        assert await c.meta.exists("/ryw/f")       # immediately back
+
+        # a cached PARENT LISTING must reflect a child mutation too
+        names = [s.name for s in await c.meta.list_status("/ryw")]
+        assert names == ["f"]
+        await c.meta.create_file("/ryw/g")
+        names = [s.name for s in await c.meta.list_status("/ryw")]
+        assert sorted(names) == ["f", "g"]
+
+
+async def test_negative_entry_revoked_by_remote_create():
+    """Client A caches an ENOENT under lease; client B creates the path.
+    The master pushes META_INVALIDATE to A (negatives are leased too —
+    the grant happens before the handler answers), so A must see the
+    file within the staleness bound."""
+    async with MiniCluster(workers=0) as mc:
+        a, b = mc.client(), mc.client()
+        await b.meta.mkdir("/nc")
+        # adopt a lease TTL first: ENOENT replies carry no token, so a
+        # fresh client can't cache negatives until one positive leased
+        # read has told it how long answers may be believed
+        assert await a.meta.exists("/nc")
+        assert not await a.meta.exists("/nc/f")
+        misses0 = a.meta.cache.counters.get("meta_cache.misses", 0)
+        assert not await a.meta.exists("/nc/f")    # cached negative
+        assert a.meta.cache.counters.get(
+            "meta_cache.misses", 0) == misses0
+
+        await b.meta.create_file("/nc/f")
+        assert await _until(lambda: a.meta.exists("/nc/f")), \
+            "cached negative outlived the staleness bound after create"
+
+
+async def test_rename_invalidates_both_ends_within_ttl():
+    async with MiniCluster(workers=0) as mc:
+        a, b = mc.client(), mc.client()
+        await b.meta.mkdir("/rn")
+        await b.meta.create_file("/rn/src")
+        assert await a.meta.exists("/rn/src")      # cached positive
+        assert not await a.meta.exists("/rn/dst")  # cached negative
+
+        await b.meta.rename("/rn/src", "/rn/dst")
+
+        async def moved():
+            return (not await a.meta.exists("/rn/src")
+                    and await a.meta.exists("/rn/dst"))
+        assert await _until(moved), \
+            "rename: stale entries outlived the staleness bound"
+
+
+async def test_delete_invalidates_remote_cache_within_ttl():
+    async with MiniCluster(workers=0) as mc:
+        a, b = mc.client(), mc.client()
+        await b.meta.mkdir("/del")
+        await b.meta.create_file("/del/f")
+        st = await a.meta.file_status("/del/f")
+        assert st is not None and st.name == "f"
+
+        await b.meta.delete("/del/f")
+
+        async def gone():
+            return not await a.meta.exists("/del/f")
+        assert await _until(gone), \
+            "delete: stale positive outlived the staleness bound"
+
+
+async def test_cross_client_listing_tracks_remote_create():
+    async with MiniCluster(workers=0) as mc:
+        a, b = mc.client(), mc.client()
+        await b.meta.mkdir("/ls")
+        await b.meta.create_file("/ls/one")
+        assert [s.name for s in await a.meta.list_status("/ls")] == ["one"]
+
+        await b.meta.create_file("/ls/two")
+
+        async def sees_two():
+            names = [s.name for s in await a.meta.list_status("/ls")]
+            return sorted(names) == ["one", "two"]
+        assert await _until(sees_two), \
+            "cached listing outlived the staleness bound after create"

@@ -92,8 +92,10 @@ async def test_fault_injection_delay_error_drop():
         inj = FaultInjector().install(mc.master.rpc)
         c = mc.client()
         # faults are injected into the PYTHON rpc server; stat/exists
-        # must not ride the native fast port around the injector here
+        # must not ride the native fast port or the lease cache around
+        # the injector here
         c.meta._fast_enabled = False
+        c.meta.cache = None
         # error injection on FILE_STATUS
         fid = inj.add(FaultSpec(kind="error", codes=[int(RpcCode.FILE_STATUS)],
                                 error_code=int(cerr.ErrorCode.IO)))

@@ -180,18 +180,32 @@ async def test_shards1_degenerates_and_raft_exclusive():
         MasterServer(conf, journal=False)
 
 
-def test_router_never_builds_fastmeta():
-    """The native read plane must stay OFF on the shard router: its
-    local store owns no files, so the mirror would serve empty
-    stat/list answers that bypass the shard fleet (found live — the
-    default conf has fast_meta on, while MiniCluster turns it off)."""
-    from curvine_tpu.master import MasterServer
+def test_router_fastmeta_tracks_backend():
+    """The router's front mirror exists only where it can reach the
+    member mirrors. The process backend leaves it OFF — the members
+    live in child address spaces, and a front answering from its own
+    (fileless) store would serve empty stats that bypass the fleet.
+    The inproc backend builds it: reads route to the attached shard
+    mirrors (mm_fleet_attach) by the same crc32(parent) partition the
+    Python router uses."""
+    from curvine_tpu.master import MasterServer, fastmeta
     conf = ClusterConf()
     conf.master.meta_shards = 2
-    assert conf.master.fast_meta      # the default that bit us
+    assert conf.master.fast_meta              # the default
+    assert conf.master.shard_backend == "process"
     srv = MasterServer(conf, journal=False)
     assert srv.sharded
     assert srv.fastmeta is None
+    conf2 = ClusterConf()
+    conf2.master.meta_shards = 2
+    conf2.master.shard_backend = "inproc"
+    srv2 = MasterServer(conf2, journal=False)
+    assert srv2.sharded
+    if fastmeta.available():
+        assert srv2.fastmeta is not None
+        srv2.fastmeta.close()
+    else:
+        assert srv2.fastmeta is None
 
 
 # ---------------------------------------------------------------------------

@@ -95,6 +95,25 @@ async def test_storm_trace_probe(tmp_path):
         "wedged replica attempt left no error span"
 
 
+async def test_storm_stale_stat_probe(tmp_path):
+    """Read fan-out plane under chaos (docs/read-plane.md): after the
+    storm quiesces, a lease-cached stat must stop serving a deleted
+    path within lease TTL + slack even when the master restarted in
+    between — the restarted master never knew the observer, so no push
+    can save it; the entry TTL / epoch flush is the only bound."""
+    storm = ChaosStorm(17, workers=3, replicas=2, duration_s=1.0,
+                       event_interval_s=0.2, writer_tasks=2,
+                       reader_tasks=1, file_size=64 * 1024,
+                       degraded_probe=False, stale_probe=True,
+                       base_dir=str(tmp_path))
+    report = await storm.run()
+    report.assert_invariants()
+    assert report.stale_stat_s is not None, "stale-stat probe never ran"
+    assert report.stale_stat_bounded, (
+        f"stat stayed stale {report.stale_stat_s:.2f}s >= "
+        f"{report.stale_stat_bound_s:.2f}s")
+
+
 MEMBERSHIP_SEEDS = [21, 22]
 
 

@@ -6,7 +6,9 @@ interaction the sharded plane relies on: each shard actor runs its OWN
 TtlManager over its partition, so expiry must act only on files the
 shard owns while the router-visible namespace reflects the reclaim."""
 
+import asyncio
 import os
+import time
 
 from curvine_tpu.common.types import SetAttrOpts, TtlAction, now_ms
 from curvine_tpu.master.sharding import shard_of
@@ -29,6 +31,20 @@ def _dir_pair(n: int = 2) -> tuple[str, str]:
         if d0 and d1:
             return d0, d1
     raise AssertionError("crc32 could not split 256 dirs over 2 shards")
+
+
+async def _reclaimed(c, path: str, timeout: float = 4.0) -> bool:
+    """True once the client stops seeing `path`. TTL actions land
+    master-side with no client RPC in the loop, so the client's lease
+    cache may serve the old entry until the META_INVALIDATE push is
+    delivered — normally one loop tick, at worst the lease TTL
+    (docs/read-plane.md). Staleness past that bound is a bug."""
+    deadline = time.monotonic() + timeout
+    while await c.meta.exists(path):
+        if time.monotonic() >= deadline:
+            return False
+        await asyncio.sleep(0.02)
+    return True
 
 
 # ---------------------------------------------------------------------------
@@ -101,8 +117,8 @@ async def test_ttl_delete_vs_free_actions():
         assert await c.meta.exists("/ttl/gone")
         # drive the clock past expiry instead of sleeping on the checker
         assert ttl.check(now_ms() + 60_000) == 2
-        # DELETE: metadata gone
-        assert not await c.meta.exists("/ttl/gone")
+        # DELETE: metadata gone (push-bounded client visibility)
+        assert await _reclaimed(c, "/ttl/gone")
         # FREE: metadata kept, cache dropped
         st = await c.meta.file_status("/ttl/freed")
         assert st.len == 1 * MB
@@ -137,7 +153,7 @@ async def test_ttl_refresh_reindexes_instead_of_reclaiming():
         assert ttl._indexed[node.id] == node.mtime + 1_000
         # once the REAL expiry passes, the action lands
         assert ttl.check(node.mtime + 60_000) == 1
-        assert not await c.meta.exists("/fresh")
+        assert await _reclaimed(c, "/fresh")
 
 
 async def test_ttl_rescan_rebuilds_index():
@@ -161,8 +177,8 @@ async def test_ttl_rescan_rebuilds_index():
         ttl.rescan()
         assert ttl._indexed == want
         assert ttl.check(now_ms() + 60_000) == 2
-        assert not await c.meta.exists("/rs/a")
-        assert not await c.meta.exists("/rs/b")
+        assert await _reclaimed(c, "/rs/a")
+        assert await _reclaimed(c, "/rs/b")
         assert await c.meta.exists("/rs/plain")
 
 
@@ -192,11 +208,11 @@ async def test_sharded_ttl_expires_per_shard():
         late = now_ms() + 60_000
         # shard 0's checker fires: ITS file goes, shard 1's survives
         assert s0.ttl.check(late) == 1
-        assert not await c.meta.exists(f"{d0}/exp")
+        assert await _reclaimed(c, f"{d0}/exp")
         assert await c.meta.exists(f"{d1}/exp")
         # shard 1 reclaims its own on its own cadence
         assert s1.ttl.check(late) == 1
-        assert not await c.meta.exists(f"{d1}/exp")
+        assert await _reclaimed(c, f"{d1}/exp")
         # dir skeleton stays put everywhere
         for srv in (s0, s1):
             assert srv.fs.exists(d0) and srv.fs.exists(d1)
@@ -227,4 +243,4 @@ async def test_sharded_ttl_rescan_stays_partitioned():
         assert await c.meta.exists(f"{d0}/f0")
         assert s0.ttl.check(now_ms() + 60_000) == 3
         for i in range(3):
-            assert not await c.meta.exists(f"{d0}/f{i}")
+            assert await _reclaimed(c, f"{d0}/f{i}")

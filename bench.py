@@ -370,6 +370,62 @@ async def _qos_overhead_bench(file_kb: int = 4096, read_kb: int = 64,
     return out
 
 
+async def _write_replay_overhead_bench(block_kb: int = 1024,
+                                       blocks: int = 4, ops: int = 10,
+                                       rounds: int = 4) -> dict:
+    """Write-pipeline replay-buffer gate (docs/resilience.md "Write
+    pipeline"): fault-free whole-file writes over the RPC upload path
+    with client.write_replay_buffer ON (the default) must stay within
+    write_replay_overhead_pct_max of OFF. The buffer is one bytearray
+    append per chunk, cleared at every block seal — this gate keeps it
+    that cheap. Rounds alternate off/on and the best of each side is
+    compared (same noise filter as _read_verify_overhead_bench).
+    Returns {write_replay_gibs_off, write_replay_gibs_on,
+    write_replay_overhead_pct}."""
+    import copy
+    import shutil
+    import tempfile
+    from curvine_tpu.client import CurvineClient
+    from curvine_tpu.testing.cluster import MiniCluster
+
+    base = tempfile.mkdtemp(prefix="curvine-replayov-")
+    mc = MiniCluster(workers=1, base_dir=base,
+                     block_size=block_kb * 1024)
+    mc.conf.client.short_circuit = False
+    out: dict = {}
+    try:
+        await mc.start()
+        c_on = mc.client()
+        conf_off = copy.deepcopy(mc.conf)
+        conf_off.client.write_replay_buffer = False
+        c_off = CurvineClient(conf_off)
+        size = block_kb * 1024 * blocks
+        data = os.urandom(size)
+
+        async def gibs(client, path: str) -> float:
+            await client.write_all(path, data)      # warm connections
+            t0 = time.perf_counter()
+            for _ in range(ops):
+                await client.write_all(path, data)
+            return ops * size / (time.perf_counter() - t0) / (1024 * MB)
+
+        best_off = best_on = 0.0
+        for _ in range(rounds):
+            best_off = max(best_off, await gibs(c_off, "/replayov/off.bin"))
+            best_on = max(best_on, await gibs(c_on, "/replayov/on.bin"))
+        await c_off.close()
+        out["write_replay_gibs_off"] = round(best_off, 3)
+        out["write_replay_gibs_on"] = round(best_on, 3)
+        out["write_replay_overhead_pct"] = round(
+            max(0.0, (best_off - best_on) / best_off * 100), 2)
+    finally:
+        try:
+            await mc.stop()
+        finally:
+            shutil.rmtree(base, ignore_errors=True)
+    return out
+
+
 def _tmpfs_raw_gibs(base: str) -> float:
     """Raw sequential write rate to the cache tier's backing dir (the
     hardware ceiling for the write path on this host)."""

@@ -313,6 +313,12 @@ async def cmd_report(args):
                 line += "  shard hits: " + "/".join(
                     str(h) for h in fm["shard_hits"])
             print(line)
+        wp = rp.get("write_plane")
+        if wp:
+            print(f"Write plane: failovers: "
+                  f"{int(wp.get('replica_failover', 0))}  "
+                  f"replayed: {_human(int(wp.get('block_replay_bytes', 0)))}  "
+                  f"degraded commits: {int(wp.get('degraded_commits', 0))}")
         rows = rp.get("shards") or []
         if rows:
             print(f"Namespace shards: {len(rows)}")

@@ -153,7 +153,9 @@ class CurvineClient:
                         ici_coords=list(self.conf.worker.ici_coords) or None,
                         short_circuit=cc.short_circuit,
                         counters=self.counters, health=self.health,
-                        tracer=self.tracer)
+                        tracer=self.tracer,
+                        replay_buffer=cc.write_replay_buffer,
+                        min_replicas=cc.write_min_replicas)
 
     async def append(self, path: str) -> FsWriter:
         fb = await self.meta.append_file(path)
@@ -164,7 +166,9 @@ class CurvineClient:
                      storage_type=_TIERS.get(cc.storage_type, StorageType.MEM),
                      short_circuit=cc.short_circuit,
                      counters=self.counters, health=self.health,
-                     tracer=self.tracer)
+                     tracer=self.tracer,
+                     replay_buffer=cc.write_replay_buffer,
+                     min_replicas=cc.write_min_replicas)
         w.pos = fb.status.len
         return w
 

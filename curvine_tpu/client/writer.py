@@ -4,7 +4,18 @@ Parity: curvine-client/src/file/ FsWriter — allocates blocks from the
 master, streams chunks to the chosen worker (pipelined against the next
 buffer fill), commits on block roll and file complete. Data is replicated
 by writing to every worker in the located block (reference writes a
-pipeline; with cache-tier replication ≤3 fan-out is equivalent)."""
+pipeline; with cache-tier replication ≤3 fan-out is equivalent).
+
+Fault tolerance (docs/resilience.md "Write pipeline"): the open block's
+bytes are kept in a bounded replay buffer (one block, off via
+client.write_replay_buffer) so a mid-stream replica loss degrades
+instead of failing the stream — on fan-out ≥2 the failed leg is dropped
+and streaming continues on the survivors while ≥ write_min_replicas
+remain (the lost replica is reported for background healing); on losing
+the last replica the block is abandoned, re-placed away from the failed
+worker, and the partial block replayed, all inside the same 90 s
+deadline budget as a block open. HDFS pipeline-recovery parity
+(Shvachko et al., MSST 2010)."""
 
 from __future__ import annotations
 
@@ -25,6 +36,11 @@ log = logging.getLogger(__name__)
 # thread-offloaded hashing only pays when there is a core to overlap with
 _OFFLOAD = (os.cpu_count() or 1) > 1
 
+# upload-leg failures a mid-stream failover can absorb: RPC/transport
+# errors, media errors (short-circuit pwrite EIO/ENOSPC), ack timeouts.
+# Anything else (CancelledError, programming errors) propagates.
+_UPLOAD_EXC = (err.CurvineError, OSError, asyncio.TimeoutError)
+
 
 class FsWriter:
     def __init__(self, fs_client, path: str, pool: ConnectionPool,
@@ -33,7 +49,9 @@ class FsWriter:
                  ici_coords: list[int] | None = None,
                  short_circuit: bool = True,
                  counters: dict | None = None,
-                 health=None, tracer=None):
+                 health=None, tracer=None,
+                 replay_buffer: bool = True,
+                 min_replicas: int = 1):
         # shared per-client Tracer: the close/commit leg gets a span (the
         # upload RPCs inherit whatever trace the caller's op opened)
         self.tracer = tracer
@@ -50,10 +68,14 @@ class FsWriter:
         self.ici_coords = ici_coords
         self.short_circuit = short_circuit
         self.counters = counters if counters is not None else {}
+        self.min_replicas = max(1, min_replicas)
         self.pos = 0
         self._buf = bytearray()
         self._block: LocatedBlock | None = None
-        self._uploads: list = []           # one per replica location
+        self._uploads: list = []           # one per live replica leg
+        self._upload_locs: list = []       # loc of each leg, in lockstep
+                                           # (legs can be dropped mid-block,
+                                           # so zip against block.locs lies)
         self._block_written = 0
         self._block_crc = 0
         # commit-time checksum algo: hardware crc32c when the native lib
@@ -66,6 +88,66 @@ class FsWriter:
         self._sc_file = None
         self._sc_conn = None
         self._sc_worker_id = 0
+        # replay buffer: every byte of the OPEN block, kept until it
+        # seals (bounded at one block by construction) so a total
+        # replica loss can rebuild the partial block on a fresh
+        # placement. None = disabled (memory-tight callers).
+        self._replay: bytearray | None = bytearray() if replay_buffer \
+            else None
+        self._recovering = False
+        # workers this stream watched fail mid-write: excluded from its
+        # own re-placements even before the shared breaker opens
+        self._failed_workers: set[int] = set()
+
+    # ---------------- small helpers ----------------
+
+    @staticmethod
+    def _addr(loc) -> str:
+        return f"{loc.ip_addr or loc.hostname}:{loc.rpc_port}"
+
+    def _count(self, name: str, n: int | float = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + n
+
+    def _span(self, op: str, **attrs):
+        """Tracer span (or a no-op when untraced)."""
+        if self.tracer is None:
+            from contextlib import nullcontext
+            return nullcontext()
+        return self.tracer.span(op, attrs=attrs or None)
+
+    def _note_leg_failed(self, loc, worker_id: int, cause) -> None:
+        """One upload leg is gone: feed the breaker, exclude the worker
+        from this stream's future placements, count the failover, and
+        leave a status=error ATTEMPT span in the trace (mirrors the read
+        path — a failed replica is a recorded event, not a gap)."""
+        addr = self._addr(loc)
+        if self.health is not None:
+            self.health.fail(addr, worker_id=worker_id)
+        self._failed_workers.add(worker_id)
+        self._count("write.replica_failover")
+        if self.tracer is not None:
+            bid = self._block.block.id if self._block is not None else 0
+            self.tracer.span("write_attempt",
+                             attrs={"addr": addr, "block": bid}
+                             ).error(cause).finish()
+        log.warning("write %s: replica %s (worker %d) lost mid-block: %s",
+                    self.path, addr, worker_id, cause)
+
+    def _report_lost_replica(self, block_id: int, worker_id: int) -> None:
+        """Tell the master (fire-and-forget) that this block lost its
+        replica on `worker_id` — the same rail the read path uses for
+        corrupt replicas, so the healing plane re-replicates in the
+        background once the (degraded) commit lands."""
+        async def _report():
+            try:
+                await self.fs.call(
+                    RpcCode.REPORT_UNDER_REPLICATED_BLOCKS,
+                    {"block_ids": [block_id], "worker_id": worker_id})
+            except Exception as e:  # noqa: BLE001 — healing is a backstop
+                log.debug("under-replication report failed: %s", e)
+        asyncio.ensure_future(_report())
+
+    # ---------------- write path ----------------
 
     async def write(self, data: bytes | memoryview) -> int:
         if self._closed:
@@ -114,15 +196,30 @@ class FsWriter:
 
     async def _send_chunk(self, chunk) -> None:
         import asyncio
+        if self._replay is not None and not self._recovering:
+            # buffered BEFORE the send: a failed chunk must be part of
+            # what a total-loss failover replays
+            self._replay += chunk
         if self._sc_file is not None:
             # short-circuit: hash + write straight into the worker's temp
             # block file — one hash pass, no socket copies
-            self._block_crc = checksum.crc_update(
+            crc = checksum.crc_update(
                 self._crc_algo, chunk, self._block_crc)
-            self._sc_file.write(chunk)
+            try:
+                self._sc_file.write(chunk)
+            except OSError as e:
+                # the co-located pwrite hit the media (EIO/ENOSPC): the
+                # one and only replica is gone — abandon, re-place,
+                # replay. _recover_block rebuilds crc/written counters.
+                loc = self._block.locs[0] if self._block.locs else None
+                if loc is not None:
+                    self._note_leg_failed(
+                        loc, self._sc_worker_id or loc.worker_id, e)
+                await self._recover_block(e)
+                return
+            self._block_crc = crc
             self._block_written += len(chunk)
-            self.counters["sc.bytes.written"] = \
-                self.counters.get("sc.bytes.written", 0) + len(chunk)
+            self._count("sc.bytes.written", len(chunk))
             return
         # multi-core: CRC in a worker thread (zlib releases the GIL),
         # overlapped with the socket send; the chain stays ordered because
@@ -137,11 +234,18 @@ class FsWriter:
                 self._crc_algo, chunk, self._block_crc)
         try:
             if len(self._uploads) == 1:
-                await self._uploads[0].send_chunk(chunk)
+                try:
+                    await self._uploads[0].send_chunk(chunk)
+                    results: list = [None]
+                except _UPLOAD_EXC as e:
+                    results = [e]
             else:
-                # replica fan-out in parallel, not serially
-                await asyncio.gather(*(up.send_chunk(chunk)
-                                       for up in self._uploads))
+                # replica fan-out in parallel, not serially — and with
+                # per-leg results, so one failed replica can be dropped
+                # without sinking the survivors
+                results = await asyncio.gather(
+                    *(up.send_chunk(chunk) for up in self._uploads),
+                    return_exceptions=True)
         finally:
             # settle the executor crc even when a send FAILS: the caller
             # (_flush_chunk) releases its memoryview of `chunk` right
@@ -152,9 +256,89 @@ class FsWriter:
                     self._block_crc = await crc_task
                 except Exception:  # noqa: BLE001 — send error wins
                     pass
+        for r in results:
+            if isinstance(r, BaseException) \
+                    and not isinstance(r, _UPLOAD_EXC):
+                raise r
+        failed = [i for i, r in enumerate(results)
+                  if isinstance(r, BaseException)]
+        if failed:
+            if not await self._drop_replicas(failed, results[failed[0]]):
+                # total loss: recovery already replayed this chunk too
+                return
         self._block_written += len(chunk)
 
-    async def _next_block(self) -> None:
+    async def _drop_replicas(self, failed: list[int], cause) -> bool:
+        """A subset of the block's upload legs failed mid-chunk. Drop
+        them (breaker feedback + under-replication report) and keep
+        streaming on the survivors while ≥ min_replicas remain; below
+        that, recover the whole block. Returns True when the survivors
+        carry on (the chunk reached them), False after a full recovery
+        (the chunk was replayed)."""
+        bid = self._block.block.id if self._block is not None else 0
+        for i in failed:
+            loc = self._upload_locs[i]
+            self._note_leg_failed(loc, loc.worker_id, cause)
+            try:
+                await self._uploads[i].abort()
+            except (err.CurvineError, OSError):
+                pass
+            self._report_lost_replica(bid, loc.worker_id)
+        keep = [i for i in range(len(self._uploads)) if i not in set(failed)]
+        self._uploads = [self._uploads[i] for i in keep]
+        self._upload_locs = [self._upload_locs[i] for i in keep]
+        if len(self._uploads) >= self.min_replicas:
+            log.info("write %s: continuing block %d on %d surviving "
+                     "replica(s)", self.path, bid, len(self._uploads))
+            return True
+        await self._recover_block(cause)
+        return False
+
+    async def _recover_block(self, cause) -> None:
+        """Total loss: every live leg of the open block failed (or too
+        few survive). Abandon the block, re-request placement excluding
+        the failed workers, replay the partial block into the fresh temp
+        block, and return with the stream exactly where the caller left
+        it — bounded by the same 90 s deadline as _next_block. Replay
+        disabled → the original failure surfaces."""
+        if self._recovering or self._replay is None:
+            raise cause
+        deadline = asyncio.get_running_loop().time() + 90.0
+        replay = bytes(self._replay)
+        log.warning("write %s: block lost its last replica (%s); "
+                    "abandoning and replaying %d bytes",
+                    self.path, cause, len(replay))
+        while True:
+            abandon = self._block.block.id if self._block is not None \
+                else None
+            await self._abort_open_attempt()
+            self._block = None
+            await self._next_block(abandon=abandon, deadline=deadline)
+            try:
+                if replay:
+                    self._recovering = True
+                    try:
+                        view = memoryview(replay)
+                        for off in range(0, len(replay), self.chunk_size):
+                            await self._send_chunk(
+                                view[off:off + self.chunk_size])
+                    finally:
+                        self._recovering = False
+                self._count("write.block_replay_bytes", len(replay))
+                log.info("write %s: block re-placed as %d, %d bytes "
+                         "replayed", self.path, self._block.block.id,
+                         len(replay))
+                return
+            except _UPLOAD_EXC as e:
+                # the replacement failed too (its workers were marked
+                # failed on the way down) — re-place again until the
+                # deadline lapses
+                if asyncio.get_running_loop().time() >= deadline:
+                    raise
+                cause = e
+
+    async def _next_block(self, abandon: int | None = None,
+                          deadline: float | None = None) -> None:
         """Allocate + open the next block. A retryable failure (e.g. the
         worker's CapacityPending while lease-encumbered bdev space
         clears after a restart) backs off and re-requests placement —
@@ -164,20 +348,30 @@ class FsWriter:
         that CapacityPending promises will clear. Commits ride only the
         FIRST add_block; each retry ABANDONS the previous allocation
         (HDFS abandonBlock — no zero-length ghost blocks on the inode)
-        and aborts any half-opened upload streams."""
+        and aborts any half-opened upload streams. Mid-block failover
+        (_recover_block) re-enters with the block to abandon and its
+        own already-running deadline."""
         import random as _random
         commits = self._take_commits()
-        abandon = None
-        deadline = asyncio.get_running_loop().time() + 90.0
+        # an explicit deadline means mid-block RECOVERY: acked caller
+        # bytes are sitting in the replay buffer, so a cluster with no
+        # placeable worker right now (rolling restart, mass quarantine)
+        # is worth waiting out — a plain first open keeps failing fast
+        recovering = deadline is not None
+        if deadline is None:
+            deadline = asyncio.get_running_loop().time() + 90.0
         delay = 0.4
-        use_exclude = self.health is not None
+        use_exclude = self.health is not None or bool(self._failed_workers)
         while True:
             try:
                 # placement steers around workers the client just watched
-                # fail: open-circuit worker ids are excluded up front so a
-                # retry isn't handed the same wedged worker back
-                exclude = (sorted(self.health.open_worker_ids())
-                           if use_exclude else None)
+                # fail: open-circuit worker ids AND this stream's own
+                # mid-write casualties are excluded up front so a retry
+                # isn't handed the same wedged worker back
+                excl = set(self._failed_workers)
+                if self.health is not None:
+                    excl |= set(self.health.open_worker_ids())
+                exclude = sorted(excl) if use_exclude and excl else None
                 self._block = await self.fs.add_block(
                     self.path, commit_blocks=commits,
                     exclude_workers=exclude,
@@ -196,7 +390,10 @@ class FsWriter:
                     # exclusions relaxed instead of hard-failing
                     use_exclude = False
                     continue
-                if not e.retryable \
+                retryable = e.retryable or (
+                    recovering
+                    and e.code == err.ErrorCode.NO_AVAILABLE_WORKER)
+                if not retryable \
                         or asyncio.get_running_loop().time() >= deadline:
                     raise
                 sleep = delay * (0.5 + _random.random() / 2)
@@ -225,6 +422,7 @@ class FsWriter:
             except (err.CurvineError, OSError):
                 pass
         self._uploads = []
+        self._upload_locs = []
 
     async def _open_block(self) -> None:
         if not self._block.locs:
@@ -232,6 +430,7 @@ class FsWriter:
         self._block_written = 0
         self._block_crc = 0
         self._uploads = []
+        self._upload_locs = []
         self._sc_file = None
         self._sc_conn = None      # else abort() could SC-abort a later
                                   # socket-path block of the same writer
@@ -239,23 +438,28 @@ class FsWriter:
             if await self._try_short_circuit(self._block.locs[0]):
                 return
         for loc in self._block.locs:
-            addr = f"{loc.ip_addr or loc.hostname}:{loc.rpc_port}"
-            try:
-                conn = await self.pool.get(addr)
-                up = await conn.open_upload(RpcCode.WRITE_BLOCK, header={
-                    "block_id": self._block.block.id,
-                    "storage_type": int(self.storage_type),
-                    "algo": self._crc_algo,
-                    "len_hint": self.block_size})
-            except err.CurvineError:
-                # feeds the breaker so the add_block retry can exclude
-                # this worker from the next placement
-                if self.health is not None:
-                    self.health.fail(addr, worker_id=loc.worker_id)
-                raise
+            addr = self._addr(loc)
+            # one span per replica ATTEMPT: a leg that refuses the open
+            # leaves a status=error span in the trace, not a gap
+            with self._span("write_attempt", addr=addr,
+                            block=self._block.block.id):
+                try:
+                    conn = await self.pool.get(addr)
+                    up = await conn.open_upload(RpcCode.WRITE_BLOCK, header={
+                        "block_id": self._block.block.id,
+                        "storage_type": int(self.storage_type),
+                        "algo": self._crc_algo,
+                        "len_hint": self.block_size})
+                except err.CurvineError:
+                    # feeds the breaker so the add_block retry can exclude
+                    # this worker from the next placement
+                    if self.health is not None:
+                        self.health.fail(addr, worker_id=loc.worker_id)
+                    raise
             if self.health is not None:
                 self.health.ok(addr)
             self._uploads.append(up)
+            self._upload_locs.append(loc)
 
     async def _try_short_circuit(self, loc) -> bool:
         """Co-located single-replica block: get a temp-file grant from the
@@ -266,8 +470,7 @@ class FsWriter:
                 or loc.ip_addr in ("127.0.0.1", "localhost")):
             return False
         try:
-            conn = await self.pool.get(
-                f"{loc.ip_addr or loc.hostname}:{loc.rpc_port}")
+            conn = await self.pool.get(self._addr(loc))
             rep = await conn.call(RpcCode.SC_WRITE_OPEN, data=pack({
                 "block_id": self._block.block.id,
                 "storage_type": int(self.storage_type),
@@ -299,29 +502,112 @@ class FsWriter:
             chunk.release()
         del self._buf[:n]
 
+    # ---------------- seal / commit ----------------
+
     async def _seal_block(self) -> None:
         if self._block is None:
             return
         await self._flush_chunk(None)
-        if self._sc_file is not None:
-            self._sc_file.close()
-            self._sc_file = None
-            await self._sc_conn.call(RpcCode.SC_WRITE_COMMIT, data=pack({
-                "block_id": self._block.block.id,
-                "len": self._block_written,
-                "crc32": self._block_crc, "algo": self._crc_algo}))
-            worker_ids = [self._sc_worker_id]
-        else:
-            worker_ids = []
-            for up, loc in zip(self._uploads, self._block.locs):
-                ack = await up.finish(header={
-                    "crc32": self._block_crc, "algo": self._crc_algo})
-                worker_ids.append(ack.header.get("worker_id", loc.worker_id))
+        for attempt in range(3):
+            try:
+                worker_ids = await self._finish_block()
+                break
+            except _UPLOAD_EXC as e:
+                # the finish/commit leg lost the last replica: recover
+                # the whole block (abandon, re-place, replay — the
+                # replay buffer holds all of it now) and re-finish.
+                # _recover_block re-raises when replay is disabled.
+                if attempt == 2:
+                    raise
+                await self._recover_block(e)
         self._commits.append(CommitBlock(
             block_id=self._block.block.id, block_len=self._block_written,
             worker_ids=worker_ids, storage_type=self.storage_type))
         self._block = None
         self._uploads = []
+        self._upload_locs = []
+        if self._replay is not None:
+            self._replay = bytearray()   # sealed: the replay window closes
+
+    async def _finish_block(self) -> list[int]:
+        """Finish every live leg IN PARALLEL (commit latency is the
+        slowest replica, not the sum) and return the acked worker ids.
+        A partial finish failure becomes a DEGRADED commit — the block
+        commits on the survivors (≥ min_replicas) and the lost replica
+        is reported for background re-replication — instead of failing
+        the seal. Total failure raises for whole-block recovery."""
+        if self._sc_file is not None:
+            self._sc_file.close()
+            self._sc_file = None
+            try:
+                await self._sc_conn.call(RpcCode.SC_WRITE_COMMIT, data=pack({
+                    "block_id": self._block.block.id,
+                    "len": self._block_written,
+                    "crc32": self._block_crc, "algo": self._crc_algo}))
+            except _UPLOAD_EXC as e:
+                loc = self._block.locs[0] if self._block.locs else None
+                if loc is not None:
+                    self._note_leg_failed(
+                        loc, self._sc_worker_id or loc.worker_id, e)
+                raise
+            return [self._sc_worker_id]
+        acks = await asyncio.gather(
+            *(up.finish(header={"crc32": self._block_crc,
+                                "algo": self._crc_algo})
+              for up in self._uploads),
+            return_exceptions=True)
+        survivors: list[tuple[int, object]] = []
+        lost: list = []
+        cause = None
+        for ack, loc in zip(acks, self._upload_locs):
+            if isinstance(ack, BaseException):
+                if not isinstance(ack, _UPLOAD_EXC):
+                    raise ack
+                lost.append(loc)
+                cause = cause or ack
+            else:
+                survivors.append((ack.header.get("worker_id",
+                                                 loc.worker_id), loc))
+        if not lost:
+            return [wid for wid, _ in survivors]
+        for loc in lost:
+            self._note_leg_failed(loc, loc.worker_id, cause)
+        if survivors:
+            # Confirm the survivors are still LIVE before acking a
+            # DEGRADED commit: a worker can die in the window between
+            # its finish ack and this commit (the master has marked it
+            # LOST by now), and with fan-out already reduced it could be
+            # the block's ONLY location — committing would ack vapor.
+            # The check rides the same report RPC that flags the lost
+            # replica for background healing.
+            bid = self._block.block.id
+            try:
+                resp = await self.fs.call(
+                    RpcCode.REPORT_UNDER_REPLICATED_BLOCKS,
+                    {"block_ids": [bid], "worker_id": lost[0].worker_id,
+                     "confirm_live": [wid for wid, _ in survivors]})
+                live = set(resp.get("live", ()))
+            except Exception as e:  # noqa: BLE001 — master unreachable:
+                # trust the finish acks; the commit itself fails anyway
+                # if the master stays gone
+                log.debug("degraded-commit liveness check failed: %s", e)
+                live = {wid for wid, _ in survivors}
+            for wid, loc in survivors:
+                if wid not in live:
+                    self._note_leg_failed(loc, wid, cause)
+            survivors = [s for s in survivors if s[0] in live]
+            for loc in lost[1:]:
+                self._report_lost_replica(bid, loc.worker_id)
+        worker_ids = [wid for wid, _ in survivors]
+        if not worker_ids or len(worker_ids) < self.min_replicas:
+            raise cause
+        # degraded commit: the block is durable on the live survivors;
+        # the healing plane restores the replica count in the background
+        self._count("write.degraded_commits")
+        log.warning("write %s: degraded commit of block %d on %d/%d "
+                    "replicas", self.path, self._block.block.id,
+                    len(worker_ids), len(worker_ids) + len(lost))
+        return worker_ids
 
     def _take_commits(self) -> list[CommitBlock]:
         out, self._commits = self._commits, []
@@ -335,9 +621,20 @@ class FsWriter:
         """Durable flush: push buffered chunks and journal any sealed-block
         commits at the master, WITHOUT completing the file — the write
         stream stays open for more writes.
+        Durability contract: the ack means every buffered byte is on
+        ≥ min_replicas live upload legs — a replica loss racing the
+        flush is recovered (survivor continuation or abandon+replay)
+        BEFORE this returns, never after the ack.
         Parity: curvine-fuse/src/fs/fuse_writer.rs WriteTask::Flush (a
         flush is a durability point, not a stream end)."""
         await self._flush_chunk(None)
+        if self._block is not None and self._sc_file is None \
+                and len(self._uploads) < min(self.min_replicas,
+                                             len(self._block.locs)):
+            # belt-and-braces: _send_chunk keeps the fan-out ≥ min after
+            # every send, but an hflush must never ack below it
+            await self._recover_block(
+                err.ConnectError("hflush below min replicas"))
         if self._commits:
             await self.fs.complete_file(self.path, self.pos,
                                         commit_blocks=self._take_commits(),
@@ -371,7 +668,12 @@ class FsWriter:
             except err.CurvineError:
                 pass
         for up in self._uploads:
-            await up.abort()
+            try:
+                await up.abort()
+            except (err.CurvineError, OSError):
+                # one dead conn must not skip the remaining aborts — the
+                # other streams' pooled conns would stay mid-protocol
+                pass
         self._closed = True
 
     async def __aenter__(self) -> "FsWriter":

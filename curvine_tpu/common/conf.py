@@ -215,6 +215,17 @@ class ClientConf:
     short_circuit: bool = True
     storage_type: str = "mem"
     write_type: str = "cache"      # cache|fs
+    # write-pipeline fault tolerance (docs/resilience.md): keep the open
+    # block's bytes in a bounded replay buffer (capped at one block) so
+    # a mid-stream replica loss can abandon the block, re-place it on a
+    # fresh worker, and replay — the caller's write never sees the
+    # fault. Disable for memory-tight callers; the stream then fails on
+    # losing its last replica (survivor fan-out continuation still works).
+    write_replay_buffer: bool = True
+    # fan-out floor: keep streaming on surviving replicas while at least
+    # this many remain; below it the whole block is re-placed + replayed.
+    # Lost replicas are reported so the healing plane restores the count.
+    write_min_replicas: int = 1
     rpc_timeout_ms: int = 30_000
     conn_retry_max: int = 3
     conn_retry_base_ms: int = 100

@@ -48,6 +48,10 @@ class ErrorCode(enum.IntEnum):
     # inflight cap / overload shed — common/qos.py); carries a
     # retry_after_ms hint the retry policy honors over its own backoff
     THROTTLED = 30
+    # the worker is draining for decommission: it refuses NEW write
+    # streams (existing ones finish) so the client re-places the block
+    # on a worker that is staying
+    DRAINING = 31
 
     # Errors where the operation may succeed if retried (possibly against a
     # different master/worker).
@@ -62,6 +66,7 @@ _RETRYABLE = {
     ErrorCode.CONNECT,
     ErrorCode.IN_PROGRESS,
     ErrorCode.THROTTLED,
+    ErrorCode.DRAINING,
 }
 
 
@@ -155,6 +160,10 @@ class Throttled(CurvineError):
 # is in the retryable set, so writers back off and re-place instead of
 # hard-failing user writes.
 CapacityPending = _make("CapacityPending", ErrorCode.IN_PROGRESS)
+# Decommission drain: a DRAINING worker bounces new WRITE_BLOCK /
+# SC_WRITE_OPEN streams so the client's placement retry lands the block
+# on a worker that is staying; streams already open keep flowing.
+WorkerDraining = _make("WorkerDraining", ErrorCode.DRAINING)
 
 _CODE_TO_CLASS: dict[ErrorCode, type[CurvineError]] = {
     c.code: c
@@ -165,6 +174,6 @@ _CODE_TO_CLASS: dict[ErrorCode, type[CurvineError]] = {
         QuotaExceeded, NotLeader, RpcTimeout, Cancelled, Unsupported,
         AbnormalData, UfsError, MountNotFound, PermissionDenied, JobNotFound,
         ConnectError, Uncompleted, FastMiss, FastGated, Throttled,
-        CapacityPending,
+        CapacityPending, WorkerDraining,
     ]
 }

@@ -927,7 +927,7 @@ class MasterFilesystem:
         chosen = self.policy.choose(
             self.workers.live_workers(), max(1, node.replicas),
             client_host=client_host, exclude=set(exclude_workers or []),
-            needed=node.block_size, ici_coords=ici_coords)
+            needed=node.block_size, ici_coords=ici_coords, min_count=1)
         args = dict(inode_id=node.id)
         # HDFS abandonBlock semantics: a writer retrying a failed block
         # open discards its previous allocation in the same journal
@@ -1057,6 +1057,11 @@ class MasterFilesystem:
             # periodic report interval leaves every pre-restart block
             # location-less for up to that long.
             cmds["report_now"] = True
+        if w.state == WorkerState.DECOMMISSIONING:
+            # drain hint: the worker bounces NEW write streams with a
+            # retryable error (in-flight ones finish), so the drain scan
+            # never races fresh uploads onto a departing worker
+            cmds["draining"] = True
         return cmds
 
     def worker_block_report(self, worker_id: int, held: dict,

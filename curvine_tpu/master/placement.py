@@ -20,6 +20,7 @@ class PlacementPolicy:
     def choose(self, workers: list[WorkerInfo], count: int,
                client_host: str = "", exclude: set[int] | None = None,
                needed: int = 0, ici_coords: list[int] | None = None,
+               min_count: int | None = None,
                ) -> list[WorkerInfo]:
         exclude = exclude or set()
         pool = [w for w in workers
@@ -29,8 +30,14 @@ class PlacementPolicy:
             if len(pool_all) >= count and count > 0:
                 pool = pool_all  # capacity pressure: let eviction handle it
         if len(pool) < count:
-            raise err.NoAvailableWorker(
-                f"need {count} workers, have {len(pool)} eligible")
+            # Degraded placement (HDFS-style): when the caller tolerates a
+            # smaller fan-out, place on what is alive rather than failing
+            # the write; the replication plane restores counts later.
+            if min_count is not None and len(pool) >= max(1, min_count):
+                count = len(pool)
+            else:
+                raise err.NoAvailableWorker(
+                    f"need {count} workers, have {len(pool)} eligible")
         return self._pick(pool, count, client_host, ici_coords)
 
     def _pick(self, pool, count, client_host, ici_coords):

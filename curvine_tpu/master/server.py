@@ -895,6 +895,14 @@ class MasterServer:
             out["meta_cache"] = cache
         if self.fastmeta is not None:
             out["fastmeta"] = self.fastmeta.counters()
+        # write-pipeline fault-tolerance rollup (client.write.* counters
+        # pushed via METRICS_REPORT): failovers absorbed, bytes replayed
+        # after total replica loss, degraded commits awaiting healing
+        pre_w = "client.write."
+        wp = {k[len(pre_w):]: v for k, v in self.metrics.counters.items()
+              if k.startswith(pre_w)}
+        if wp:
+            out["write_plane"] = wp
         return out
 
     def _tenant_stats(self, q):
@@ -1174,7 +1182,16 @@ class MasterServer:
             self.replication.enqueue_evacuation(wid, bids)
         else:
             self.replication.enqueue(bids)
-        return {"success": True}
+        out = {"success": True}
+        # degraded-commit liveness check: a writer about to commit on a
+        # reduced replica set asks which survivors this master still
+        # considers LIVE — a worker that died between its finish ack and
+        # the commit must count as lost, not as the block's sole copy
+        confirm = q.get("confirm_live")
+        if confirm is not None:
+            live = {w.address.worker_id for w in self.fs.workers.live_workers()}
+            out["live"] = [w for w in confirm if w in live]
+        return out
 
     def _replication_result(self, q):
         self.replication.on_result(q["block_id"], q["worker_id"],

@@ -343,6 +343,12 @@ class Connection:
 
         async def send_chunk(self, data: bytes | memoryview,
                              header: dict | None = None) -> None:
+            # before EOF the only message the server can have sent is an
+            # error (refused open, mid-stream write failure): surface it
+            # NOW so the caller fails over instead of streaming the rest
+            # of the block into the void and learning at finish()
+            if not self.q.empty():
+                self.q.get_nowait().check()
             await self.conn.send(Message(code=self.code, req_id=self.req_id,
                                          flags=Flags.CHUNK, header=header or {},
                                          data=data))
@@ -359,7 +365,18 @@ class Connection:
             return rep.check()
 
         async def abort(self) -> None:
-            self.conn.unregister(self.req_id)
+            """Best-effort cancel: an EOF frame flagged `abort` tells the
+            server to discard the superseded stream's temp state now
+            instead of waiting for connection teardown; then stop
+            listening for the ack. A dead conn just unregisters."""
+            try:
+                await self.conn.send(Message(
+                    code=self.code, req_id=self.req_id, flags=Flags.EOF,
+                    header={"abort": True}))
+            except Exception:   # noqa: BLE001 — conn already down
+                pass
+            finally:
+                self.conn.unregister(self.req_id)
 
     async def open_upload(self, code: int, header: dict | None = None,
                           timeout: float | None = None,

@@ -1246,3 +1246,366 @@ class MembershipStorm:
 async def run_membership_storm(seed: int, **kw) -> MembershipStormReport:
     """One-call entry point for the raft membership-churn storm."""
     return await MembershipStorm(seed, **kw).run()
+
+
+# ---------------------------------------------------------------------------
+# Write-pipeline storm: kill/EIO/drop workers under concurrent writers
+# (docs/resilience.md "Write pipeline")
+# ---------------------------------------------------------------------------
+
+@dataclass
+class WritePipelineStormReport:
+    """Outcome of a WritePipelineStorm run. Headline invariants: zero
+    acked-write loss (every file whose close() was acked reads back
+    checksum-clean), no writer exceeds its per-file budget on a fault
+    (failover/replay is bounded work, not an unbounded stall), and every
+    replica the failover plane flagged converges back to healed once the
+    storm quiesces."""
+    seed: int
+    events: list[dict] = field(default_factory=list)
+    ops: dict = field(default_factory=dict)
+    acked_files: int = 0
+    integrity_errors: list[str] = field(default_factory=list)
+    replication_converged: bool = True
+    unconverged_blocks: list[int] = field(default_factory=list)
+    max_write_s: float = 0.0
+    write_budget_s: float = 0.0
+    failovers: int = 0
+    replayed_bytes: int = 0
+    degraded_commits: int = 0
+    leaked_tasks: list[str] = field(default_factory=list)
+    elapsed_s: float = 0.0
+
+    @property
+    def integrity_ok(self) -> bool:
+        return not self.integrity_errors
+
+    def assert_invariants(self) -> None:
+        problems = []
+        if self.integrity_errors:
+            problems.append(f"integrity: {self.integrity_errors}")
+        if not self.replication_converged:
+            problems.append(
+                f"flagged replicas never healed: {self.unconverged_blocks}")
+        if self.acked_files == 0:
+            problems.append("no writes were acked (harness bug)")
+        if self.max_write_s > self.write_budget_s:
+            problems.append(
+                f"a writer took {self.max_write_s:.2f}s on one file "
+                f">= budget {self.write_budget_s:.2f}s")
+        if self.leaked_tasks:
+            problems.append(f"leaked asyncio tasks: {self.leaked_tasks}")
+        assert not problems, (
+            f"write-pipeline storm seed={self.seed} invariants violated: "
+            + "; ".join(problems) + f" (events={self.events})")
+
+
+class WritePipelineStorm:
+    """Seeded write-path chaos: concurrent writers stream multi-block
+    files while the schedule kills workers mid-stream, injects IO-error
+    and drop faults on the WRITE_BLOCK plane, and restarts the fallen.
+    Unlike ChaosStorm (whole-system churn), every fault here lands on an
+    in-flight write pipeline: the point is to prove mid-stream replica
+    failover, block replay, and degraded commit never lose an acked
+    byte and never stall a writer unbounded."""
+
+    EVENTS = ("kill_worker", "restart_worker", "fault_error",
+              "fault_drop", "clear_faults")
+
+    def __init__(self, seed: int, workers: int = 4, replicas: int = 2,
+                 duration_s: float = 2.5, event_interval_s: float = 0.3,
+                 writer_tasks: int = 3, blocks_per_file: int = 3,
+                 block_size: int = 256 * 1024,
+                 write_budget_s: float = 30.0,
+                 converge_timeout_s: float = 25.0,
+                 base_dir: str | None = None,
+                 overall_timeout_s: float | None = None):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.n_workers = workers
+        self.replicas = min(replicas, workers)
+        self.duration_s = duration_s
+        self.event_interval_s = event_interval_s
+        self.writer_tasks = writer_tasks
+        self.block_size = block_size
+        self.file_size = blocks_per_file * block_size
+        self.write_budget_s = write_budget_s
+        self.converge_timeout_s = converge_timeout_s
+        self.base_dir = base_dir
+        self.overall_timeout_s = overall_timeout_s if overall_timeout_s \
+            else duration_s + converge_timeout_s + 60.0
+        self.report = WritePipelineStormReport(
+            seed=seed, write_budget_s=write_budget_s)
+        self.acked: dict[str, str] = {}
+        self._stop = False
+        self._alive: set[int] = set()
+        self._winj: dict[int, FaultInjector] = {}
+        self._client_counters: list[dict] = []
+
+    def _count(self, op: str, n: int = 1) -> None:
+        self.report.ops[op] = self.report.ops.get(op, 0) + n
+
+    def _configure(self, mc: MiniCluster) -> None:
+        cc = mc.conf.client
+        # remote writes only: short-circuit would bypass the upload
+        # pipeline this storm exists to stress
+        cc.short_circuit = False
+        cc.rpc_timeout_ms = 3_000
+        cc.conn_retry_max = 4
+        cc.conn_retry_base_ms = 50
+        cc.breaker_fail_threshold = 2
+        cc.breaker_open_ms = 1_000
+        cc.replicas = self.replicas
+        cc.block_size = self.block_size
+
+    def _tune_master(self, mc: MiniCluster) -> None:
+        mc.master.replication.scan_interval_s = 0.3
+        mc.master.replication.pull_budget_ms = 2_000
+
+    def _install_worker(self, idx: int, worker) -> None:
+        inj = self._winj.get(idx)
+        if inj is None:
+            inj = self._winj[idx] = FaultInjector()
+        inj.install(worker.rpc)
+        self._alive.add(idx)
+
+    # ---------------- workload ----------------
+
+    async def _writer(self, mc: MiniCluster, wid: int) -> None:
+        c = mc.client()
+        self._client_counters.append(c.counters)
+        step = max(1, self.block_size // 4)
+        k = 0
+        while not self._stop:
+            tag = f"wp{wid}/f{k}"
+            path = f"/wpstorm/{tag}"
+            data = storm_bytes(self.seed, tag, self.file_size)
+            t0 = time.monotonic()
+            w = None
+            try:
+                w = await c.create(path, overwrite=True,
+                                   replicas=self.replicas)
+                # stream chunk-by-chunk so kills/faults land MID-block,
+                # not between whole-file write_all calls
+                for off in range(0, len(data), step):
+                    await w.write(data[off:off + step])
+                    await asyncio.sleep(0)
+                await w.close()
+                self.acked[path] = hashlib.sha256(data).hexdigest()
+                self._count("write_ok")
+                self.report.max_write_s = max(
+                    self.report.max_write_s, time.monotonic() - t0)
+            except _EXPECTED as e:
+                self._count("write_err")
+                log.debug("wpstorm write %s failed: %s", path, e)
+                if w is not None:
+                    try:
+                        await w.abort()
+                    except _EXPECTED:
+                        pass
+            k += 1
+            await asyncio.sleep(0.01)
+
+    # ---------------- chaos schedule ----------------
+
+    def _unhealed_blocks(self, mc: MiniCluster) -> list[int]:
+        blocks = mc.master.fs.blocks
+        under = [m.block_id for m in blocks.under_replicated()]
+        for bid, locs in blocks.locs.items():
+            meta = blocks.get(bid)
+            if not locs and meta is not None and meta.len > 0:
+                under.append(bid)
+        return under
+
+    def _safe_to_kill(self, mc: MiniCluster) -> bool:
+        """Same last-replica guard as ChaosStorm: only strike while
+        every committed block keeps its full replica count on workers
+        that are REALLY alive right now (the master's LOST view lags a
+        kill), so acked data always survives the next kill."""
+        if self._unhealed_blocks(mc):
+            return False
+        alive_ids = {mc.workers[i].worker_id for i in self._alive}
+        blocks = mc.master.fs.blocks
+        for bid, locs in blocks.locs.items():
+            if not locs:
+                continue
+            want = min(blocks.desired_of(bid), len(alive_ids))
+            if len(set(locs) & alive_ids) < want:
+                return False
+        return True
+
+    def _pick_event(self) -> str:
+        weights = {"kill_worker": 4, "restart_worker": 4,
+                   "fault_error": 3, "fault_drop": 2, "clear_faults": 3}
+        if self.replicas < 2:
+            # single-copy files: ANY kill destroys acked data by design,
+            # so the storm drives replay purely with injected faults
+            weights["kill_worker"] = 0
+        names = list(weights)
+        return self.rng.choices(names, [weights[n] for n in names])[0]
+
+    async def _apply_event(self, mc: MiniCluster, ev: str) -> None:
+        rng = self.rng
+        rec = {"t": round(time.monotonic(), 3), "event": ev}
+        if ev == "kill_worker":
+            if (len(self._alive) < self.n_workers
+                    or not self._safe_to_kill(mc)):
+                rec["skipped"] = True
+            else:
+                idx = rng.choice(sorted(self._alive))
+                self._alive.discard(idx)
+                self._winj.pop(idx, None)
+                await mc.kill_worker(idx)
+                rec["worker"] = idx
+        elif ev == "restart_worker":
+            if len(self._alive) >= self.n_workers:
+                rec["skipped"] = True
+            else:
+                w = await mc.add_worker()
+                idx = len(mc.workers) - 1
+                self._install_worker(idx, w)
+                rec["worker"] = idx
+        elif ev in ("fault_error", "fault_drop"):
+            kind = ev.split("_", 1)[1]
+            # every fault targets the write plane: an injected IO error
+            # is the storm's "disk EIO mid-upload", a drop wedges the
+            # stream until the upload ack times out
+            spec = FaultSpec(
+                kind=kind,
+                probability=rng.choice([0.5, 1.0]),
+                error_code=int(err.ErrorCode.IO),
+                error_msg=f"wpstorm seed={self.seed}",
+                max_hits=rng.randint(2, 10),
+                codes=[int(RpcCode.WRITE_BLOCK)])
+            if self._alive:
+                idx = rng.choice(sorted(self._alive))
+                self._winj[idx].add(spec)
+                rec["target"] = f"worker{idx}"
+            rec["kind"] = kind
+        elif ev == "clear_faults":
+            for inj in self._winj.values():
+                inj.clear()
+        self.report.events.append(rec)
+
+    # ---------------- invariants ----------------
+
+    async def _await_convergence(self, mc: MiniCluster) -> None:
+        deadline = time.monotonic() + self.converge_timeout_s
+        while time.monotonic() < deadline:
+            under = self._unhealed_blocks(mc)
+            if not under:
+                return
+            await asyncio.sleep(0.2)
+        self.report.replication_converged = False
+        self.report.unconverged_blocks = under[:32]
+
+    async def _verify_integrity(self, mc: MiniCluster) -> None:
+        c = mc.client()
+        for path in sorted(self.acked):
+            want = self.acked[path]
+            try:
+                r = await c.open(path)
+                try:
+                    data = await r.read_all()
+                finally:
+                    await r.close()
+            except _EXPECTED as e:
+                self.report.integrity_errors.append(
+                    f"post-quiesce read of {path} failed: {e!r}")
+                continue
+            got = hashlib.sha256(data).hexdigest()
+            if got != want:
+                self.report.integrity_errors.append(
+                    f"post-quiesce {path}: {len(data)}B, digest "
+                    f"{got[:12]} != acked {want[:12]}")
+        self.report.acked_files = len(self.acked)
+
+    # ---------------- driver ----------------
+
+    async def _drive(self, mc: MiniCluster, workers: list,
+                     t_start: float) -> None:
+        while not self.acked and time.monotonic() - t_start < 5.0:
+            await asyncio.sleep(0.05)
+        t_end = time.monotonic() + self.duration_s
+        while time.monotonic() < t_end:
+            await self._apply_event(mc, self._pick_event())
+            await asyncio.sleep(self.event_interval_s)
+
+        # ---- quiesce ----
+        for inj in self._winj.values():
+            inj.clear()
+        while len(self._alive) < self.n_workers:
+            w = await mc.add_worker()
+            self._install_worker(len(mc.workers) - 1, w)
+        for i in self._alive:
+            mc.workers[i]._hb_fails = 0
+            mc.workers[i]._hb_backoff_until = 0.0
+        self._stop = True
+        await asyncio.gather(*workers, return_exceptions=False)
+        del workers[:]
+        await mc.await_workers(self.n_workers, timeout=15.0)
+        await self._await_convergence(mc)
+        await self._verify_integrity(mc)
+        self.report.failovers = sum(
+            c.get("write.replica_failover", 0)
+            for c in self._client_counters)
+        self.report.replayed_bytes = sum(
+            c.get("write.block_replay_bytes", 0)
+            for c in self._client_counters)
+        self.report.degraded_commits = sum(
+            c.get("write.degraded_commits", 0)
+            for c in self._client_counters)
+
+    async def run(self) -> WritePipelineStormReport:
+        t_start = time.monotonic()
+        baseline = {t for t in asyncio.all_tasks() if not t.done()}
+        mc = MiniCluster(workers=self.n_workers, base_dir=self.base_dir)
+        self._configure(mc)
+        await mc.start()
+        self._tune_master(mc)
+        for i, w in enumerate(mc.workers):
+            self._install_worker(i, w)
+
+        workers = [asyncio.ensure_future(self._writer(mc, i))
+                   for i in range(self.writer_tasks)]
+        try:
+            try:
+                await asyncio.wait_for(self._drive(mc, workers, t_start),
+                                       self.overall_timeout_s)
+            except asyncio.TimeoutError:
+                raise AssertionError(
+                    f"write-pipeline storm seed={self.seed} WEDGED: "
+                    f"exceeded its {self.overall_timeout_s:.0f}s budget "
+                    f"(events={self.report.events}); task stacks:\n"
+                    + _dump_task_stacks()) from None
+        finally:
+            self._stop = True
+            for t in workers:
+                t.cancel()
+            for idx, inj in self._winj.items():
+                if idx < len(mc.workers):
+                    inj.uninstall(mc.workers[idx].rpc)
+            try:
+                await asyncio.wait_for(mc.stop(), 30.0)
+            except asyncio.TimeoutError:
+                raise AssertionError(
+                    f"write-pipeline storm seed={self.seed}: cluster "
+                    "stop WEDGED; task stacks:\n"
+                    + _dump_task_stacks()) from None
+
+        for _ in range(10):
+            leaked = [t for t in asyncio.all_tasks()
+                      if not t.done() and t not in baseline
+                      and t is not asyncio.current_task()]
+            if not leaked:
+                break
+            await asyncio.sleep(0.05)
+        self.report.leaked_tasks = [repr(t) for t in leaked]
+        self.report.elapsed_s = time.monotonic() - t_start
+        return self.report
+
+
+async def run_write_pipeline_storm(seed: int,
+                                   **kw) -> WritePipelineStormReport:
+    """One-call entry point for the write-pipeline fault storm."""
+    return await WritePipelineStorm(seed, **kw).run()

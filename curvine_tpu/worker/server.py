@@ -174,6 +174,10 @@ class WorkerServer:
         self._hb_backoff_until = 0.0
         # rate limit for master-requested full block reports (report_now)
         self._forced_report_at = 0.0
+        # decommission drain (heartbeat-driven): refuse NEW write streams
+        # with a retryable error so clients re-place elsewhere; streams
+        # already open keep flowing until they finish
+        self.draining = False
         self._register_handlers()
 
     @property
@@ -328,9 +332,10 @@ class WorkerServer:
         payload = pack(body)
         deletes: set[int] = set()
         report_now = False
+        draining = False
 
         async def beat(addr: str) -> bool:
-            nonlocal report_now
+            nonlocal report_now, draining
             try:
                 rep = await self._bounded_master_call(
                     addr, RpcCode.WORKER_HEARTBEAT, payload,
@@ -340,6 +345,8 @@ class WorkerServer:
                     deletes.add(bid)
                 if body.get("report_now"):
                     report_now = True
+                if body.get("draining"):
+                    draining = True
                 return True
             except Exception as e:  # noqa: BLE001 — peer down is routine
                 log.debug("heartbeat to %s failed: %s", addr, e)
@@ -368,6 +375,12 @@ class WorkerServer:
                      self._hb_fails)
         self._hb_fails = 0
         self._hb_backoff_until = 0.0
+        if draining != self.draining:
+            # master state is authoritative either way: recommission
+            # clears the refusal just like decommission sets it
+            log.info("worker %d %s new write streams (decommission drain)",
+                     self.worker_id, "refusing" if draining else "accepting")
+            self.draining = draining
         for bid in deletes:
             self.store.delete(bid)
             if self.hbm is not None:
@@ -622,6 +635,12 @@ class WorkerServer:
         connection's receive loop with a view into its reusable buffer)."""
         q = unpack(msg.data) or msg.header
         block_id = q["block_id"]
+        if self.draining:
+            # refusal happens at stream OPEN only — chunks of streams
+            # admitted before the drain keep landing below
+            raise err.WorkerDraining(
+                f"worker {self.worker_id} is draining; "
+                f"re-place block {block_id}")
         hint = StorageType(q.get("storage_type", int(StorageType.MEM)))
         # the dispatch span closes when this handler returns (chunks
         # arrive later, in the receive loop's task); a manually-finished
@@ -709,6 +728,18 @@ class WorkerServer:
                     return
                 if tail["t"] is not None:
                     await tail["t"]
+                if header.get("abort"):
+                    # the client superseded this upload attempt (mid-
+                    # stream failover replaced the block elsewhere):
+                    # discard the temp state now instead of leaking it
+                    # until connection teardown. No ack — the client
+                    # already stopped listening on this req_id.
+                    conn.close_stream(msg.req_id)
+                    f.close()
+                    self.store.delete(block_id)
+                    wspan.set_attr("aborted", True)
+                    wspan.finish()
+                    return
                 conn.close_stream(msg.req_id)
                 f.close()
                 want = header.get("crc32")
@@ -752,6 +783,10 @@ class WorkerServer:
         via SC_WRITE_COMMIT. The TPU-host counterpart of the reference's
         short-circuit read (orpc zero-copy parity, write direction)."""
         q = unpack(msg.data) or {}
+        if self.draining:
+            raise err.WorkerDraining(
+                f"worker {self.worker_id} is draining; "
+                f"re-place block {q['block_id']}")
         info = self.store.create_temp(
             q["block_id"], StorageType(q.get("storage_type",
                                              int(StorageType.MEM))),

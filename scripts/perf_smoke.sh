@@ -13,6 +13,7 @@
 #   BENCH_RPC=0 skips the RPC transport gate.
 #   BENCH_VERIFY=0 skips the read-verification overhead gate.
 #   BENCH_QOS=0 skips the admission-overhead gate.
+#   BENCH_WRITEREPLAY=0 skips the write-replay-buffer overhead gate.
 # Exit: 0 = at/above the regression gates, 1 = regression, 2 = harness error.
 
 set -u
@@ -388,6 +389,46 @@ print(f"perf_smoke: qos_overhead_pct={pct} ceiling={ceiling} "
 if pct > ceiling:
     print(f"perf_smoke: FAIL — admission overhead {pct}% > {ceiling}% "
           "(the un-throttled QoS hot path got too heavy)",
+          file=sys.stderr)
+    sys.exit(1)
+print("perf_smoke: PASS")
+EOF
+    rc=$?
+    [ $rc -ne 0 ] && exit $rc
+fi
+
+if [ "${BENCH_WRITEREPLAY:-1}" = "0" ]; then
+    echo "perf_smoke: write-replay gate skipped (BENCH_WRITEREPLAY=0)"
+else
+    # write-replay gate: fault-free whole-file writes with the replay
+    # buffer ON (the default — it is what makes mid-stream replica
+    # failover able to replay the open block) must stay within
+    # write_replay_overhead_pct_max of OFF. The buffer is one bytearray
+    # append per chunk; this keeps it that cheap.
+    REPLAY_OUT=$(JAX_PLATFORMS=cpu timeout 150 python - <<'EOF'
+import asyncio, json, os, sys
+sys.path.insert(0, os.getcwd())
+from bench import _write_replay_overhead_bench
+print(json.dumps(asyncio.run(_write_replay_overhead_bench())))
+EOF
+)
+    rc=$?
+    if [ $rc -ne 0 ] || [ -z "$REPLAY_OUT" ]; then
+        echo "perf_smoke: write-replay microbench failed (rc=$rc)" >&2
+        exit 2
+    fi
+    echo "$REPLAY_OUT"
+    python - "$FLOOR_FILE" <<'EOF' "$REPLAY_OUT"
+import json, sys
+floor_file, result = sys.argv[1], json.loads(sys.argv[2])
+ceiling = json.load(open(floor_file))["write_replay_overhead_pct_max"]
+pct = result.get("write_replay_overhead_pct", 100.0)
+print(f"perf_smoke: write_replay_overhead_pct={pct} ceiling={ceiling} "
+      f"(gibs off={result.get('write_replay_gibs_off')} "
+      f"on={result.get('write_replay_gibs_on')})")
+if pct > ceiling:
+    print(f"perf_smoke: FAIL — replay buffer costs {pct}% > {ceiling}% "
+          "on fault-free writes (one append per chunk got heavy)",
           file=sys.stderr)
     sys.exit(1)
 print("perf_smoke: PASS")

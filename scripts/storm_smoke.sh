@@ -5,9 +5,13 @@
 # abusive-tenant QoS storm (victim p99 contained, abuser mostly
 # THROTTLED, shed-before-queue held), and the raft membership-churn
 # seeds (add-learner/remove/transfer/leader-kill under writes: ≤1
-# leader per term, zero acked-write loss, removed node never leads) —
-# plus the deadline/breaker acceptance tests from tests/test_storm.py
-# and fail on any invariant violation. Mirrors scripts/perf_smoke.sh.
+# leader per term, zero acked-write loss, removed node never leads) and
+# the write-pipeline seeds (workers killed / WRITE_BLOCK faults injected
+# under concurrent multi-block writers: zero acked-write loss, bounded
+# per-file budgets, flagged replicas healed, plus the replicas=1 replay
+# variant) — plus the deadline/breaker acceptance tests from
+# tests/test_storm.py and fail on any invariant violation. Mirrors
+# scripts/perf_smoke.sh.
 #
 # Usage: scripts/storm_smoke.sh [project_root]
 #   STORM_RAFT_REPEAT=N   additionally run the raft election/storm tests

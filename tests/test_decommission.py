@@ -193,3 +193,56 @@ async def test_drain_waits_for_block_report_after_lost_return():
         await worker.block_report_once()
         await _drain_until(mc, victim, WorkerState.DECOMMISSIONED)
         assert await c.read_all("/lostret.bin") == payload
+
+
+async def test_draining_worker_refuses_new_writes():
+    """A DRAINING worker refuses NEW write streams at the door with a
+    retryable error (docs/resilience.md "Write pipeline"): the refusal
+    flag rides the heartbeat reply, WRITE_BLOCK and SC_WRITE_OPEN both
+    bounce, and an end-to-end write simply places elsewhere — in-flight
+    uploads it already accepted are untouched."""
+    from curvine_tpu.common.types import StorageType
+    from curvine_tpu.rpc import RpcCode
+    from curvine_tpu.rpc.frame import pack
+
+    async with MiniCluster(workers=2) as mc:
+        mc.conf.client.short_circuit = False
+        c = mc.client()
+        victim = mc.workers[0]
+        await c.meta.decommission_worker(victim.worker_id)
+
+        async def flagged():
+            while not victim.draining:
+                await asyncio.sleep(0.05)
+        await asyncio.wait_for(flagged(), 10.0)
+
+        # direct WRITE_BLOCK stream: refused, and the refusal is the
+        # retryable DRAINING code (clients re-place, never hard-fail)
+        conn = await c.pool.get(f"127.0.0.1:{victim.rpc.port}")
+        up = await conn.open_upload(RpcCode.WRITE_BLOCK, header={
+            "block_id": 999_999, "storage_type": int(StorageType.MEM),
+            "algo": "crc32c", "len_hint": 1024})
+        with pytest.raises(err.WorkerDraining) as ei:
+            await up.finish(header={"crc32": 0, "algo": "crc32c"})
+        assert ei.value.retryable
+
+        with pytest.raises(err.WorkerDraining):
+            await conn.call(RpcCode.SC_WRITE_OPEN, data=pack({
+                "block_id": 999_998,
+                "storage_type": int(StorageType.MEM),
+                "len_hint": 1024}))
+
+        # end-to-end: a new write succeeds on the healthy worker
+        await c.write_all("/drain/new.bin", b"z" * 2048, replicas=1)
+        fb = await c.meta.get_block_locations("/drain/new.bin")
+        assert all(loc.worker_id != victim.worker_id
+                   for lb in fb.block_locs for loc in lb.locs)
+
+        # recommission: the worker accepts new streams again
+        await c.meta.decommission_worker(victim.worker_id, on=False)
+
+        async def unflagged():
+            while victim.draining:
+                await asyncio.sleep(0.05)
+        await asyncio.wait_for(unflagged(), 10.0)
+        await c.close()

@@ -133,6 +133,44 @@ async def test_membership_storm_deterministic(seed, tmp_path):
         "no membership event applied cleanly — the schedule had no content"
 
 
+WRITE_PIPELINE_SEEDS = [1, 7]
+
+
+@pytest.mark.parametrize("seed", WRITE_PIPELINE_SEEDS)
+async def test_write_pipeline_storm_deterministic(seed, tmp_path):
+    """Write-pipeline fault storm (docs/resilience.md "Write
+    pipeline"): workers killed and WRITE_BLOCK faults injected while
+    concurrent writers stream multi-block files. Invariants: zero
+    acked-write loss, every acked file reads back checksum-clean, no
+    writer exceeds its per-file budget on a single fault, and flagged
+    replicas converge to healed after quiesce."""
+    from curvine_tpu.testing.storm import WritePipelineStorm
+    storm = WritePipelineStorm(seed, base_dir=str(tmp_path))
+    report = await storm.run()
+    report.assert_invariants()
+    assert report.acked_files > 0
+    # the schedule had real content: at least one fault actually landed
+    # on an in-flight pipeline and the failover plane absorbed it
+    assert report.failovers >= 1, \
+        f"no replica failover fired (events={report.events})"
+
+
+async def test_write_pipeline_storm_replay(tmp_path):
+    """Single-replica variant: with fan-out 1 every mid-stream fault
+    kills the LAST leg, so the writer must abandon the block, re-place
+    it, and replay the buffered bytes — the storm proves replay never
+    loses an acked byte (kills are disabled: destroying the only copy
+    of committed data is loss by design, not a recoverable fault)."""
+    from curvine_tpu.testing.storm import WritePipelineStorm
+    storm = WritePipelineStorm(9, workers=3, replicas=1,
+                               base_dir=str(tmp_path))
+    report = await storm.run()
+    report.assert_invariants()
+    assert report.acked_files > 0
+    assert report.replayed_bytes > 0, \
+        f"no block replay fired (events={report.events})"
+
+
 async def test_tenant_storm_abuser_contained(tmp_path):
     """Multi-tenant admission (docs/qos.md): 20 victims + 1 abuser
     hammering at 10× its token-bucket quota with retries disabled. The

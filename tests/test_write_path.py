@@ -1,0 +1,360 @@
+"""Write-pipeline fault tolerance (docs/resilience.md "Write pipeline").
+
+The fault-vector matrix for the write path: worker death at block open /
+mid-chunk / at finish-commit, across short-circuit vs socket uploads and
+1/2/3-replica fan-out. Every vector asserts byte-exact read-back after
+the caller's stream completes WITHOUT an error, plus the bookkeeping the
+failover leaves behind: commit worker_ids that name only the survivors,
+failover/replay counters, and (e2e) the healing plane restoring the
+replica count of a degraded commit in the background.
+
+HDFS pipeline-recovery parity (Shvachko et al., MSST 2010): replace the
+failed datanode, replay, continue — the caller never sees the fault.
+"""
+
+import asyncio
+import hashlib
+import time
+
+import pytest
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.fault.runtime import FaultInjector, FaultSpec
+from curvine_tpu.rpc import RpcCode
+from curvine_tpu.testing import MiniCluster
+from curvine_tpu.testing.storm import storm_bytes
+
+KB = 1024
+BLOCK = 256 * KB
+
+
+def _cfg(mc, sc=False):
+    cc = mc.conf.client
+    cc.short_circuit = sc
+    cc.write_chunk_size = 64 * KB     # several chunks per block, so
+    #                                   faults land MID-block
+    cc.rpc_timeout_ms = 3_000
+    cc.conn_retry_max = 2
+    cc.conn_retry_base_ms = 50
+    return mc.client()
+
+
+def _worker_idx(mc, worker_id):
+    return next(i for i, wk in enumerate(mc.workers)
+                if wk.worker_id == worker_id)
+
+
+async def _locs(c, path):
+    fb = await c.meta.get_block_locations(path)
+    return [(lb.block.id, [l.worker_id for l in lb.locs])
+            for lb in fb.block_locs]
+
+
+# ---------------------------------------------------------------------
+# death mid-chunk (the tentpole vector): a leg's worker dies while the
+# stream is inside a block
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("replicas", [2, 3])
+async def test_mid_chunk_death_survivors_continue(replicas, tmp_path):
+    """Fan-out >= 2: the failed leg is dropped, the stream continues on
+    the survivors, the caller never sees the fault, and every block
+    committed after the kill names only live workers."""
+    async with MiniCluster(workers=3, base_dir=str(tmp_path)) as mc:
+        c = _cfg(mc)
+        data = storm_bytes(31, f"mid{replicas}", 1024 * KB)
+        w = await c.create("/mid.bin", replicas=replicas, block_size=BLOCK)
+        await w.write(data[:300 * KB])           # 44 KB into block 2
+        victim = w._upload_locs[0].worker_id
+        await mc.kill_worker(_worker_idx(mc, victim))
+        await w.write(data[300 * KB:])
+        await w.close()
+
+        assert await c.read_all("/mid.bin") == data
+        assert c.counters.get("write.replica_failover", 0) >= 1
+        # post-kill blocks (2..4) commit on survivors only — the dead
+        # worker must not appear in their worker_ids
+        for bid, ids in (await _locs(c, "/mid.bin"))[1:]:
+            assert victim not in ids, (bid, ids)
+            assert len(ids) >= 1
+        await c.close()
+
+
+async def test_mid_chunk_death_last_replica_replayed(tmp_path):
+    """Fan-out 1: losing the only leg abandons the block, re-places it
+    away from the dead worker, and replays the partial bytes — the
+    caller's stream is untouched and no ghost block stays behind."""
+    async with MiniCluster(workers=3, base_dir=str(tmp_path)) as mc:
+        c = _cfg(mc)
+        data = storm_bytes(32, "replay", 768 * KB)
+        w = await c.create("/rp.bin", replicas=1, block_size=BLOCK)
+        await w.write(data[:100 * KB])           # mid block 1: nothing
+        #                                          sealed yet
+        victim = w._upload_locs[0].worker_id
+        await mc.kill_worker(_worker_idx(mc, victim))
+        await w.write(data[100 * KB:])
+        await w.close()
+
+        assert await c.read_all("/rp.bin") == data
+        assert c.counters.get("write.block_replay_bytes", 0) > 0
+        for bid, ids in await _locs(c, "/rp.bin"):
+            assert victim not in ids, (bid, ids)
+        await c.close()
+
+
+async def test_replay_disabled_surfaces_the_loss(tmp_path):
+    """client.write_replay_buffer=False: the bounded replay buffer is
+    off, so losing the last replica mid-block is a caller-visible error
+    (memory-tight callers traded recovery for zero buffering)."""
+    async with MiniCluster(workers=3, base_dir=str(tmp_path)) as mc:
+        mc.conf.client.write_replay_buffer = False
+        c = _cfg(mc)
+        w = await c.create("/noreplay.bin", replicas=1, block_size=BLOCK)
+        await w.write(b"x" * (100 * KB))
+        victim = w._upload_locs[0].worker_id
+        await mc.kill_worker(_worker_idx(mc, victim))
+        with pytest.raises((err.CurvineError, OSError)):
+            await w.write(b"y" * (300 * KB))
+            await w.close()
+        await w.abort()
+        await c.close()
+
+
+# ---------------------------------------------------------------------
+# death at block open
+# ---------------------------------------------------------------------
+
+async def test_open_death_refused_leg_dropped(tmp_path):
+    """A worker that refuses the NEXT block's upload open (injected
+    WRITE_BLOCK error — same surface as a draining/dying worker) is
+    dropped at the first chunk and the block streams on the other legs."""
+    async with MiniCluster(workers=3, base_dir=str(tmp_path)) as mc:
+        c = _cfg(mc)
+        data = storm_bytes(33, "open", 512 * KB)
+        w = await c.create("/open.bin", replicas=3, block_size=BLOCK)
+        await w.write(data[:BLOCK])              # block 1 sealed clean
+        victim = mc.workers[0]
+        inj = FaultInjector().install(victim.rpc)
+        inj.add(FaultSpec(kind="error",
+                          error_code=int(err.ErrorCode.IO),
+                          error_msg="refused at open",
+                          codes=[int(RpcCode.WRITE_BLOCK)]))
+        await w.write(data[BLOCK:])              # block 2: one leg refused
+        await w.close()
+        inj.clear()
+
+        assert await c.read_all("/open.bin") == data
+        assert c.counters.get("write.replica_failover", 0) >= 1
+        bid, ids = (await _locs(c, "/open.bin"))[1]
+        assert victim.worker_id not in ids, (bid, ids)
+        await c.close()
+
+
+async def test_open_death_dead_workers_replaced(tmp_path):
+    """Two of three workers die before the stream opens its first block:
+    placement retries exclude each dead worker as its open fails, and the
+    write lands on the survivor without a caller error."""
+    async with MiniCluster(workers=3, base_dir=str(tmp_path)) as mc:
+        c = _cfg(mc)
+        survivor = mc.workers[2].worker_id
+        await mc.kill_worker(0)
+        await mc.kill_worker(1)
+        data = storm_bytes(34, "dead", 300 * KB)
+        w = await c.create("/dead.bin", replicas=1, block_size=BLOCK)
+        await w.write(data)
+        await w.close()
+
+        assert await c.read_all("/dead.bin") == data
+        for bid, ids in await _locs(c, "/dead.bin"):
+            assert ids == [survivor], (bid, ids)
+        await c.close()
+
+
+# ---------------------------------------------------------------------
+# death at finish / commit
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("replicas", [1, 2])
+async def test_finish_death(replicas, tmp_path):
+    """The victim dies AFTER every chunk reached it but before the
+    finish ack. Fan-out 2: degraded commit on the survivor (counted,
+    reported for healing). Fan-out 1: whole-block recovery replays and
+    commits elsewhere. Either way close() succeeds and the commit's
+    worker_ids name only live workers."""
+    async with MiniCluster(workers=3, base_dir=str(tmp_path)) as mc:
+        c = _cfg(mc)
+        data = storm_bytes(35, f"fin{replicas}", 128 * KB)
+        w = await c.create("/fin.bin", replicas=replicas, block_size=BLOCK)
+        await w.write(data)                      # streamed, block open
+        assert w._block is not None              # seal still pending
+        victim = w._upload_locs[0].worker_id
+        await mc.kill_worker(_worker_idx(mc, victim))
+        await w.close()                          # finish hits the corpse
+
+        assert await c.read_all("/fin.bin") == data
+        [(bid, ids)] = await _locs(c, "/fin.bin")
+        assert victim not in ids, (bid, ids)
+        if replicas == 2:
+            assert c.counters.get("write.degraded_commits", 0) == 1
+        else:
+            assert c.counters.get("write.block_replay_bytes", 0) > 0
+        await c.close()
+
+
+# ---------------------------------------------------------------------
+# short-circuit vectors (co-located single-replica writes)
+# ---------------------------------------------------------------------
+
+class _EIOOnce:
+    """File proxy whose next write fails with EIO — the co-located
+    pwrite hitting failed media."""
+
+    def __init__(self, f):
+        self._f = f
+        self.fired = False
+
+    def write(self, b):
+        if not self.fired:
+            self.fired = True
+            raise OSError(5, "Input/output error")
+        return self._f.write(b)
+
+    def close(self):
+        self._f.close()
+
+
+async def test_sc_eio_mid_write_recovers(tmp_path):
+    """Short-circuit mid-chunk death: the local pwrite hits EIO, the one
+    and only replica is gone — abandon, re-place away from the failed
+    worker, replay, and the caller's write returns untouched."""
+    async with MiniCluster(workers=2, base_dir=str(tmp_path)) as mc:
+        c = _cfg(mc, sc=True)
+        data = storm_bytes(36, "eio", 400 * KB)
+        w = await c.create("/eio.bin", replicas=1, block_size=BLOCK)
+        await w.write(data[:64 * KB])
+        assert w._sc_file is not None, "short circuit did not engage"
+        victim = w._sc_worker_id
+        w._sc_file = _EIOOnce(w._sc_file)
+        await w.write(data[64 * KB:])
+        await w.close()
+
+        assert await c.read_all("/eio.bin") == data
+        assert c.counters.get("write.replica_failover", 0) >= 1
+        assert c.counters.get("write.block_replay_bytes", 0) > 0
+        bid, ids = (await _locs(c, "/eio.bin"))[0]
+        assert victim not in ids, (bid, ids)
+        await c.close()
+
+
+async def test_sc_commit_death_replayed(tmp_path):
+    """Short-circuit commit death on a single-worker cluster: the
+    SC_WRITE_COMMIT is refused once, recovery re-places — relaxing the
+    exclusion when the failed worker is the ONLY worker — replays, and
+    the re-commit lands."""
+    async with MiniCluster(workers=1, base_dir=str(tmp_path)) as mc:
+        c = _cfg(mc, sc=True)
+        inj = FaultInjector().install(mc.workers[0].rpc)
+        inj.add(FaultSpec(kind="error",
+                          error_code=int(err.ErrorCode.IO),
+                          error_msg="commit refused",
+                          codes=[int(RpcCode.SC_WRITE_COMMIT)],
+                          max_hits=1))
+        data = storm_bytes(37, "sccommit", BLOCK)
+        await c.write_all("/scc.bin", data, replicas=1)
+        inj.clear()
+
+        assert await c.read_all("/scc.bin") == data
+        assert c.counters.get("write.block_replay_bytes", 0) > 0
+        await c.close()
+
+
+async def test_zero_live_workers_recovery_waits(tmp_path):
+    """Rolling-restart case: losing the LAST replica while NO worker is
+    placeable must not surface NoAvailableWorker to the caller —
+    mid-block recovery keeps re-requesting placement inside its 90 s
+    deadline and completes once a worker comes back."""
+    async with MiniCluster(workers=1, base_dir=str(tmp_path)) as mc:
+        c = _cfg(mc)
+        data = storm_bytes(40, "zero", 512 * KB)
+        w = await c.create("/zero.bin", replicas=1, block_size=BLOCK)
+        await w.write(data[:100 * KB])
+        await mc.kill_worker(0)
+
+        async def revive():
+            # past the LOST timeout (~2 s): there is a real window with
+            # zero placeable workers before the replacement registers
+            await asyncio.sleep(3.5)
+            await mc.add_worker()
+
+        reviver = asyncio.create_task(revive())
+        await w.write(data[100 * KB:])
+        await w.close()
+        await reviver
+        assert await c.read_all("/zero.bin") == data
+        assert c.counters.get("write.block_replay_bytes", 0) > 0
+        await c.close()
+
+
+# ---------------------------------------------------------------------
+# hflush durability contract
+# ---------------------------------------------------------------------
+
+async def test_hflush_acks_only_durable_bytes(tmp_path):
+    """An hflush that raced a replica loss recovers BEFORE acking: after
+    it returns, the buffered bytes are on >= min_replicas live legs and
+    a reader (post-close) sees exactly them."""
+    async with MiniCluster(workers=3, base_dir=str(tmp_path)) as mc:
+        mc.conf.client.write_min_replicas = 2
+        c = _cfg(mc)
+        data = storm_bytes(38, "hflush", 200 * KB)
+        w = await c.create("/hf.bin", replicas=2, block_size=BLOCK)
+        await w.write(data[:96 * KB])
+        victim = w._upload_locs[0].worker_id
+        await mc.kill_worker(_worker_idx(mc, victim))
+        await w.write(data[96 * KB:])
+        await w.hflush()
+        # the ack's promise: the open block's fan-out is back at >= min
+        assert len(w._uploads) >= 2, \
+            "hflush acked below write_min_replicas"
+        await w.close()
+        assert await c.read_all("/hf.bin") == data
+        await c.close()
+
+
+# ---------------------------------------------------------------------
+# e2e: degraded commit healed by the replication plane (acceptance)
+# ---------------------------------------------------------------------
+
+async def test_killed_mid_block_replica_healed(tmp_path):
+    """The acceptance headline: a 3-replica write with one worker killed
+    mid-block completes without a caller error, reads back
+    checksum-clean, and the lost replica is re-replicated by the healing
+    plane — every block converges back to 3 live locations."""
+    async with MiniCluster(workers=4, base_dir=str(tmp_path)) as mc:
+        mc.master.replication.scan_interval_s = 0.3
+        c = _cfg(mc)
+        data = storm_bytes(39, "heal", 1024 * KB)
+        w = await c.create("/heal.bin", replicas=3, block_size=BLOCK)
+        await w.write(data[:300 * KB])
+        victim = w._upload_locs[0].worker_id
+        await mc.kill_worker(_worker_idx(mc, victim))
+        await w.write(data[300 * KB:])
+        await w.close()
+
+        got = await c.read_all("/heal.bin")
+        assert hashlib.sha256(got).hexdigest() == \
+            hashlib.sha256(data).hexdigest()
+
+        live = {wk.worker_id for wk in mc.workers
+                if wk.worker_id != victim}
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            locs = await _locs(c, "/heal.bin")
+            if all(len(set(ids) & live) >= 3 for _, ids in locs):
+                break
+            await asyncio.sleep(0.25)
+        locs = await _locs(c, "/heal.bin")
+        assert all(len(set(ids) & live) >= 3 for _, ids in locs), \
+            f"replicas never healed to 3 live copies: {locs}"
+        assert await c.read_all("/heal.bin") == data
+        await c.close()

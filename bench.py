@@ -707,6 +707,121 @@ async def _read_plane_smoke(n_files: int = 32, stat_ops: int = 3_000,
     return out
 
 
+async def _shm_read_bench(iters: int = 2_000, block_mb: int = 4) -> dict:
+    """Shared-memory short-circuit read gate for perf_smoke.sh
+    (docs/data-plane.md). Closed-loop p50/p99 of cached 4K pread_view
+    against a MEM-tier block, A/B:
+
+      A (shm):    default client — GET_BLOCK_INFO advertises the sealed
+                  memfd, the read is an mmap slice, zero RPC data plane
+      B (socket): client.short_circuit off — every read crosses the
+                  worker RPC socket (the pre-shm co-located path)
+
+    The acceptance bar is shm p99 >= 3x better than the socket p99 for
+    co-located reads; shm.grants/read.shm_hits are asserted so a silent
+    fallback can't masquerade as a win. shm_read_gibs streams the block
+    through pread_view (mmap -> aligned buffer memcpy) for the
+    throughput floor. Returns {p99_cached_4k_read_us,
+    p50_cached_4k_read_us, socket_p99_cached_4k_read_us,
+    shm_p99_speedup, shm_read_gibs}."""
+    import copy
+    import random
+    import shutil
+    from curvine_tpu.client import CurvineClient
+    from curvine_tpu.testing import MiniCluster
+
+    base = os.path.join(_pick_shm_dir(), f"curvine-shmbench-{os.getpid()}")
+    size = block_mb * MB
+    slots = size // 4096 - 1
+    out: dict = {}
+
+    async def lat_us(client, path: str, n: int) -> list:
+        r = await client.open(path)
+        rng = random.Random(11)
+        for _ in range(16):                                  # warm
+            await r.pread_view(rng.randrange(slots) * 4096, 4096)
+        lat = []
+        for _ in range(n):
+            off = rng.randrange(slots) * 4096
+            t0 = time.perf_counter()
+            await r.pread_view(off, 4096)
+            lat.append((time.perf_counter() - t0) * 1e6)
+        await r.close()
+        lat.sort()
+        return lat
+
+    try:
+        async with MiniCluster(workers=1, base_dir=base, journal=False,
+                               block_size=size) as mc:
+            c = mc.client()
+            await c.write_all("/shm/hot.bin", os.urandom(size))
+
+            a = await lat_us(c, "/shm/hot.bin", iters)
+            hits = c.counters.get("read.shm_hits", 0)
+            out["p50_cached_4k_read_us"] = round(a[len(a) // 2], 1)
+            out["p99_cached_4k_read_us"] = round(
+                a[int(0.99 * len(a)) - 1], 1)
+            out["shm_hits"] = int(hits)
+
+            # throughput: stream the whole block through the shm path
+            r = await c.open("/shm/hot.bin")
+            seg = MB
+            reps = 16
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                off = 0
+                while off < size:
+                    v = await r.pread_view(off, seg)
+                    off += len(v)
+            out["shm_read_gibs"] = round(
+                reps * size / (1024 ** 3) / (time.perf_counter() - t0), 3)
+            await r.close()
+            await c.close()
+
+            # B side: same cluster, short-circuit off — the socket
+            # path. Prefetch off too: the whole-block prefetch window
+            # would serve the random reads from client memory and hide
+            # the per-read RPC this gate exists to measure.
+            conf_b = copy.deepcopy(mc.conf)
+            conf_b.client.short_circuit = False
+            conf_b.client.enable_smart_prefetch = False
+            conf_b.client.read_ahead_chunks = 0
+            cb = CurvineClient(conf_b)
+            b = await lat_us(cb, "/shm/hot.bin", max(400, iters // 4))
+            await cb.close()
+            out["socket_p99_cached_4k_read_us"] = round(
+                b[int(0.99 * len(b)) - 1], 1)
+            out["shm_p99_speedup"] = round(
+                out["socket_p99_cached_4k_read_us"]
+                / max(out["p99_cached_4k_read_us"], 1e-9), 2)
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    return out
+
+
+async def _ladder_smoke(clients: int = 64, duration: float = 2.0,
+                        rate: float = 10.0) -> dict:
+    """Scaled-down open-loop concurrency rung (scripts/latency_ladder.py
+    at 64 clients, short duration) so perf_smoke.sh exercises the fleet
+    rig without the full 1K walk. Returns {ladder_clients,
+    ladder_achieved_qps, ladder_p50_us, ladder_p99_us,
+    ladder_errors}."""
+    scripts = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "scripts")
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+    from latency_ladder import run_ladder
+
+    res = await run_ladder(rungs=(clients,), duration=duration,
+                           rate=rate, procs=min(os.cpu_count() or 2, 4))
+    rung = res["rungs"][0]
+    return {"ladder_clients": rung["clients"],
+            "ladder_achieved_qps": rung["achieved_qps"],
+            "ladder_p50_us": rung["p50_us"],
+            "ladder_p99_us": rung["p99_us"],
+            "ladder_errors": rung["errors"]}
+
+
 async def run_bench(total_mb: int = 256, block_mb: int = 64,
                     latency_block_mb: int = 1, latency_iters: int = 200):
     import jax
@@ -1095,6 +1210,13 @@ async def run_bench(total_mb: int = 256, block_mb: int = 64,
     # ---- read fan-out plane: stat/open/read ladder, lease cache
     # off vs warm (docs/read-plane.md) ----
     results.update(await _read_plane_smoke())
+
+    # ---- 100 us-class data plane: shm short-circuit A/B + the
+    # open-loop concurrency rung (docs/data-plane.md) ----
+    if os.environ.get("BENCH_SHM", "1") != "0":
+        results.update(await _shm_read_bench())
+    if os.environ.get("BENCH_LADDER", "1") != "0":
+        results.update(await _ladder_smoke())
     return results
 
 
@@ -1454,6 +1576,21 @@ def main(argv: list[str] | None = None):
         "meta_cache_speedup": round(
             results.get("meta_cache_speedup", 0), 1),
         "open_read_p99_ms": round(results.get("open_read_p99_ms", 0), 3),
+        "p99_cached_4k_read_us": round(
+            results.get("p99_cached_4k_read_us", 0), 1),
+        "p50_cached_4k_read_us": round(
+            results.get("p50_cached_4k_read_us", 0), 1),
+        "socket_p99_cached_4k_read_us": round(
+            results.get("socket_p99_cached_4k_read_us", 0), 1),
+        "shm_p99_speedup": round(results.get("shm_p99_speedup", 0), 2),
+        "shm_read_gibs": round(results.get("shm_read_gibs", 0), 3),
+        "shm_hits": int(results.get("shm_hits", 0)),
+        "ladder_clients": int(results.get("ladder_clients", 0)),
+        "ladder_achieved_qps": round(
+            results.get("ladder_achieved_qps", 0), 1),
+        "ladder_p50_us": round(results.get("ladder_p50_us", 0), 1),
+        "ladder_p99_us": round(results.get("ladder_p99_us", 0), 1),
+        "ladder_errors": int(results.get("ladder_errors", 0)),
         "rpc_rtt_us": round(results.get("rpc_rtt_us", 0), 1),
         "rpc_pipelined_qps": round(results.get("rpc_pipelined_qps", 0), 1),
         "loop_impl": results.get("loop_impl", "asyncio"),

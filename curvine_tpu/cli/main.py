@@ -319,6 +319,12 @@ async def cmd_report(args):
                   f"{int(wp.get('replica_failover', 0))}  "
                   f"replayed: {_human(int(wp.get('block_replay_bytes', 0)))}  "
                   f"degraded commits: {int(wp.get('degraded_commits', 0))}")
+        dp = rp.get("read_plane")
+        if dp:
+            print(f"Read plane: shm hits: {int(dp.get('shm_hits', 0))}  "
+                  f"fallbacks: {int(dp.get('shm_fallbacks', 0))}  "
+                  f"zero-copy: "
+                  f"{_human(int(dp.get('zero_copy_bytes', 0)))}")
         rows = rp.get("shards") or []
         if rows:
             print(f"Namespace shards: {len(rows)}")

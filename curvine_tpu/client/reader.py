@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import mmap
 import os
 import time
 import zlib
@@ -16,7 +17,7 @@ from contextlib import nullcontext
 
 from curvine_tpu.common import errors as err  # noqa: F401
 from curvine_tpu.common.types import FileBlocks, LocatedBlock
-from curvine_tpu.rpc import RpcCode
+from curvine_tpu.rpc import RpcCode, transport
 from curvine_tpu.rpc.client import ConnectionPool
 from curvine_tpu.rpc.deadline import Deadline
 from curvine_tpu.rpc.frame import pack, unpack
@@ -150,6 +151,25 @@ class FsReader:
         # block_id -> (crc, algo) captured from GET_BLOCK_INFO for the
         # short-circuit paths (remote reads get it on the EOF frame)
         self._block_crc: dict[int, tuple[int, str]] = {}
+        # shared-memory short-circuit (docs/data-plane.md): the worker
+        # advertised a sealed-memfd side channel for these blocks; maps
+        # are block_id -> (memfd, mmap), verified once at map time and
+        # bounded by the same _SC_CACHE_CAP FIFO as the fd cache
+        # (_drop_local closes both)
+        self._shm_sock: dict[int, str] = {}
+        self._shm_maps: dict[int, tuple[int, mmap.mmap]] = {}
+        # registered receive buffers (rpc/transport.py): caller-visible
+        # destinations >= _aligned_min are page-aligned mmap-backed so
+        # remote payloads scatter straight into device-ingestible
+        # memory; prefetch segments cycle through the bounded pool
+        rc = getattr(pool, "rpc_conf", None)
+        self._aligned_min = getattr(rc, "recv_aligned_min",
+                                    transport._ALIGNED_MIN)
+        self._recv_pool = transport.recv_pool()
+        if rc is not None:
+            self._recv_pool.max_bytes = rc.recv_registered_bytes
+        # which path served the current read op (span attribute)
+        self._serve_paths: set[str] = set()
 
     # ---------------- positioning ----------------
 
@@ -257,6 +277,26 @@ class FsReader:
                 os.close(cached[0])
             except OSError:
                 pass
+        self._drop_shm(bid)
+
+    def _drop_shm(self, bid: int) -> None:
+        """Close a block's shm map + memfd. A zero-copy view still held
+        by a caller keeps the mapping alive past this close (BufferError
+        → the mmap object stays open until the last view is released and
+        GC finishes it) — eviction can never tear pages out from under a
+        live read. The fd closes either way; the map holds the pages."""
+        self._shm_sock.pop(bid, None)
+        ent = self._shm_maps.pop(bid, None)
+        if ent is not None:
+            fd, mm = ent
+            try:
+                mm.close()
+            except BufferError:
+                pass
+            try:
+                os.close(fd)
+            except OSError:
+                pass
 
     async def _local_path(self, lb: LocatedBlock) -> str | None:
         """Resolve the on-disk path for a co-located block (cached)."""
@@ -297,6 +337,12 @@ class FsReader:
                         if lease:
                             self._local_expiry[bid] = \
                                 sent_at + lease / 1000
+                        if info.get("shm") and info.get("shm_sock"):
+                            # worker offers the sealed-memfd side
+                            # channel for this block: the next read
+                            # fetches the fd and maps it (shm wins
+                            # over the preadv fd path)
+                            self._shm_sock[bid] = info["shm_sock"]
                 except err.CurvineError as e:
                     log.debug("short-circuit probe failed for %d: %s", bid, e)
         while len(self._local_paths) >= self._SC_CACHE_CAP:
@@ -322,6 +368,124 @@ class FsReader:
                     os.close(cached[0])
                 except OSError:
                     pass
+            self._drop_shm(bid)
+
+    # ---------------- shared-memory short-circuit ----------------
+
+    def _count(self, key: str, n: float = 1) -> None:
+        self.counters[key] = self.counters.get(key, 0) + n
+
+    def _mark(self, path: str) -> None:
+        self._serve_paths.add(path)
+
+    def _served_by(self) -> str:
+        return "+".join(sorted(self._serve_paths)) or "none"
+
+    async def _shm_map(self, lb: LocatedBlock) -> mmap.mmap | None:
+        """The block's shm mapping, fetching + sealing-checking on first
+        use: connect to the worker's SCM_RIGHTS side channel (blocking
+        socket → thread; asyncio can't carry ancillary fds), map the
+        sealed memfd read-only, verify the full block ONCE against the
+        commit-time checksum — after which every read of the block is a
+        pure memory access. None → caller uses the fd/socket paths."""
+        bid = lb.block.id
+        ent = self._shm_maps.get(bid)
+        if ent is not None:
+            return ent[1]
+        if not self.short_circuit:
+            return None
+        if bid not in self._local_paths:
+            await self._local_path(lb)      # probe captures shm_sock
+        spath = self._shm_sock.get(bid)
+        if spath is None:
+            return None
+        from curvine_tpu.worker.shm import fetch_block_fd
+        try:
+            fd, length = await asyncio.to_thread(fetch_block_fd,
+                                                 spath, bid)
+        except (LookupError, OSError, ValueError) as e:
+            # worker dropped the export / channel gone: stop retrying
+            # this block, serve it through fd/socket instead
+            log.debug("shm fetch for block %d failed: %s", bid, e)
+            self._shm_sock.pop(bid, None)
+            self._count("read.shm_fallbacks")
+            return None
+        other = self._shm_maps.get(bid)
+        if other is not None:
+            # lost a concurrent-fetch race: keep the first mapping
+            os.close(fd)
+            return other[1]
+        if length != lb.block.len or length <= 0:
+            os.close(fd)
+            self._shm_sock.pop(bid, None)
+            self._count("read.shm_fallbacks")
+            return None
+        try:
+            mm = mmap.mmap(fd, length, access=mmap.ACCESS_READ)
+        except (OSError, ValueError):
+            os.close(fd)
+            self._count("read.shm_fallbacks")
+            return None
+        if self.verify and not self._sc_verify_ok(lb, memoryview(mm)):
+            # _sc_verify_ok flagged the replica and dropped the caches
+            try:
+                mm.close()
+            except BufferError:
+                pass
+            os.close(fd)
+            self._count("read.shm_fallbacks")
+            return None
+        self._shm_maps[bid] = (fd, mm)
+        return mm
+
+    async def _shm_read_into(self, lb: LocatedBlock, block_off: int,
+                             out) -> int:
+        """Fill ``out`` from the block's shm mapping (one memcpy, zero
+        RPCs, zero syscalls); 0 → not shm-served, use the next path."""
+        mm = await self._shm_map(lb)
+        if mm is None:
+            return 0
+        import numpy as np
+        n = len(out)
+        out[:n] = np.frombuffer(mm, dtype=np.uint8, count=n,
+                                offset=block_off)
+        self._note_sc_read(lb.block.id, n)
+        self._count("read.shm_hits")
+        self._mark("shm")
+        return n
+
+    async def _shm_view(self, offset: int, n: int):
+        """Zero-copy numpy view onto a shm-mapped block range — the
+        whole point of the shm plane: read_range/mmap_view return a
+        read-only slice of the sealed mapping itself, no RPC, no copy.
+        None → range not single-block / block not shm-served."""
+        if n <= 0:
+            return None
+        located = self._locate(offset)
+        if located is None:
+            return None
+        lb, block_off = located
+        if block_off + n > lb.block.len:
+            return None
+        mm = await self._shm_map(lb)
+        if mm is None:
+            return None
+        import numpy as np
+        self._note_sc_read(lb.block.id, n)
+        self._count("read.shm_hits")
+        self._count("read.zero_copy_bytes", n)
+        self._mark("shm")
+        return np.frombuffer(mm, dtype=np.uint8, count=n,
+                             offset=block_off)
+
+    def _alloc_out(self, n: int):
+        """Caller-visible read destination: page-aligned mmap-backed
+        (registered-receive style, numpy/HBM-view friendly) from
+        rpc.recv_aligned_min up; small reads stay on the heap."""
+        import numpy as np
+        if n >= self._aligned_min:
+            return transport.alloc_aligned(n)
+        return np.empty(n, dtype=np.uint8)
 
     # ---------------- read integrity ----------------
 
@@ -368,6 +532,7 @@ class FsReader:
                 os.close(cached[0])
             except OSError:
                 pass
+        self._drop_shm(bid)
         return False
 
     # ---------------- short-circuit read accounting ----------------
@@ -448,13 +613,16 @@ class FsReader:
         remote segments stream into the same buffer, served from the
         sequential prefetch window when it has them. Use for device
         ingest and FUSE reads; `pread` stays for bytes consumers."""
-        import numpy as np
         n = max(0, min(n, self.len - offset))
-        out = np.empty(n, dtype=np.uint8)
-        with self._span("pread_view", path=self.path, offset=offset, n=n):
+        out = self._alloc_out(n)
+        self._serve_paths = set()
+        with self._span("pread_view", path=self.path, offset=offset,
+                        n=n) as sp:
             filled = await self._read_into(
                 offset, out, use_prefetch=True,
                 deadline=self._deadline(deadline_ms))
+            if sp is not None:
+                sp.set_attr("served_by", self._served_by())
         self.detector.record_read(offset, offset + filled)
         self._prefetch_topup(offset + filled)
         return out[:filled]
@@ -483,10 +651,18 @@ class FsReader:
                 out[filled:filled + nh] = 0
                 self.counters["hole.bytes.read"] = \
                     self.counters.get("hole.bytes.read", 0) + nh
+                self._mark("hole")
                 filled += nh
                 continue
             lb, block_off = located
             seg = min(n - filled, lb.block.len - block_off)
+            # shared-memory first: zero RPCs AND zero syscalls once the
+            # block is mapped (the fd path below still costs a preadv)
+            got = await self._shm_read_into(lb, block_off,
+                                            out[filled:filled + seg])
+            if got > 0:
+                filled += got
+                continue
             fd = await self._local_fd(lb)
             if fd is not None:
                 base = self._local_offs.get(lb.block.id, 0)
@@ -504,6 +680,7 @@ class FsReader:
                     fd = None
                 else:
                     self._note_sc_read(lb.block.id, got)
+                    self._mark("local")
                     filled += got
             if fd is None:
                 # remote: stream chunks straight into the output buffer
@@ -523,16 +700,28 @@ class FsReader:
         slice split + per-slice readers). Each slice streams
         independently (its own pooled connections for remote blocks), so
         one large file saturates multiple workers/replicas instead of
-        one socket."""
+        one socket.
+
+        Shm-mapped single-block ranges skip ALL of that: the return is
+        a read-only zero-copy view onto the sealed mapping itself."""
         import numpy as np
         n = max(0, min(n, self.len - offset))
-        out = np.empty(n, dtype=np.uint8)
         if n == 0:
-            return out
+            return np.empty(0, dtype=np.uint8)
         dl = self._deadline(deadline_ms)
+        self._serve_paths = set()
         with self._span("read_range", path=self.path, offset=offset,
-                        n=n, parallel=parallel):
-            return await self._read_range(offset, n, parallel, out, dl)
+                        n=n, parallel=parallel) as sp:
+            view = await self._shm_view(offset, n)
+            if view is not None:
+                if sp is not None:
+                    sp.set_attr("served_by", "shm")
+                return view
+            out = self._alloc_out(n)
+            got = await self._read_range(offset, n, parallel, out, dl)
+            if sp is not None:
+                sp.set_attr("served_by", self._served_by())
+            return got
 
     async def _read_range(self, offset: int, n: int, parallel: int,
                           out, dl):
@@ -613,12 +802,14 @@ class FsReader:
                 ent.cancel()
 
     async def _fetch_seg(self, s: int, seg_len: int):
-        import numpy as np
         located = self._locate(s)
         if located is None:
             raise err.BlockNotFound(f"prefetch segment at {s}")
         lb, block_off = located
-        buf = np.empty(seg_len, dtype=np.uint8)
+        # registered receive buffer: prefetch segments are internal
+        # (consumed by copy, then released), so they cycle through the
+        # bounded aligned pool instead of churning fresh allocations
+        buf = self._recv_pool.acquire(seg_len)
         got = await self._readinto_remote(lb, block_off, memoryview(buf))
         return buf[:got]
 
@@ -648,10 +839,12 @@ class FsReader:
         out[:n] = buf[rel:rel + n]
         self.counters["pf.bytes.read"] = \
             self.counters.get("pf.bytes.read", 0) + n
+        self._mark("prefetch")
         if rel + n >= len(buf):
             self._pf.pop(s, None)        # fully consumed
             if s in self._pf_order:
                 self._pf_order.remove(s)
+            self._recv_pool.release(buf)  # back to the registered pool
         return n
 
     async def _readinto_remote(self, lb: LocatedBlock, block_off: int,
@@ -691,6 +884,10 @@ class FsReader:
                             f"checksum verification")
                 if self.health is not None:
                     self.health.ok(addr)
+                # readinto scatter: payload bytes landed directly in
+                # the caller's (aligned) buffer — no intermediate copy
+                self._count("read.zero_copy_bytes", max(0, got))
+                self._mark("remote")
                 return got
             except err.CurvineError as e:
                 if self.health is not None:
@@ -742,8 +939,14 @@ class FsReader:
         jax.device_put with no further Python copies. (Named for the
         original mmap implementation; fd+preadv beats mmap here because
         per-page fault cost dwarfs the copy on virtualized hosts.)
-        Returns None when the range isn't short-circuit readable."""
+        Returns None when the range isn't short-circuit readable.
+
+        Shm-mapped blocks ARE true zero-copy here again: the sealed
+        mapping serves a read-only view with no preadv and no buffer."""
         import numpy as np
+        view = await self._shm_view(offset, n)
+        if view is not None:
+            return view
         located = self._locate(offset)
         if located is None:
             return None
@@ -780,6 +983,14 @@ class FsReader:
             return b"\x00" * nh
         lb, block_off = located
         n = min(n, lb.block.len - block_off)
+        mm = await self._shm_map(lb)
+        if mm is not None:
+            # bytes API: one mandatory copy (bytes are owning), still
+            # zero RPCs and zero syscalls
+            self._note_sc_read(lb.block.id, n)
+            self._count("read.shm_hits")
+            self._mark("shm")
+            return mm[block_off:block_off + n]
         fd = await self._local_fd(lb)
         if fd is not None:
             base = self._local_offs.get(lb.block.id, 0)
@@ -793,6 +1004,7 @@ class FsReader:
                 self._drop_local(lb.block.id)
             else:
                 self._note_sc_read(lb.block.id, len(data))
+                self._mark("local")
                 return data
         # failover across replica locations (local-first, breaker-aware)
         locs = self._failover_locs(lb)
@@ -904,16 +1116,32 @@ class FsReader:
         return bytes(out)
 
     async def close(self) -> None:
+        # prefetch window: cancel AND await, so no task outlives the
+        # reader (a cancelled-never-awaited task warns at loop teardown
+        # and pins its receive buffer)
+        tasks = [ent for ent in self._pf.values()
+                 if isinstance(ent, asyncio.Task)]
         for ent in self._pf.values():
             if isinstance(ent, asyncio.Task):
                 ent.cancel()
+            else:
+                self._recv_pool.release(ent)
         self._pf.clear()
         self._pf_order.clear()
-        if self._sc_flush_task is not None and not self._sc_flush_task.done():
-            try:
-                await self._sc_flush_task
-            except Exception:  # noqa: BLE001 — accounting only
-                pass
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+        # heat accounting: drain the in-flight flush, then flush the
+        # residual below the 512 batch threshold — pending counts must
+        # never be silently dropped at close
+        t, self._sc_flush_task = self._sc_flush_task, None
+        if t is not None:
+            if not t.done():
+                try:
+                    await t
+                except (Exception, asyncio.CancelledError):  # noqa: BLE001
+                    pass
+            elif not t.cancelled():
+                t.exception()     # retrieve, or the loop warns later
         if self._sc_reads:
             await self._flush_sc_reads()
         for fd, _path in self._local_fds.values():
@@ -922,3 +1150,5 @@ class FsReader:
             except OSError:
                 pass
         self._local_fds.clear()
+        for bid in list(self._shm_maps):
+            self._drop_shm(bid)

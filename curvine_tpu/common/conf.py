@@ -185,6 +185,15 @@ class WorkerConf:
     disk_probe_failures: int = 2
     disk_probe_successes: int = 3
     disk_evac_batch: int = 256
+    # shared-memory short-circuit reads (docs/data-plane.md): MEM-tier
+    # blocks are exported as sealed memfds and handed to co-located
+    # clients over an SCM_RIGHTS unix side channel; read_range becomes a
+    # zero-RPC, zero-copy mmap slice. Needs os.memfd_create (Linux);
+    # auto-disabled elsewhere and clients fall back to the socket path.
+    shm_reads: bool = True
+    # sealed-memfd export cache entries (LRU; evictions close the
+    # worker-side fd — client-held dups stay valid, unlink semantics)
+    shm_export_cap: int = 128
 
 
 @dataclass
@@ -320,6 +329,16 @@ class RpcConf:
     # bulk-recv buffer: one sock_recv_into typically lands many small
     # frames, decoded back-to-back with no further syscalls
     recv_buffer_bytes: int = 256 * 1024
+    # registered receive buffers (transport.RegisteredBuffers): remote
+    # block reads land in page-aligned mmap-backed destinations acquired
+    # from a bounded reuse pool — the client-side mirror of the worker's
+    # io_uring registered buffers (numpy/HBM-view friendly; readinto
+    # scatters the payload straight into them). 0 disables pooling;
+    # aligned allocation still applies above recv_aligned_min.
+    recv_registered_bytes: int = 32 * MB
+    # reads at least this large get an aligned mmap-backed destination
+    # instead of a heap numpy buffer
+    recv_aligned_min: int = 256 * 1024
 
 
 @dataclass

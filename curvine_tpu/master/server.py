@@ -903,6 +903,14 @@ class MasterServer:
               if k.startswith(pre_w)}
         if wp:
             out["write_plane"] = wp
+        # data-plane read rollup (client.read.* counters pushed via
+        # METRICS_REPORT): shm short-circuit hits/fallbacks and bytes
+        # delivered zero-copy (docs/data-plane.md)
+        pre_r = "client.read."
+        rp = {k[len(pre_r):]: v for k, v in self.metrics.counters.items()
+              if k.startswith(pre_r)}
+        if rp:
+            out["read_plane"] = rp
         return out
 
     def _tenant_stats(self, q):

@@ -29,7 +29,9 @@ which must bypass the bulk buffer)."""
 from __future__ import annotations
 
 import asyncio
+import mmap
 import socket
+import threading
 from collections import deque
 
 from curvine_tpu.common.errors import ConnectError
@@ -45,6 +47,125 @@ RECV_BUFFER_BYTES = 256 * 1024
 RECV_RETAIN_MAX = 8 * 1024 * 1024
 # sendmsg iovec count per syscall (IOV_MAX is 1024 on linux)
 _IOV_CAP = 512
+
+# ---------------- registered receive buffers ----------------
+#
+# The client-side mirror of the worker's io_uring registered buffers
+# (worker/io_engine.py AlignedBuf/BufferPool): remote block reads land
+# in page-aligned, mmap-backed destinations so the readinto scatter
+# path (rpc/client.py _Sink) delivers payload bytes straight into a
+# buffer jax.device_put / numpy can consume with no realignment copy.
+# Anonymous mmap gives page alignment by construction and returns pages
+# to the OS on free — a caller keeping the array alive owns the pages,
+# one dropping it releases them, so buffers handed to callers need no
+# explicit release protocol.
+
+_ALIGNED_MIN = 256 * 1024        # default reads-this-large-get-aligned
+_REGISTERED_MIN = 64 * 1024      # smallest pooled size class
+_REGISTERED_MAX = 8 * 1024 * 1024  # largest pooled size class
+
+
+def alloc_aligned(n: int):
+    """Page-aligned numpy uint8 buffer of length ``n``, backed by an
+    anonymous mmap (freed on GC). The registered-receive destination
+    for caller-visible reads."""
+    import numpy as np
+    if n <= 0:
+        return np.empty(0, dtype=np.uint8)
+    mm = mmap.mmap(-1, n)
+    return np.frombuffer(mm, dtype=np.uint8, count=n)
+
+
+class RegisteredBuffers:
+    """Bounded reuse pool of page-aligned mmap regions, by power-of-two
+    size class (mirror of io_engine.BufferPool for the receive side).
+    ``acquire(n)`` returns a numpy view of length ``n`` onto a pooled
+    region; ``release(arr)`` returns the region for reuse (up to
+    ``max_bytes`` retained — beyond that the pages go back to the OS).
+    Only INTERNAL consumers release (prefetch segments); buffers that
+    escape to callers are simply never released and get collected."""
+
+    def __init__(self, max_bytes: int = 32 * 1024 * 1024,
+                 min_size: int = _REGISTERED_MIN,
+                 max_size: int = _REGISTERED_MAX):
+        self.min_size = min_size
+        self.max_size = max_size
+        self.max_bytes = max(0, max_bytes)
+        self.retained = 0
+        self._free: dict[int, list[mmap.mmap]] = {}
+        self._lock = threading.Lock()
+        self.acquired = 0
+        self.reused = 0
+
+    def _cls(self, n: int) -> int:
+        size = self.min_size
+        while size < n:
+            size *= 2
+        return size
+
+    def acquire(self, n: int):
+        """Numpy uint8 view of length ``n`` on an aligned region; the
+        view's ``.base`` mmap carries identity for ``release``."""
+        import numpy as np
+        if n <= 0:
+            return np.empty(0, dtype=np.uint8)
+        if n > self.max_size:
+            return alloc_aligned(n)      # giant: unpooled one-off
+        size = self._cls(n)
+        with self._lock:
+            free = self._free.get(size)
+            mm = free.pop() if free else None
+            if mm is not None:
+                self.retained -= size
+                self.reused += 1
+        if mm is None:
+            mm = mmap.mmap(-1, size)
+        self.acquired += 1
+        return np.frombuffer(mm, dtype=np.uint8, count=size)[:n]
+
+    def release(self, arr) -> None:
+        """Return an ``acquire``d view's region to the pool (no-op for
+        foreign buffers)."""
+        base = getattr(arr, "base", None)
+        while base is not None and not isinstance(base, mmap.mmap):
+            # numpy chains ndarray views down to a memoryview over the
+            # region; .obj unwraps that last hop to the mmap itself
+            if isinstance(base, memoryview):
+                base = base.obj
+            else:
+                base = getattr(base, "base", None)
+        if not isinstance(base, mmap.mmap):
+            return
+        size = len(base)
+        if size < self.min_size or size > self.max_size:
+            return
+        with self._lock:
+            if self.retained + size <= self.max_bytes:
+                self._free.setdefault(size, []).append(base)
+                self.retained += size
+
+    def drain(self) -> None:
+        with self._lock:
+            regions = [mm for lst in self._free.values() for mm in lst]
+            self._free.clear()
+            self.retained = 0
+        for mm in regions:
+            try:
+                mm.close()
+            except BufferError:
+                pass                     # a live view pins it; GC frees
+
+
+_recv_pool: RegisteredBuffers | None = None
+
+
+def recv_pool() -> RegisteredBuffers:
+    """Process-wide registered receive pool (sized by
+    rpc.recv_registered_bytes at first client construction)."""
+    global _recv_pool
+    if _recv_pool is None:
+        _recv_pool = RegisteredBuffers()
+    return _recv_pool
 
 
 async def recv_exact(loop: asyncio.AbstractEventLoop, sock: socket.socket,

@@ -123,6 +123,16 @@ class WorkerServer:
                     self.conf.client.rpc_timeout_ms / 1000.0)
         self.store = BlockStore(tiers, wc.eviction_high_water,
                                 wc.eviction_low_water)
+        # shared-memory read plane (worker/shm.py): sealed-memfd export
+        # cache + SCM_RIGHTS side channel for co-located clients. The
+        # channel itself starts in start() (port must be final); deleted
+        # blocks drop their export so a stale copy is never handed out.
+        from curvine_tpu.worker.shm import ShmExporter, shm_supported
+        self.shm = None
+        self._shm_channel = None
+        if wc.shm_reads and shm_supported():
+            self.shm = ShmExporter(cap=wc.shm_export_cap)
+            self.store.on_delete = self.shm.invalidate
         # per-dir DiskHealth thresholds from conf (the state machine
         # itself lives on each TierDir — worker/storage.py)
         for tier in self.store.tiers:
@@ -219,6 +229,17 @@ class WorkerServer:
                                            or self.hbm is not None):
             self.executor.submit_periodic("promote", self._promote_once,
                                           wc.promote_interval_ms / 1000)
+        if self.shm is not None:
+            from curvine_tpu.worker.shm import ShmChannel, channel_path
+            ch = ShmChannel(channel_path(self.rpc.port), self._shm_grant)
+            try:
+                ch.start()
+                self._shm_channel = ch
+            except OSError as e:
+                # no unix sockets here (exotic sandbox): clients simply
+                # never see the shm capability flags — clean fallback
+                log.warning("shm side channel disabled: %s", e)
+                self.shm = None
         log.info("worker %d started at %s", self.worker_id, self.addr)
 
     async def stop(self) -> None:
@@ -226,6 +247,11 @@ class WorkerServer:
         for t in self._bg:
             t.cancel()
         self._bg.clear()
+        if self._shm_channel is not None:
+            await asyncio.to_thread(self._shm_channel.stop)
+            self._shm_channel = None
+        if self.shm is not None:
+            self.shm.close()
         await self.rpc.stop()
         await self.master_pool.close()
         await self.peer_pool.close()
@@ -1014,7 +1040,38 @@ class WorkerServer:
             # mmap/pread bytes against it without a worker round-trip
             rep["crc32"] = info.crc32c
             rep["crc_algo"] = info.crc_algo
+        if self._shm_servable(info):
+            # capability negotiation: a client that understands the shm
+            # plane fetches the sealed memfd over the side channel and
+            # serves reads as zero-RPC mmap slices; everyone else just
+            # ignores the flags and keeps the fd/socket paths
+            rep["shm"] = True
+            rep["shm_sock"] = self._shm_channel.path
         return rep
+
+    def _shm_servable(self, info) -> bool:
+        """MEM-tier file-layout committed blocks only: extents live
+        inside a shared backing file (a memfd copy would defeat the
+        lease machinery) and disk tiers would double-buffer the page
+        cache into anonymous memory for no latency win."""
+        return (self.shm is not None and self._shm_channel is not None
+                and info.state == BlockState.COMMITTED
+                and not getattr(info, "is_extent", False)
+                and info.tier.storage_type == StorageType.MEM)
+
+    def _shm_grant(self, block_id: int) -> tuple[int, int]:
+        """Side-channel policy hook (runs on the channel thread): look
+        the block up, gate on tier/layout, export a sealed memfd.
+        LookupError → NOT_FOUND reply → the client falls back."""
+        try:
+            info = self.store.get(block_id, touch=False)
+        except err.CurvineError:
+            raise LookupError(f"block {block_id}") from None
+        if not self._shm_servable(info):
+            raise LookupError(f"block {block_id} not shm-servable")
+        fd, length = self.shm.export(block_id, info.path, info.len)
+        self.metrics.inc("shm.grants")
+        return fd, length
 
     async def _sc_read_report(self, msg: Message, conn: ServerConn):
         """Short-circuit read accounting: clients read through cached fds

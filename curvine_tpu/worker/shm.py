@@ -1,0 +1,307 @@
+"""Shared-memory block export: sealed memfds + SCM_RIGHTS hand-off.
+
+The worker-side half of the shm short-circuit read plane
+(docs/data-plane.md). For MEM-tier file-layout blocks the worker keeps
+a bounded cache of sealed memfd copies; a co-located client that saw
+the ``shm``/``shm_sock`` capability flags on its GET_BLOCK_INFO probe
+connects to the unix side channel, sends the block id, and receives the
+fd in SCM_RIGHTS ancillary data — after which every read of the block
+is an mmap slice with zero RPCs and zero copies.
+
+Shape: HDFS short-circuit local reads (DfsClientShm / the
+DomainSocket fd-passing plane), adapted to sealed memfds so the handed
+fd is immutable by construction: F_SEAL_SHRINK|GROW|WRITE mean the
+bytes a client mapped can never change under it, and eviction on the
+worker merely closes OUR fd — client-held dups keep the pages alive
+(the same unlink semantics the fd-based short-circuit path relies on).
+
+asyncio cannot carry SCM_RIGHTS, so the side channel is a small
+blocking AF_UNIX listener on a daemon thread; requests are one fixed
+8-byte frame and replies one 16-byte frame, so a request is served in
+microseconds and a thread per accepted connection stays cheap (clients
+connect once per block, not per read)."""
+
+from __future__ import annotations
+
+import array
+import logging
+import os
+import socket
+import struct
+import tempfile
+import threading
+
+log = logging.getLogger(__name__)
+
+# request: little-endian u64 block id.  reply: i8 status + 7 pad bytes
+# + u64 block length; status 0 carries the fd in SCM_RIGHTS ancillary.
+_REQ = struct.Struct("<Q")
+_REP = struct.Struct("<b7xQ")
+OK = 0
+NOT_FOUND = 1
+ERROR = 2
+
+_SENDFILE_CHUNK = 8 * 1024 * 1024
+
+
+def shm_supported() -> bool:
+    """memfd_create + unix-socket fd passing: Linux, py3.8+."""
+    return hasattr(os, "memfd_create") and hasattr(socket, "SCM_RIGHTS")
+
+
+def channel_path(port: int) -> str:
+    """Side-channel socket path: short (AF_UNIX caps sun_path at ~108
+    bytes, so the worker's data dir — often a deep tmp path in tests —
+    is not usable), unique per process+port."""
+    return os.path.join(tempfile.gettempdir(),
+                        f"cv-shm-{os.getpid()}-{port}.sock")
+
+
+def _seal(fd: int) -> None:
+    import fcntl
+    seals = (fcntl.F_SEAL_SHRINK | fcntl.F_SEAL_GROW
+             | fcntl.F_SEAL_WRITE | fcntl.F_SEAL_SEAL)
+    fcntl.fcntl(fd, fcntl.F_ADD_SEALS, seals)
+
+
+class ShmExporter:
+    """Bounded LRU of sealed-memfd block copies.
+
+    ``export`` returns a worker-owned fd for a committed MEM-tier block:
+    a memfd the block file's bytes were sendfile'd into, then sealed.
+    Eviction (LRU past ``cap``) and ``invalidate`` (block deleted) close
+    the worker's fd only — dups already handed to clients stay valid.
+    Thread-safe: called from the side-channel thread and the event
+    loop."""
+
+    def __init__(self, cap: int = 128):
+        self.cap = max(1, cap)
+        self._lock = threading.Lock()
+        # block_id -> (memfd, length); dict order is the LRU order
+        self._fds: dict[int, tuple[int, int]] = {}
+        self.exports = 0        # memfd copies materialized
+        self.hits = 0           # grants served from the cache
+        self.evictions = 0
+
+    def export(self, block_id: int, path: str, length: int) -> tuple[int, int]:
+        """(memfd, length) for the block file at ``path``; cached."""
+        with self._lock:
+            ent = self._fds.pop(block_id, None)
+            if ent is not None:
+                self._fds[block_id] = ent       # refresh LRU position
+                self.hits += 1
+                return ent
+        fd = self._copy_to_memfd(block_id, path, length)
+        with self._lock:
+            ent = self._fds.pop(block_id, None)
+            if ent is not None:
+                # raced with another grant: keep the first copy
+                self._fds[block_id] = ent
+                self.hits += 1
+                self._close(fd)
+                return ent
+            while len(self._fds) >= self.cap:
+                old_fd, _n = self._fds.pop(next(iter(self._fds)))
+                self._close(old_fd)
+                self.evictions += 1
+            self._fds[block_id] = (fd, length)
+            self.exports += 1
+            return fd, length
+
+    @staticmethod
+    def _copy_to_memfd(block_id: int, path: str, length: int) -> int:
+        src = os.open(path, os.O_RDONLY)
+        try:
+            fd = os.memfd_create(f"cv-blk-{block_id}",
+                                 os.MFD_CLOEXEC | os.MFD_ALLOW_SEALING)
+            try:
+                os.ftruncate(fd, length)
+                off = 0
+                while off < length:
+                    n = os.sendfile(fd, src, off,
+                                    min(_SENDFILE_CHUNK, length - off))
+                    if n == 0:
+                        raise OSError(
+                            f"short copy of block {block_id}: "
+                            f"{off}/{length}")
+                    off += n
+                _seal(fd)
+            except OSError:
+                os.close(fd)
+                raise
+            return fd
+        finally:
+            os.close(src)
+
+    @staticmethod
+    def _close(fd: int) -> None:
+        try:
+            os.close(fd)
+        except OSError:
+            pass
+
+    def invalidate(self, block_id: int) -> None:
+        with self._lock:
+            ent = self._fds.pop(block_id, None)
+        if ent is not None:
+            self._close(ent[0])
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._fds)
+
+    def close(self) -> None:
+        with self._lock:
+            fds, self._fds = list(self._fds.values()), {}
+        for fd, _n in fds:
+            self._close(fd)
+
+
+class ShmChannel:
+    """AF_UNIX SCM_RIGHTS side channel serving block fds.
+
+    ``grant(block_id) -> (fd, length)`` is the server's policy hook
+    (resolve the block, check the tier, export through the
+    ShmExporter); it runs on the channel's threads, so it must only
+    touch thread-safe state (BlockStore and ShmExporter both take their
+    own locks)."""
+
+    def __init__(self, path: str, grant):
+        self.path = path
+        self.grant = grant
+        self._srv: socket.socket | None = None
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    def start(self) -> None:
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            srv.bind(self.path)
+            srv.listen(64)
+        except OSError:
+            srv.close()
+            raise
+        self._srv = srv
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="shm-channel", daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                break                    # listener closed (stop)
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        """One client connection: fixed-size request/reply frames until
+        EOF (clients typically fetch one fd per connection)."""
+        with conn:
+            conn.settimeout(5.0)
+            while not self._stop.is_set():
+                try:
+                    req = self._recv_exact(conn, _REQ.size)
+                except OSError:
+                    return
+                if req is None:
+                    return               # clean EOF
+                (block_id,) = _REQ.unpack(req)
+                try:
+                    fd, length = self.grant(block_id)
+                except LookupError:
+                    self._reply(conn, NOT_FOUND, 0, None)
+                    continue
+                except Exception as e:  # noqa: BLE001 — keep serving
+                    log.debug("shm grant for %d failed: %s", block_id, e)
+                    self._reply(conn, ERROR, 0, None)
+                    continue
+                if not self._reply(conn, OK, length, fd):
+                    return
+
+    @staticmethod
+    def _recv_exact(conn: socket.socket, n: int) -> bytes | None:
+        buf = b""
+        while len(buf) < n:
+            got = conn.recv(n - len(buf))
+            if not got:
+                return None if not buf else buf
+            buf += got
+        return buf
+
+    @staticmethod
+    def _reply(conn: socket.socket, status: int, length: int,
+               fd: int | None) -> bool:
+        anc = []
+        if fd is not None:
+            anc = [(socket.SOL_SOCKET, socket.SCM_RIGHTS,
+                    array.array("i", [fd]))]
+        try:
+            conn.sendmsg([_REP.pack(status, length)], anc)
+            return True
+        except OSError:
+            return False
+
+    def stop(self) -> None:
+        self._stop.set()
+        srv, self._srv = self._srv, None
+        if srv is not None:
+            # close() alone does NOT wake a thread blocked in accept()
+            # on Linux; shutdown() forces accept to return so the join
+            # below is immediate instead of eating its timeout
+            try:
+                srv.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                srv.close()
+            except OSError:
+                pass
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2.0)
+
+
+def fetch_block_fd(sock_path: str, block_id: int,
+                   timeout: float = 5.0) -> tuple[int, int]:
+    """Client half: connect to the worker's side channel, request one
+    block, return (fd, length). Blocking — run under asyncio.to_thread.
+    Raises LookupError when the worker no longer serves the block and
+    OSError on channel trouble (both are clean fallbacks to the socket
+    read path)."""
+    with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+        s.settimeout(timeout)
+        s.connect(sock_path)
+        s.sendall(_REQ.pack(block_id))
+        data, anc, _flags, _addr = s.recvmsg(
+            _REP.size, socket.CMSG_SPACE(array.array("i").itemsize))
+        if len(data) < _REP.size:
+            raise ConnectionResetError("shm channel closed mid-reply")
+        status, length = _REP.unpack(data)
+        fds = array.array("i")
+        for level, ctype, cdata in anc:
+            if level == socket.SOL_SOCKET and ctype == socket.SCM_RIGHTS:
+                fds.frombytes(cdata[:len(cdata)
+                                    - (len(cdata) % fds.itemsize)])
+        if status == NOT_FOUND:
+            for fd in fds:
+                os.close(fd)
+            raise LookupError(f"block {block_id} not shm-served")
+        if status != OK or not fds:
+            for fd in fds:
+                os.close(fd)
+            raise OSError(f"shm grant failed (status {status})")
+        fd = fds[0]
+        for extra in list(fds)[1:]:
+            os.close(extra)
+        return fd, length

@@ -437,6 +437,11 @@ class BlockStore:
         # disk-level fault injection (fault/disk.DiskFaultInjector);
         # None in production — storms and tests install one
         self.fault_hook = None
+        # block-removal hook (worker/shm.py ShmExporter.invalidate): a
+        # deleted/evicted block must drop its sealed-memfd export so a
+        # stale copy is never handed to a new client. Fired under the
+        # store lock; the callback must not call back into the store.
+        self.on_delete = None
         # last scrub cycle's outcome counts (metrics exporter reads it)
         self.scrub_last = {"verified": 0, "mismatch": 0, "truncated": 0,
                            "io_error": 0}
@@ -792,6 +797,11 @@ class BlockStore:
                 self._remove_locked(info)
 
     def _remove_locked(self, info: BlockInfo) -> None:
+        if self.on_delete is not None:
+            try:
+                self.on_delete(info.block_id)
+            except Exception:  # noqa: BLE001 — removal must proceed
+                pass
         if info.is_extent:
             if self._read_pins.get(info.block_id):
                 # an active stream holds (fd, offset) into the backing

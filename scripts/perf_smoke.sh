@@ -14,6 +14,8 @@
 #   BENCH_VERIFY=0 skips the read-verification overhead gate.
 #   BENCH_QOS=0 skips the admission-overhead gate.
 #   BENCH_WRITEREPLAY=0 skips the write-replay-buffer overhead gate.
+#   BENCH_SHM=0 skips the shared-memory read-plane gate.
+#   BENCH_LADDER=0 skips the open-loop concurrency-rung gate.
 # Exit: 0 = at/above the regression gates, 1 = regression, 2 = harness error.
 
 set -u
@@ -273,6 +275,112 @@ if p99 > floors["open_read_p99_ms_max"]:
     print(f"perf_smoke: FAIL — open_read_p99_ms {p99} > "
           f"{floors['open_read_p99_ms_max']} (warm open+read tail "
           "regressed)", file=sys.stderr)
+    sys.exit(1)
+print("perf_smoke: PASS")
+EOF
+    rc=$?
+    [ $rc -ne 0 ] && exit $rc
+fi
+
+if [ "${BENCH_SHM:-1}" = "0" ]; then
+    echo "perf_smoke: shared-memory read-plane gate skipped (BENCH_SHM=0)"
+else
+    # shared-memory read-plane gate (docs/data-plane.md): closed-loop
+    # 4K pread_view p99 against a MEM-tier block must stay 100us-class
+    # (absolute ceiling), shm streaming throughput gets the usual 30%
+    # slack, and shm p99 must beat the per-read socket path by the
+    # ABSOLUTE shm_p99_speedup_min ratio — the zero-RPC plane's reason
+    # to exist.
+    SHM_OUT=$(JAX_PLATFORMS=cpu timeout 300 python - <<'EOF'
+import asyncio, json, os, sys
+sys.path.insert(0, os.getcwd())
+from bench import _shm_read_bench
+print(json.dumps(asyncio.run(_shm_read_bench())))
+EOF
+)
+    rc=$?
+    if [ $rc -ne 0 ] || [ -z "$SHM_OUT" ]; then
+        echo "perf_smoke: shared-memory microbench failed (rc=$rc)" >&2
+        exit 2
+    fi
+    echo "$SHM_OUT"
+    python - "$FLOOR_FILE" <<'EOF' "$SHM_OUT"
+import json, sys
+floor_file, result = sys.argv[1], json.loads(sys.argv[2])
+floors = json.load(open(floor_file))
+p99 = result.get("p99_cached_4k_read_us", 1e9)
+gibs = result.get("shm_read_gibs", 0.0)
+speedup = result.get("shm_p99_speedup", 0.0)
+hits = result.get("shm_hits", 0)
+gibs_gate = floors["shm_read_gibs"] * 0.7       # >30% regression fails
+print(f"perf_smoke: p99_cached_4k_read_us={p99} "
+      f"ceiling={floors['p99_cached_4k_read_us_max']} "
+      f"shm_read_gibs={gibs} gate={gibs_gate:.3f} "
+      f"shm_p99_speedup={speedup} "
+      f"floor={floors['shm_p99_speedup_min']} shm_hits={hits}")
+if hits <= 0:
+    print("perf_smoke: FAIL — shm_hits=0: the bench never took the "
+          "shared-memory path (silent fallback would fake the gate)",
+          file=sys.stderr)
+    sys.exit(1)
+if p99 > floors["p99_cached_4k_read_us_max"]:
+    print(f"perf_smoke: FAIL — p99_cached_4k_read_us {p99} > "
+          f"{floors['p99_cached_4k_read_us_max']} (cached-read tail "
+          "left the 100us class)", file=sys.stderr)
+    sys.exit(1)
+if gibs < gibs_gate:
+    print(f"perf_smoke: FAIL — shm_read_gibs {gibs} < {gibs_gate:.3f} "
+          f"(floor {floors['shm_read_gibs']} - 30%)", file=sys.stderr)
+    sys.exit(1)
+if speedup < floors["shm_p99_speedup_min"]:
+    print(f"perf_smoke: FAIL — shm_p99_speedup {speedup}x < "
+          f"{floors['shm_p99_speedup_min']}x (absolute floor: shm must "
+          "beat the per-read socket path)", file=sys.stderr)
+    sys.exit(1)
+print("perf_smoke: PASS")
+EOF
+    rc=$?
+    [ $rc -ne 0 ] && exit $rc
+fi
+
+if [ "${BENCH_LADDER:-1}" = "0" ]; then
+    echo "perf_smoke: concurrency-rung gate skipped (BENCH_LADDER=0)"
+else
+    # open-loop concurrency rung (scripts/latency_ladder.py at 64
+    # clients, short duration): must complete with zero errors and a
+    # tail under the deliberately loose ladder_p99_us_max ceiling —
+    # open-loop latency includes queueing, so on small boxes this only
+    # catches collapse, not noise.
+    LADDER_OUT=$(JAX_PLATFORMS=cpu timeout 300 python - <<'EOF'
+import asyncio, json, os, sys
+sys.path.insert(0, os.getcwd())
+from bench import _ladder_smoke
+print(json.dumps(asyncio.run(_ladder_smoke())))
+EOF
+)
+    rc=$?
+    if [ $rc -ne 0 ] || [ -z "$LADDER_OUT" ]; then
+        echo "perf_smoke: concurrency-rung microbench failed (rc=$rc)" >&2
+        exit 2
+    fi
+    echo "$LADDER_OUT"
+    python - "$FLOOR_FILE" <<'EOF' "$LADDER_OUT"
+import json, sys
+floor_file, result = sys.argv[1], json.loads(sys.argv[2])
+ceiling = json.load(open(floor_file))["ladder_p99_us_max"]
+p99 = result.get("ladder_p99_us", 1e9)
+errs = result.get("ladder_errors", -1)
+qps = result.get("ladder_achieved_qps", 0.0)
+print(f"perf_smoke: ladder_p99_us={p99} ceiling={ceiling} "
+      f"clients={result.get('ladder_clients')} qps={qps} errors={errs}")
+if errs != 0:
+    print(f"perf_smoke: FAIL — ladder rung had {errs} read errors",
+          file=sys.stderr)
+    sys.exit(1)
+if p99 > ceiling:
+    print(f"perf_smoke: FAIL — ladder_p99_us {p99} > {ceiling} "
+          "(open-loop tail collapsed under the 64-client rung)",
+          file=sys.stderr)
     sys.exit(1)
 print("perf_smoke: PASS")
 EOF

@@ -1,0 +1,342 @@
+"""Shared-memory short-circuit reads (docs/data-plane.md).
+
+The worker exports committed MEM-tier blocks as sealed memfds and hands
+the fd to co-located clients over an SCM_RIGHTS side channel; the client
+maps it once and serves reads as pure memory accesses — zero RPCs on the
+data plane. These tests pin the protocol (capability negotiation, clean
+fallback), the resource discipline (fd/mmap LRU, no leaks under churn,
+close() flushes heat), and the observability rail (counters reach the
+master's read-plane rollup)."""
+
+import asyncio
+import fcntl
+import gc
+import mmap
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from curvine_tpu.common.conf import ClusterConf
+from curvine_tpu.testing import MiniCluster
+from curvine_tpu.worker import shm as wshm
+from curvine_tpu.rpc import transport
+
+MB = 1024 * 1024
+
+pytestmark = pytest.mark.skipif(
+    not wshm.shm_supported(),
+    reason="memfd_create/SCM_RIGHTS not available on this platform")
+
+
+def _fd_count() -> int:
+    return len(os.listdir("/proc/self/fd"))
+
+
+# ---------------- the hit path: zero-RPC data plane ----------------
+
+async def test_shm_read_skips_rpc_data_plane(tmp_path):
+    """Co-located MEM-tier reads are served from the sealed-memfd
+    mapping: the hit counter moves, the worker's RPC read path does
+    not, and read_range returns a read-only zero-copy view."""
+    conf = ClusterConf()
+    conf.data_dir = str(tmp_path)
+    async with MiniCluster(workers=1, conf=conf, base_dir=str(tmp_path),
+                           block_size=MB) as mc:
+        c = mc.client()
+        payload = os.urandom(MB + 4096)
+        await c.write_all("/shm/a.bin", payload)
+        r = await c.open("/shm/a.bin")
+
+        for off in (0, 4096, MB - 4096, MB, MB + 100):
+            got = await r.pread_view(off, 4096)
+            assert bytes(got) == payload[off:off + 4096]
+        assert c.counters.get("read.shm_hits", 0) >= 5
+        # the data plane never touched the worker's RPC read path
+        assert mc.workers[0].metrics.counters.get("bytes.read", 0) == 0
+        assert mc.workers[0].metrics.counters.get("shm.grants", 0) >= 1
+
+        # single-block range: a zero-copy view onto the mapping itself
+        view = await r.read_range(8192, 4096)
+        assert isinstance(view, np.ndarray)
+        assert not view.flags.writeable
+        assert bytes(view) == payload[8192:8192 + 4096]
+        assert c.counters.get("read.zero_copy_bytes", 0) >= 4096
+        await r.close()
+        await c.close()
+
+
+async def test_shm_disabled_capability_negotiation(tmp_path):
+    """worker.shm_reads=false: GET_BLOCK_INFO advertises no shm
+    capability and the client transparently serves the same bytes
+    through the fd/socket paths — no shm hit, no error."""
+    conf = ClusterConf()
+    conf.data_dir = str(tmp_path)
+    conf.worker.shm_reads = False
+    async with MiniCluster(workers=1, conf=conf, base_dir=str(tmp_path),
+                           block_size=MB) as mc:
+        assert mc.workers[0].shm is None
+        c = mc.client()
+        payload = os.urandom(64 * 1024)
+        await c.write_all("/shm/off.bin", payload)
+        r = await c.open("/shm/off.bin")
+        got = await r.pread_view(1000, 5000)
+        assert bytes(got) == payload[1000:6000]
+        assert c.counters.get("read.shm_hits", 0) == 0
+        assert not r._shm_sock and not r._shm_maps
+        await r.close()
+        await c.close()
+
+
+async def test_shm_fetch_failure_falls_back(tmp_path, monkeypatch):
+    """A client whose side-channel fetch fails (no SCM_RIGHTS, channel
+    gone, worker restarted) falls back to the socket/fd path: bytes
+    stay correct, the fallback counter records it, and the block is not
+    retried against the dead channel."""
+    conf = ClusterConf()
+    conf.data_dir = str(tmp_path)
+    async with MiniCluster(workers=1, conf=conf, base_dir=str(tmp_path),
+                           block_size=MB) as mc:
+        c = mc.client()
+        payload = os.urandom(64 * 1024)
+        await c.write_all("/shm/fb.bin", payload)
+
+        def boom(sock_path, block_id, timeout=5.0):
+            raise OSError("side channel unavailable")
+
+        monkeypatch.setattr(wshm, "fetch_block_fd", boom)
+        r = await c.open("/shm/fb.bin")
+        got = await r.pread_view(0, 4096)
+        assert bytes(got) == payload[:4096]
+        assert c.counters.get("read.shm_fallbacks", 0) >= 1
+        assert c.counters.get("read.shm_hits", 0) == 0
+        # the failed block stopped advertising: no retry storm
+        bid = r.blocks.block_locs[0].block.id
+        assert bid not in r._shm_sock
+        await r.close()
+        await c.close()
+
+
+# ---------------- resource discipline: LRU, leaks, close ----------------
+
+async def test_shm_fd_lru_churn_no_leak(tmp_path):
+    """Block turnover far past both caches (client map LRU + worker
+    export LRU) must not grow the process fd table: every eviction
+    closes its memfd."""
+    conf = ClusterConf()
+    conf.data_dir = str(tmp_path)
+    conf.worker.shm_export_cap = 4
+    async with MiniCluster(workers=1, conf=conf, base_dir=str(tmp_path),
+                           block_size=64 * 1024) as mc:
+        c = mc.client()
+        n_blocks = 16
+        payload = os.urandom(n_blocks * 64 * 1024)
+        await c.write_all("/shm/churn.bin", payload)
+        r = await c.open("/shm/churn.bin")
+        r._SC_CACHE_CAP = 4          # shadow the class FIFO bound
+
+        async def churn(rounds: int) -> None:
+            for i in range(rounds):
+                off = (i % n_blocks) * 64 * 1024
+                got = await r.pread_view(off, 4096)
+                assert bytes(got) == payload[off:off + 4096]
+
+        await churn(64)              # reach steady state
+        gc.collect()
+        base = _fd_count()
+        await churn(640)             # 10x turnover across both LRUs
+        gc.collect()
+        assert _fd_count() <= base + 2, \
+            "fd table grew under shm block churn (leaked memfd/mmap)"
+        assert len(r._shm_maps) <= r._SC_CACHE_CAP
+        assert len(mc.workers[0].shm) <= 4
+        assert mc.workers[0].shm.evictions > 0
+        await r.close()
+        assert not r._shm_maps
+        await c.close()
+
+
+async def test_shm_eviction_mid_read_keeps_view_valid(tmp_path):
+    """A zero-copy view handed to the caller outlives eviction of its
+    mapping: _drop_shm tolerates the exported buffer (BufferError) and
+    the bytes stay correct until the caller releases the view."""
+    conf = ClusterConf()
+    conf.data_dir = str(tmp_path)
+    async with MiniCluster(workers=1, conf=conf, base_dir=str(tmp_path),
+                           block_size=MB) as mc:
+        c = mc.client()
+        payload = os.urandom(MB)
+        await c.write_all("/shm/evict.bin", payload)
+        r = await c.open("/shm/evict.bin")
+        view = await r.read_range(4096, 8192)
+        assert bytes(view) == payload[4096:4096 + 8192]
+        bid = r.blocks.block_locs[0].block.id
+        assert bid in r._shm_maps
+        r._drop_shm(bid)             # concurrent eviction
+        assert bid not in r._shm_maps
+        # the mapping can't actually close while the view holds it
+        assert bytes(view) == payload[4096:4096 + 8192]
+        del view
+        gc.collect()
+        await r.close()
+        await c.close()
+
+
+async def test_close_flushes_pending_sc_reads(tmp_path):
+    """close() flushes sc-read heat counts below the 512 batch
+    threshold and leaves no flush task behind — the worker's
+    promotion scans see short sessions too."""
+    conf = ClusterConf()
+    conf.data_dir = str(tmp_path)
+    async with MiniCluster(workers=1, conf=conf, base_dir=str(tmp_path),
+                           block_size=MB) as mc:
+        c = mc.client()
+        await c.write_all("/shm/heat.bin", os.urandom(MB))
+        r = await c.open("/shm/heat.bin")
+        bid = r.blocks.block_locs[0].block.id
+        for i in range(20):          # well under the 512 threshold
+            await r.pread_view(i * 4096, 4096)
+        assert r._sc_reads, "reads were not accounted for flush"
+        h0 = mc.workers[0].store.get(bid, touch=False).heat
+        await r.close()
+        assert mc.workers[0].store.get(bid, touch=False).heat >= h0 + 20
+        assert r._sc_flush_task is None and not r._sc_reads
+        assert not r._pf and not r._shm_maps
+        await c.close()
+
+
+# ---------------- unit: exporter, channel, transport pool ----------------
+
+async def test_shm_exporter_seals_and_lru(tmp_path):
+    """ShmExporter: the memfd is sealed immutable, carries the block
+    bytes, and the LRU closes evicted fds."""
+    blocks = {}
+    for i in range(3):
+        p = tmp_path / f"b{i}"
+        p.write_bytes(bytes([i]) * 4096)
+        blocks[i] = str(p)
+    ex = wshm.ShmExporter(cap=2)
+    try:
+        fd0, n0 = ex.export(0, blocks[0], 4096)
+        assert n0 == 4096
+        seals = fcntl.fcntl(fd0, fcntl.F_GET_SEALS)
+        assert seals & fcntl.F_SEAL_WRITE and seals & fcntl.F_SEAL_SEAL
+        assert os.pread(fd0, 4096, 0) == b"\x00" * 4096
+        with pytest.raises(OSError):
+            os.pwrite(fd0, b"x", 0)          # sealed: immutable
+        fd0b, _ = ex.export(0, blocks[0], 4096)
+        assert fd0b == fd0 and ex.hits == 1  # cache hit, same fd
+        ex.export(1, blocks[1], 4096)
+        ex.export(2, blocks[2], 4096)        # evicts block 0 (LRU)
+        assert len(ex) == 2 and ex.evictions == 1
+        with pytest.raises(OSError):
+            os.fstat(fd0)                    # eviction closed it
+    finally:
+        ex.close()
+    assert len(ex) == 0
+
+
+async def test_shm_channel_fd_handoff(tmp_path):
+    """ShmChannel/fetch_block_fd: the SCM_RIGHTS round trip dups a
+    usable fd into the receiver; unknown blocks raise LookupError."""
+    data = os.urandom(8192)
+    fd = os.memfd_create("cv-test")
+    os.write(fd, data)
+
+    def grant(block_id: int):
+        if block_id != 7:
+            raise LookupError(block_id)
+        return fd, len(data)
+
+    path = wshm.channel_path(os.getpid() % 60_000)
+    ch = wshm.ShmChannel(path, grant)
+    ch.start()
+    try:
+        got_fd, n = await asyncio.to_thread(wshm.fetch_block_fd, path, 7)
+        assert n == len(data)
+        assert got_fd != fd                  # a dup, not the original
+        assert os.pread(got_fd, n, 0) == data
+        os.close(got_fd)
+        with pytest.raises(LookupError):
+            await asyncio.to_thread(wshm.fetch_block_fd, path, 8)
+    finally:
+        ch.stop()
+        os.close(fd)
+    assert not os.path.exists(path)
+
+
+def test_alloc_aligned_and_registered_pool():
+    """transport.alloc_aligned returns page-aligned mmap-backed arrays;
+    RegisteredBuffers recycles them under a byte cap."""
+    arr = transport.alloc_aligned(300_000)
+    assert len(arr) == 300_000
+    assert arr.ctypes.data % mmap.PAGESIZE == 0
+
+    pool = transport.RegisteredBuffers(max_bytes=2 * MB,
+                                       min_size=64 * 1024,
+                                       max_size=MB)
+    a = pool.acquire(100_000)
+    assert len(a) == 100_000 and a.ctypes.data % mmap.PAGESIZE == 0
+    pool.release(a)
+    b = pool.acquire(90_000)                 # same power-of-two class
+    assert pool.reused == 1
+    pool.release(b)
+    # over max_size: served aligned but never pooled (nor counted)
+    big = pool.acquire(4 * MB)
+    assert len(big) == 4 * MB
+    held, retained = pool.acquired, pool.retained
+    pool.release(big)
+    assert pool.acquired == held and pool.retained == retained
+    # the cap bounds retention: releases past max_bytes are dropped
+    extras = [pool.acquire(MB) for _ in range(4)]
+    for e in extras:
+        pool.release(e)
+    assert pool.retained <= 2 * MB
+    pool.drain()
+
+
+# ---------------- observability: counters reach the master ----------------
+
+async def test_read_plane_rollup_reaches_master(tmp_path):
+    """read.shm_* counters ride the METRICS_REPORT push plane and land
+    in the master's read-plane rollup (the `cv report` feed)."""
+    conf = ClusterConf()
+    conf.data_dir = str(tmp_path)
+    async with MiniCluster(workers=1, conf=conf, base_dir=str(tmp_path),
+                           block_size=MB) as mc:
+        c = mc.client()
+        await c.write_all("/shm/obs.bin", os.urandom(MB))
+        r = await c.open("/shm/obs.bin")
+        await r.pread_view(0, 4096)
+        await r.read_range(4096, 4096)       # zero-copy view path
+        await r.close()
+        await c.flush_metrics()
+        m = mc.master.metrics.as_dict()
+        assert m.get("client.read.shm_hits", 0) >= 2
+        assert m.get("client.read.zero_copy_bytes", 0) >= 4096
+        table = await mc.master._shard_table({})
+        assert table["read_plane"]["shm_hits"] >= 2
+        assert table["read_plane"]["zero_copy_bytes"] >= 4096
+        await c.close()
+
+
+# ---------------- the ladder, scaled down to a tier-1 smoke ----------------
+
+async def test_latency_ladder_smoke():
+    """One scaled-down open-loop rung (64 clients over a process fleet,
+    Poisson arrivals) completes with zero errors — the tier-1 guard for
+    scripts/latency_ladder.py and the perf_smoke concurrency gate."""
+    scripts = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts")
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+    from latency_ladder import run_ladder
+
+    res = await run_ladder(rungs=(64,), duration=1.0, rate=4.0, procs=2)
+    rung = res["rungs"][0]
+    assert rung["clients"] == 64
+    assert rung["errors"] == 0
+    assert rung["samples"] > 0
+    assert rung["p99_us"] == rung["p99_us"]      # not NaN
+    assert rung["p50_us"] <= rung["p99_us"] <= rung["p999_us"]

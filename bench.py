@@ -426,6 +426,96 @@ async def _write_replay_overhead_bench(block_kb: int = 1024,
     return out
 
 
+async def _ec_smoke(cell_mb: int = 1, rounds: int = 3,
+                    block_mb: int = 4, reads: int = 3) -> dict:
+    """Erasure-coding gate (docs/erasure-coding.md): (a) raw RS(6,3)
+    encode throughput through the preferred GF(256) path (native kernel
+    when built) — the per-byte budget the background convert job spends
+    striping cold blocks; (b) degraded-vs-intact read A/B on a live
+    cluster: read_all of a one-stripe rs-2-1 file with every cell up,
+    then with the first data cell's holder killed so every read decodes
+    inline from the k survivors (the master is kept blind via a long
+    lost-timeout, so nothing heals mid-measurement). Returns
+    {ec_encode_gibs, ec_read_intact_gibs, ec_read_degraded_gibs,
+    ec_degraded_read_overhead_pct}."""
+    import shutil
+    import tempfile
+    from curvine_tpu.common import ec as eclib
+    from curvine_tpu.common.types import JobState, SetAttrOpts
+    from curvine_tpu.testing.cluster import MiniCluster
+
+    prof = eclib.ECProfile.parse("rs-6-3")
+    cells, _cs = eclib.split(os.urandom(prof.k * cell_mb * MB), prof.k)
+    best = 0.0
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        eclib.encode(prof, cells)
+        best = max(best, prof.k * cell_mb / 1024
+                   / (time.perf_counter() - t0))
+    out: dict = {"ec_encode_gibs": round(best, 3)}
+
+    base = tempfile.mkdtemp(prefix="curvine-ecsmoke-")
+    mc = MiniCluster(workers=3, base_dir=base, block_size=block_mb * MB,
+                     journal=False, lost_timeout_ms=600_000)
+    try:
+        await mc.start()
+        c = mc.client()
+        payload = os.urandom(block_mb * MB)
+        await c.write_all("/ecsmoke/f.bin", payload)
+        await c.meta.set_attr("/ecsmoke/f.bin", SetAttrOpts(ec="rs-2-1"))
+        job_id = await c.meta.submit_job("ec_convert", "/ecsmoke/f.bin")
+
+        async def converted():
+            while True:
+                job = await c.meta.job_status(job_id)
+                if job.state == JobState.COMPLETED:
+                    break
+                if job.state in (JobState.FAILED, JobState.CANCELLED):
+                    raise RuntimeError(f"ec_convert: {job.message}")
+                await asyncio.sleep(0.05)
+            while True:
+                fb = await c.meta.get_block_locations("/ecsmoke/f.bin")
+                if fb.block_locs and all(
+                        lb.ec is not None and not lb.locs
+                        for lb in fb.block_locs):
+                    return fb
+                await asyncio.sleep(0.05)
+        fb = await asyncio.wait_for(converted(), 30)
+
+        async def read_gibs() -> float:
+            peak = 0.0
+            for _ in range(reads):
+                r = await c.open("/ecsmoke/f.bin")
+                t0 = time.perf_counter()
+                got = await r.read_all()
+                dt = time.perf_counter() - t0
+                await r.close()
+                if got != payload:
+                    raise RuntimeError("ec A/B read corrupt")
+                peak = max(peak, len(payload) / dt / (1024 * MB))
+            return peak
+
+        intact = await read_gibs()
+        victim_wid = \
+            fb.block_locs[0].ec["cells"][0]["locs"][0]["worker_id"]
+        victim = next(i for i, w in enumerate(mc.workers)
+                      if w.worker_id == victim_wid)
+        await mc.kill_worker(victim)
+        degraded = await read_gibs()
+        if not c.counters.get("read.ec_degraded", 0):
+            raise RuntimeError("ec A/B never took the degraded path")
+        out["ec_read_intact_gibs"] = round(intact, 3)
+        out["ec_read_degraded_gibs"] = round(degraded, 3)
+        out["ec_degraded_read_overhead_pct"] = round(
+            max(0.0, (intact - degraded) / intact * 100), 2)
+    finally:
+        try:
+            await mc.stop()
+        finally:
+            shutil.rmtree(base, ignore_errors=True)
+    return out
+
+
 def _tmpfs_raw_gibs(base: str) -> float:
     """Raw sequential write rate to the cache tier's backing dir (the
     hardware ceiling for the write path on this host)."""

@@ -63,6 +63,7 @@ struct Rec {
   std::string owner, group, target, xattr_mp;      // xattr: msgpack map
   int64_t sp_ttl = 0, sp_ufs_mtime = 0;
   int32_t sp_type = 0, sp_action = 0, sp_state = 0;
+  std::string sp_ec;                               // "" = replicated
 
   bool is_dir() const { return ftype == 0; }        // FileType.DIR == 0
 };
@@ -109,12 +110,13 @@ void encode_status(std::string& o, const Rec& r, const std::string& path) {
     o += r.xattr_mp;                               // verbatim splice
   }
   pack_str(o, "storage_policy");
-  mp_map(o, 5);
+  mp_map(o, 6);
   pack_str(o, "storage_type");   pack_int(o, r.sp_type);
   pack_str(o, "ttl_ms");         pack_int(o, r.sp_ttl);
   pack_str(o, "ttl_action");     pack_int(o, r.sp_action);
   pack_str(o, "ufs_mtime");      pack_int(o, r.sp_ufs_mtime);
   pack_str(o, "state");          pack_int(o, r.sp_state);
+  pack_str(o, "ec");             pack_str(o, r.sp_ec);
   pack_str(o, "owner");          pack_str(o, r.owner);
   pack_str(o, "group");          pack_str(o, r.group);
   pack_str(o, "mode");           pack_int(o, r.mode);
@@ -667,7 +669,7 @@ void mm_put(void* h, int64_t id, int64_t parent_id, int ftype,
             int replicas, int is_complete, int nlink, int64_t children_num,
             const char* target, const char* xattr_mp, int xattr_len,
             int sp_type, long long sp_ttl, int sp_action,
-            long long sp_ufs_mtime, int sp_state) {
+            long long sp_ufs_mtime, int sp_state, const char* sp_ec) {
   auto* m = static_cast<Mirror*>(h);
   Rec r;
   r.id = id;
@@ -694,6 +696,7 @@ void mm_put(void* h, int64_t id, int64_t parent_id, int ftype,
   r.sp_action = sp_action;
   r.sp_ufs_mtime = sp_ufs_mtime;
   r.sp_state = sp_state;
+  r.sp_ec = sp_ec ? sp_ec : "";
   std::unique_lock<std::shared_mutex> lk(m->mu);
   m->inodes[id] = std::move(r);
 }

@@ -239,6 +239,15 @@ async def cmd_blocks(args):
     try:
         fb = await c.meta.get_block_locations(args.path)
         for lb in fb.block_locs:
+            if lb.ec is not None and not lb.locs:
+                cells = " ".join(
+                    f"{cell['block_id']}@" + (",".join(
+                        str(a["worker_id"]) for a in cell["locs"]) or "-")
+                    for cell in lb.ec["cells"])
+                print(f"block {lb.block.id} offset={lb.offset} "
+                      f"len={lb.block.len} ec={lb.ec['profile']} "
+                      f"cells=[{cells}]")
+                continue
             locs = ",".join(f"{l.hostname}:{l.rpc_port}" for l in lb.locs)
             print(f"block {lb.block.id} offset={lb.offset} "
                   f"len={lb.block.len} locs=[{locs}]")
@@ -325,6 +334,20 @@ async def cmd_report(args):
                   f"fallbacks: {int(dp.get('shm_fallbacks', 0))}  "
                   f"zero-copy: "
                   f"{_human(int(dp.get('zero_copy_bytes', 0)))}")
+        hl = rp.get("replication")
+        if hl:
+            print(f"Healing rail: replicates: "
+                  f"{int(hl.get('replicates', 0))}  "
+                  f"evacuates: {int(hl.get('evacuates', 0))}  "
+                  f"reconstructs: {int(hl.get('reconstructs', 0))}  "
+                  f"retires: {int(hl.get('retires', 0))}  "
+                  f"verdicts: {int(hl.get('verdict.bit_rot', 0))} bit-rot"
+                  f" / {int(hl.get('verdict.truncated', 0))} truncated")
+        ep = rp.get("ec_plane")
+        if ep:
+            print(f"EC plane: stripes committed: "
+                  f"{int(ep.get('stripes_committed', 0))}  "
+                  f"degraded reads: {int(ep.get('degraded_reads', 0))}")
         rows = rp.get("shards") or []
         if rows:
             print(f"Namespace shards: {len(rows)}")
@@ -612,6 +635,101 @@ async def cmd_load_cancel(args):
         await c.close()
 
 
+# ---------------- erasure coding ----------------
+
+async def cmd_ec(args):
+    """EC controls (docs/erasure-coding.md): `set-policy` stamps an
+    RS(k,m) profile on a file or directory subtree; `convert` submits
+    the job that stripes its cold replicated blocks and retires the
+    extra copies once each stripe commits."""
+    from curvine_tpu.common.ec import ECProfile
+    c = await _client(args)
+    try:
+        if args.action == "set-policy":
+            if not args.profile:
+                print("usage: cv ec set-policy <path> <rs-K-M>",
+                      file=sys.stderr)
+                raise SystemExit(2)
+            prof = ECProfile.parse(args.profile)    # validate before RPC
+            await c.meta.set_attr(args.path, SetAttrOpts(ec=prof.name))
+            print(f"ec policy {prof.name} set on {args.path}")
+            return
+        job_id = await c.meta.submit_job("ec_convert", args.path)
+        print(f"submitted ec convert job {job_id}")
+        if args.wait:
+            while True:
+                job = await c.meta.job_status(job_id)
+                done = sum(1 for t in job.tasks
+                           if t.state == JobState.COMPLETED)
+                print(f"  {job.state.name}: {done}/{len(job.tasks)} tasks")
+                if job.state in (JobState.COMPLETED, JobState.FAILED,
+                                 JobState.CANCELLED):
+                    if job.message:
+                        print(f"  {job.message}", file=sys.stderr)
+                    break
+                await asyncio.sleep(1)
+    finally:
+        await c.close()
+
+
+async def cmd_fsck(args):
+    """Stripe audit: walk every block of <path>. Replicated blocks just
+    report their live copy count; erasure-coded blocks check each cell
+    for a live holder and the stripe for fault-domain spread (two cells
+    on one worker die together). --repair reports lost cells to the
+    master so reconstruction starts now instead of at the next scan."""
+    from curvine_tpu.common.ec import ECProfile
+    from curvine_tpu.rpc import RpcCode
+    c = await _client(args)
+    problems = 0
+    missing: list[int] = []
+    try:
+        fb = await c.meta.get_block_locations(args.path)
+        for lb in fb.block_locs:
+            if lb.ec is None or lb.locs:
+                state = "ok" if lb.locs else "MISSING"
+                if not lb.locs:
+                    problems += 1
+                    missing.append(lb.block.id)
+                print(f"block {lb.block.id} replicated x{len(lb.locs)} "
+                      f"[{state}]")
+                continue
+            prof = ECProfile.parse(lb.ec["profile"])
+            cells = lb.ec["cells"]
+            lost = [cell["block_id"] for cell in cells
+                    if not cell["locs"]]
+            holders = [a["worker_id"] for cell in cells
+                       for a in cell["locs"][:1]]
+            crowded = len(holders) - len(set(holders))
+            if len(lost) > prof.m:
+                state = "LOST"          # past decodability: m+1 gone
+            elif lost:
+                state = "DEGRADED"
+            elif crowded:
+                state = "crowded"
+            else:
+                state = "ok"
+            if lost:
+                problems += 1
+                missing.extend(lost)
+            line = (f"block {lb.block.id} {prof.name} cells "
+                    f"{len(cells) - len(lost)}/{len(cells)} live")
+            if crowded:
+                line += f", {crowded} co-located"
+            print(f"{line} [{state}]")
+        if args.repair and missing:
+            await c.meta.call(RpcCode.REPORT_UNDER_REPLICATED_BLOCKS,
+                              {"block_ids": missing})
+            print(f"reported {len(missing)} lost cells/blocks for repair")
+        if problems:
+            print(f"fsck: {problems} problem block(s) under {args.path}",
+                  file=sys.stderr)
+            return 1
+        print(f"fsck: {args.path} healthy")
+    finally:
+        await c.close()
+
+
 async def cmd_bench(args):
     from curvine_tpu.client import CurvineClient
     c = CurvineClient(_conf(args))
@@ -807,6 +925,13 @@ def build_parser() -> argparse.ArgumentParser:
         A("path"), A("--bytes", type=int), A("--files", type=int))
     add("load-status", cmd_load_status, A("job_id"))
     add("load-cancel", cmd_load_cancel, A("job_id"))
+    add("ec", cmd_ec,
+        A("action", choices=["set-policy", "convert"]),
+        A("path"),
+        A("profile", nargs="?"),
+        A("--wait", action="store_true"))
+    add("fsck", cmd_fsck, A("path"),
+        A("--repair", action="store_true"))
     add("bench", cmd_bench, A("--size-mb", type=int, default=256))
     add("master", cmd_master)
     add("worker", cmd_worker)

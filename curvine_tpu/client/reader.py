@@ -16,7 +16,9 @@ import zlib
 from contextlib import nullcontext
 
 from curvine_tpu.common import errors as err  # noqa: F401
-from curvine_tpu.common.types import FileBlocks, LocatedBlock
+from curvine_tpu.common.types import (
+    ExtendedBlock, FileBlocks, LocatedBlock, WorkerAddress,
+)
 from curvine_tpu.rpc import RpcCode, transport
 from curvine_tpu.rpc.client import ConnectionPool
 from curvine_tpu.rpc.deadline import Deadline
@@ -303,6 +305,8 @@ class FsReader:
         bid = lb.block.id
         if bid in self._local_paths:
             return self._local_paths[bid]
+        if not lb.locs:
+            return None          # EC stripe (or locationless): no probe
         path = None
         if self.short_circuit:
             loc = self._pick_loc(lb)
@@ -392,7 +396,7 @@ class FsReader:
         ent = self._shm_maps.get(bid)
         if ent is not None:
             return ent[1]
-        if not self.short_circuit:
+        if not self.short_circuit or not lb.locs:
             return None
         if bid not in self._local_paths:
             await self._local_path(lb)      # probe captures shm_sock
@@ -656,6 +660,16 @@ class FsReader:
                 continue
             lb, block_off = located
             seg = min(n - filled, lb.block.len - block_off)
+            if self._ec_active(lb):
+                import numpy as np
+                data = await self._read_ec(lb, block_off, seg,
+                                           deadline)
+                if not data:
+                    break
+                out[filled:filled + len(data)] = np.frombuffer(
+                    data, dtype=np.uint8)
+                filled += len(data)
+                continue
             # shared-memory first: zero RPCs AND zero syscalls once the
             # block is mapped (the fd path below still costs a preadv)
             got = await self._shm_read_into(lb, block_off,
@@ -783,6 +797,11 @@ class FsReader:
                 return
             located = self._locate(s)
             lb, block_off = located
+            if self._ec_active(lb):
+                # EC stripes bypass prefetch: the decode path manages
+                # its own per-cell fan-out, and a prefetched segment
+                # would double-read the cells
+                return
             seg_len = min(self.chunk_size - (block_off % self.chunk_size),
                           lb.offset + lb.block.len - s, self.len - s)
             if self._local_paths.get(lb.block.id, "?") is not None:
@@ -970,6 +989,141 @@ class FsReader:
         self._note_sc_read(lb.block.id, n)
         return buf
 
+    # ---------------- erasure-coded reads ----------------
+
+    @staticmethod
+    def _ec_active(lb: LocatedBlock) -> bool:
+        """Committed stripe with its replicas retired: reads go through
+        the cells. While replicas still exist (mid-conversion) they keep
+        serving — the descriptor only takes over once locs drain."""
+        return lb.ec is not None and not lb.locs
+
+    def _cell_live(self, cell: dict) -> bool:
+        """A cell is worth dialing only via a location not behind an
+        open breaker: a dead holder costs a connect timeout PER CHUNK
+        otherwise, collapsing degraded throughput. Open-circuit cells
+        count as lost; the breaker half-opens after open_s, so the
+        intact path comes back by itself once the holder recovers."""
+        if not cell["locs"]:
+            return False
+        if self.health is None:
+            return True
+        return any(
+            self.health.allow(f"{a.get('ip_addr') or a.get('hostname')}:"
+                              f"{a.get('rpc_port')}")
+            for a in cell["locs"])
+
+    async def _read_cell(self, ec: dict, cell: dict, off: int, n: int,
+                         deadline: Deadline | None = None) -> bytes:
+        """Read [off, off+n) of one stripe cell, with the same replica
+        failover, breaker accounting, and EOF-checksum verification as a
+        plain block — a cell IS a first-class block, just located via
+        the stripe descriptor instead of lb.locs."""
+        clb = LocatedBlock(
+            block=ExtendedBlock(id=cell["block_id"], len=ec["cell_size"]),
+            locs=[WorkerAddress.from_wire(a) for a in cell["locs"]])
+        if not clb.locs:
+            raise err.BlockNotFound(
+                f"cell {cell['block_id']} has no live locations")
+        locs = self._failover_locs(clb)
+        last_err: Exception | None = None
+        for i, loc in enumerate(locs):
+            hop = None
+            if deadline is not None:
+                deadline.check(f"read cell {cell['block_id']}")
+                hop = deadline.sub(len(locs) - i)
+            try:
+                with self._span("read_cell", addr=self._addr(loc),
+                                block=cell["block_id"]):
+                    return await self._read_from(loc, clb, off, n,
+                                                 deadline=hop)
+            except err.CurvineError as e:
+                last_err = e
+        raise last_err or err.BlockNotFound(
+            f"cell {cell['block_id']} unreadable")
+
+    async def _read_ec(self, lb: LocatedBlock, block_off: int, n: int,
+                       deadline: Deadline | None = None) -> bytes:
+        """Serve [block_off, block_off+n) of an erasure-coded block.
+
+        Intact path: zero decode — scatter-gather exactly the needed
+        byte ranges of the covering DATA cells (cell j holds block bytes
+        [j*cell_size, (j+1)*cell_size)). Degraded path: the codec is
+        positionwise-linear, so the same relative byte window of any k
+        surviving cells (parity included) decodes just the needed range
+        inline, under the caller's deadline budget. Stripe tail padding
+        never reaches callers — reads clamp to block_len."""
+        from curvine_tpu.common.ec import ECProfile
+        ec = lb.ec
+        prof = ECProfile.parse(ec["profile"])
+        cs = ec["cell_size"]
+        n = min(n, ec.get("block_len", lb.block.len) - block_off)
+        if n <= 0:
+            return b""
+        a, b = block_off, block_off + n
+        spans = []             # (data cell index, intra-cell start, end)
+        for j in range(a // cs, (b - 1) // cs + 1):
+            spans.append((j, max(a - j * cs, 0), min(b - j * cs, cs)))
+        cells = ec["cells"]
+        if all(self._cell_live(cells[j]) for j, _s, _e in spans):
+            try:
+                parts = await asyncio.gather(
+                    *(self._read_cell(ec, cells[j], s, e - s, deadline)
+                      for j, s, e in spans))
+                if all(len(p) == e - s
+                       for p, (_j, s, e) in zip(parts, spans)):
+                    return b"".join(parts)
+            except err.CurvineError:
+                pass           # a holder died mid-read: degrade below
+        return await self._read_ec_degraded(prof, ec, spans, deadline)
+
+    async def _read_ec_degraded(self, prof, ec: dict, spans: list,
+                                deadline: Deadline | None) -> bytes:
+        from curvine_tpu.common import ec as eclib
+        cells = ec["cells"]
+        lo = min(s for _j, s, _e in spans)
+        hi = max(e for _j, _s, e in spans)
+        slots: list[bytes | None] = [None] * (prof.k + prof.m)
+        lost: list[int] = []
+        got = 0
+        for idx, cell in enumerate(cells):
+            if got >= prof.k:
+                break
+            if not self._cell_live(cell):
+                lost.append(cell["block_id"])
+                continue
+            try:
+                data = await self._read_cell(ec, cell, lo, hi - lo,
+                                             deadline)
+            except err.CurvineError:
+                lost.append(cell["block_id"])
+                continue
+            if len(data) != hi - lo:
+                lost.append(cell["block_id"])
+                continue
+            slots[idx] = data
+            got += 1
+        if got < prof.k:
+            raise err.BlockNotFound(
+                f"block {ec['cells'][0]['block_id']}: only {got}/{prof.k}"
+                f" stripe cells readable — stripe lost")
+        data_cells = eclib.decode(prof, slots)
+        self._count("read.ec_degraded")
+        self._mark("ec_degraded")
+        if lost:
+            # fire-and-forget: tell the master which cells are gone so
+            # reconstruction starts now, not at the next scrub/scan
+            async def _report(ids=tuple(lost)):
+                try:
+                    await self.fs.call(
+                        RpcCode.REPORT_UNDER_REPLICATED_BLOCKS,
+                        {"block_ids": list(ids)})
+                except Exception as e:  # noqa: BLE001 — scan backstops
+                    log.debug("lost-cell report failed: %s", e)
+            asyncio.ensure_future(_report())
+        return b"".join(bytes(data_cells[j][s - lo:e - lo])
+                        for j, s, e in spans)
+
     async def _read_some(self, offset: int, n: int,
                          deadline: Deadline | None = None) -> bytes:
         located = self._locate(offset)
@@ -983,6 +1137,8 @@ class FsReader:
             return b"\x00" * nh
         lb, block_off = located
         n = min(n, lb.block.len - block_off)
+        if self._ec_active(lb):
+            return await self._read_ec(lb, block_off, n, deadline)
         mm = await self._shm_map(lb)
         if mm is not None:
             # bytes API: one mandatory copy (bytes are owning), still

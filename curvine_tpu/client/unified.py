@@ -303,7 +303,10 @@ class CurvineClient:
         if st.storage_policy.state == StorageState.UFS:
             return False
         fb = await self.meta.get_block_locations(path)
-        return all(lb.locs for lb in fb.block_locs)
+        # a committed stripe retires its replicas, so empty locs is the
+        # NORMAL cached state for an erasure-coded block — it serves
+        # through the cells (degraded decode included)
+        return all(lb.locs or lb.ec is not None for lb in fb.block_locs)
 
     async def unified_open(self, path: str):
         """Open preferring cache; uncached files under a mount stream

@@ -401,6 +401,30 @@ class GatewayConf:
 
 
 @dataclass
+class ECConf:
+    """Erasure-coded capacity tier (docs/erasure-coding.md).
+
+    EC is a per-file/directory storage class (`cv ec set-policy`); this
+    section sets the cluster defaults the convert job and the stripe
+    audit use."""
+
+    # master-side enable switch for the background convert job; the
+    # codec, degraded reads, and reconstruction work regardless (stripes
+    # that already exist must stay readable when conversion is off)
+    enabled: bool = True
+    # default profile for files marked `ec` without an explicit one and
+    # for `cv ec convert` without --profile
+    profile: str = "rs-6-3"
+    # a block is "cold" (eligible for conversion) when its file's mtime
+    # is at least this old; 0 = every complete file qualifies
+    convert_cold_s: int = 0
+    # leader-side auto-sweep: submit an ec_convert job over "/" every
+    # this many seconds, converting files whose policy carries an EC
+    # profile. 0 = operator-submitted jobs only (cv ec convert).
+    sweep_interval_s: float = 0.0
+
+
+@dataclass
 class ClusterConf:
     cluster_name: str = "curvine-tpu"
     master: MasterConf = field(default_factory=MasterConf)
@@ -411,6 +435,7 @@ class ClusterConf:
     obs: ObsConf = field(default_factory=ObsConf)
     rpc: RpcConf = field(default_factory=RpcConf)
     qos: QosConf = field(default_factory=QosConf)
+    ec: ECConf = field(default_factory=ECConf)
     data_dir: str = "data"
 
     @staticmethod
@@ -471,7 +496,8 @@ def _coerce(cur, raw: str, annotation: str = ""):
 def _apply_env(conf: "ClusterConf", env: dict) -> None:
     sections = {"master": conf.master, "worker": conf.worker,
                 "client": conf.client, "fuse": conf.fuse,
-                "obs": conf.obs, "rpc": conf.rpc, "qos": conf.qos}
+                "obs": conf.obs, "rpc": conf.rpc, "qos": conf.qos,
+                "ec": conf.ec}
     for key, raw in env.items():
         if not key.startswith("CURVINE_") or key == "CURVINE_CONF":
             continue

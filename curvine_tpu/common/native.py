@@ -21,12 +21,22 @@ _lib = None
 _tried = False
 
 
+def _stale() -> bool:
+    """A prebuilt .so older than its source misses newly added symbols
+    (which would silently disable whole native paths) — rebuild it."""
+    try:
+        src = os.path.join(_CSRC, "native.cc")
+        return os.path.getmtime(_SO) < os.path.getmtime(src)
+    except OSError:
+        return False
+
+
 def _load():
     global _lib, _tried
     if _tried:
         return _lib
     _tried = True
-    if not os.path.exists(_SO) and os.path.exists(
+    if (not os.path.exists(_SO) or _stale()) and os.path.exists(
             os.path.join(_CSRC, "Makefile")):
         try:
             subprocess.run(["make", "-C", _CSRC], capture_output=True,
@@ -52,6 +62,16 @@ def _load():
             lib.cv_checksum_file.argtypes = [
                 ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
                 ctypes.POINTER(ctypes.c_uint32)]
+            try:
+                # newer symbol — a stale prebuilt .so (rebuild refused by
+                # a missing compiler) must not take down the older paths
+                lib.cv_gf_mul_xor.restype = None
+                lib.cv_gf_mul_xor.argtypes = [
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
+                    ctypes.c_uint8]
+                lib._has_gf = True
+            except AttributeError:
+                lib._has_gf = False
             _lib = lib
             log.info("native helpers loaded: %s", _SO)
         except OSError as e:
@@ -77,6 +97,36 @@ def crc32c(data, seed: int = 0) -> int:
     except TypeError:
         buf = bytes(data)
     return lib.cv_crc32c(buf, n, seed)
+
+
+def has_gf() -> bool:
+    lib = _load()
+    return lib is not None and getattr(lib, "_has_gf", False)
+
+
+def gf_mul_xor(dst, src, coef: int) -> bool:
+    """dst[i] ^= gf_mul(coef, src[i]) over GF(256)/0x11d — the RS codec
+    hot loop. dst must be a writable contiguous buffer (numpy uint8
+    array); src any contiguous buffer of the same length. Returns False
+    when the native kernel is unavailable (caller falls back to the
+    table path in common/ec.py)."""
+    lib = _load()
+    if lib is None or not getattr(lib, "_has_gf", False):
+        return False
+    n = dst.nbytes if hasattr(dst, "nbytes") else len(dst)
+    # numpy arrays hand over their data pointer (read-only views too —
+    # from_buffer would refuse those); other buffers go through ctypes
+    dbuf = dst.ctypes.data if hasattr(dst, "ctypes") \
+        else (ctypes.c_char * n).from_buffer(dst)
+    if hasattr(src, "ctypes"):
+        sbuf = src.ctypes.data
+    else:
+        try:
+            sbuf = (ctypes.c_char * n).from_buffer(src)
+        except TypeError:
+            sbuf = bytes(src)
+    lib.cv_gf_mul_xor(dbuf, sbuf, n, coef)
+    return True
 
 
 def xxh64(data, seed: int = 0) -> int:

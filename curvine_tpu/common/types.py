@@ -140,6 +140,9 @@ class StoragePolicy(Wire):
     ttl_action: TtlAction = TtlAction.NONE
     ufs_mtime: int = 0
     state: StorageState = StorageState.CV
+    # erasure-coding storage class: "" = replicated, else an rs-<k>-<m>
+    # profile name — `replicas=3` and `ec=rs-6-3` are peer choices
+    ec: str = ""
 
     # hand-rolled codec: this sits on the per-inode encode path of the
     # KV meta store, where the generic dataclass walker is measurably hot
@@ -148,7 +151,8 @@ class StoragePolicy(Wire):
                 "ttl_ms": self.ttl_ms,
                 "ttl_action": int(self.ttl_action),
                 "ufs_mtime": self.ufs_mtime,
-                "state": int(self.state)}
+                "state": int(self.state),
+                "ec": self.ec}
 
     @classmethod
     def from_wire(cls, d: dict) -> "StoragePolicy":
@@ -158,7 +162,8 @@ class StoragePolicy(Wire):
                    ttl_action=TtlAction(d.get("ttl_action",
                                               int(TtlAction.NONE))),
                    ufs_mtime=d.get("ufs_mtime", 0),
-                   state=StorageState(d.get("state", int(StorageState.CV))))
+                   state=StorageState(d.get("state", int(StorageState.CV))),
+                   ec=d.get("ec", ""))
 
 
 @dataclass
@@ -285,6 +290,10 @@ class LocatedBlock(Wire):
     offset: int = 0
     locs: list[WorkerAddress] = field(default_factory=list)
     storage_types: list[StorageType] = field(default_factory=list)
+    # erasure-coded stripe descriptor (None for replicated blocks):
+    # {"profile": "rs-6-3", "cell_size": int, "cells":
+    #  [{"index", "block_id", "locs": [WorkerAddress wire...]}]}
+    ec: dict | None = None
 
 
 @dataclass
@@ -351,12 +360,15 @@ class TaskInfo(Wire):
     job_id: str = ""
     worker_id: int = 0
     path: str = ""
-    kind: str = "load"          # load (ufs→cache) | export (cache→ufs)
+    kind: str = "load"    # load (ufs→cache) | export (cache→ufs) | ec_convert
     state: JobState = JobState.PENDING
     message: str = ""
     total_len: int = 0
     loaded_len: int = 0
     attempts: int = 0
+    # kind-specific plan (ec_convert: per-block stripe plans). Not
+    # journaled — job resume re-plans from scratch.
+    payload: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -389,6 +401,9 @@ class SetAttrOpts(Wire):
     remove_x_attr: list[str] = field(default_factory=list)
     atime: int | None = None
     mtime: int | None = None
+    # EC storage class: None = leave unchanged, "" = back to replicated,
+    # "rs-<k>-<m>" = mark for erasure coding (applied by the convert job)
+    ec: str | None = None
 
 
 _register(StoragePolicy, storage_type=StorageType, ttl_action=TtlAction,

@@ -73,7 +73,7 @@ def _load():
         ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p, c_i64, c_i64,
         ctypes.c_int, ctypes.c_int, ctypes.c_int, c_i64, ctypes.c_char_p,
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int, c_ll, ctypes.c_int,
-        c_ll, ctypes.c_int]
+        c_ll, ctypes.c_int, ctypes.c_char_p]
     lib.mm_remove.argtypes = [ctypes.c_void_p, c_i64]
     lib.mm_child_put.argtypes = [ctypes.c_void_p, c_i64, ctypes.c_char_p,
                                  c_i64]
@@ -156,7 +156,8 @@ class FastMeta:
             1 if node.is_complete else 0, node.nlink, node.children_num,
             node.target.encode() if node.target is not None else None,
             x, len(x), int(sp.storage_type), sp.ttl_ms,
-            int(sp.ttl_action), sp.ufs_mtime, int(sp.state))
+            int(sp.ttl_action), sp.ufs_mtime, int(sp.state),
+            sp.ec.encode())
 
     def remove_inode(self, inode_id: int) -> None:
         if self._h:

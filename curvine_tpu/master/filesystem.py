@@ -73,6 +73,12 @@ class MasterFilesystem:
         # possibly-stale boot peers
         self.raft_conf: dict | None = None
         self.acl = None             # set by AclEnforcer (permission checks)
+        # runtime mirrors of the durable EC stripe map (store.iter_ec):
+        # logical block id -> stripe wire, and the reverse cell index
+        # cell block id -> (logical block id, cell index). Kept hot so
+        # the get_block_locations path never pays a KV read per block.
+        self.ec_stripes: dict[int, dict] = {}
+        self.ec_cells: dict[int, tuple[int, int]] = {}
         # GroupCommitter (common/journal.py), installed by MasterServer:
         # when present, _log journals unflushed + stages KV writes; the
         # RPC handler awaits committer.sync() before replying.
@@ -86,8 +92,24 @@ class MasterFilesystem:
 
     # ==================== journal plumbing ====================
 
+    def _rebuild_ec_index(self) -> None:
+        self.ec_stripes = {}
+        self.ec_cells = {}
+        for bid, stripe in self.store.iter_ec():
+            self._ec_index(bid, stripe)
+
+    def _ec_index(self, block_id: int, stripe: dict) -> None:
+        old = self.ec_stripes.get(block_id)
+        if old is not None:
+            for cid in old.get("cells", []):
+                self.ec_cells.pop(cid, None)
+        self.ec_stripes[block_id] = stripe
+        for idx, cid in enumerate(stripe.get("cells", [])):
+            self.ec_cells[cid] = (block_id, idx)
+
     def recover(self) -> None:
         if self.journal is None:
+            self._rebuild_ec_index()
             return
         snap, entries = self.journal.recover()
         if self._kv:
@@ -123,6 +145,7 @@ class MasterFilesystem:
                      "replayed %d tail entries",
                      self.tree.count(), self.blocks.count(),
                      self.store.get_counter("applied_seq"), replayed)
+            self._rebuild_ec_index()
             return
         if snap is not None:
             self._load_snapshot(snap)
@@ -134,6 +157,7 @@ class MasterFilesystem:
         if snap is not None or entries:
             log.info("recovered namespace: %d inodes, %d blocks, seq=%d",
                      self.tree.count(), self.blocks.count(), self.journal.seq)
+        self._rebuild_ec_index()
 
     audit_log = False   # set from MasterConf.audit_log
 
@@ -287,6 +311,7 @@ class MasterFilesystem:
                  "next_block_id": self.store.get_counter("next_block_id", 1),
                  "inodes": inodes, "blocks": blocks,
                  "jobs": list(self.store.iter_jobs()),
+                 "ec": [[bid, stripe] for bid, stripe in self.store.iter_ec()],
                  "deco": sorted(self.workers.deco_ids)}
         if self.mounts is not None:
             state["mounts"] = self.mounts.snapshot_state()
@@ -333,6 +358,9 @@ class MasterFilesystem:
             self.store.block_put(bid, blen, iid, rep)
         for wire in snap.get("jobs", []):
             self.store.job_put(wire["job_id"], wire)
+        for bid, stripe in snap.get("ec", []):
+            self.store.ec_put(bid, stripe)
+        self._rebuild_ec_index()
         self.workers.deco_ids = set(snap.get("deco", []))
         for wid in self.workers.deco_ids:
             self.store.deco_put(wid)
@@ -386,6 +414,97 @@ class MasterFilesystem:
 
     def _apply_job_del(self, job_id: str) -> None:
         self.store.job_remove(job_id)
+
+    # ==================== erasure-coded stripes ====================
+    # A striped logical block keeps its durable block record (length,
+    # inode linkage) but its bytes live in k+m CELL blocks, each a
+    # first-class block with its own checksum and replica location.
+    # Protocol: ec_plan durably allocates + registers the cell ids
+    # BEFORE any cell byte is written (a cell arriving in a worker
+    # block report must never look like an orphan and get GC'd), then
+    # the converting worker writes all cells and sends EC_COMMIT_STRIPE,
+    # which journals ec_put (state "committed") — the read path switches
+    # to the stripe and the 3x replicas retire copy-first-delete-last.
+
+    def ec_plan(self, block_id: int, profile: str, k: int, m: int,
+                cell_size: int) -> list[int]:
+        durable = self.store.block_get(block_id)
+        if durable is None:
+            raise err.InvalidArgument(f"ec_plan: unknown block {block_id}")
+        stripe = self.ec_stripes.get(block_id)
+        if stripe is not None and stripe.get("state") == "committed":
+            raise err.InvalidArgument(
+                f"ec_plan: block {block_id} already striped")
+        return self._log("ec_plan", dict(
+            block_id=block_id, profile=profile, n_cells=k + m,
+            cell_size=cell_size))
+
+    def _apply_ec_plan(self, block_id: int, profile: str, n_cells: int,
+                       cell_size: int) -> list[int]:
+        durable = self.store.block_get(block_id)
+        if durable is None:
+            raise err.InvalidArgument(f"ec_plan: unknown block {block_id}")
+        blen, inode_id, _rep = durable
+        # re-plan (job retry after a crash): free the previous attempt's
+        # cells so abandoned ids never leak in the durable block table
+        old = self.ec_stripes.get(block_id)
+        if old is not None and old.get("state") != "committed":
+            for cid in old.get("cells", []):
+                meta = self.blocks.remove_block(cid)
+                if meta:
+                    for wid in meta.locs:
+                        self.pending_deletes.setdefault(wid, set()).add(cid)
+        cells = [self.tree.alloc_block_id() for _ in range(n_cells)]
+        for cid in cells:
+            self.store.block_put(cid, cell_size, inode_id, 1)
+        stripe = {"profile": profile, "cell_size": cell_size,
+                  "block_len": blen, "cells": cells, "state": "planned"}
+        self.store.ec_put(block_id, stripe)
+        self._ec_index(block_id, stripe)
+        return cells
+
+    def ec_commit(self, block_id: int,
+                  cell_locs: list[list[int]]) -> None:
+        """EC_COMMIT_STRIPE: all cells written. cell_locs is
+        [[cell_id, worker_id, storage_type], ...]."""
+        stripe = self.ec_stripes.get(block_id)
+        if stripe is None:
+            raise err.InvalidArgument(
+                f"ec_commit: no planned stripe for block {block_id}")
+        known = set(stripe.get("cells", []))
+        for cid, _wid, _st in cell_locs:
+            if cid not in known:
+                raise err.InvalidArgument(
+                    f"ec_commit: cell {cid} not in stripe {block_id}")
+        if stripe.get("state") != "committed":
+            self._log("ec_put", dict(block_id=block_id))
+        # replica locations are runtime state (rebuilt by reports)
+        for cid, wid, st in cell_locs:
+            self.blocks.add_replica(cid, wid, StorageType(st))
+        self.retire_stripe_replicas(block_id)
+
+    def _apply_ec_put(self, block_id: int) -> None:
+        stripe = self.store.ec_get(block_id)
+        if stripe is None:
+            raise err.InvalidArgument(
+                f"ec_put: no planned stripe for block {block_id}")
+        stripe = dict(stripe)
+        stripe["state"] = "committed"
+        self.store.ec_put(block_id, stripe)
+        self._ec_index(block_id, stripe)
+
+    def retire_stripe_replicas(self, block_id: int) -> None:
+        """Copy-first-delete-last: drop the replicated copies of a
+        committed stripe. Runtime-only (worker deletes ride heartbeat
+        pending_deletes); the replication scan re-runs this until the
+        locations converge to empty, so a crash between ec_put and the
+        deletes cannot strand live replicas."""
+        meta = self.blocks.get(block_id)
+        if meta is None:
+            return
+        for wid in list(meta.locs):
+            self.blocks.remove_replica(block_id, wid)
+            self.pending_deletes.setdefault(wid, set()).add(block_id)
 
     # ==================== namespace ops ====================
 
@@ -610,6 +729,17 @@ class MasterFilesystem:
         the delete path have already removed it from the store (saving
         would resurrect it as an orphan); the free path saves explicitly."""
         for bid in node.blocks:
+            stripe = self.ec_stripes.pop(bid, None)
+            if stripe is not None:
+                # striped block: free its cells too
+                for cid in stripe.get("cells", []):
+                    self.ec_cells.pop(cid, None)
+                    cmeta = self.blocks.remove_block(cid)
+                    if cmeta:
+                        for wid in cmeta.locs:
+                            self.pending_deletes.setdefault(
+                                wid, set()).add(cid)
+                self.store.ec_remove(bid)
             meta = self.blocks.remove_block(bid)
             if meta:
                 for wid in meta.locs:
@@ -648,6 +778,9 @@ class MasterFilesystem:
         self._mount_write_guard(path)
         if self.tree.resolve(path) is None:
             raise err.FileNotFound(path)
+        if opts.ec:
+            from curvine_tpu.common.ec import ECProfile
+            ECProfile.parse(opts.ec)       # validate before journaling
         self._log("set_attr", dict(path=path, opts=opts.to_wire()))
 
     def _apply_set_attr(self, path: str, opts: dict) -> None:
@@ -671,6 +804,8 @@ class MasterFilesystem:
             node.atime = o.atime
         if o.mtime is not None:
             node.mtime = o.mtime
+        if o.ec is not None:
+            node.storage_policy.ec = o.ec
         node.x_attr.update(o.add_x_attr)
         for k in o.remove_x_attr:
             node.x_attr.pop(k, None)
@@ -1035,9 +1170,34 @@ class MasterFilesystem:
                 block=ExtendedBlock(id=bid, len=meta.len,
                                     storage_type=sts[0] if sts else StorageType.MEM,
                                     file_type=node.file_type),
-                offset=off, locs=locs, storage_types=sts))
+                offset=off, locs=locs, storage_types=sts,
+                ec=self._ec_descriptor(bid)))
             off += meta.len
         return FileBlocks(status=node.to_status(path), block_locs=out)
+
+    def _ec_descriptor(self, block_id: int) -> dict | None:
+        """Stripe descriptor for a located block: per-cell ids + live
+        worker addresses (wire form). None for replicated blocks and
+        for stripes still mid-conversion (replicas serve those)."""
+        stripe = self.ec_stripes.get(block_id)
+        if stripe is None or stripe.get("state") != "committed":
+            return None
+        cells = []
+        for idx, cid in enumerate(stripe["cells"]):
+            cmeta = self.blocks.get(cid)
+            clocs = []
+            if cmeta is not None:
+                for wid in cmeta.locs:
+                    try:
+                        w = self.workers.get(wid)
+                    except err.WorkerNotFound:
+                        continue
+                    if w.state.value in (0, 2):
+                        clocs.append(w.address.to_wire())
+            cells.append({"index": idx, "block_id": cid, "locs": clocs})
+        return {"profile": stripe["profile"],
+                "cell_size": stripe["cell_size"],
+                "block_len": stripe["block_len"], "cells": cells}
 
     # ==================== worker plane ====================
 

@@ -34,7 +34,7 @@ class JobManager:
 
     def submit(self, kind: str, path: str, recursive: bool = True,
                replicas: int = 1) -> JobInfo:
-        if kind not in ("load", "export"):
+        if kind not in ("load", "export", "ec_convert"):
             raise err.Unsupported(f"job kind {kind!r}")
         job = JobInfo(job_id=uuid.uuid4().hex[:16], kind=kind, path=path,
                       state=JobState.PENDING, create_ms=now_ms(),
@@ -48,6 +48,9 @@ class JobManager:
         if job.kind == "load":
             fut = asyncio.ensure_future(
                 self._plan_load(job, job.recursive, job.replicas))
+        elif job.kind == "ec_convert":
+            fut = asyncio.ensure_future(
+                self._plan_ec_convert(job, job.recursive))
         else:
             fut = asyncio.ensure_future(self._plan_export(job, job.recursive))
         fut.add_done_callback(lambda f: self._plan_done(job, f))
@@ -190,6 +193,102 @@ class JobManager:
             job.message = str(e) or type(e).__name__
             job.finish_ms = now_ms()
             self._persist(job)
+
+    async def _plan_ec_convert(self, job: JobInfo, recursive: bool) -> None:
+        """Walk job.path for complete, cold files marked with an EC
+        storage class (policy.ec, `cv ec set-policy`) and plan one
+        stripe per block: allocate + durably register cell ids
+        (fs.ec_plan), place the k+m cells on distinct workers, and hand
+        a converting worker the full plan. Blocks already striped are
+        skipped, so the job is idempotent and resume-safe."""
+        from curvine_tpu.common.conf import ECConf
+        from curvine_tpu.common.ec import ECProfile
+        try:
+            econf = getattr(self, "ec_conf", None) or ECConf()
+            cold_ms = econf.convert_cold_s * 1000
+            files = []
+
+            def walk(path: str) -> None:
+                for st in self.fs.list_status(path):
+                    if st.is_dir:
+                        if recursive:
+                            walk(st.path)
+                    elif st.is_complete and st.storage_policy.ec:
+                        files.append(st)
+
+            st = self.fs.file_status(job.path)
+            if st.is_dir:
+                walk(job.path)
+            elif st.is_complete and st.storage_policy.ec:
+                files.append(st)
+            if job.state != JobState.PENDING:
+                return                # cancelled mid-plan: stay cancelled
+            now = now_ms()
+            planned = 0
+            for f in files:
+                if cold_ms and f.mtime > now - cold_ms:
+                    continue          # still warm
+                profile = ECProfile.parse(f.storage_policy.ec)
+                plans = self._plan_file_stripes(f, profile)
+                if not plans:
+                    continue
+                task = TaskInfo(task_id=uuid.uuid4().hex[:16],
+                                job_id=job.job_id, path=f.path,
+                                kind="ec_convert", total_len=f.len,
+                                payload={"profile": profile.name,
+                                         "blocks": plans})
+                job.tasks.append(task)
+                await self._pending.put(task)
+                planned += 1
+            job.state = JobState.RUNNING if planned else JobState.COMPLETED
+            if not planned:
+                job.finish_ms = now_ms()
+                self._persist(job)
+        except Exception as e:  # noqa: BLE001 — job fails with message
+            log.warning("ec_convert job %s planning failed: %s",
+                        job.job_id, e)
+            job.state = JobState.FAILED
+            job.message = str(e) or type(e).__name__
+            job.finish_ms = now_ms()
+            self._persist(job)
+
+    def _plan_file_stripes(self, f, profile) -> list[dict]:
+        """Per-block stripe plans for one file: journal cell ids, pick
+        k+m target workers (distinct when the cluster allows — the
+        placement policy spreads; smaller clusters wrap round-robin)."""
+        node = self.fs.tree.resolve(f.path)
+        if node is None:
+            return []
+        plans = []
+        for bid in node.blocks:
+            stripe = self.fs.ec_stripes.get(bid)
+            if stripe is not None and stripe.get("state") == "committed":
+                continue              # already striped
+            meta = self.fs.blocks.get(bid)
+            if meta is None or meta.len == 0 or not meta.locs:
+                continue              # nothing to stripe / no source copy
+            k, m = profile.k, profile.m
+            cell_size = profile.cell_size(meta.len)
+            workers = self.fs.workers.live_workers()
+            chosen = self.fs.policy.choose(workers, k + m,
+                                           needed=cell_size, min_count=1)
+            targets = [chosen[i % len(chosen)] for i in range(k + m)]
+            cell_ids = self.fs.ec_plan(bid, profile.name, k, m, cell_size)
+            sources = []
+            for wid in meta.locs:
+                try:
+                    w = self.fs.workers.get(wid)
+                except err.CurvineError:
+                    continue
+                if w.state.value in (0, 2):
+                    sources.append(w.address.to_wire())
+            plans.append({
+                "block_id": bid, "block_len": meta.len,
+                "cell_size": cell_size, "sources": sources,
+                "cells": [{"index": i, "block_id": cid,
+                           "addr": targets[i].address.to_wire()}
+                          for i, cid in enumerate(cell_ids)]})
+        return plans
 
     async def run(self, leader_gate=None) -> None:
         was_leader = False

@@ -23,9 +23,10 @@ log = logging.getLogger(__name__)
 
 class ReplicationManager:
     def __init__(self, fs, scan_interval_s: float = 5.0,
-                 pull_budget_ms: int = 20_000):
+                 pull_budget_ms: int = 20_000, metrics=None):
         self._leader_gate = None
         self.fs = fs
+        self.metrics = metrics
         self.scan_interval_s = scan_interval_s
         # end-to-end budget for one dispatched pull (submit RPC + the
         # destination's stream from the source), propagated in the RPC
@@ -51,6 +52,58 @@ class ReplicationManager:
         # quarantined blocks every heartbeat, so this map survives a
         # master restart without being persisted.
         self._evac: dict[int, int] = {}
+        # scrub verdicts (block_id -> "mismatch" | "truncated") from
+        # worker reports: the distinction picks the repair path. A
+        # truncated replica is re-pulled from a healthy copy; a rotten
+        # EC cell is re-encoded from its surviving siblings — its local
+        # bytes can't be trusted as a source. Entries clear when the
+        # repair lands; like _evac, workers re-report until then.
+        self._verdicts: dict[int, str] = {}
+
+    def _inc(self, name: str, v: int = 1) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(name, v)
+
+    def note_verdicts(self, verdicts: dict[int, str]) -> None:
+        for bid, verdict in verdicts.items():
+            if self._verdicts.get(bid) != verdict:
+                self._inc("replication.verdict.bit_rot"
+                          if verdict == "mismatch"
+                          else "replication.verdict.truncated")
+            self._verdicts[bid] = verdict
+
+    def _classify(self, bid: int) -> str:
+        """Work-item kind for one queued block id. All kinds share the
+        queue, dedup sets, and per-block retry backoff; only the
+        dispatch differs:
+          retire      — the logical block behind a committed stripe
+                        still holds replicas: drop them (copy-first-
+                        delete-last tail of EC conversion)
+          reconstruct — an EC stripe cell: repair is a k-of-n decode
+                        from sibling cells, not a replica copy
+          evacuate    — a flagged replica being moved off its worker
+          replicate   — plain under-replication, pull a copy
+        """
+        stripe = getattr(self.fs, "ec_stripes", {}).get(bid)
+        if stripe is not None and stripe.get("state") == "committed":
+            return "retire"
+        if bid in getattr(self.fs, "ec_cells", {}):
+            return "reconstruct"
+        if bid in self._evac:
+            return "evacuate"
+        return "replicate"
+
+    async def _dispatch(self, bid: int) -> bool:
+        kind = self._classify(bid)
+        if kind == "retire":
+            meta = self.fs.blocks.get(bid)
+            if meta is not None and meta.locs:
+                self.fs.retire_stripe_replicas(bid)
+                self._inc("replication.retires")
+            return True
+        if kind == "reconstruct":
+            return await self._reconstruct(bid)
+        return await self._replicate(bid)   # evacuate shares the pull path
 
     def enqueue(self, block_ids: list[int]) -> None:
         for bid in block_ids:
@@ -103,9 +156,9 @@ class ReplicationManager:
                     continue    # RPC-fed work (scrub reports, requeues)
                                 # must not dispatch from a follower either
                 try:
-                    ok = await self._replicate(bid)
+                    ok = await self._dispatch(bid)
                 except Exception as e:
-                    log.warning("replication of block %d failed: %s", bid, e)
+                    log.warning("repair of block %d failed: %s", bid, e)
                     ok = False
                 if ok:
                     self._backoff_ms.pop(bid, None)
@@ -136,7 +189,35 @@ class ReplicationManager:
                 # sweep unresolved evacuations: a dropped dispatch (lost
                 # race, restart) is retried at scan cadence
                 self.enqueue(list(self._evac))
+            self._ec_scan()
             self._drain_scan()
+
+    def _ec_scan(self) -> None:
+        """EC stripe sweep. under_replicated() skips blocks with zero
+        recorded locations, so a cell whose only holder was purged by
+        worker_lost is invisible to the generic scan — it surfaces
+        here. The sweep also re-drives the two convergent EC tails:
+        committed stripes whose logical block still holds replicas
+        (retirement), and cells flagged rotten by scrub (re-encode)."""
+        stripes = getattr(self.fs, "ec_stripes", None)
+        if not stripes:
+            return
+        lost, retire = [], []
+        for bid, stripe in stripes.items():
+            if stripe.get("state") != "committed":
+                continue
+            meta = self.fs.blocks.get(bid)
+            if meta is not None and meta.locs:
+                retire.append(bid)
+            for cid in stripe["cells"]:
+                if self._live_replicas(cid) == 0 or cid in self._verdicts:
+                    lost.append(cid)
+        if lost:
+            log.info("ec scan: %d stripe cells need reconstruction",
+                     len(lost))
+            self.enqueue(lost)
+        if retire:
+            self.enqueue(retire)
 
     def _live_replicas(self, block_id: int) -> int:
         from curvine_tpu.common.types import WorkerState
@@ -284,6 +365,116 @@ class ReplicationManager:
             return False
         finally:
             self._inflight.discard(block_id)
+        self._inc("replication.evacuates" if evac_wid is not None
+                  else "replication.replicates")
+        return True
+
+    def _live_holder(self, block_id: int, exclude_wid: int | None = None):
+        from curvine_tpu.common.types import WorkerState
+        for wid in self.fs.blocks.locs.get(block_id, {}):
+            if wid == exclude_wid:
+                continue
+            w = self.fs.workers.workers.get(wid)
+            if w is not None and w.state == WorkerState.LIVE:
+                return w
+        return None
+
+    async def _reconstruct(self, cell_id: int) -> bool:
+        """Dispatch one stripe-cell rebuild. Unlike _replicate there may
+        be NOTHING to copy — the cell's bytes are recomputed on the
+        destination from any k live sibling cells (data preferred, so an
+        all-data source set decodes without a matrix inversion). Returns
+        True when the cell needs no action, False to retry with backoff
+        (fewer than k live siblings, or no placement target)."""
+        from curvine_tpu.common.ec import ECProfile
+        ref = self.fs.ec_cells.get(cell_id)
+        if ref is None:
+            return True                          # stripe freed meanwhile
+        block_id, cell_index = ref
+        stripe = self.fs.ec_stripes.get(block_id)
+        if stripe is None or stripe.get("state") != "committed":
+            return True                          # still converting
+        evac_wid = self._evac.get(cell_id)
+        if evac_wid is not None and \
+                evac_wid not in self.fs.blocks.locs.get(cell_id, {}):
+            self._evac.pop(cell_id, None)
+            evac_wid = None
+        # a flagged or verdict-carrying copy never counts as healthy —
+        # bit rot repairs by re-encode even while the rotten copy serves
+        suspect = evac_wid is not None or cell_id in self._verdicts
+        if not suspect and self._live_holder(cell_id) is not None:
+            return True
+        if suspect and self._live_holder(cell_id, exclude_wid=evac_wid) \
+                is not None and cell_id not in self._verdicts:
+            # a clean copy already exists elsewhere: just retire the flag
+            if evac_wid is not None:
+                self._retire_evacuated(cell_id, evac_wid)
+            return True
+        prof = ECProfile.parse(stripe["profile"])
+        sources, holders = [], set()
+        for idx, cid in enumerate(stripe["cells"]):
+            if cid == cell_id or len(sources) >= prof.k:
+                continue
+            if cid in self._verdicts:
+                continue                 # never decode from rotten bytes
+            w = self._live_holder(cid, exclude_wid=self._evac.get(cid))
+            if w is None:
+                continue
+            holders.add(w.address.worker_id)
+            sources.append({"index": idx, "block_id": cid,
+                            "addr": w.address.to_wire()})
+        if len(sources) < prof.k:
+            log.debug("cell %d of block %d: only %d/%d live sibling "
+                      "cells, cannot reconstruct yet",
+                      cell_id, block_id, len(sources), prof.k)
+            return False
+        # placement: keep the rebuilt cell off every worker already
+        # holding a cell of this stripe (fault-domain separation); on a
+        # cluster too small for that, co-locate rather than wedge repair
+        exclude = set()
+        for cid in stripe["cells"]:
+            exclude |= set(self.fs.blocks.locs.get(cid, {}))
+        live = self.fs.workers.live_workers()
+        try:
+            dst = self.fs.policy.choose(
+                live, 1, exclude=exclude,
+                needed=stripe["cell_size"])[0]
+        except err.CurvineError:
+            try:
+                own = set(self.fs.blocks.locs.get(cell_id, {}))
+                dst = self.fs.policy.choose(
+                    live, 1, exclude=own,
+                    needed=stripe["cell_size"])[0]
+            except err.CurvineError as e:
+                log.debug("no reconstruction target for cell %d: %s",
+                          cell_id, e)
+                return False
+        self._inflight.add(cell_id)
+        from contextlib import nullcontext
+        span = self.tracer.start_trace(
+            "reconstruct_cell", attrs={"block_id": cell_id,
+                                       "dst": dst.address.worker_id}) \
+            if self.tracer is not None else nullcontext()
+        try:
+            with span:
+                conn = await self.pool.get(
+                    f"{dst.address.ip_addr or dst.address.hostname}:{dst.address.rpc_port}")
+                await conn.call(
+                    RpcCode.SUBMIT_BLOCK_REPLICATION_JOB, data=pack({
+                        "block_id": cell_id,
+                        "block_len": stripe["cell_size"],
+                        "ec": {"cell_index": cell_index,
+                               "profile": stripe["profile"],
+                               "cell_size": stripe["cell_size"],
+                               "sources": sources},
+                    }), deadline=Deadline.after_ms(self.pull_budget_ms))
+        except err.CurvineError as e:
+            log.warning("reconstruct submit for cell %d to worker %d "
+                        "failed: %s", cell_id, dst.address.worker_id, e)
+            return False
+        finally:
+            self._inflight.discard(cell_id)
+        self._inc("replication.reconstructs")
         return True
 
     def _retire_evacuated(self, block_id: int, worker_id: int) -> None:
@@ -298,10 +489,15 @@ class ReplicationManager:
     def on_result(self, block_id: int, worker_id: int, success: bool,
                   message: str) -> None:
         if not success:
-            log.warning("replication of %d on worker %d failed: %s",
+            log.warning("repair of %d on worker %d failed: %s",
                         block_id, worker_id, message)
             self.enqueue([block_id])
-        elif block_id in self._evac:
+            return
+        # a landed rebuild supersedes any scrub verdict on the block;
+        # clearing it lets the next dispatch see the fresh copy as
+        # healthy and retire the flagged one
+        self._verdicts.pop(block_id, None)
+        if block_id in self._evac:
             # the new copy landed: re-run the dispatch check, which
             # retires the quarantined replica once the count holds
             self.enqueue([block_id])

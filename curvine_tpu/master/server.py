@@ -95,8 +95,10 @@ class MasterServer:
             j, self.fs.store, window_ms=mc.journal_group_commit_ms,
             max_entries=mc.journal_group_max, metrics=self.metrics)
         self.jobs = JobManager(self.fs, self.mounts)
+        self.jobs.ec_conf = self.conf.ec
         self.replication = ReplicationManager(
-            self.fs, pull_budget_ms=mc.replication_pull_budget_ms)
+            self.fs, pull_budget_ms=mc.replication_pull_budget_ms,
+            metrics=self.metrics)
         self.fs.on_worker_lost = self.replication.on_worker_lost
         self.ttl = TtlManager(self.fs, check_ms=mc.ttl_check_ms)
         # client read leases (master/read_leases.py): only on endpoints
@@ -374,6 +376,7 @@ class MasterServer:
         r(C.REQUEST_REPLACEMENT_WORKER, self._h(self._replacement_worker))
         r(C.REPORT_UNDER_REPLICATED_BLOCKS, self._h(self._report_under_replicated))
         r(C.REPORT_BLOCK_REPLICATION_RESULT, self._h(self._replication_result))
+        r(C.EC_COMMIT_STRIPE, self._h(self._ec_commit_stripe, mutate=True))
         r(C.DECOMMISSION_WORKER, self._h(self._decommission_worker,
                                          mutate=True))
         # mounts
@@ -911,6 +914,15 @@ class MasterServer:
               if k.startswith(pre_r)}
         if rp:
             out["read_plane"] = rp
+        # healing-rail rollup: replicate/evacuate/reconstruct outcomes +
+        # scrub verdicts (master-side counters), and the EC stripe plane
+        for prefix, key in (("replication.", "replication"),
+                            ("ec.", "ec_plane")):
+            vals = {k[len(prefix):]: v
+                    for k, v in self.metrics.counters.items()
+                    if k.startswith(prefix)}
+            if vals:
+                out[key] = vals
         return out
 
     def _tenant_stats(self, q):
@@ -1186,9 +1198,23 @@ class MasterServer:
         # mid-heal (or the mismatch was a transient read fault).
         wid = q.get("worker_id")
         bids = q.get("block_ids", [])
+        # scrub verdicts (BlockStore.verify_detail): "mismatch" = bit-rot
+        # (an EC cell is re-encoded from survivors), "truncated" = short
+        # write (re-pull the full copy). Recorded before enqueue so the
+        # dispatcher classifies with the verdict in hand.
+        verdicts = q.get("verdicts")
+        if verdicts:
+            self.replication.note_verdicts(
+                {int(k): v for k, v in verdicts.items()})
         if wid is not None:
             self.replication.enqueue_evacuation(wid, bids)
         else:
+            # clients report the lost cell behind a degraded EC read
+            # this way (no worker attribution — the holder is gone)
+            ec_cells = getattr(self.fs, "ec_cells", {})
+            lost = sum(1 for b in bids if b in ec_cells)
+            if lost:
+                self.metrics.inc("ec.degraded_reads", lost)
             self.replication.enqueue(bids)
         out = {"success": True}
         # degraded-commit liveness check: a writer about to commit on a
@@ -1205,6 +1231,17 @@ class MasterServer:
         self.replication.on_result(q["block_id"], q["worker_id"],
                                    q.get("success", False), q.get("message", ""))
         return {}
+
+    def _ec_commit_stripe(self, q):
+        """EC_COMMIT_STRIPE: a converting (or reconstructing) worker
+        finished writing cells. Journals the stripe map (first commit)
+        and registers the runtime cell locations; the replicated copies
+        retire copy-first-delete-last via heartbeat pending_deletes."""
+        cells = [[int(c["block_id"]), int(c["worker_id"]),
+                  int(c.get("storage_type", 1))] for c in q.get("cells", [])]
+        self.fs.ec_commit(q["block_id"], cells)
+        self.metrics.inc("ec.stripes_committed")
+        return {"success": True}
 
     # --- mounts ---
     def _mount(self, q):

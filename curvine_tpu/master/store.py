@@ -54,6 +54,7 @@ class MemMetaStore:
         self.jobs_tbl: dict[str, dict] = {}
         self.deco_tbl: set[int] = set()
         self.tx_tbl: dict[str, dict] = {}
+        self.ec_tbl: dict[int, dict] = {}
 
     # inodes
     def get(self, inode_id: int):
@@ -127,6 +128,20 @@ class MemMetaStore:
     def iter_jobs(self):
         return iter(list(self.jobs_tbl.values()))
 
+    # EC stripe records: logical block id -> {"profile", "cell_size",
+    # "block_len", "cells": [cell block ids], "state"}
+    def ec_put(self, block_id: int, wire: dict) -> None:
+        self.ec_tbl[block_id] = wire
+
+    def ec_get(self, block_id: int) -> dict | None:
+        return self.ec_tbl.get(block_id)
+
+    def ec_remove(self, block_id: int) -> None:
+        self.ec_tbl.pop(block_id, None)
+
+    def iter_ec(self):
+        return iter(list(self.ec_tbl.items()))
+
     # cross-shard two-phase tx records (master/sharding.py): a prepared
     # participant persists its vote here so the recovery sweep can
     # resolve in-doubt transactions after a crash
@@ -193,6 +208,7 @@ class MemMetaStore:
         self.jobs_tbl.clear()
         self.deco_tbl.clear()
         self.tx_tbl.clear()
+        self.ec_tbl.clear()
 
     def close(self) -> None:
         pass
@@ -424,6 +440,25 @@ class KvMetaStore:
     def iter_jobs(self):
         for _k, raw in self.kv.scan(prefix=b"J"):
             yield msgpack.unpackb(raw, raw=False, strict_map_key=False)
+
+    # ---- EC stripe records ----
+    def ec_put(self, block_id: int, wire: dict) -> None:
+        self._pending[b"E" + _U64.pack(block_id)] = msgpack.packb(
+            wire, use_bin_type=True)
+
+    def ec_get(self, block_id: int) -> dict | None:
+        raw = self._read(b"E" + _U64.pack(block_id))
+        if raw is None:
+            return None
+        return msgpack.unpackb(raw, raw=False, strict_map_key=False)
+
+    def ec_remove(self, block_id: int) -> None:
+        self._pending[b"E" + _U64.pack(block_id)] = None
+
+    def iter_ec(self):
+        for k, raw in self.kv.scan(prefix=b"E"):
+            yield _U64.unpack(k[1:])[0], msgpack.unpackb(
+                raw, raw=False, strict_map_key=False)
 
     # ---- cross-shard two-phase tx records (master/sharding.py) ----
     def tx_put(self, txid: str, wire: dict) -> None:

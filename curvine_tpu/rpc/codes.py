@@ -62,6 +62,10 @@ class RpcCode(enum.IntEnum):
     REQUEST_REPLACEMENT_WORKER = 44
     REPORT_UNDER_REPLICATED_BLOCKS = 45
     DECOMMISSION_WORKER = 46
+    # worker -> master: all k+m cells of an erasure-coded stripe are
+    # written + committed; master journals the stripe map and retires
+    # the replicated copies copy-first-delete-last
+    EC_COMMIT_STRIPE = 47
 
     METRICS_REPORT = 60
     # cluster-health rollup (master monitor + dir watchdog snapshot)

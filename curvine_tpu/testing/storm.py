@@ -29,6 +29,7 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import logging
+import os
 import random
 import time
 from dataclasses import dataclass, field
@@ -105,6 +106,13 @@ class StormReport:
     # nonzero count under bitflip faults proves detection fired; the
     # integrity invariant proves none of them reached a reader)
     checksum_mismatches: int = 0
+    # EC stripe-loss storms (ec_storm=True): committed stripes under
+    # chaos, degraded decodes observed mid-storm, and whether every
+    # stripe converged back to k+m live cells after quiesce
+    ec_stripes: int = 0
+    ec_degraded_reads: int = 0
+    ec_converged: bool = True
+    ec_unhealed: list = field(default_factory=list)
     elapsed_s: float = 0.0
 
     @property
@@ -146,6 +154,10 @@ class StormReport:
         if not self.evacuation_converged:
             problems.append(
                 f"quarantined dirs not evacuated: {self.unevacuated}")
+        if not self.ec_converged:
+            problems.append(
+                f"stripes did not heal to k+m live cells: "
+                f"{self.ec_unhealed}")
         assert not problems, (
             f"storm seed={self.seed} invariants violated: "
             + "; ".join(problems) + f" (events={self.events})")
@@ -156,7 +168,8 @@ class ChaosStorm:
 
     EVENTS = ("kill_worker", "restart_worker", "restart_master",
               "fault_delay", "fault_drop", "fault_error", "clear_faults",
-              "disk_bitflip", "disk_eio", "disk_enospc")
+              "disk_bitflip", "disk_eio", "disk_enospc",
+              "ec_stripe_loss")
 
     def __init__(self, seed: int, workers: int = 3, replicas: int = 2,
                  duration_s: float = 2.5, event_interval_s: float = 0.25,
@@ -169,6 +182,9 @@ class ChaosStorm:
                  stale_probe: bool = False,
                  trace_probe: bool = False,
                  disk_faults: bool = False,
+                 ec_storm: bool = False,
+                 ec_profile: str = "rs-2-1",
+                 ec_files: int = 2,
                  base_dir: str | None = None,
                  overall_timeout_s: float | None = None):
         self.seed = seed
@@ -188,6 +204,19 @@ class ChaosStorm:
         self.stale_probe = stale_probe
         self.trace_probe = trace_probe
         self.disk_faults = disk_faults
+        self.ec_storm = ec_storm
+        self.ec_profile = ec_profile
+        self.ec_files = ec_files
+        # striped files written before the chaos starts; every event
+        # strike and the post-quiesce invariants key off this set
+        self._ec_paths: list[str] = []
+        self._ec_blocks: dict[int, str] = {}  # logical block id -> path
+        self._ec_client = None
+        # cells we bitflipped that have not finished the verdict cycle
+        # (scrub flags them → master re-encodes → verdict cleared); a
+        # rotten cell is a LOSS the master hasn't seen yet, so strikes
+        # and kills must refuse while one is outstanding
+        self._ec_rot_pending: dict[int, bool] = {}   # cid -> verdict seen
         self.base_dir = base_dir
         # self-watchdog: a wedged storm must FAIL with task stacks, not
         # hang the suite — any unbounded wait the chaos uncovers becomes
@@ -243,6 +272,10 @@ class ChaosStorm:
             wc.disk_probe_interval_s = 0.2
             wc.disk_probe_failures = 2
             wc.scrub_interval_s = 0.5
+        if self.ec_storm:
+            # a bitflipped cell must earn its scrub verdict (mismatch →
+            # re-encode, not re-pull) within the storm window
+            mc.conf.worker.scrub_interval_s = 0.5
 
     def _tune_master(self, mc: MiniCluster) -> None:
         mc.master.replication.scan_interval_s = 0.3
@@ -330,6 +363,8 @@ class ChaosStorm:
         if self.disk_faults:
             weights.update({"disk_bitflip": 3, "disk_eio": 3,
                             "disk_enospc": 2})
+        if self.ec_storm:
+            weights["ec_stripe_loss"] = 5
         names = list(weights)
         return self.rng.choices(names, [weights[n] for n in names])[0]
 
@@ -346,6 +381,12 @@ class ChaosStorm:
             # still alive — killing anything now could destroy the last
             # real copy without the guard seeing it
             return False
+        if self._rotten_cells(mc):
+            # a copy with a bit-rot/truncation verdict (or a flip the
+            # scrubber hasn't found yet) is NOT a real copy: for an
+            # RS(k,m) stripe it already spends one of the m losses, so
+            # a kill on top could push the stripe past decodability
+            return False
         alive_ids = {mc.workers[i].worker_id for i in self._alive}
         blocks = mc.master.fs.blocks
         for bid, locs in blocks.locs.items():
@@ -355,6 +396,17 @@ class ChaosStorm:
             if len(set(locs) & alive_ids) < want:
                 return False
         return True
+
+    def _rotten_cells(self, mc: MiniCluster) -> bool:
+        """True while any copy is known (master verdict) or about to be
+        known (our own un-scrubbed bitflips) corrupt."""
+        verdicts = getattr(mc.master.replication, "_verdicts", None) or {}
+        for cid, seen in list(self._ec_rot_pending.items()):
+            if not seen and cid in verdicts:
+                self._ec_rot_pending[cid] = True
+            elif seen and cid not in verdicts:
+                del self._ec_rot_pending[cid]    # re-encoded: healed
+        return bool(verdicts) or bool(self._ec_rot_pending)
 
     async def _apply_event(self, mc: MiniCluster, ev: str) -> None:
         rng = self.rng
@@ -434,6 +486,8 @@ class ChaosStorm:
                     seed=rng.randint(0, 1 << 16)))
                 rec["target"] = f"worker{idx}"
                 rec["kind"] = kind
+        elif ev == "ec_stripe_loss":
+            await self._ec_stripe_loss(mc, rec)
         elif ev == "clear_faults":
             self._minj.clear()
             for inj in self._winj.values():
@@ -441,6 +495,142 @@ class ChaosStorm:
             for dinj in self._dinj.values():
                 dinj.clear()
         self.report.events.append(rec)
+
+    # ---------------- EC stripe-loss plane ----------------
+
+    async def _setup_ec(self, mc: MiniCluster) -> None:
+        """Pre-storm: write + convert ``ec_files`` striped files, wait
+        for commit + replica retirement. Their deterministic contents
+        join ``acked`` so the integrity sweep covers them, and every
+        ec_stripe_loss event strikes one of their stripes."""
+        from curvine_tpu.common.types import JobState, SetAttrOpts
+        from curvine_tpu.common.ec import ECProfile
+        prof = ECProfile.parse(self.ec_profile)
+        c = self._ec_client = mc.client()
+        self._client_counters.append(c.counters)
+        size = prof.k * 96 * 1024 + 4097       # ragged tail on purpose
+        for i in range(self.ec_files):
+            tag = f"ec/f{i}"
+            path = f"/storm/{tag}"
+            data = storm_bytes(self.seed, tag, size)
+            await c.write_all(path, data, replicas=self.replicas)
+            await c.meta.set_attr(path, SetAttrOpts(ec=self.ec_profile))
+            job_id = await c.meta.submit_job("ec_convert", path)
+            t_end = time.monotonic() + 20.0
+            while time.monotonic() < t_end:
+                job = await c.meta.job_status(job_id)
+                assert job.state != JobState.FAILED, job.message
+                if job.state == JobState.COMPLETED:
+                    fb = await c.meta.get_block_locations(path)
+                    if fb.block_locs and all(
+                            lb.ec is not None and not lb.locs
+                            for lb in fb.block_locs):
+                        break
+                await asyncio.sleep(0.05)
+            else:
+                raise AssertionError(
+                    f"ec storm setup: {path} never finished converting")
+            self.acked[path] = hashlib.sha256(data).hexdigest()
+            self._ec_paths.append(path)
+            for lb in fb.block_locs:
+                self._ec_blocks[lb.block.id] = path
+        self.report.ec_stripes = sum(
+            1 for s in mc.master.fs.ec_stripes.values()
+            if s.get("state") == "committed")
+
+    async def _ec_stripe_loss(self, mc: MiniCluster, rec: dict) -> None:
+        """Strike one committed stripe: kill a cell-holding worker OR
+        flip a byte inside one cell on media. Both leave the stripe
+        below k+m; a probe read right after must still return exact
+        bytes via degraded decode-on-read. Kills obey _safe_to_kill —
+        once a stripe is down a cell (desired replicas unmet), further
+        kills are refused until reconstruction heals it, so losses can
+        never stack past what k survivors can decode."""
+        rng = self.rng
+        fs = mc.master.fs
+        stripes = [(bid, s) for bid, s in
+                   sorted(getattr(fs, "ec_stripes", {}).items())
+                   if s.get("state") == "committed"]
+        if not stripes:
+            rec["skipped"] = "no committed stripes"
+            return
+        bid, stripe = rng.choice(stripes)
+        alive_ids = {mc.workers[i].worker_id for i in self._alive}
+        # cells of THIS stripe that live on a currently-alive worker
+        live_cells = []
+        for cid in stripe.get("cells", []):
+            for wid in fs.blocks.locs.get(cid) or ():
+                if wid in alive_ids:
+                    live_cells.append((cid, wid))
+                    break
+        if not live_cells:
+            rec["skipped"] = "no live cells"
+            return
+        rec["stripe"] = bid
+        full_strength = (
+            len(live_cells) == len(stripe.get("cells", []))
+            and not self._rotten_cells(mc))
+        if rng.random() < 0.5 and len(self._alive) >= self.n_workers \
+                and self._safe_to_kill(mc):
+            # kill a cell holder (bounded to one down at a time by the
+            # guard: the dead cell keeps _safe_to_kill False until the
+            # master reconstructs it onto a live worker)
+            cid, wid = rng.choice(live_cells)
+            idx = next(i for i in self._alive
+                       if mc.workers[i].worker_id == wid)
+            self._alive.discard(idx)
+            self._winj.pop(idx, None)
+            self._dinj.pop(idx, None)
+            if self._disk_victim == idx:
+                self._disk_victim = None
+            await mc.kill_worker(idx)
+            rec["kind"] = "kill_cell_holder"
+            rec["cell"], rec["worker"] = cid, idx
+        elif full_strength:
+            # bit-rot inside one cell: the probe read's EOF checksum
+            # rejects the rotten cell (decode routes around it) and the
+            # scrub verdict steers the master to re-encode, not re-pull.
+            # Only a stripe at full verified strength takes a flip —
+            # rot on an already-degraded stripe would stack losses past
+            # m, which _safe_to_kill exists to forbid for kills
+            cid, wid = rng.choice(live_cells)
+            idx = next(i for i in self._alive
+                       if mc.workers[i].worker_id == wid)
+            w = mc.workers[idx]
+            info = w.store.get(cid, touch=False)
+            if info is None:
+                rec["skipped"] = "cell not resident"
+                return
+            off = rng.randrange(max(1, os.path.getsize(info.path)))
+            with open(info.path, "r+b") as f:
+                f.seek(off)
+                byte = f.read(1)
+                f.seek(off)
+                f.write(bytes([byte[0] ^ (1 << rng.randrange(8))]))
+            self._ec_rot_pending[cid] = False
+            rec["kind"] = "bitflip_in_cell"
+            rec["cell"], rec["worker"] = cid, idx
+        else:
+            rec["skipped"] = "stripe below full strength"
+            return
+        # deterministic degraded-read probe: the stripe just lost a
+        # cell, yet ITS file's bytes must come back exact RIGHT NOW
+        path = self._ec_blocks.get(bid) or rng.choice(self._ec_paths)
+        want = self.acked[path]
+        try:
+            r = await self._ec_client.open(path)
+            try:
+                data = await r.read_all(deadline_ms=self.deadline_ms)
+            finally:
+                await r.close()
+            self._count("ec_probe_ok")
+            if hashlib.sha256(data).hexdigest() != want:
+                self.report.integrity_errors.append(
+                    f"ec probe read of {path} after {rec['kind']}: "
+                    "wrong bytes")
+        except _EXPECTED as e:
+            self._count("ec_probe_err")
+            log.debug("ec probe read %s failed: %s", path, e)
 
     # ---------------- invariants ----------------
 
@@ -451,9 +641,14 @@ class ChaosStorm:
         # the state a committed block is in after its holder was marked
         # LOST (heartbeats dropped by a fault) until the holder returns
         # and re-reports. Those must heal too before the storm is over.
+        # Exception: a committed stripe's LOGICAL block is SUPPOSED to
+        # end with zero replica locations (retired copy-first-delete-
+        # last); its durability lives in the cells, swept separately.
+        stripes = getattr(mc.master.fs, "ec_stripes", None) or {}
         for bid, locs in blocks.locs.items():
             meta = blocks.get(bid)
-            if not locs and meta is not None and meta.len > 0:
+            if not locs and meta is not None and meta.len > 0 \
+                    and bid not in stripes:
                 under.append(bid)
         return under
 
@@ -466,6 +661,32 @@ class ChaosStorm:
             await asyncio.sleep(0.2)
         self.report.replication_converged = False
         self.report.unconverged_blocks = under[:32]
+
+    async def _await_ec_convergence(self, mc: MiniCluster) -> None:
+        """EC invariant: after quiesce every committed stripe converges
+        back to k+m cells each with a live holder — degraded stripes
+        must be RECONSTRUCTED (cells re-encoded from k survivors onto
+        live workers), not merely tolerated by decode-on-read."""
+        deadline = time.monotonic() + self.converge_timeout_s
+        unhealed: list = []
+        while True:
+            fs = mc.master.fs
+            alive_ids = {mc.workers[i].worker_id for i in self._alive}
+            unhealed = []
+            for bid, stripe in getattr(fs, "ec_stripes", {}).items():
+                if stripe.get("state") != "committed":
+                    continue
+                for cid in stripe.get("cells", []):
+                    locs = fs.blocks.locs.get(cid) or ()
+                    if not set(locs) & alive_ids:
+                        unhealed.append((bid, cid))
+            if not unhealed:
+                return
+            if time.monotonic() >= deadline:
+                self.report.ec_converged = False
+                self.report.ec_unhealed = unhealed[:16]
+                return
+            await asyncio.sleep(0.2)
 
     async def _await_evacuation(self, mc: MiniCluster) -> None:
         """Disk-fault invariant: every dir the storm drove into
@@ -544,9 +765,11 @@ class ChaosStorm:
         budgeted read must finish via failover within budget + slack —
         the headline number of the deadline plane (vs a full RPC
         timeout without it)."""
-        if self.replicas < 2 or len(self._alive) < 2 or not self.acked:
+        paths = [p for p in sorted(self.acked)
+                 if p not in self._ec_paths]
+        if self.replicas < 2 or len(self._alive) < 2 or not paths:
             return
-        path = sorted(self.acked)[0]
+        path = paths[0]
         c = mc.client()                   # fresh client: cold breakers
         victim = await self._probe_victim(mc, c, path)
         if victim is None:
@@ -624,9 +847,11 @@ class ChaosStorm:
         2. the master's span store does not leak across a master
            restart: a fresh master starts with an EMPTY store (spans
            are runtime telemetry, not journaled state)."""
-        if self.replicas < 2 or len(self._alive) < 2 or not self.acked:
+        paths = [p for p in sorted(self.acked)
+                 if p not in self._ec_paths]
+        if self.replicas < 2 or len(self._alive) < 2 or not paths:
             return
-        path = sorted(self.acked)[0]
+        path = paths[0]
         c = mc.client()                   # fresh client: cold breakers
         victim = await self._probe_victim(mc, c, path)
         if victim is None:
@@ -715,9 +940,14 @@ class ChaosStorm:
         await self._await_convergence(mc)
         if self.disk_faults:
             await self._await_evacuation(mc)
+        if self.ec_storm:
+            await self._await_ec_convergence(mc)
         await self._verify_integrity(mc)
         self.report.checksum_mismatches = sum(
             c.get("read.checksum_mismatch", 0)
+            for c in self._client_counters)
+        self.report.ec_degraded_reads = sum(
+            c.get("read.ec_degraded", 0)
             for c in self._client_counters)
         if self.degraded_probe:
             await self._probe_degraded_read(mc)
@@ -736,6 +966,8 @@ class ChaosStorm:
         self._minj.install(mc.master.rpc)
         for i, w in enumerate(mc.workers):
             self._install_worker(i, w)
+        if self.ec_storm:
+            await self._setup_ec(mc)
 
         workers = [asyncio.ensure_future(self._writer(mc, i))
                    for i in range(self.writer_tasks)]

@@ -588,7 +588,13 @@ class WorkerServer:
                 await self._leader_call(
                     RpcCode.REPORT_UNDER_REPLICATED_BLOCKS,
                     pack({"block_ids": corrupt,
-                          "worker_id": self.worker_id}))
+                          "worker_id": self.worker_id,
+                          # verify_detail verdicts: the master repairs a
+                          # "truncated" copy by re-pull and a "mismatch"
+                          # (bit-rot) EC cell by re-encode from siblings
+                          "verdicts": {bid: self.store.scrub_verdicts[bid]
+                                       for bid in corrupt
+                                       if bid in self.store.scrub_verdicts}}))
             except Exception as e:  # noqa: BLE001 — next scrub retries
                 log.warning("corrupt-block report failed: %s", e)
 
@@ -994,10 +1000,16 @@ class WorkerServer:
                 len(data))
             try:
                 await asyncio.to_thread(_write_block_bytes, info, data)
-                await asyncio.to_thread(self.store.commit,
-                                        b["block_id"], len(data))
+                # sender-computed checksum (EC cell placement and other
+                # trusted peers): the cell commits first-class verified,
+                # so the scrubber covers it like any block
+                await asyncio.to_thread(
+                    self.store.commit, b["block_id"], len(data),
+                    checksum=b.get("crc32"),
+                    checksum_algo=b.get("algo", "crc32"))
                 results.append({"block_id": b["block_id"], "len": len(data),
-                                "worker_id": self.worker_id})
+                                "worker_id": self.worker_id,
+                                "storage_type": int(info.tier.storage_type)})
             except Exception as e:
                 if isinstance(e, OSError):
                     self.store.note_io_error(info.tier)
@@ -1005,7 +1017,9 @@ class WorkerServer:
                 raise
         self.metrics.inc("bytes.written",
                          sum(r["len"] for r in results))
-        return {"results": results}
+        # results ride the DATA frame: consumers (unified batch writer,
+        # EC cell placement) parse unpack(rep.data)["results"]
+        return {}, pack({"results": results})
 
     async def _delete_block(self, msg: Message, conn: ServerConn):
         q = unpack(msg.data) or {}
@@ -1089,8 +1103,36 @@ class WorkerServer:
         Parity: worker/replication/replication_job.rs (pull-based)."""
         q = unpack(msg.data) or {}
         block_id = q["block_id"]
-        src = WorkerAddress.from_wire(q["source"])
         ok, message = True, ""
+        ecq = q.get("ec")
+        if ecq is not None:
+            # stripe-cell rebuild: there may be NOTHING to copy — decode
+            # the cell from k sibling cells instead of pulling a replica
+            try:
+                if not self.store.contains(block_id):
+                    await self._reconstruct_cell(ecq, block_id)
+                    await self._leader_call(
+                        RpcCode.WORKER_BLOCK_REPORT, pack({
+                            "worker_id": self.worker_id,
+                            "blocks": {block_id: ecq["cell_size"]},
+                            "storage_types": {block_id: int(
+                                self.store.get(block_id,
+                                               touch=False)
+                                .tier.storage_type)},
+                            "incremental": True}))
+            except Exception as e:  # noqa: BLE001
+                ok, message = False, str(e)
+                self.store.delete(block_id)
+            try:
+                await self._leader_call(
+                    RpcCode.REPORT_BLOCK_REPLICATION_RESULT,
+                    pack({"block_id": block_id,
+                          "worker_id": self.worker_id,
+                          "success": ok, "message": message}))
+            except Exception as e:
+                log.warning("reconstruct result report failed: %s", e)
+            return {"success": ok, "message": message}
+        src = WorkerAddress.from_wire(q["source"])
         try:
             if not self.store.contains(block_id):
                 peer = await self.peer_pool.get(
@@ -1210,7 +1252,10 @@ class WorkerServer:
     async def _submit_task(self, msg: Message, conn: ServerConn):
         q = unpack(msg.data) or {}
         task = TaskInfo.from_wire(q["task"])
-        asyncio.ensure_future(self._run_load_task(task))
+        if task.kind == "ec_convert":
+            asyncio.ensure_future(self._run_ec_convert_task(task))
+        else:
+            asyncio.ensure_future(self._run_load_task(task))
         return {"accepted": True}
 
     async def _run_load_task(self, task: TaskInfo) -> None:
@@ -1238,3 +1283,177 @@ class WorkerServer:
                 except Exception as e:
                     log.warning("task report failed: %s", e)
                 await client.close()
+
+    # ---------------- erasure coding ----------------
+
+    async def _pull_verified(self, src: WorkerAddress, block_id: int,
+                             deadline=None) -> bytes:
+        """Pull one whole block/cell from a peer into memory, verified
+        against the commit-time checksum riding the EOF frame. In-memory
+        on purpose: every EC caller needs the full bytes for the matrix
+        pass anyway, and cells are bounded by block_size/k."""
+        peer = await self.peer_pool.get(
+            f"{src.ip_addr or src.hostname}:{src.rpc_port}")
+        chunks: list[bytes] = []
+        src_crc = src_algo = None
+        async for m in peer.call_stream(
+                RpcCode.READ_BLOCK, header={"block_id": block_id},
+                deadline=deadline):
+            if len(m.data):
+                chunks.append(bytes(m.data))
+            if m.is_eof:
+                h = m.header or {}
+                src_crc = h.get("block_crc32")
+                src_algo = h.get("block_crc_algo")
+        data = b"".join(chunks)
+        if src_crc is not None and checksum.supported(src_algo):
+            if checksum.crc_update(src_algo, data) != src_crc:
+                raise err.AbnormalData(
+                    f"pull of block {block_id} from worker "
+                    f"{src.worker_id} failed checksum verify")
+        return data
+
+    async def _pull_any(self, sources: list[dict], block_id: int,
+                        deadline=None) -> bytes:
+        last: Exception | None = None
+        for wire in sources:
+            try:
+                return await self._pull_verified(
+                    WorkerAddress.from_wire(wire), block_id,
+                    deadline=deadline)
+            except Exception as e:  # noqa: BLE001 — try the next holder
+                last = e
+        raise last or err.BlockNotFound(
+            f"no servable source for block {block_id}")
+
+    def _write_local_cell(self, cell_id: int, data: bytes) -> int:
+        """Commit one stripe cell into the local store with a fresh
+        first-class checksum (cells scrub and verify like any block)."""
+        info = self.store.create_temp(cell_id, size_hint=len(data))
+        algo = checksum.preferred_algo()
+        crc = checksum.crc_update(algo, data)
+        try:
+            _write_block_bytes(info, data, self.store.fault_hook)
+            self.store.commit(cell_id, len(data), checksum=crc,
+                              checksum_algo=algo)
+        except Exception:
+            self.store.delete(cell_id)
+            raise
+        return int(info.tier.storage_type)
+
+    async def _place_cells(self, placed: dict) -> list[dict]:
+        """Land encoded cells on their target workers. Local targets
+        commit directly; remote targets ride WRITE_BLOCKS_BATCH (cells
+        are small one-shot writes — the streaming protocol buys nothing)
+        with the sender-computed checksum so every cell commits
+        first-class verified. `placed`: addr_key -> (addr, [(cell_id,
+        bytes), ...]). Returns EC_COMMIT_STRIPE cell entries."""
+        out = []
+        algo = checksum.preferred_algo()
+        for addr, cells in placed.values():
+            if addr.worker_id == self.worker_id:
+                for cid, data in cells:
+                    st = await asyncio.to_thread(
+                        self._write_local_cell, cid, data)
+                    out.append({"block_id": cid,
+                                "worker_id": self.worker_id,
+                                "storage_type": st})
+                continue
+            peer = await self.peer_pool.get(
+                f"{addr.ip_addr or addr.hostname}:{addr.rpc_port}")
+            rep = await peer.call(RpcCode.WRITE_BLOCKS_BATCH, data=pack({
+                "blocks": [{"block_id": cid, "data": data,
+                            "crc32": checksum.crc_update(algo, data),
+                            "algo": algo}
+                           for cid, data in cells]}))
+            for r in (unpack(rep.data) or {}).get("results", []):
+                out.append({"block_id": r["block_id"],
+                            "worker_id": r["worker_id"],
+                            "storage_type": r.get("storage_type", 1)})
+        return out
+
+    async def _convert_one_stripe(self, prof, plan: dict) -> None:
+        from curvine_tpu.common import ec as eclib
+        block_id = plan["block_id"]
+        data = await self._pull_any(plan["sources"], block_id)
+        if len(data) != plan["block_len"]:
+            raise err.AbnormalData(
+                f"block {block_id}: pulled {len(data)}B, "
+                f"expected {plan['block_len']}B")
+        cells, _ = await asyncio.to_thread(
+            eclib.split, data, prof.k, plan["cell_size"])
+        parity = await asyncio.to_thread(eclib.encode, prof, cells)
+        coded = cells + parity
+        placed: dict = {}
+        for c in plan["cells"]:
+            addr = WorkerAddress.from_wire(c["addr"])
+            key = (addr.worker_id, addr.rpc_port)
+            placed.setdefault(key, (addr, []))[1].append(
+                (c["block_id"], bytes(coded[c["index"]])))
+        entries = await self._place_cells(placed)
+        # commit the stripe map on the master: this flips reads over to
+        # the cells and starts retiring the replicated copies
+        await self._leader_call(RpcCode.EC_COMMIT_STRIPE, pack({
+            "block_id": block_id, "cells": entries}))
+
+    async def _run_ec_convert_task(self, task: TaskInfo) -> None:
+        """Stripe a batch of cold replicated blocks: pull each block
+        (verified), RS-encode it into k+m cells, land the cells on their
+        planned workers, and EC_COMMIT_STRIPE. One bad block fails the
+        task (the job planner re-plans on resubmit) but blocks already
+        committed stay converted — the conversion is per-stripe atomic."""
+        from curvine_tpu.common import ec as eclib
+        async with self._task_sem:
+            payload = task.payload or {}
+            done = 0
+            try:
+                prof = eclib.ECProfile.parse(payload.get("profile", ""))
+                for plan in payload.get("blocks", []):
+                    await self._convert_one_stripe(prof, plan)
+                    done += 1
+                task.state = JobState.COMPLETED
+            except Exception as e:  # noqa: BLE001
+                task.state = JobState.FAILED
+                task.message = str(e)
+                log.warning("ec convert task %s failed after %d stripes: "
+                            "%s", task.task_id, done, e)
+            task.loaded_len = done
+            task.worker_id = self.worker_id
+            try:
+                await self._leader_call(RpcCode.REPORT_TASK,
+                                        pack({"task": task.to_wire()}))
+            except Exception as e:
+                log.warning("task report failed: %s", e)
+
+    async def _reconstruct_cell(self, ecq: dict, cell_id: int) -> None:
+        """Rebuild one lost/rotten stripe cell from any k live sibling
+        cells (decode, or re-encode for a parity target) and commit it
+        locally under a fresh checksum."""
+        from curvine_tpu.common import ec as eclib
+        prof = eclib.ECProfile.parse(ecq["profile"])
+        cell_size = ecq["cell_size"]
+        slots: list[bytes | None] = [None] * (prof.k + prof.m)
+        got = 0
+        for s in ecq["sources"]:
+            if got >= prof.k:
+                break
+            try:
+                b = await self._pull_verified(
+                    WorkerAddress.from_wire(s["addr"]), s["block_id"])
+            except Exception as e:  # noqa: BLE001 — source died mid-heal
+                log.debug("cell source %d unavailable: %s",
+                          s["block_id"], e)
+                continue
+            if len(b) != cell_size:
+                continue             # partial/stale copy: never decode it
+            slots[s["index"]] = b
+            got += 1
+        if got < prof.k:
+            raise err.BlockNotFound(
+                f"cell {cell_id}: only {got}/{prof.k} sibling cells "
+                f"readable")
+        idx = ecq["cell_index"]
+        rebuilt = await asyncio.to_thread(
+            eclib.reconstruct, prof, slots, [idx])
+        await asyncio.to_thread(self._write_local_cell, cell_id,
+                                bytes(rebuilt[idx]))

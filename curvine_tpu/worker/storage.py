@@ -445,6 +445,11 @@ class BlockStore:
         # last scrub cycle's outcome counts (metrics exporter reads it)
         self.scrub_last = {"verified": 0, "mismatch": 0, "truncated": 0,
                            "io_error": 0}
+        # last cycle's per-block verdicts (block_id -> "mismatch" |
+        # "truncated"): rides the corrupt-block report so the master
+        # picks the repair path — a truncated copy is re-pulled whole,
+        # a bit-rotten EC cell is re-encoded from its siblings
+        self.scrub_verdicts: dict[int, str] = {}
         self._lock = threading.Lock()
         # block ids mid-tier-move (copy runs lock-free; see _move_block)
         self._moving: set[int] = set()
@@ -681,6 +686,7 @@ class BlockStore:
         stats = {"verified": 0, "mismatch": 0, "truncated": 0,
                  "io_error": 0}
         corrupt = []
+        verdicts: dict[int, str] = {}
         for bid in candidates:
             try:
                 ok, reason = self.verify_detail(bid)
@@ -715,7 +721,9 @@ class BlockStore:
                 if b is not None:
                     b.verified_at = time.time()
             corrupt.append(bid)
+            verdicts[bid] = reason
         self.scrub_last = stats
+        self.scrub_verdicts = verdicts
         return corrupt
 
     def get(self, block_id: int, touch: bool = True) -> BlockInfo:

@@ -16,6 +16,7 @@
 #   BENCH_WRITEREPLAY=0 skips the write-replay-buffer overhead gate.
 #   BENCH_SHM=0 skips the shared-memory read-plane gate.
 #   BENCH_LADDER=0 skips the open-loop concurrency-rung gate.
+#   BENCH_EC=0 skips the erasure-coding gate.
 # Exit: 0 = at/above the regression gates, 1 = regression, 2 = harness error.
 
 set -u
@@ -538,6 +539,55 @@ if pct > ceiling:
     print(f"perf_smoke: FAIL — replay buffer costs {pct}% > {ceiling}% "
           "on fault-free writes (one append per chunk got heavy)",
           file=sys.stderr)
+    sys.exit(1)
+print("perf_smoke: PASS")
+EOF
+    rc=$?
+    [ $rc -ne 0 ] && exit $rc
+fi
+
+if [ "${BENCH_EC:-1}" = "0" ]; then
+    echo "perf_smoke: erasure-coding gate skipped (BENCH_EC=0)"
+else
+    # erasure-coding gate: (a) RS(6,3) encode GiB/s through the
+    # preferred GF(256) path — the convert job's per-byte budget;
+    # (b) degraded-vs-intact read A/B with one cell holder dead —
+    # decode-on-read must stay an inline cost, not a re-dial-the-dead-
+    # holder-per-chunk collapse (docs/erasure-coding.md).
+    EC_OUT=$(JAX_PLATFORMS=cpu timeout 150 python - <<'EOF'
+import asyncio, json, os, sys
+sys.path.insert(0, os.getcwd())
+from bench import _ec_smoke
+print(json.dumps(asyncio.run(_ec_smoke())))
+EOF
+)
+    rc=$?
+    if [ $rc -ne 0 ] || [ -z "$EC_OUT" ]; then
+        echo "perf_smoke: erasure-coding microbench failed (rc=$rc)" >&2
+        exit 2
+    fi
+    echo "$EC_OUT"
+    python - "$FLOOR_FILE" <<'EOF' "$EC_OUT"
+import json, sys
+floor_file, result = sys.argv[1], json.loads(sys.argv[2])
+floors = json.load(open(floor_file))
+floor = floors["ec_encode_gibs"]
+ceiling = floors["ec_degraded_read_overhead_pct_max"]
+gibs = result.get("ec_encode_gibs", 0.0)
+pct = result.get("ec_degraded_read_overhead_pct", 100.0)
+gate = floor * 0.7                      # >30% regression fails
+print(f"perf_smoke: ec_encode_gibs={gibs} floor={floor} gate={gate:.3f}  "
+      f"ec_degraded_read_overhead_pct={pct} ceiling={ceiling} "
+      f"(gibs intact={result.get('ec_read_intact_gibs')} "
+      f"degraded={result.get('ec_read_degraded_gibs')})")
+if gibs < gate:
+    print(f"perf_smoke: FAIL — ec_encode_gibs {gibs} < {gate:.3f} "
+          f"(floor {floor} - 30%)", file=sys.stderr)
+    sys.exit(1)
+if pct > ceiling:
+    print(f"perf_smoke: FAIL — degraded reads cost {pct}% > {ceiling}% "
+          "over intact (inline decode or dead-holder short-circuit "
+          "regressed)", file=sys.stderr)
     sys.exit(1)
 print("perf_smoke: PASS")
 EOF

@@ -64,6 +64,61 @@ def test_cli_fs_flow(cluster_loop, tmp_path, capsys):
     assert _cv(mc, "ls", "/cli") == 1     # gone → error exit
 
 
+@pytest.fixture
+def ec_cluster_loop():
+    """3-worker variant of cluster_loop: RS(2,1) stripes need three
+    fault domains for full cell spread."""
+    import threading
+    loop = asyncio.new_event_loop()
+    mc = MiniCluster(workers=3)
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    asyncio.run_coroutine_threadsafe(mc.start(), loop).result(30)
+    yield mc
+    asyncio.run_coroutine_threadsafe(mc.stop(), loop).result(30)
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(5)
+
+
+def test_cli_ec_and_fsck(ec_cluster_loop, tmp_path, capsys):
+    """cv ec set-policy / convert --wait, EC-aware cv blocks, the stripe
+    audit (cv fsck [--repair]), and the report rollup line."""
+    import time
+    mc = ec_cluster_loop
+    src = tmp_path / "ec.bin"
+    src.write_bytes(os.urandom(256 * 1024 + 17))
+    assert _cv(mc, "mkdir", "/ec") == 0
+    assert _cv(mc, "put", str(src), "/ec/f.bin") == 0
+    assert _cv(mc, "ec", "set-policy", "/ec/f.bin", "rs-9") == 1
+    assert "rs-9" in capsys.readouterr().err       # rejected client-side
+    assert _cv(mc, "ec", "set-policy", "/ec/f.bin", "rs-2-1") == 0
+    assert "rs-2-1" in capsys.readouterr().out
+    assert _cv(mc, "ec", "convert", "/ec/f.bin", "--wait") == 0
+    assert "COMPLETED" in capsys.readouterr().out
+    # the job completing precedes replica retirement: poll cv blocks
+    # until the stripe descriptor takes over from the replica locs
+    deadline = time.time() + 15
+    while True:
+        assert _cv(mc, "blocks", "/ec/f.bin") == 0
+        out = capsys.readouterr().out
+        if "ec=rs-2-1" in out and "cells=[" in out:
+            break
+        assert time.time() < deadline, f"stripe never took over: {out}"
+        time.sleep(0.2)
+    assert _cv(mc, "fsck", "/ec/f.bin") == 0
+    out = capsys.readouterr().out
+    assert "3/3 live" in out and "healthy" in out
+    assert _cv(mc, "fsck", "/ec/f.bin", "--repair") == 0
+    capsys.readouterr()
+    assert _cv(mc, "report") == 0
+    out = capsys.readouterr().out
+    assert "EC plane: stripes committed:" in out
+    # round-trip still bit-exact through the CLI read path
+    dst = tmp_path / "ec.out"
+    assert _cv(mc, "get", "/ec/f.bin", str(dst)) == 0
+    assert dst.read_bytes() == src.read_bytes()
+
+
 def test_cli_mounts_and_load(cluster_loop, capsys):
     from curvine_tpu.ufs import create_ufs
     from curvine_tpu.ufs import memory as memufs

@@ -65,6 +65,33 @@ async def test_storm_disk_faults_deterministic(seed, tmp_path):
         "no disk-fault events fired"
 
 
+EC_STORM_SEEDS = [1, 6]
+
+
+@pytest.mark.parametrize("seed", EC_STORM_SEEDS)
+async def test_storm_ec_stripe_loss_deterministic(seed, tmp_path):
+    """EC stripe-loss storm (docs/erasure-coding.md): committed RS(2,1)
+    stripes under a schedule that kills cell-holding workers and flips
+    bits inside cells on media. Invariants: every probe read straight
+    after a strike returns exact bytes via degraded decode-on-read
+    (read.ec_degraded > 0 proves decode really fired), _safe_to_kill
+    never lets losses stack past what k survivors can decode, and after
+    quiesce every stripe converges back to k+m live cells."""
+    storm = ChaosStorm(seed, workers=3, replicas=2, duration_s=2.0,
+                       event_interval_s=0.2, writer_tasks=1,
+                       reader_tasks=1, file_size=64 * 1024,
+                       ec_storm=True, degraded_probe=False,
+                       master_restarts=False, base_dir=str(tmp_path))
+    report = await storm.run()
+    report.assert_invariants()
+    assert report.ec_stripes > 0, "no stripes committed before the storm"
+    struck = [e for e in report.events
+              if e["event"] == "ec_stripe_loss" and "kind" in e]
+    assert struck, f"no stripe-loss strike landed (events={report.events})"
+    assert report.ec_degraded_reads > 0, \
+        "no degraded decode-on-read fired under stripe loss"
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("seed", [11, 23, 42])
 async def test_storm_long_randomized(seed, tmp_path):

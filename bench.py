@@ -889,6 +889,135 @@ async def _shm_read_bench(iters: int = 2_000, block_mb: int = 4) -> dict:
     return out
 
 
+def _cache_scan_bench(hot_n: int = 16, block_kb: int = 1,
+                      cap_kb: int = 64, scan_factor: int = 8,
+                      touch_every: int = 64) -> dict:
+    """Cache-admission scan-resistance A/B for perf_smoke.sh
+    (docs/caching.md). One BlockStore per policy (single MEM tier, so
+    every eviction is a drop), identical workload: a hot working set is
+    written and touched, then `scan_factor`x the tier's capacity of
+    one-touch blocks streams through, with the hot set re-read sparser
+    than the eviction cadence — the access pattern pure LRU is known to
+    lose (each sweep's blocks are younger than the hot set's last
+    touch). The hit pct is hot reads that found the block resident.
+    The acceptance bar is s3fifo >= 1.3x the lru hit pct; the absolute
+    `scan_resist_ratio_min` floor lives in scripts/perf_floor.json.
+    Returns {scan_resist_s3fifo_hit_pct, scan_resist_lru_hit_pct,
+    scan_resist_ratio, scan_ghost_hits, scan_probation_evictions}."""
+    import shutil
+    import tempfile
+    from curvine_tpu.common.types import StorageType
+    from curvine_tpu.worker.storage import BlockStore, TierDir
+
+    size = block_kb * 1024
+    n_scan = cap_kb * 1024 * scan_factor // size
+    out: dict = {}
+
+    def run(admission: str, root: str) -> tuple[float, dict]:
+        mem = TierDir(StorageType.MEM, os.path.join(root, admission),
+                      cap_kb * 1024)
+        store = BlockStore([mem], high_water=0.9, low_water=0.5,
+                           admission=admission)
+        for bid in range(hot_n):
+            info = store.create_temp(bid, size_hint=size)
+            with open(info.path, "wb") as f:
+                f.write(b"\0" * size)
+            store.commit(bid, size)
+        for bid in range(hot_n):
+            store.get(bid)
+        hits = attempts = 0
+        for k in range(n_scan):
+            info = store.create_temp(10_000 + k, size_hint=size)
+            with open(info.path, "wb") as f:
+                f.write(b"\0" * size)
+            store.commit(10_000 + k, size)
+            if k % touch_every == 0:
+                for bid in range(hot_n):
+                    attempts += 1
+                    if store.contains(bid):
+                        hits += 1
+                        store.get(bid)
+        return hits / max(1, attempts), store.cache_stats()["total"]
+
+    root = tempfile.mkdtemp(prefix="curvine-scanbench-")
+    try:
+        s3, s3_stats = run("s3fifo", root)
+        lru, _ = run("lru", root)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    out["scan_resist_s3fifo_hit_pct"] = round(s3 * 100, 1)
+    out["scan_resist_lru_hit_pct"] = round(lru * 100, 1)
+    out["scan_resist_ratio"] = round(s3 / max(lru, 0.01), 2)
+    out["scan_ghost_hits"] = s3_stats.get("ghost_hits", 0)
+    out["scan_probation_evictions"] = s3_stats.get("scan_evicted", 0)
+    return out
+
+
+async def _prefetch_epoch_bench(shards: int = 8, shard_kb: int = 128,
+                                batch: int = 8, seq_len: int = 1024,
+                                step_s: float = 0.005) -> dict:
+    """Epoch-boundary input-wait gate for perf_smoke.sh
+    (docs/caching.md). A CacheShardSource with prefetch advise on
+    streams TWO consecutive epochs (the boundary re-shuffles the shard
+    order) through an AsyncDevicePrefetcher into a consumer simulating
+    a fixed-length train step; the StepProfiler attributes every stall.
+    The acceptance bar is a steady-state input_wait fraction at or
+    under `input_wait_frac_max` across the boundary — the cache plus
+    the rolling prefetch window must keep the consumer compute-bound.
+    Returns {input_wait_frac, prefetch_steps, prefetch_window_jobs}."""
+    import shutil
+    import numpy as np
+    from curvine_tpu.obs.profiler import StepProfiler
+    from curvine_tpu.testing import MiniCluster
+    from curvine_tpu.tpu.ingest import AsyncDevicePrefetcher
+    from curvine_tpu.tpu.loader import CacheShardSource
+
+    base = os.path.join(_pick_shm_dir(),
+                        f"curvine-prefetchbench-{os.getpid()}")
+    prof = StepProfiler()
+    steps = 0
+    try:
+        async with MiniCluster(workers=1, base_dir=base, journal=False,
+                               block_size=MB) as mc:
+            c = mc.client()
+            rng = np.random.default_rng(3)
+            for i in range(shards):
+                tok = rng.integers(0, 2 ** 31, shard_kb * 256,
+                                   dtype=np.int32)
+                await c.write_all(f"/bench/epoch/shard-{i:03d}.bin",
+                                  tok.tobytes())
+            src = CacheShardSource(c, "/bench/epoch", batch, seq_len,
+                                   shuffle_seed=7, prefetch=True,
+                                   prefetch_window=4)
+            per_epoch = shards * shard_kb * 256 // (batch * seq_len)
+
+            async def three_epochs():
+                for _ in range(3):
+                    async for b in src.batches():
+                        yield b
+
+            # epoch 0 is warmup outside the measurement (pipeline fill,
+            # first listing, first jax dispatch): the gate is the
+            # STEADY-STATE input wait across the epoch 1 -> 2 boundary
+            pf = AsyncDevicePrefetcher(three_epochs(), None, depth=2)
+            async for _ in pf:
+                await asyncio.sleep(step_s)       # the simulated step
+                steps += 1
+                if steps == per_epoch:
+                    src.profiler = prof
+                    pf.profiler = prof
+                elif steps > per_epoch:
+                    prof.step_done()
+            jobs = sum(1 for j in mc.master.jobs.jobs.values()
+                       if j.kind == "prefetch")
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    frac = prof.summary()["fractions"]
+    return {"input_wait_frac": round(frac.get("input_wait", 0.0), 4),
+            "prefetch_steps": steps,
+            "prefetch_window_jobs": jobs}
+
+
 async def _ladder_smoke(clients: int = 64, duration: float = 2.0,
                         rate: float = 10.0) -> dict:
     """Scaled-down open-loop concurrency rung (scripts/latency_ladder.py

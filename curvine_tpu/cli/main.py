@@ -348,6 +348,24 @@ async def cmd_report(args):
             print(f"EC plane: stripes committed: "
                   f"{int(ep.get('stripes_committed', 0))}  "
                   f"degraded reads: {int(ep.get('degraded_reads', 0))}")
+        cp = rp.get("cache_plane")
+        if cp:
+            tier0 = cp.pop("tier0", None)
+            store = cp.pop("store", {})
+            for tier in sorted(cp):
+                st = cp[tier]
+                misses = int(st.get("misses",
+                                    store.get("misses", 0) if tier == "mem"
+                                    else 0))
+                print(f"Cache plane [{tier}]: hits: "
+                      f"{int(st.get('hits', 0))}  misses: {misses}  "
+                      f"ghost hits: {int(st.get('ghost_hits', 0))}  "
+                      f"scan evicted: {int(st.get('scan_evicted', 0))}  "
+                      f"admits: {int(st.get('admits', 0))}")
+            if tier0:
+                occ = "  ".join(f"{t}={_human(int(b))}"
+                                for t, b in sorted(tier0.items()))
+                print(f"Cache plane [tier0 occupancy]: {occ}")
         rows = rp.get("shards") or []
         if rows:
             print(f"Namespace shards: {len(rows)}")

@@ -581,6 +581,16 @@ class FsClient:
                           replicas: int = 1) -> str:
         return await self.submit_job("load", path, recursive, replicas)
 
+    async def prefetch_window(self, path: str, cursor: int = 0,
+                              window: int = 8, epoch: int = 0,
+                              seed: int = 0) -> dict:
+        """Epoch-aware prefetch advise (docs/caching.md): tell the
+        master where the read cursor is in the deterministic
+        (seed, epoch) shard order; it keeps `window` shards warm ahead."""
+        return await self.call(RpcCode.PREFETCH_WINDOW, {
+            "path": path, "cursor": int(cursor), "window": int(window),
+            "epoch": int(epoch), "seed": int(seed)}, mutate=True)
+
     async def submit_export(self, path: str, recursive: bool = True) -> str:
         return await self.submit_job("export", path, recursive)
 

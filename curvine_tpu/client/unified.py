@@ -395,6 +395,34 @@ class CurvineClient:
             raise
         return total
 
+    async def advise(self, path: str, cursor: int = 0, window: int = 8,
+                     epoch: int = 0, seed: int = 0) -> dict:
+        """Advise the master's rolling prefetch window (docs/caching.md):
+        the caller is reading `path`'s shards in the deterministic
+        (seed, epoch) order of common/epoch.py and its cursor is at
+        shard index `cursor` — keep the next `window` shards warm."""
+        return await self.meta.prefetch_window(path, cursor=cursor,
+                                               window=window, epoch=epoch,
+                                               seed=seed)
+
+    async def prefetch(self, path: str) -> int:
+        """Warm one file ahead of a read cursor (the worker side of
+        prefetch tasks): already-cached files cost one metadata probe
+        and a block touch; uncached mount-backed files load from the
+        UFS. Advisory — a file that can't be warmed (freed, no mount)
+        is skipped, never an error."""
+        try:
+            st = await self.meta.file_status(path)
+            if st.is_complete and (st.len == 0 or
+                                   await self._has_cached_blocks(path, st)):
+                return 0               # already warm
+        except err.FileNotFound:
+            pass
+        try:
+            return await self.load_from_ufs(path)
+        except err.MountNotFound:
+            return 0                   # cache-native and gone: advisory
+
     async def export_to_ufs(self, path: str) -> int:
         """Persist one cached file out to its mounted UFS location."""
         mount, ufs, uri = await self._ufs_for(path)

@@ -194,6 +194,14 @@ class WorkerConf:
     # sealed-memfd export cache entries (LRU; evictions close the
     # worker-side fd — client-held dups stay valid, unlink semantics)
     shm_export_cap: int = 128
+    # cache admission on the MEM + HBM tiers (docs/caching.md):
+    # "s3fifo" = ghost-cache admission (small probationary FIFO + main
+    # FIFO + ghost queue of recently-evicted ids) so a one-touch backfill
+    # scan cannot flush the multi-touch working set; "lru" = the
+    # byte-compatible historical policy (victims by atime)
+    cache_admission: str = "s3fifo"
+    cache_ghost_entries: int = 8192
+    cache_small_ratio: float = 0.1
 
 
 @dataclass
@@ -207,6 +215,9 @@ class ClientConf:
     # Empty → "default". The S3 gateway derives it from the access key
     # instead; this field is the explicit path for native clients.
     tenant: str = ""
+    # epoch-aware prefetch (docs/caching.md): shards ahead of the read
+    # cursor kept warming via PREFETCH_WINDOW advise calls (0 disables)
+    prefetch_window: int = 8
     block_size: int = 64 * MB
     replicas: int = 1
     write_chunk_size: int = 4 * MB

@@ -97,7 +97,7 @@ def _op_class_table() -> dict[int, str]:
               C.RESIZE_FILE, C.FREE, C.CREATE_FILES_BATCH,
               C.ADD_BLOCKS_BATCH, C.COMPLETE_FILES_BATCH, C.META_BATCH,
               C.SET_LOCK, C.MOUNT, C.UNMOUNT, C.UPDATE_MOUNT,
-              C.SUBMIT_JOB, C.CANCEL_JOB,
+              C.SUBMIT_JOB, C.CANCEL_JOB, C.PREFETCH_WINDOW,
               C.WRITE_BLOCK, C.WRITE_BLOCKS_BATCH, C.WRITE_COMMITS_BATCH,
               C.DELETE_BLOCK, C.SC_WRITE_OPEN, C.SC_WRITE_COMMIT,
               C.SC_WRITE_ABORT}
@@ -177,14 +177,20 @@ class TenantState:
 
     __slots__ = ("name", "priority", "inflight_cap", "bucket", "classes",
                  "inflight", "admitted", "throttled", "shed",
-                 "_win_start", "_win_count", "last_qps")
+                 "_win_start", "_win_count", "last_qps", "tier0_bytes")
 
     def __init__(self, name: str, qps: float, burst: float, priority: int,
                  inflight_cap: int, shares: dict[str, float],
-                 now: float | None = None):
+                 now: float | None = None, tier0_bytes: int = 0):
         self.name = name
         self.priority = priority
         self.inflight_cap = inflight_cap
+        # tier-0 cache partition (docs/caching.md): byte quota for this
+        # tenant's committed blocks on the MEM-and-faster tiers; 0 = no
+        # partition. Enforced by BlockStore eviction preferring
+        # over-quota tenants' blocks first — a soft partition, so idle
+        # capacity stays usable by anyone.
+        self.tier0_bytes = tier0_bytes
         self.bucket = TokenBucket(qps, burst, now=now)
         # op-class sub-buckets: each class may use share × tenant rate;
         # the tenant bucket still caps the sum, so shares of 1.0 mean
@@ -311,7 +317,9 @@ class AdmissionController:
             doa_enabled=qc.doa_enabled, doa_margin=qc.doa_margin,
             slow_op_ms=slow_op_ms, metrics=metrics)
         for spec in qc.tenants:
-            # "name:qps[:priority[:inflight_cap]]" — env/TOML friendly
+            # "name:qps[:priority[:inflight_cap[:tier0_mb]]]" —
+            # env/TOML friendly; tier0_mb is the tier-0 cache partition
+            # in MiB (0/absent = no partition)
             parts = str(spec).split(":")
             if not parts or not parts[0]:
                 continue
@@ -324,6 +332,8 @@ class AdmissionController:
                     kw["priority"] = int(parts[2])
                 if len(parts) > 3 and parts[3]:
                     kw["inflight_cap"] = int(parts[3])
+                if len(parts) > 4 and parts[4]:
+                    kw["tier0_bytes"] = int(float(parts[4]) * 1024 * 1024)
             except ValueError:
                 continue
             ctrl.set_quota(name, **kw)
@@ -333,7 +343,8 @@ class AdmissionController:
 
     def set_quota(self, name: str, qps: float | None = None,
                   burst: float | None = None, priority: int | None = None,
-                  inflight_cap: int | None = None) -> None:
+                  inflight_cap: int | None = None,
+                  tier0_bytes: int | None = None) -> None:
         ov = self._overrides.setdefault(name, {})
         if qps is not None:
             ov["qps"] = qps
@@ -343,7 +354,18 @@ class AdmissionController:
             ov["priority"] = priority
         if inflight_cap is not None:
             ov["inflight_cap"] = inflight_cap
+        if tier0_bytes is not None:
+            ov["tier0_bytes"] = tier0_bytes
         self.tenants.pop(name, None)     # rebuilt lazily with new quota
+
+    def tier0_quota(self, name: str) -> int | None:
+        """Tier-0 cache partition for `name` in bytes, or None when the
+        tenant has no partition configured (BlockStore.tier0_quota hook)."""
+        ov = self._overrides.get(name)
+        if ov is None:
+            return None
+        q = ov.get("tier0_bytes", 0)
+        return int(q) if q else None
 
     def _tenant(self, name: str) -> TenantState:
         ts = self.tenants.get(name)
@@ -355,7 +377,8 @@ class AdmissionController:
                 ov.get("burst", self.default_burst or 0.0),
                 ov.get("priority", self.default_priority),
                 ov.get("inflight_cap", self.default_inflight_cap),
-                self.shares)
+                self.shares,
+                tier0_bytes=ov.get("tier0_bytes", 0))
             self.tenants[name] = ts
         return ts
 
@@ -518,5 +541,6 @@ class AdmissionController:
                     "admitted": ts.admitted,
                     "throttled": ts.throttled,
                     "shed": ts.shed,
+                    "tier0_bytes": ts.tier0_bytes,
                 } for ts in self.tenants.values()},
         }

@@ -385,6 +385,16 @@ class JobInfo(Wire):
     # an interrupted job (resume)
     recursive: bool = True
     replicas: int = 1
+    # prefetch-window jobs (kind="prefetch", docs/caching.md): ONLY the
+    # cursor/window bounds and the (seed, epoch) that deterministically
+    # regenerate the shard order are persisted — never the file list, so
+    # a master restart resumes the window instead of re-walking the
+    # dataset (the in-RAM order is recomputed via common/epoch.py)
+    cursor: int = 0
+    window: int = 0
+    epoch: int = 0
+    seed: int = 0
+    total_files: int = 0
 
 
 @dataclass

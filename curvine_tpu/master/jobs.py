@@ -31,6 +31,11 @@ class JobManager:
         self.dispatch_interval_s = dispatch_interval_s
         self._pending: asyncio.Queue[TaskInfo] = asyncio.Queue()
         self._rr = itertools.count()
+        # rolling prefetch windows (docs/caching.md): (path, epoch) ->
+        # job_id of the active kind="prefetch" job. The shard order and
+        # high-water plan index live ONLY in RAM (job._order/_next) —
+        # recovery recomputes them from the persisted (seed, epoch)
+        self._prefetch: dict[tuple[str, int], str] = {}
 
     def submit(self, kind: str, path: str, recursive: bool = True,
                replicas: int = 1) -> JobInfo:
@@ -51,9 +56,111 @@ class JobManager:
         elif job.kind == "ec_convert":
             fut = asyncio.ensure_future(
                 self._plan_ec_convert(job, job.recursive))
+        elif job.kind == "prefetch":
+            fut = asyncio.ensure_future(self._plan_prefetch(job))
         else:
             fut = asyncio.ensure_future(self._plan_export(job, job.recursive))
         fut.add_done_callback(lambda f: self._plan_done(job, f))
+
+    # ---------------- epoch-aware prefetch ----------------
+
+    def advise_prefetch(self, path: str, cursor: int = 0, window: int = 8,
+                        epoch: int = 0, seed: int = 0) -> JobInfo:
+        """PREFETCH_WINDOW entry: the client advises where its read
+        cursor is (shard index into the deterministic epoch order) and
+        how far ahead to warm. One rolling job per (path, epoch); an
+        advancing cursor extends the planned window incrementally —
+        already-warmed shards are never re-planned. Only the bounds are
+        journaled; the order itself is a pure function of
+        (sorted shard list, seed, epoch) via common/epoch.py."""
+        window = max(1, int(window))
+        cursor = max(0, int(cursor))
+        key = (path, int(epoch))
+        job = None
+        jid = self._prefetch.get(key)
+        if jid is not None:
+            job = self.jobs.get(jid)
+            if job is not None and job.state not in (JobState.PENDING,
+                                                     JobState.RUNNING):
+                job = None
+        if job is None:
+            # a new epoch retires this path's windows two epochs back —
+            # the boundary pair (tail of e, head of e+1) stays active
+            for (p, e), oid in list(self._prefetch.items()):
+                if p == path and e < int(epoch) - 1:
+                    old = self.jobs.get(oid)
+                    if old is not None and old.state in (
+                            JobState.PENDING, JobState.RUNNING):
+                        old.state = JobState.COMPLETED
+                        old.finish_ms = now_ms()
+                        self._persist(old)
+                    del self._prefetch[(p, e)]
+            job = JobInfo(job_id=uuid.uuid4().hex[:16], kind="prefetch",
+                          path=path, state=JobState.PENDING,
+                          create_ms=now_ms(), cursor=cursor, window=window,
+                          epoch=int(epoch), seed=int(seed))
+            self.jobs[job.job_id] = job
+            self._prefetch[key] = job.job_id
+            self._persist(job)
+            self._plan(job)
+            return job
+        moved = cursor > job.cursor or window != job.window
+        job.cursor = max(job.cursor, cursor)
+        job.window = window
+        if moved:
+            self._persist(job)           # bounds only — tasks stay local
+            asyncio.ensure_future(self._extend_prefetch(job))
+        return job
+
+    async def _plan_prefetch(self, job: JobInfo) -> None:
+        """(Re)build the in-RAM epoch order and plan the current window.
+        On recovery this runs with job.tasks empty and job.cursor at the
+        persisted read position: ONLY [cursor, cursor+window) is planned
+        — unlike load jobs, a restart never re-walks the dataset."""
+        from curvine_tpu.common.epoch import epoch_shard_order
+        try:
+            st = self.fs.file_status(job.path)
+            if st.is_dir:
+                shards = [s.path for s in self.fs.list_status(job.path)
+                          if not s.is_dir]
+            else:
+                shards = [st.path]
+            order = epoch_shard_order(shards, job.seed or None, job.epoch)
+            if job.state not in (JobState.PENDING, JobState.RUNNING):
+                return                # cancelled mid-plan: stay cancelled
+            job._order = order                      # RAM only
+            job._next = job.cursor                  # next index to plan
+            job.total_files = len(order)
+            if not order:
+                job.state = JobState.COMPLETED
+                job.finish_ms = now_ms()
+                self._persist(job)
+                return
+            job.state = JobState.RUNNING
+            await self._extend_prefetch(job)
+        except Exception as e:  # noqa: BLE001 — job fails with message
+            log.warning("prefetch job %s planning failed: %s",
+                        job.job_id, e)
+            job.state = JobState.FAILED
+            job.message = str(e) or type(e).__name__
+            job.finish_ms = now_ms()
+            self._persist(job)
+
+    async def _extend_prefetch(self, job: JobInfo) -> None:
+        """Queue warm tasks for order[_next, min(cursor+window, total))."""
+        order = getattr(job, "_order", None)
+        if order is None or job.state not in (JobState.PENDING,
+                                              JobState.RUNNING):
+            return
+        hi = min(job.cursor + job.window, len(order))
+        for idx in range(getattr(job, "_next", job.cursor), hi):
+            task = TaskInfo(task_id=uuid.uuid4().hex[:16],
+                            job_id=job.job_id, path=order[idx],
+                            kind="prefetch")
+            job.tasks.append(task)
+            await self._pending.put(task)
+        job._next = max(getattr(job, "_next", job.cursor), hi)
+        self._maybe_finish(job)
 
     def _plan_done(self, job: JobInfo, fut: asyncio.Future) -> None:
         """Backstop for a planner coroutine that died OUTSIDE its own
@@ -100,6 +207,11 @@ class JobManager:
                 job.state = JobState.PENDING
                 job.tasks = []
                 self.jobs[job.job_id] = job
+                if job.kind == "prefetch":
+                    # re-attach the rolling window so the client's next
+                    # advise extends THIS job; _plan_prefetch resumes
+                    # from the persisted cursor, not the dataset start
+                    self._prefetch[(job.path, job.epoch)] = job.job_id
                 self._plan(job)
                 resumed += 1
                 log.info("resuming %s job %s on %s", job.kind,
@@ -350,6 +462,12 @@ class JobManager:
         if not job.tasks:
             # reachable mid-resume (tasks reset, re-plan in flight): an
             # empty set must not read as 'all tasks completed'
+            return
+        if job.kind == "prefetch" \
+                and getattr(job, "_next", 0) < job.total_files:
+            # the window hasn't reached the end of the epoch order yet —
+            # the job is rolling, not done, even with all current tasks
+            # complete (the client's next advise extends it)
             return
         states = {t.state for t in job.tasks}
         if states <= {JobState.COMPLETED}:

@@ -389,6 +389,7 @@ class MasterServer:
         r(C.SUBMIT_JOB, self._h(self._submit_job, mutate=True))
         r(C.GET_JOB_STATUS, self._h(self._job_status))
         r(C.CANCEL_JOB, self._h(self._cancel_job, mutate=True))
+        r(C.PREFETCH_WINDOW, self._h(self._prefetch_window, mutate=True))
         r(C.REPORT_TASK, self._h(self._report_task))
         # sharded namespace plane: every master answers the 2PC
         # participant protocol and stats (a shard IS a MasterServer);
@@ -923,6 +924,22 @@ class MasterServer:
                     if k.startswith(prefix)}
             if vals:
                 out[key] = vals
+        # cache-intelligence rollup (docs/caching.md): workers heartbeat
+        # flattened "cache.<tier>.<stat>" admission counters (hits,
+        # misses, ghost_hits, scan_evicted, admits) and per-tenant
+        # tier-0 occupancy as "cache.tier0.<tenant>" — summed across
+        # workers into per-tier dicts for `cv report`'s Cache plane line
+        cp: dict = {}
+        for counters in self._worker_counters.values():
+            for k, v in counters.items():
+                if not k.startswith("cache."):
+                    continue
+                tier, _, stat = k[len("cache."):].partition(".")
+                if stat:
+                    grp = cp.setdefault(tier, {})
+                    grp[stat] = grp.get(stat, 0) + v
+        if cp:
+            out["cache_plane"] = cp
         return out
 
     def _tenant_stats(self, q):
@@ -1282,6 +1299,19 @@ class MasterServer:
                                recursive=q.get("recursive", True),
                                replicas=q.get("replicas", 1))
         return {"job_id": job.job_id}
+
+    def _prefetch_window(self, q):
+        """Epoch-aware prefetch advise (docs/caching.md): the client
+        names its read cursor in the deterministic epoch order; the
+        job manager keeps a rolling window of upcoming shards warm."""
+        job = self.jobs.advise_prefetch(
+            q["path"], cursor=int(q.get("cursor", 0)),
+            window=int(q.get("window", 8)), epoch=int(q.get("epoch", 0)),
+            seed=int(q.get("seed", 0)))
+        return {"job_id": job.job_id, "state": int(job.state),
+                "cursor": job.cursor, "window": job.window,
+                "planned": getattr(job, "_next", 0),
+                "total": job.total_files}
 
     def _job_status(self, q):
         return {"job": self.jobs.status(q["job_id"]).to_wire()}

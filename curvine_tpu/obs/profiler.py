@@ -50,6 +50,15 @@ class StepProfiler:
     def step_done(self) -> None:
         self.steps += 1
         self.metrics.inc("steps")
+        self.publish_fractions()
+
+    def publish_fractions(self) -> None:
+        """Gauge each stage's share of accounted pipeline time as
+        ``stage.<name>.frac`` so /metrics answers 'where did the step
+        go' without a snapshot call (input_wait is the cache indictment
+        number the perf gate watches)."""
+        for stage, frac in self.summary()["fractions"].items():
+            self.metrics.gauge(f"stage.{stage}.frac", frac)
 
     # ---------------- reporting ----------------
 

@@ -98,6 +98,12 @@ class RpcCode(enum.IntEnum):
     # and the `cv report` tenants table
     TENANT_STATS = 74
 
+    # epoch-aware prefetch (docs/caching.md): the SDK advises the
+    # master of the deterministic shard order for the epoch it is about
+    # to read; the master keeps a rolling window of upcoming shards
+    # warming ahead of the read cursor (master/jobs.py kind="prefetch")
+    PREFETCH_WINDOW = 75
+
     # block interface (worker)
     WRITE_BLOCK = 80
     READ_BLOCK = 81

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from curvine_tpu.common.epoch import epoch_shard_order
 from curvine_tpu.sdk.filesystem import CurvineFileSystem
 
 
@@ -19,13 +20,30 @@ def _list_shards(fs: CurvineFileSystem, path: str) -> list[str]:
     return sorted(s.path for s in fs.list_status(path) if not s.is_dir)
 
 
+def next_epoch_order(fs: CurvineFileSystem, path: str,
+                     shuffle_seed: int | None, epoch: int) -> list[str]:
+    """Shard visit order for a given epoch.
+
+    Public hook: callers (or the master's prefetch planner) can compute
+    the *next* epoch's order ahead of time and warm the cache before the
+    current epoch drains.  Same (seed, epoch) always yields the same
+    permutation.
+    """
+    return epoch_shard_order(_list_shards(fs, path), shuffle_seed, epoch)
+
+
 def jax_batches(fs: CurvineFileSystem, path: str, batch: int, seq_len: int,
-                dtype=np.int32, shuffle_seed: int | None = None):
-    """Yield [batch, seq_len] numpy token batches from cached shards."""
+                dtype=np.int32, shuffle_seed: int | None = None,
+                epoch: int = 0):
+    """Yield [batch, seq_len] numpy token batches from cached shards.
+
+    The shard order is a deterministic per-epoch permutation seeded by
+    (shuffle_seed, epoch): re-running the same epoch replays the same
+    order, and the next epoch's order is computable in advance (see
+    ``next_epoch_order``) so prefetch can run ahead of the cursor.
+    """
     dtype = np.dtype(dtype)
-    shards = _list_shards(fs, path)
-    if shuffle_seed is not None:
-        shards = list(np.random.default_rng(shuffle_seed).permutation(shards))
+    shards = epoch_shard_order(_list_shards(fs, path), shuffle_seed, epoch)
     per_batch = batch * seq_len
     carry = np.empty(0, dtype=dtype)
     for shard in shards:

@@ -1841,3 +1841,214 @@ async def run_write_pipeline_storm(seed: int,
                                    **kw) -> WritePipelineStormReport:
     """One-call entry point for the write-pipeline fault storm."""
     return await WritePipelineStorm(seed, **kw).run()
+
+
+# ---------------------------------------------------------------- cache scan
+
+
+@dataclass
+class CacheScanStormReport:
+    """Outcome of a CacheScanStorm run. Headline invariant: a cold
+    backfill scan writing `scan_factor`x the cache's capacity while a
+    hot working set is being read in a loop must NOT flush the hot set —
+    the post-quiesce hot hit rate stays above the floor (S3-FIFO routes
+    one-touch scan blocks through the probationary queue and out)."""
+    seed: int
+    admission: str = "s3fifo"
+    hot_files: int = 0
+    hot_reads_ok: int = 0
+    hot_reads_err: int = 0
+    scan_files: int = 0
+    scan_write_errs: int = 0
+    hot_resident: int = 0
+    hot_hit_rate: float = 0.0
+    hot_floor: float = 0.0
+    integrity_errors: list[str] = field(default_factory=list)
+    cache_stats: dict = field(default_factory=dict)
+    leaked_tasks: list[str] = field(default_factory=list)
+    elapsed_s: float = 0.0
+
+    def assert_invariants(self) -> None:
+        problems = []
+        if self.integrity_errors:
+            problems.append(f"integrity: {self.integrity_errors}")
+        if self.scan_files == 0:
+            problems.append("no scan files were written (harness bug)")
+        if not self.cache_stats.get("evicted"):
+            problems.append("scan never pressured the cache "
+                            "(no evictions — harness bug)")
+        if self.hot_hit_rate < self.hot_floor:
+            problems.append(
+                f"hot set flushed by the scan: post-quiesce hit rate "
+                f"{self.hot_hit_rate:.2f} < floor {self.hot_floor:.2f} "
+                f"({self.hot_resident}/{self.hot_files} resident, "
+                f"admission={self.admission})")
+        if self.leaked_tasks:
+            problems.append(f"leaked asyncio tasks: {self.leaked_tasks}")
+        assert not problems, (
+            f"cache-scan storm seed={self.seed} invariants violated: "
+            + "; ".join(problems) + f" (stats={self.cache_stats})")
+
+
+class CacheScanStorm:
+    """Seeded scan-resistance storm: a hot working set (sized well under
+    the MEM tier) is read in a loop by concurrent readers while a
+    backfill task streams `scan_factor`x the tier's capacity of
+    one-touch files through the same tier. Eviction pressure is real —
+    the tier is a single MEM dir with no slower tier, so every eviction
+    is a drop and an evicted hot file becomes unreadable. After the
+    scan drains and the readers quiesce, each hot file is read once
+    more: the fraction that still serves (checksum-clean) is the hot
+    hit rate the report gates on."""
+
+    def __init__(self, seed: int, hot_files: int = 16,
+                 file_size: int = 128 * 1024,
+                 tier_capacity: int = 8 * MB, scan_factor: float = 2.0,
+                 reader_tasks: int = 2, hot_floor: float = 0.6,
+                 admission: str = "s3fifo",
+                 base_dir: str | None = None,
+                 overall_timeout_s: float = 90.0):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.hot_files = hot_files
+        self.file_size = file_size
+        self.tier_capacity = tier_capacity
+        self.n_scan = int(tier_capacity * scan_factor) // file_size
+        self.reader_tasks = reader_tasks
+        self.admission = admission
+        self.base_dir = base_dir
+        self.overall_timeout_s = overall_timeout_s
+        self.report = CacheScanStormReport(
+            seed=seed, admission=admission, hot_files=hot_files,
+            hot_floor=hot_floor)
+        self._stop = False
+
+    def _hot_path(self, i: int) -> str:
+        return f"/cachestorm/hot/h{i:03d}"
+
+    async def _read_hot(self, c, i: int) -> bool:
+        path = self._hot_path(i)
+        r = await c.open(path)
+        try:
+            data = await r.read_all()
+        finally:
+            await r.close()
+        return data == storm_bytes(self.seed, f"hot{i}", self.file_size)
+
+    async def _reader(self, mc: MiniCluster, rid: int) -> None:
+        c = mc.client()
+        rng = random.Random(self.seed * 7919 + rid)
+        while not self._stop:
+            order = list(range(self.hot_files))
+            rng.shuffle(order)
+            for i in order:
+                if self._stop:
+                    return
+                try:
+                    if await self._read_hot(c, i):
+                        self.report.hot_reads_ok += 1
+                    else:
+                        self.report.integrity_errors.append(
+                            f"mid-storm hot read h{i} returned bad bytes")
+                except _EXPECTED:
+                    # an evicted hot file reads as an error: counted, the
+                    # post-quiesce floor decides if it was too many
+                    self.report.hot_reads_err += 1
+                await asyncio.sleep(0)
+
+    async def _scanner(self, mc: MiniCluster) -> None:
+        c = mc.client()
+        for k in range(self.n_scan):
+            if self._stop:
+                return
+            data = storm_bytes(self.seed, f"scan{k}", self.file_size)
+            try:
+                await c.write_all(f"/cachestorm/scan/s{k:04d}", data)
+                self.report.scan_files += 1
+            except _EXPECTED as e:
+                self.report.scan_write_errs += 1
+                log.debug("cachestorm scan write %d failed: %s", k, e)
+            # a breath between backfill files so reader sweeps interleave
+            # (the deterministic part is the policy, not the schedule)
+            await asyncio.sleep(0.002)
+
+    async def run(self) -> CacheScanStormReport:
+        t_start = time.monotonic()
+        baseline = {t for t in asyncio.all_tasks() if not t.done()}
+        mc = MiniCluster(workers=1, base_dir=self.base_dir,
+                         tier_capacity=self.tier_capacity,
+                         block_size=max(self.file_size, 256 * 1024))
+        mc.conf.worker.cache_admission = self.admission
+        mc.conf.client.replicas = 1
+        await mc.start()
+        readers: list[asyncio.Task] = []
+        try:
+            try:
+                await asyncio.wait_for(self._drive(mc, readers),
+                                       self.overall_timeout_s)
+            except asyncio.TimeoutError:
+                raise AssertionError(
+                    f"cache-scan storm seed={self.seed} WEDGED: exceeded "
+                    f"its {self.overall_timeout_s:.0f}s budget; task "
+                    "stacks:\n" + _dump_task_stacks()) from None
+        finally:
+            self._stop = True
+            for t in readers:
+                t.cancel()
+            try:
+                await asyncio.wait_for(mc.stop(), 30.0)
+            except asyncio.TimeoutError:
+                raise AssertionError(
+                    f"cache-scan storm seed={self.seed}: cluster stop "
+                    "WEDGED; task stacks:\n"
+                    + _dump_task_stacks()) from None
+
+        for _ in range(10):
+            leaked = [t for t in asyncio.all_tasks()
+                      if not t.done() and t not in baseline
+                      and t is not asyncio.current_task()]
+            if not leaked:
+                break
+            await asyncio.sleep(0.05)
+        self.report.leaked_tasks = [repr(t) for t in leaked]
+        self.report.elapsed_s = time.monotonic() - t_start
+        return self.report
+
+    async def _drive(self, mc: MiniCluster, readers: list) -> None:
+        c = mc.client()
+        # seed the hot working set, then touch it so the admission
+        # policy sees it as multi-touch before the scan starts
+        for i in range(self.hot_files):
+            await c.write_all(self._hot_path(i),
+                              storm_bytes(self.seed, f"hot{i}",
+                                          self.file_size))
+        for i in range(self.hot_files):
+            await self._read_hot(c, i)
+
+        readers += [asyncio.ensure_future(self._reader(mc, r))
+                    for r in range(self.reader_tasks)]
+        await self._scanner(mc)
+        self._stop = True
+        await asyncio.gather(*readers, return_exceptions=False)
+        del readers[:]
+
+        # ---- post-quiesce: what survived the scan? ----
+        resident = 0
+        for i in range(self.hot_files):
+            try:
+                if await self._read_hot(c, i):
+                    resident += 1
+                else:
+                    self.report.integrity_errors.append(
+                        f"post-quiesce hot read h{i} returned bad bytes")
+            except _EXPECTED:
+                pass                    # evicted: a miss, not corruption
+        self.report.hot_resident = resident
+        self.report.hot_hit_rate = resident / max(1, self.hot_files)
+        self.report.cache_stats = \
+            mc.workers[0].store.cache_stats().get("total", {})
+
+
+async def run_cache_scan_storm(seed: int, **kw) -> CacheScanStormReport:
+    """One-call entry point for the cache scan-resistance storm."""
+    return await CacheScanStorm(seed, **kw).run()

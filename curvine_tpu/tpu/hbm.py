@@ -18,7 +18,9 @@ log = logging.getLogger(__name__)
 
 
 class HbmTier:
-    def __init__(self, capacity_bytes: int, device=None):
+    def __init__(self, capacity_bytes: int, device=None,
+                 admission: str = "lru", ghost_entries: int = 2048):
+        from curvine_tpu.common.cache import make_policy
         self.capacity = capacity_bytes
         self.device = device if device is not None else jax.devices()[0]
         self.used = 0
@@ -27,6 +29,10 @@ class HbmTier:
         self.hits = 0
         self.misses = 0
         self.spills = 0
+        # ghost-cache admission (common/cache.py): HBM is the scarcest
+        # tier of all — an autopin sweep over a cold scan must not spill
+        # the hot training blocks, so s3fifo protection applies here too
+        self.policy = make_policy(admission, ghost_entries=ghost_entries)
 
     def __contains__(self, block_id: int) -> bool:
         return block_id in self._blocks
@@ -37,6 +43,7 @@ class HbmTier:
         to device_put."""
         if block_id in self._blocks:
             self._atime[block_id] = time.monotonic()
+            self.policy.on_access(block_id)
             return self._blocks[block_id]
         arr = np.frombuffer(data, dtype=np.uint8) if isinstance(
             data, (bytes, bytearray, memoryview)) else data
@@ -48,35 +55,45 @@ class HbmTier:
         self._blocks[block_id] = dev_arr
         self._atime[block_id] = time.monotonic()
         self.used += need
+        self.policy.on_admit(block_id, need)
         return dev_arr
 
     def get(self, block_id: int) -> jax.Array | None:
         arr = self._blocks.get(block_id)
         if arr is None:
             self.misses += 1
+            self.policy.misses += 1
             return None
         self.hits += 1
+        self.policy.hits += 1
         self._atime[block_id] = time.monotonic()
+        self.policy.on_access(block_id)
         return arr
 
-    def drop(self, block_id: int) -> None:
+    def drop(self, block_id: int, evicted: bool = False) -> None:
         arr = self._blocks.pop(block_id, None)
         self._atime.pop(block_id, None)
         if arr is not None:
+            self.policy.on_remove(block_id, evicted=evicted)
             self.used -= arr.nbytes
             arr.delete()
 
     def _evict_for(self, need: int) -> None:
         while self.used + need > self.capacity and self._blocks:
-            victim = min(self._atime, key=self._atime.get)
+            order = self.policy.victim_order(list(self._atime.items()))
+            victim = order[0] if order else min(self._atime,
+                                                key=self._atime.get)
             log.debug("hbm tier evicting block %d", victim)
             self.spills += 1
-            self.drop(victim)
+            self.drop(victim, evicted=True)
 
     def stats(self) -> dict:
+        ps = self.policy.stats()
         return {"capacity": self.capacity, "used": self.used,
                 "blocks": len(self._blocks), "hits": self.hits,
-                "misses": self.misses, "spills": self.spills}
+                "misses": self.misses, "spills": self.spills,
+                "ghost_hits": ps.get("ghost_hits", 0),
+                "scan_evicted": ps.get("scan_evicted", 0)}
 
 
 class MultiHbmTier:
@@ -89,7 +106,8 @@ class MultiHbmTier:
     This is the multi-chip completion of the round-2 single-device tier
     (which bound jax.devices()[0] only)."""
 
-    def __init__(self, capacity_bytes: int, devices=None):
+    def __init__(self, capacity_bytes: int, devices=None,
+                 admission: str = "lru", ghost_entries: int = 2048):
         """``capacity_bytes`` is the TOTAL HBM budget for the tier (the
         operator's `worker.hbm_capacity`), split evenly across the local
         chips — same semantics as the round-2 single-device tier, so the
@@ -98,7 +116,9 @@ class MultiHbmTier:
         if not devices:
             raise ValueError("no local devices for the HBM tier")
         per_chip = max(1, capacity_bytes // len(devices))
-        self.tiers: dict = {d.id: HbmTier(per_chip, device=d)
+        self.tiers: dict = {d.id: HbmTier(per_chip, device=d,
+                                          admission=admission,
+                                          ghost_entries=ghost_entries)
                             for d in devices}
         self.devices = list(devices)
 
@@ -186,7 +206,11 @@ class MultiHbmTier:
                               for b in t._blocks}),
                "hits": sum(t.hits for t in self.tiers.values()),
                "misses": sum(t.misses for t in self.tiers.values()),
-               "spills": sum(t.spills for t in self.tiers.values())}
+               "spills": sum(t.spills for t in self.tiers.values()),
+               "ghost_hits": sum(t.policy.ghost_hits
+                                 for t in self.tiers.values()),
+               "scan_evicted": sum(t.policy.scan_evicted
+                                   for t in self.tiers.values())}
         agg["per_device"] = self.per_device_stats()
         return agg
 
@@ -199,6 +223,8 @@ def export_metrics(tier, registry, prefix: str = "hbm") -> None:
     registry.gauge(f"{prefix}.hits", st.get("hits", 0))
     registry.gauge(f"{prefix}.misses", st.get("misses", 0))
     registry.gauge(f"{prefix}.spills", st.get("spills", 0))
+    registry.gauge(f"{prefix}.ghost_hits", st.get("ghost_hits", 0))
+    registry.gauge(f"{prefix}.scan_evicted", st.get("scan_evicted", 0))
     registry.gauge(f"{prefix}.used", st["used"])
     registry.gauge(f"{prefix}.capacity", st["capacity"])
     registry.gauge(f"{prefix}.occupancy",

@@ -16,16 +16,24 @@ from typing import AsyncIterator
 import numpy as np
 
 from curvine_tpu.client import CurvineClient
+from curvine_tpu.common.epoch import epoch_shard_order
 
 log = logging.getLogger(__name__)
 
 
 class CacheShardSource:
-    """Async stream of [batch, seq_len] token batches out of cached shards."""
+    """Async stream of [batch, seq_len] token batches out of cached shards.
+
+    Shard order is a deterministic per-epoch permutation of the sorted
+    listing, seeded by (shuffle_seed, epoch).  With ``prefetch=True`` the
+    source advises the master's rolling prefetch-window job as the read
+    cursor advances, and pre-advises epoch+1's window near the tail of
+    each epoch so the epoch boundary lands on a warm cache."""
 
     def __init__(self, client: CurvineClient, path: str, batch: int,
                  seq_len: int, dtype=np.int32, shuffle_seed: int | None = None,
-                 drop_remainder: bool = True, profiler=None):
+                 drop_remainder: bool = True, profiler=None, epoch: int = 0,
+                 prefetch: bool = False, prefetch_window: int = 8):
         self.client = client
         self.path = path
         self.batch = batch
@@ -36,20 +44,57 @@ class CacheShardSource:
         # optional StepProfiler (obs/profiler.py): cache_fetch + decode
         # stage timings per shard
         self.profiler = profiler
+        self.epoch = int(epoch)
+        self.prefetch = prefetch
+        self.prefetch_window = int(prefetch_window)
+        self._advise_tasks: set = set()
 
-    async def shards(self) -> list[str]:
+    async def shards(self, epoch: int | None = None) -> list[str]:
         statuses = await self.client.meta.list_status(self.path)
         files = sorted(s.path for s in statuses if not s.is_dir)
-        if self.shuffle_seed is not None:
-            rng = np.random.default_rng(self.shuffle_seed)
-            files = list(rng.permutation(files))
-        return files
+        return epoch_shard_order(files, self.shuffle_seed,
+                                 self.epoch if epoch is None else epoch)
+
+    async def next_epoch_order(self) -> list[str]:
+        """Shard order the NEXT epoch will use — public hook so callers
+        can warm it (or inspect it) before the current epoch drains."""
+        return await self.shards(epoch=self.epoch + 1)
+
+    async def _advise(self, cursor: int, epoch: int | None = None) -> None:
+        try:
+            await self.client.advise(
+                self.path, cursor=cursor, window=self.prefetch_window,
+                epoch=self.epoch if epoch is None else epoch,
+                seed=self.shuffle_seed or 0)
+        except Exception as e:           # advisory: never fail the read path
+            log.debug("prefetch advise failed: %s", e)
+
+    def _advise_bg(self, cursor: int, epoch: int | None = None) -> None:
+        """Fire-and-forget advise: the window RPC must never sit in the
+        read path's latency (it is advisory — input_wait is the number
+        this plane exists to shrink)."""
+        if not self.prefetch:
+            return
+        import asyncio
+        t = asyncio.ensure_future(self._advise(cursor, epoch))
+        self._advise_tasks.add(t)
+        t.add_done_callback(self._advise_tasks.discard)
 
     async def batches(self) -> AsyncIterator[np.ndarray]:
         import time as _time
         tokens_per_batch = self.batch * self.seq_len
         carry = np.empty(0, dtype=self.dtype)
-        for shard in await self.shards():
+        order = await self.shards()
+        self._advise_bg(0)
+        advised_next_epoch = False
+        for idx, shard in enumerate(order):
+            if idx:
+                self._advise_bg(idx)
+            if not advised_next_epoch \
+                    and idx >= len(order) - self.prefetch_window:
+                # tail of the epoch: start warming epoch+1's head
+                self._advise_bg(0, epoch=self.epoch + 1)
+                advised_next_epoch = True
             t0 = _time.perf_counter()
             reader = await self.client.open(shard)
             n_tokens = reader.len // self.dtype.itemsize
@@ -77,6 +122,12 @@ class CacheShardSource:
             if rest.size:
                 carry = rest.copy()     # own it before the mmap closes
             await reader.close()
+        if self._advise_tasks:
+            import asyncio
+            await asyncio.gather(*list(self._advise_tasks),
+                                 return_exceptions=True)
+        # epoch drained: subsequent batches() calls replay the next epoch
+        self.epoch += 1
         if carry.size and not self.drop_remainder:
             pad = tokens_per_batch - carry.size
             yield np.pad(carry, (0, pad)).reshape(self.batch, self.seq_len)
@@ -121,7 +172,8 @@ class TpuTrainFeed:
 
     def __init__(self, client: CurvineClient, path: str, batch: int,
                  seq_len: int, mesh=None, depth: int = 2, dtype=np.int32,
-                 profiler=None):
+                 profiler=None, shuffle_seed: int | None = None,
+                 prefetch: bool = False, prefetch_window: int = 8):
         from jax.sharding import PartitionSpec as P
         from curvine_tpu.obs.profiler import StepProfiler
         from curvine_tpu.tpu.ingest import AsyncDevicePrefetcher
@@ -131,7 +183,10 @@ class TpuTrainFeed:
         # answers "where did the step go".
         self.profiler = profiler if profiler is not None else StepProfiler()
         self.source = CacheShardSource(client, path, batch, seq_len, dtype,
-                                       profiler=self.profiler)
+                                       shuffle_seed=shuffle_seed,
+                                       profiler=self.profiler,
+                                       prefetch=prefetch,
+                                       prefetch_window=prefetch_window)
         spec = None
         if mesh is not None:
             seq = "seq" if "seq" in mesh.axis_names else None

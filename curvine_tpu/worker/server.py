@@ -33,6 +33,17 @@ _TIER_NAMES = {"hbm": StorageType.HBM, "mem": StorageType.MEM,
                "ssd": StorageType.SSD, "hdd": StorageType.HDD}
 
 
+def _tenant_of(msg) -> str:
+    """Writer's tenant id off the RPC header (qos front-door rail) —
+    stamped onto the block for the tier-0 cache partitions; "" for
+    cluster-internal traffic that carries no tenant."""
+    from curvine_tpu.common.qos import TENANT_KEY
+    try:
+        return str(msg.header.get(TENANT_KEY) or "")
+    except AttributeError:
+        return ""
+
+
 def worker_id_for(hostname: str, port: int) -> int:
     return zlib.crc32(f"{hostname}:{port}".encode()) & 0x7FFFFFFF
 
@@ -122,7 +133,10 @@ class WorkerServer:
                     tier.lease_slack_s,
                     self.conf.client.rpc_timeout_ms / 1000.0)
         self.store = BlockStore(tiers, wc.eviction_high_water,
-                                wc.eviction_low_water)
+                                wc.eviction_low_water,
+                                admission=wc.cache_admission,
+                                ghost_entries=wc.cache_ghost_entries,
+                                small_ratio=wc.cache_small_ratio)
         # shared-memory read plane (worker/shm.py): sealed-memfd export
         # cache + SCM_RIGHTS side channel for co-located clients. The
         # channel itself starts in start() (port must be final); deleted
@@ -157,6 +171,10 @@ class WorkerServer:
             self.conf.qos, slow_op_ms=self.conf.obs.slow_op_ms,
             metrics=self.metrics)
         self.rpc.qos = self.qos
+        # per-job cache partitions (docs/caching.md): eviction prefers
+        # blocks of tenants over their tier-0 byte quota (from the same
+        # "name:qps[:prio[:inflight[:tier0_mb]]]" tenant specs)
+        self.store.tier0_quota = self.qos.tier0_quota
         if self.io_engine is not None:
             self.io_engine.metrics = self.metrics
         self.master_pool = ConnectionPool(size=2, rpc_conf=self.conf.rpc)
@@ -171,7 +189,9 @@ class WorkerServer:
                 # one tier per local chip (a TPU host drives 4-8): per-chip
                 # capacity accounting, least-used placement, replica spread
                 from curvine_tpu.tpu.hbm import MultiHbmTier
-                self.hbm = MultiHbmTier(wc.hbm_capacity)
+                self.hbm = MultiHbmTier(wc.hbm_capacity,
+                                        admission=wc.cache_admission,
+                                        ghost_entries=wc.cache_ghost_entries)
             except Exception as e:  # noqa: BLE001 — no device available
                 log.warning("hbm tier disabled: %s", e)
         self._bg: list[asyncio.Task] = []
@@ -326,6 +346,27 @@ class WorkerServer:
                           last_heartbeat_ms=now_ms(),
                           ici_coords=list(self.conf.worker.ici_coords))
 
+    def _cache_metrics(self) -> dict[str, float]:
+        """Flattened cache.<tier>.<stat> counters: per-storage-type
+        admission policy stats (summed over dirs), the HBM tier, and
+        per-tenant tier-0 occupancy as cache.tier0.<tenant>."""
+        out: dict[str, float] = {}
+        for t in self.store.tiers:
+            pre = f"cache.{t.storage_type.name.lower()}."
+            for k, v in t.policy.stats().items():
+                if k in ("small", "main", "ghost"):
+                    continue
+                out[pre + k] = out.get(pre + k, 0) + v
+        out["cache.store.misses"] = self.store.miss_total
+        if self.hbm is not None:
+            st = self.hbm.stats()
+            for k in ("hits", "misses", "spills", "ghost_hits",
+                      "scan_evicted"):
+                out[f"cache.hbm.{k}"] = st.get(k, 0)
+        for tenant, used in self.store.tenant_occupancy().items():
+            out[f"cache.tier0.{tenant}"] = used
+        return out
+
     async def heartbeat_once(self) -> None:
         """Heartbeat EVERY master: followers serve reads and need live
         worker state + replica locations too (runtime locs never ride the
@@ -340,11 +381,19 @@ class WorkerServer:
         if self.hbm is not None:
             from curvine_tpu.tpu.hbm import export_metrics
             export_metrics(self.hbm, self.metrics)
-        body = {"info": self._info().to_wire(),
-                "metrics": {
+        wm = {
             "bytes.read": self.metrics.counters.get("bytes.read", 0),
             "bytes.written": self.metrics.counters.get("bytes.written", 0),
-        }}
+        }
+        # cache-intelligence counters (docs/caching.md): flattened
+        # per-tier admission stats + per-tenant tier-0 occupancy; the
+        # master folds them into the `cv report` Cache plane rollup and
+        # they double as local /metrics gauges
+        cm = self._cache_metrics()
+        wm.update(cm)
+        for name, v in cm.items():
+            self.metrics.gauge(name, v)
+        body = {"info": self._info().to_wire(), "metrics": wm}
         # quarantined dirs: advertise (a bounded batch of) their resident
         # committed blocks so the master drives evacuation through the
         # replication manager — re-sent every beat until evacuated, so a
@@ -679,7 +728,8 @@ class WorkerServer:
         # span covers the whole stream: request frame → EOF commit/error
         wspan = self.tracer.span("write_block_stream", parent=msg.trace,
                                  attrs={"block_id": block_id})
-        info = self.store.create_temp(block_id, hint, q.get("len_hint", 0))
+        info = self.store.create_temp(block_id, hint, q.get("len_hint", 0),
+                                      tenant=_tenant_of(msg))
         hook = self.store.fault_hook
         if hook is not None:
             try:
@@ -822,7 +872,7 @@ class WorkerServer:
         info = self.store.create_temp(
             q["block_id"], StorageType(q.get("storage_type",
                                              int(StorageType.MEM))),
-            q.get("len_hint", 0))
+            q.get("len_hint", 0), tenant=_tenant_of(msg))
         if info.is_extent:
             # the sc client opens the path with O_TRUNC — fatal on a
             # shared bdev file; stream over the socket instead
@@ -997,7 +1047,7 @@ class WorkerServer:
             info = self.store.create_temp(
                 b["block_id"], StorageType(b.get("storage_type",
                                                  int(StorageType.MEM))),
-                len(data))
+                len(data), tenant=_tenant_of(msg))
             try:
                 await asyncio.to_thread(_write_block_bytes, info, data)
                 # sender-computed checksum (EC cell placement and other
@@ -1267,6 +1317,8 @@ class WorkerServer:
             try:
                 if task.kind == "export":
                     n = await client.export_to_ufs(task.path)
+                elif task.kind == "prefetch":
+                    n = await client.prefetch(task.path)
                 else:
                     n = await client.load_from_ufs(task.path)
                 task.state = JobState.COMPLETED

@@ -38,6 +38,11 @@ class BlockInfo:
     alloc_len: int = 0
     heat: int = 0                 # reads since the last promotion scan
     verified_at: float = 0.0      # last successful scrub pass (0 = never)
+    # writer's tenant id (qos TENANT_KEY off the RPC header): feeds the
+    # per-tenant tier-0 occupancy gauges and the over-quota-first
+    # eviction preference; "" for cluster-internal writes (replication,
+    # EC cells, tier moves)
+    tenant: str = ""
 
     @property
     def is_extent(self) -> bool:
@@ -147,6 +152,10 @@ class TierDir:
         self.used = 0
         self.dir_id = dir_id or f"{storage_type.name.lower()}:{root}"
         self.health = DiskHealth()
+        # admission policy (common/cache.py); BlockStore.__init__
+        # replaces this per the configured worker.cache_admission
+        from curvine_tpu.common.cache import LruPolicy
+        self.policy = LruPolicy()
         os.makedirs(root, exist_ok=True)
 
     def block_path(self, block_id: int, suffix: str = ".blk") -> str:
@@ -215,6 +224,8 @@ class BdevTier(TierDir):
         self.used = 0
         self.dir_id = dir_id or f"bdev:{path}"
         self.health = DiskHealth()
+        from curvine_tpu.common.cache import LruPolicy
+        self.policy = LruPolicy()
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         if not os.path.exists(path):
             with open(path, "wb") as f:
@@ -426,10 +437,27 @@ class BlockStore:
     worker threads)."""
 
     def __init__(self, tiers: list[TierDir], high_water: float = 0.95,
-                 low_water: float = 0.80):
+                 low_water: float = 0.80, admission: str = "lru",
+                 ghost_entries: int = 8192, small_ratio: float = 0.1):
         if not tiers:
             raise err.InvalidArgument("worker needs at least one tier")
         self.tiers = sorted(tiers, key=lambda t: int(t.storage_type))
+        # per-tier-dir admission policy (common/cache.py): ghost-cache
+        # scan resistance applies to the MEM-and-faster tiers (the ones
+        # a backfill scan can flush); capacity tiers keep plain LRU —
+        # their victims demote/drop by age and scans pass through anyway
+        from curvine_tpu.common.cache import make_policy
+        self.admission = admission
+        for t in self.tiers:
+            kind = admission if int(t.storage_type) <= int(StorageType.MEM) \
+                else "lru"
+            t.policy = make_policy(kind, ghost_entries=ghost_entries,
+                                   small_ratio=small_ratio)
+        # tier-0 byte quota per tenant (worker/server.py wires this to
+        # the qos plane's tenant specs): callable tenant -> bytes|None.
+        # None (no hook / no quota) keeps eviction order byte-identical.
+        self.tier0_quota = None
+        self.miss_total = 0           # lookups of blocks we don't hold
         self.blocks: dict[int, BlockInfo] = {}
         self.high_water = high_water
         self.low_water = low_water
@@ -547,7 +575,7 @@ class BlockStore:
             f"need {size_hint}B, all tiers tried after eviction: {tried}")
 
     def create_temp(self, block_id: int, hint: StorageType | None = None,
-                    size_hint: int = 0) -> BlockInfo:
+                    size_hint: int = 0, tenant: str = "") -> BlockInfo:
         with self._lock:
             if block_id in self._moving:
                 # a tier move holds this id's paths/extents; a new
@@ -561,7 +589,7 @@ class BlockStore:
                     raise err.FileAlreadyExists(f"block {block_id} committed")
                 self._remove_locked(old)
             tier = self.pick_tier(hint, size_hint)
-            info = BlockInfo(block_id=block_id, tier=tier)
+            info = BlockInfo(block_id=block_id, tier=tier, tenant=tenant)
             if isinstance(tier, BdevTier):
                 # extents are fixed at allocation: the client's len_hint
                 # (block_size) bounds the block
@@ -602,6 +630,7 @@ class BlockStore:
         with self._lock:
             info.crc32c = checksum
             info.crc_algo = checksum_algo
+            info.tier.policy.on_admit(block_id, length)
             if info.is_extent:
                 # ONE index write per commit, under the lock (save_index
                 # iterates self.blocks, which eviction mutates under it)
@@ -732,6 +761,8 @@ class BlockStore:
             if touch:
                 info.atime = time.time()
                 info.heat += 1
+                info.tier.policy.hits += 1
+                info.tier.policy.on_access(block_id)
             return info
 
     def touch_reads(self, block_id: int, reads: int) -> None:
@@ -745,6 +776,8 @@ class BlockStore:
             if info is not None and reads > 0:
                 info.atime = time.time()
                 info.heat += reads
+                info.tier.policy.hits += reads
+                info.tier.policy.on_access(block_id)
 
     def pin_read(self, block_id: int, touch: bool = True) -> BlockInfo:
         """Atomically look up a block and take a read pin on it; pair
@@ -756,6 +789,8 @@ class BlockStore:
             if touch:
                 info.atime = time.time()
                 info.heat += 1
+                info.tier.policy.hits += 1
+                info.tier.policy.on_access(block_id)
             self._read_pins[block_id] = self._read_pins.get(block_id, 0) + 1
             return info
 
@@ -778,6 +813,8 @@ class BlockStore:
             info = self._get_locked(block_id)
             info.atime = time.time()
             info.heat += 1
+            info.tier.policy.hits += 1
+            info.tier.policy.on_access(block_id)
             lease_ms = 0
             if isinstance(info.tier, BdevTier) \
                     and info.tier.quarantine_s > 0:
@@ -804,7 +841,11 @@ class BlockStore:
             if info is not None:
                 self._remove_locked(info)
 
-    def _remove_locked(self, info: BlockInfo) -> None:
+    def _remove_locked(self, info: BlockInfo, evicted: bool = False) -> None:
+        # `evicted` = removal under cache pressure (trim/evict): the id
+        # enters the policy's ghost queue so a near-future re-admission
+        # skips probation. Plain deletes/overwrites never ghost.
+        info.tier.policy.on_remove(info.block_id, evicted=evicted)
         if self.on_delete is not None:
             try:
                 self.on_delete(info.block_id)
@@ -843,6 +884,7 @@ class BlockStore:
     def _get_locked(self, block_id: int) -> BlockInfo:
         info = self.blocks.get(block_id)
         if info is None:
+            self.miss_total += 1
             raise err.BlockNotFound(f"block {block_id}")
         return info
 
@@ -991,7 +1033,13 @@ class BlockStore:
                 if src_tier.io_engine is not None:
                     src_tier.io_engine.forget(src_path)
                 src_tier.used -= length
-            # dest accounting already reserved; just swap the entry
+            # dest accounting already reserved; just swap the entry.
+            # Policy handoff: a demotion is an eviction from the fast
+            # tier's viewpoint (ghost-eligible — a re-heated block skips
+            # probation on its way back up); a promotion is not.
+            demoting = int(dest.storage_type) > int(src_tier.storage_type)
+            src_tier.policy.on_remove(block_id, evicted=demoting)
+            dest.policy.on_admit(block_id, length)
             info.tier, info.offset, info.alloc_len = dest, new_off, new_alloc
             if was_extent:
                 src_tier.save_index(self.blocks)
@@ -1009,20 +1057,24 @@ class BlockStore:
         self._reclaim_locked()
         target_free = max(need, int(tier.capacity * (1 - self.low_water)))
         now = time.time()
-        victims = sorted(
-            (b for b in self.blocks.values()
-             if b.tier is tier and b.state == BlockState.COMMITTED
-             and b.block_id not in self._moving
-             # never evict a block with an active reader, and skip
-             # leased bdev extents entirely: their free lands in
-             # quarantine, so dropping destroys data without making
-             # room and demoting burns copy IO for zero freed bytes —
-             # the lease lapses within lease_s + lease_slack_s and the
-             # next scan takes them
-             and not self._read_pins.get(b.block_id)
-             and not (isinstance(tier, BdevTier)
-                      and tier.free_would_quarantine(b.block_id, now))),
-            key=lambda b: b.atime)
+        eligible = [
+            b for b in self.blocks.values()
+            if b.tier is tier and b.state == BlockState.COMMITTED
+            and b.block_id not in self._moving
+            # never evict a block with an active reader, and skip
+            # leased bdev extents entirely: their free lands in
+            # quarantine, so dropping destroys data without making
+            # room and demoting burns copy IO for zero freed bytes —
+            # the lease lapses within lease_s + lease_slack_s and the
+            # next scan takes them
+            and not self._read_pins.get(b.block_id)
+            and not (isinstance(tier, BdevTier)
+                     and tier.free_would_quarantine(b.block_id, now))]
+        order = tier.policy.victim_order(
+            [(b.block_id, b.atime) for b in eligible])
+        by_id = {b.block_id: b for b in eligible}
+        victims = [by_id[k] for k in order if k in by_id]
+        victims = self._quota_first(tier, victims)
         plan: list[tuple[int, TierDir | None]] = []
         freed = tier.available
         for b in victims:
@@ -1032,6 +1084,58 @@ class BlockStore:
             plan.append((b.block_id, dest))
             freed += b.len if not isinstance(tier, BdevTier) else b.alloc_len
         return plan, target_free, freed
+
+    def _quota_first(self, tier: TierDir, victims: list) -> list:
+        """Per-job cache partitions: on tier-0 (MEM and faster), blocks
+        of tenants over their tier-0 byte quota are evicted before
+        anyone else's — a bulk export that blew past its partition pays
+        for the pressure it created, in policy order within each group.
+        No quota hook / nobody over quota → order untouched."""
+        if self.tier0_quota is None \
+                or int(tier.storage_type) > int(StorageType.MEM):
+            return victims
+        occ = self._tenant_occupancy_locked()
+        over = set()
+        for tenant, used in occ.items():
+            q = self.tier0_quota(tenant)
+            if q is not None and q > 0 and used > q:
+                over.add(tenant)
+        if not over:
+            return victims
+        return ([b for b in victims if b.tenant in over]
+                + [b for b in victims if b.tenant not in over])
+
+    def _tenant_occupancy_locked(self) -> dict[str, int]:
+        occ: dict[str, int] = {}
+        for b in self.blocks.values():
+            if b.state == BlockState.COMMITTED \
+                    and int(b.tier.storage_type) <= int(StorageType.MEM):
+                occ[b.tenant or "default"] = \
+                    occ.get(b.tenant or "default", 0) + b.len
+        return occ
+
+    def tenant_occupancy(self) -> dict[str, int]:
+        """Committed tier-0 (MEM and faster) bytes per tenant — the
+        per-tenant occupancy gauges behind the cache partitions."""
+        with self._lock:
+            return self._tenant_occupancy_locked()
+
+    def cache_stats(self) -> dict[str, dict[str, int]]:
+        """Per-tier-dir admission/hit counters plus a store-wide rollup
+        (the worker heartbeats the rollup; `cv report` prints it)."""
+        with self._lock:
+            out: dict[str, dict[str, int]] = {}
+            total: dict[str, int] = {}
+            for t in self.tiers:
+                s = t.policy.stats()
+                out[t.dir_id] = s
+                for k, v in s.items():
+                    if k in ("small", "main", "ghost"):
+                        continue
+                    total[k] = total.get(k, 0) + v
+            total["misses"] = total.get("misses", 0) + self.miss_total
+            out["total"] = total
+            return out
 
     def _slower_tier_for(self, tier: TierDir, size: int) -> TierDir | None:
         """Next tier strictly slower than `tier` with room for `size`.
@@ -1062,7 +1166,7 @@ class BlockStore:
             info = self.blocks.get(bid)
             if info is None:
                 continue
-            self._remove_locked(info)
+            self._remove_locked(info, evicted=True)
             evicted.append(bid)
             self.dropped_total += 1
         if evicted:
@@ -1123,7 +1227,7 @@ class BlockStore:
                         # same futile-drop guard as the planner: a leased
                         # extent's free lands in quarantine — destroying
                         # data without making room
-                        self._remove_locked(info)
+                        self._remove_locked(info, evicted=True)
                         removed.append(bid)
                         self.dropped_total += 1
                         progress = True

@@ -17,6 +17,7 @@
 #   BENCH_SHM=0 skips the shared-memory read-plane gate.
 #   BENCH_LADDER=0 skips the open-loop concurrency-rung gate.
 #   BENCH_EC=0 skips the erasure-coding gate.
+#   BENCH_CACHE=0 skips the cache-plane (scan resistance + prefetch) gate.
 # Exit: 0 = at/above the regression gates, 1 = regression, 2 = harness error.
 
 set -u
@@ -381,6 +382,59 @@ if errs != 0:
 if p99 > ceiling:
     print(f"perf_smoke: FAIL — ladder_p99_us {p99} > {ceiling} "
           "(open-loop tail collapsed under the 64-client rung)",
+          file=sys.stderr)
+    sys.exit(1)
+print("perf_smoke: PASS")
+EOF
+    rc=$?
+    [ $rc -ne 0 ] && exit $rc
+fi
+
+if [ "${BENCH_CACHE:-1}" = "0" ]; then
+    echo "perf_smoke: cache-plane gate skipped (BENCH_CACHE=0)"
+else
+    # cache-plane gate (docs/caching.md): the admission A/B must keep
+    # s3fifo's hot-set hit pct >= scan_resist_ratio_min x the LRU
+    # fallback under a one-touch scan, and the steady-state input_wait
+    # fraction across an epoch boundary with prefetch advising must
+    # stay under the input_wait_frac_max ceiling — both absolute.
+    CACHE_OUT=$(JAX_PLATFORMS=cpu timeout 180 python - <<'EOF'
+import asyncio, json, os, sys
+sys.path.insert(0, os.getcwd())
+from bench import _cache_scan_bench, _prefetch_epoch_bench
+out = _cache_scan_bench()
+out.update(asyncio.run(_prefetch_epoch_bench()))
+print(json.dumps(out))
+EOF
+)
+    rc=$?
+    if [ $rc -ne 0 ] || [ -z "$CACHE_OUT" ]; then
+        echo "perf_smoke: cache-plane microbench failed (rc=$rc)" >&2
+        exit 2
+    fi
+    echo "$CACHE_OUT"
+    python - "$FLOOR_FILE" <<'EOF' "$CACHE_OUT"
+import json, sys
+floor_file, result = sys.argv[1], json.loads(sys.argv[2])
+floors = json.load(open(floor_file))
+ratio_floor = floors["scan_resist_ratio_min"]
+wait_ceiling = floors["input_wait_frac_max"]
+ratio = result.get("scan_resist_ratio", 0.0)
+wait = result.get("input_wait_frac", 1.0)
+print(f"perf_smoke: scan_resist_ratio={ratio} floor={ratio_floor} "
+      f"(s3fifo={result.get('scan_resist_s3fifo_hit_pct')}% "
+      f"lru={result.get('scan_resist_lru_hit_pct')}%)  "
+      f"input_wait_frac={wait} ceiling={wait_ceiling} "
+      f"steps={result.get('prefetch_steps')}")
+if ratio < ratio_floor:
+    print(f"perf_smoke: FAIL — scan_resist_ratio {ratio} < {ratio_floor} "
+          "(absolute floor; ghost-cache admission lost its scan "
+          "resistance)", file=sys.stderr)
+    sys.exit(1)
+if wait > wait_ceiling:
+    print(f"perf_smoke: FAIL — input_wait_frac {wait} > {wait_ceiling} "
+          "(absolute ceiling; the prefetch window is no longer keeping "
+          "the consumer compute-bound across the epoch boundary)",
           file=sys.stderr)
     sys.exit(1)
 print("perf_smoke: PASS")

@@ -9,7 +9,10 @@
 # the write-pipeline seeds (workers killed / WRITE_BLOCK faults injected
 # under concurrent multi-block writers: zero acked-write loss, bounded
 # per-file budgets, flagged replicas healed, plus the replicas=1 replay
-# variant) — plus the deadline/breaker acceptance tests from
+# variant) and the cache_scan seeds (a 2x-capacity one-touch backfill
+# scan against a hot read loop: S3-FIFO admission must hold the
+# post-quiesce hot hit rate above the floor, docs/caching.md) — plus
+# the deadline/breaker acceptance tests from
 # tests/test_storm.py and fail on any invariant violation. Mirrors
 # scripts/perf_smoke.sh.
 #

@@ -1,0 +1,360 @@
+"""Cache-intelligence plane: ghost-cache admission, epoch-aware
+prefetch windows, and per-tenant tier-0 partitions.
+
+Covers common/cache.py (S3-FIFO vs the byte-compatible LRU fallback),
+common/epoch.py (deterministic per-epoch shard orders), the BlockStore
+and HBM integrations (scan resistance, tenant quota-first eviction),
+and the master's rolling prefetch jobs — including the persistence
+contract: a restart resumes the window from the journaled cursor
+instead of re-walking the dataset (docs/caching.md)."""
+
+import asyncio
+
+import pytest
+
+from curvine_tpu.common import errors as err
+from curvine_tpu.common.cache import LruPolicy, S3FifoPolicy, make_policy
+from curvine_tpu.common.epoch import epoch_shard_order
+from curvine_tpu.common.types import JobState, StorageType
+from curvine_tpu.testing import MiniCluster
+from curvine_tpu.worker.storage import BlockStore, TierDir
+
+KB = 1024
+
+
+# ---------------------------------------------------------------------
+# policy unit tests
+# ---------------------------------------------------------------------
+
+def test_lru_policy_byte_compatible():
+    """LruPolicy.victim_order must equal the historical
+    sorted-by-atime-ascending order exactly — `cache_admission = "lru"`
+    is a byte-compatible fallback, not an approximation."""
+    entries = [(7, 3.0), (1, 1.0), (9, 2.0), (4, 5.0), (2, 4.0)]
+    assert LruPolicy().victim_order(entries) == \
+        [k for k, _ in sorted(entries, key=lambda e: e[1])]
+
+
+def test_s3fifo_scan_does_not_evict_hot_set():
+    p = S3FifoPolicy()
+    hot = list(range(10))
+    for k in hot:
+        p.on_admit(k, 1)
+        p.on_access(k)          # touched after admission: earns main
+    scan = list(range(100, 140))
+    for k in scan:
+        p.on_admit(k, 1)        # one-touch: never accessed again
+    entries = [(k, float(k)) for k in hot + scan]
+    order = p.victim_order(entries)
+    # every scan block is more evictable than every hot block
+    n = len(scan)
+    assert set(order[:n]) == set(scan), \
+        f"scan blocks should lead the victim order, got {order[:n]}"
+    assert all(k in order[n:] for k in hot)
+
+
+def test_s3fifo_ghost_readmission_promotes_to_main():
+    p = S3FifoPolicy()
+    p.on_admit(5, 1)
+    p.on_remove(5, evicted=True)           # out through the small queue
+    assert p.scan_evicted == 1
+    p.on_admit(5, 1)                       # wanted again: skip probation
+    assert p.ghost_hits == 1
+    assert 5 in p._main and 5 not in p._small
+    # a fresh scan cannot push the readmitted block to the order's front
+    for k in range(100, 110):
+        p.on_admit(k, 1)
+    order = p.victim_order([(k, float(k)) for k in [5] + list(range(100, 110))])
+    assert order.index(5) >= 10
+
+
+def test_s3fifo_second_chance_decays():
+    """A once-hot block rides at most _FREQ_CAP second chances: after
+    its freq drains with no further accesses it falls out of main."""
+    p = S3FifoPolicy()
+    p.on_admit(1, 1)
+    for _ in range(10):
+        p.on_access(1)                     # freq caps at 3
+    entries = [(1, 1.0)]
+    for i in range(4):
+        order = p.victim_order(entries)
+        if order:
+            break
+    assert order == [1], "freq cap must bound second chances"
+
+
+def test_s3fifo_unknown_ids_are_probationary():
+    """Ids recovered from disk before the policy attached are ordered
+    ahead of the protected main set (probation), oldest first."""
+    p = S3FifoPolicy()
+    p.on_admit(1, 1)
+    p.on_access(1)
+    p.victim_order([(1, 1.0)])             # promote 1 to main
+    order = p.victim_order([(1, 1.0), (50, 5.0), (51, 4.0)])
+    assert order[:2] == [51, 50]           # unknown, oldest first
+    assert order[-1] == 1
+
+
+def test_make_policy():
+    assert isinstance(make_policy("s3fifo"), S3FifoPolicy)
+    assert isinstance(make_policy("lru"), LruPolicy)
+    with pytest.raises(ValueError):
+        make_policy("arc")
+
+
+# ---------------------------------------------------------------------
+# epoch shard orders
+# ---------------------------------------------------------------------
+
+def test_epoch_shard_order_deterministic():
+    shards = [f"/ds/shard-{i:03d}" for i in range(32)]
+    a = epoch_shard_order(shards, seed=7, epoch=3)
+    b = epoch_shard_order(list(reversed(shards)), seed=7, epoch=3)
+    assert a == b, "order is a pure function of the shard SET"
+    assert sorted(a) == sorted(shards)
+    assert a != epoch_shard_order(shards, seed=7, epoch=4), \
+        "different epochs reshuffle"
+    assert a != epoch_shard_order(shards, seed=8, epoch=3), \
+        "different seeds reshuffle"
+
+
+def test_epoch_shard_order_no_seed_is_sorted():
+    shards = ["/b", "/a", "/c"]
+    assert epoch_shard_order(shards, None, 5) == ["/a", "/b", "/c"]
+
+
+# ---------------------------------------------------------------------
+# BlockStore integration: scan resistance + tenant partitions
+# ---------------------------------------------------------------------
+
+def _mem_store(tmp_path, admission, cap=16 * KB):
+    mem = TierDir(StorageType.MEM, str(tmp_path / f"mem-{admission}"), cap)
+    return BlockStore([mem], high_water=0.9, low_water=0.5,
+                      admission=admission)
+
+
+def _put(store, bid, size=KB, tenant=""):
+    info = store.create_temp(bid, size_hint=size, tenant=tenant)
+    with open(info.path, "wb") as f:
+        f.write(b"\0" * size)
+    return store.commit(bid, size)
+
+
+def _scan_ab(tmp_path, admission, hot_n=4, scan_n=64, touch_every=16):
+    """Write a hot set, touch it, then stream one-touch scan blocks with
+    periodic hot re-reads (sparser than the eviction cadence — the
+    access pattern LRU is known to lose). Returns hot survivors."""
+    store = _mem_store(tmp_path, admission)
+    hot = list(range(hot_n))
+    for bid in hot:
+        _put(store, bid)
+    for bid in hot:
+        store.get(bid)
+    for k in range(scan_n):
+        _put(store, 1000 + k)
+        if k % touch_every == 0:
+            for bid in hot:
+                if store.contains(bid):
+                    store.get(bid)
+    return sum(1 for bid in hot if store.contains(bid)), store
+
+
+def test_store_s3fifo_scan_resistant_lru_not(tmp_path):
+    s3_survivors, s3_store = _scan_ab(tmp_path, "s3fifo")
+    lru_survivors, _ = _scan_ab(tmp_path, "lru")
+    assert s3_survivors == 4, \
+        f"s3fifo flushed the hot set ({s3_survivors}/4 survived)"
+    assert s3_survivors > lru_survivors, \
+        f"scan resistance A/B inverted: s3fifo={s3_survivors} " \
+        f"lru={lru_survivors}"
+    stats = s3_store.cache_stats()["total"]
+    assert stats["scan_evicted"] > 0
+    assert stats["evicted"] >= stats["scan_evicted"]
+
+
+def test_store_slow_tiers_stay_lru(tmp_path):
+    """Admission only guards tier 0: an SSD tier keeps LruPolicy even
+    when the store is constructed with s3fifo."""
+    mem = TierDir(StorageType.MEM, str(tmp_path / "mem"), 4 * KB)
+    ssd = TierDir(StorageType.SSD, str(tmp_path / "ssd"), 64 * KB)
+    store = BlockStore([mem, ssd], admission="s3fifo")
+    assert store.tiers[0].policy.name == "s3fifo"
+    assert store.tiers[1].policy.name == "lru"
+
+
+def test_tenant_occupancy_and_quota_first_eviction(tmp_path):
+    store = _mem_store(tmp_path, "lru")
+    quotas = {"greedy": 2 * KB}
+    store.tier0_quota = quotas.get
+    for bid in range(4):
+        _put(store, bid, tenant="greedy")        # 4 KB: 2x its partition
+    for bid in range(4, 6):
+        _put(store, 100 + bid, tenant="modest")  # 2 KB, no quota
+    occ = store.tenant_occupancy()
+    assert occ == {"greedy": 4 * KB, "modest": 2 * KB}
+    # make greedy's blocks the HOTTEST: pure LRU would evict modest
+    # first, the partition plane must still pick the over-quota tenant
+    for bid in range(4):
+        store.get(bid)
+    for k in range(12):
+        _put(store, 2000 + k, tenant="modest")
+    occ = store.tenant_occupancy()
+    assert occ.get("greedy", 0) <= 2 * KB, \
+        f"over-quota tenant not evicted first: {occ}"
+
+
+def test_demotion_registers_on_slower_tier_policy(tmp_path):
+    """A tier move is an eviction on the source policy (ghost-eligible)
+    and an admission on the destination policy."""
+    mem = TierDir(StorageType.MEM, str(tmp_path / "mem"), 4 * KB)
+    ssd = TierDir(StorageType.SSD, str(tmp_path / "ssd"), 64 * KB)
+    store = BlockStore([mem, ssd], high_water=0.9, low_water=0.5,
+                       admission="s3fifo")
+    for bid in range(4):
+        _put(store, bid)
+    store.get(3)
+    assert store.maybe_evict()
+    demoted = [b for b in range(4)
+               if store.get(b, touch=False).tier is ssd]
+    assert demoted
+    assert mem.policy.evicted >= len(demoted)
+    assert ssd.policy.admits >= len(demoted)
+
+
+# ---------------------------------------------------------------------
+# HBM tier admission
+# ---------------------------------------------------------------------
+
+def test_hbm_scan_does_not_spill_hot(monkeypatch):
+    from curvine_tpu.tpu.hbm import HbmTier
+    tier = HbmTier(8 * KB, admission="s3fifo")
+    for bid in range(4):
+        tier.put(bid, b"\0" * KB)
+    for bid in range(4):
+        assert tier.get(bid) is not None     # earn main membership
+    for k in range(16):                       # 2x capacity one-touch scan
+        tier.put(100 + k, b"\0" * KB)
+    hot_resident = sum(1 for bid in range(4) if bid in tier)
+    assert hot_resident == 4, \
+        f"HBM scan spilled the hot set ({hot_resident}/4 resident)"
+    st = tier.stats()
+    assert st["scan_evicted"] > 0
+
+
+def test_hbm_lru_fallback_spills_oldest(monkeypatch):
+    from curvine_tpu.tpu.hbm import HbmTier
+    tier = HbmTier(4 * KB, admission="lru")
+    for bid in range(4):
+        tier.put(bid, b"\0" * KB)
+    tier.get(0)                               # 0 is now the newest
+    tier.put(9, b"\0" * KB)
+    assert 0 in tier and 1 not in tier
+
+
+# ---------------------------------------------------------------------
+# master: rolling prefetch-window jobs
+# ---------------------------------------------------------------------
+
+async def _seed_shards(c, n=6, size=256):
+    for i in range(n):
+        await c.write_all(f"/ds/shard-{i:03d}.bin", b"\0" * size)
+    return [f"/ds/shard-{i:03d}.bin" for i in range(n)]
+
+
+async def _wait(cond, timeout=10.0):
+    async def w():
+        while not cond():
+            await asyncio.sleep(0.05)
+    await asyncio.wait_for(w(), timeout)
+
+
+async def test_prefetch_window_plans_epoch_order(tmp_path):
+    async with MiniCluster(workers=1, base_dir=str(tmp_path)) as mc:
+        c = mc.client()
+        shards = await _seed_shards(c)
+        r = await c.advise("/ds", cursor=0, window=2, epoch=1, seed=42)
+        job = mc.master.jobs.jobs[r["job_id"]]
+        await _wait(lambda: len(job.tasks) >= 2)
+        want = epoch_shard_order(shards, 42, 1)
+        assert [t.path for t in job.tasks] == want[:2]
+        assert job.total_files == len(shards)
+        assert job.state in (JobState.PENDING, JobState.RUNNING)
+
+        # cursor advance extends the window incrementally — already
+        # planned shards are never re-planned
+        await c.advise("/ds", cursor=2, window=2, epoch=1, seed=42)
+        await _wait(lambda: len(job.tasks) >= 4)
+        assert [t.path for t in job.tasks] == want[:4]
+
+        # rolling semantics: the job must NOT finish mid-window even
+        # with every queued task drained
+        await _wait(lambda: all(t.state == JobState.COMPLETED
+                                for t in job.tasks), 15.0)
+        assert job.state != JobState.COMPLETED
+        # walk the cursor to the end: now it may complete
+        await c.advise("/ds", cursor=len(shards), window=2, epoch=1,
+                       seed=42)
+        await _wait(lambda: job.state == JobState.COMPLETED, 15.0)
+
+
+async def test_prefetch_restart_resumes_cursor_not_dataset(tmp_path):
+    """The persistence fix: only {cursor, window, epoch, seed} are
+    journaled. A master restart re-derives the order from the namespace
+    + seed and resumes planning AT the cursor — it must not re-walk
+    shards the reader already passed."""
+    async with MiniCluster(workers=1, base_dir=str(tmp_path)) as mc:
+        c = mc.client()
+        shards = await _seed_shards(c)
+        r = await c.advise("/ds", cursor=3, window=2, epoch=0, seed=9)
+        jid = r["job_id"]
+        job = mc.master.jobs.jobs[jid]
+        await _wait(lambda: len(job.tasks) >= 2)
+
+        await mc.restart_master()
+        jobs2 = mc.master.jobs
+        await _wait(lambda: jid in jobs2.jobs
+                    and len(jobs2.jobs[jid].tasks) >= 2, 15.0)
+        job2 = jobs2.jobs[jid]
+        assert job2.cursor == 3 and job2.epoch == 0 and job2.seed == 9
+        want = epoch_shard_order(shards, 9, 0)
+        planned = [t.path for t in job2.tasks]
+        assert planned == want[3:5], \
+            f"restart re-planned {planned}, expected only the window " \
+            f"{want[3:5]} at the persisted cursor"
+        assert jobs2._prefetch[("/ds", 0)] == jid
+
+
+async def test_prefetch_epoch_rollover_retires_old_windows(tmp_path):
+    async with MiniCluster(workers=1, base_dir=str(tmp_path)) as mc:
+        c = mc.client()
+        await _seed_shards(c)
+        r0 = await c.advise("/ds", epoch=0)
+        r1 = await c.advise("/ds", epoch=1)
+        assert r0["job_id"] != r1["job_id"]
+        jobs = mc.master.jobs
+        # the boundary pair (e, e+1) stays active together
+        assert ("/ds", 0) in jobs._prefetch and ("/ds", 1) in jobs._prefetch
+        await c.advise("/ds", epoch=2)
+        assert ("/ds", 0) not in jobs._prefetch
+        assert jobs.jobs[r0["job_id"]].state == JobState.COMPLETED
+        assert ("/ds", 1) in jobs._prefetch
+
+
+async def test_prefetch_missing_path_fails_with_message(tmp_path):
+    async with MiniCluster(workers=0, base_dir=str(tmp_path)) as mc:
+        c = mc.client()
+        r = await c.advise("/nowhere")
+        job = mc.master.jobs.jobs[r["job_id"]]
+        await _wait(lambda: job.state == JobState.FAILED)
+        assert job.message
+
+
+async def test_client_prefetch_skips_cached(tmp_path):
+    """Worker-side task body: an already-cached complete file is a no-op
+    (unlike load_from_ufs, which always overwrites), and a path with no
+    mount is advisory — 0, not an error."""
+    async with MiniCluster(workers=1, base_dir=str(tmp_path)) as mc:
+        c = mc.client()
+        await c.write_all("/warm", b"x" * 512)
+        assert await c.prefetch("/warm") == 0
+        assert await c.prefetch("/warm-missing-no-mount") == 0

@@ -1018,6 +1018,108 @@ async def _prefetch_epoch_bench(shards: int = 8, shard_kb: int = 128,
             "prefetch_window_jobs": jobs}
 
 
+async def _ici_smoke(payload_mb: int = 64, rounds: int = 3) -> dict:
+    """ICI data-plane gate (docs/ici-plane.md). Two halves:
+
+    (a) checkpoint broadcast rail A/B — the pipelined chunked mesh
+    broadcast (`ici_plane.broadcast_bytes`) against the flat single-put
+    replicate over the same device mesh. `ckpt_broadcast_gibs` is
+    AGGREGATE delivered bandwidth (payload bytes x devices / wall
+    time): chunking keeps every transfer on the runtime's pooled
+    staging buffers, so the pipelined rail must hold a multiple of the
+    flat baseline (~1.5 GiB/s aggregate on the 8-way CPU mesh).
+
+    (b) peer-HBM replication pull — a re-replication whose source
+    advertises the block HBM-resident must ride the device path end to
+    end. `ici_peer_pull_ratio` = peer_pulls / (peer_pulls +
+    tcp_fallbacks) over the healing round; in this controlled A the
+    device domain is intact, so anything under 1.0 means the hint or
+    the landing path regressed.
+
+    Returns {ckpt_broadcast_gibs, ckpt_broadcast_flat_gibs,
+    ckpt_broadcast_speedup, ici_peer_pull_ratio, ici_peer_pulls} or
+    {ici_skip: reason} when the backend cannot form a multi-device
+    mesh (e.g. a jaxlib without the virtual-device collectives)."""
+    import jax
+    from curvine_tpu.common.conf import ClusterConf
+    from curvine_tpu.rpc import RpcCode
+    from curvine_tpu.rpc.frame import pack
+    from curvine_tpu.testing import MiniCluster
+    from curvine_tpu.tpu import ici_plane
+    from curvine_tpu.tpu.mesh import make_mesh
+
+    try:
+        devs = jax.devices()
+    except RuntimeError as e:           # backend never came up
+        return {"ici_skip": f"no device backend: {e}"}
+    if len(devs) < 2:
+        return {"ici_skip": f"needs a multi-device mesh, have "
+                            f"{len(devs)} device(s)"}
+    mesh = make_mesh(devices=devs, axis_names=("data",))
+    data = os.urandom(payload_mb << 20)
+    out: dict = {}
+
+    # ---- (a) broadcast rail A/B: best-of-rounds on both rails ----
+    # Each rail runs its rounds back to back with one untimed warm-up:
+    # a checkpoint is MANY tensors streamed through the same bounded
+    # chunk pool, so the steady state (buffers recycled) is what the
+    # rail delivers in practice — a cold round only measures the
+    # allocator faulting fresh pages, and interleaving the rails lets
+    # the flat path's whole-payload buffers evict the chunk pool.
+    def _best(rail, warmups=2):
+        best = float("inf")
+        for i in range(rounds + warmups):
+            t0 = time.perf_counter()
+            res = rail(data, mesh)
+            dt = time.perf_counter() - t0
+            del res
+            if i >= warmups:             # pool takes ~2 rounds to form
+                best = min(best, dt)
+        return best
+
+    # chunked rail first: its bounded pool is what we are measuring,
+    # and the flat rail only benefits from pages already faulted in —
+    # running it second keeps the A/B conservative for the speedup
+    pipe_s = _best(ici_plane.broadcast_bytes)
+    flat_s = _best(ici_plane.flat_replicate)
+    agg = len(data) * len(devs) / (1 << 30)
+    out["ckpt_broadcast_gibs"] = round(agg / pipe_s, 3)
+    out["ckpt_broadcast_flat_gibs"] = round(agg / flat_s, 3)
+    out["ckpt_broadcast_speedup"] = round(flat_s / pipe_s, 2)
+    out["ckpt_broadcast_devices"] = len(devs)
+
+    # ---- (b) peer-HBM pull over one healing round ----
+    conf = ClusterConf()
+    conf.worker.hbm_capacity = 32 * 1024 * 1024
+    async with MiniCluster(workers=2, conf=conf) as mc:
+        mc.master.replication.scan_interval_s = 0.3
+        c = mc.client()
+        blob = os.urandom(1 << 20)
+        await c.write_all("/bench/ici", blob)
+        fb = await c.meta.get_block_locations("/bench/ici")
+        bid = fb.block_locs[0].block.id
+        src_wid = fb.block_locs[0].locs[0].worker_id
+        src = next(w for w in mc.workers if w.worker_id == src_wid)
+        dst = next(w for w in mc.workers if w.worker_id != src_wid)
+        conn = await c.pool.get(src.addr)
+        await conn.call(RpcCode.HBM_PIN, data=pack({"block_id": bid}))
+        await src.heartbeat_once()
+        mc.master.fs.blocks.desired[bid] = 2
+        mc.master.replication.enqueue([bid])
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            fb = await c.meta.get_block_locations("/bench/ici")
+            if len(fb.block_locs[0].locs) >= 2:
+                break
+            await asyncio.sleep(0.1)
+        pulls = dst.metrics.counters.get("ici.peer_pulls", 0)
+        falls = dst.metrics.counters.get("ici.tcp_fallbacks", 0)
+        out["ici_peer_pulls"] = int(pulls)
+        out["ici_peer_pull_ratio"] = round(
+            pulls / max(1, pulls + falls), 3)
+    return out
+
+
 async def _ladder_smoke(clients: int = 64, duration: float = 2.0,
                         rate: float = 10.0) -> dict:
     """Scaled-down open-loop concurrency rung (scripts/latency_ladder.py
@@ -1436,6 +1538,11 @@ async def run_bench(total_mb: int = 256, block_mb: int = 64,
         results.update(await _shm_read_bench())
     if os.environ.get("BENCH_LADDER", "1") != "0":
         results.update(await _ladder_smoke())
+
+    # ---- ICI data plane: broadcast rail A/B + peer-HBM pull
+    # (docs/ici-plane.md) ----
+    if os.environ.get("BENCH_ICI", "1") != "0":
+        results.update(await _ici_smoke())
     return results
 
 

@@ -366,6 +366,20 @@ async def cmd_report(args):
                 occ = "  ".join(f"{t}={_human(int(b))}"
                                 for t, b in sorted(tier0.items()))
                 print(f"Cache plane [tier0 occupancy]: {occ}")
+        ip = rp.get("ici_plane")
+        if ip:
+            # broadcast GiB/s = aggregate delivered bandwidth of the
+            # tree-scheduled checkpoint rail (bytes × replicas / time)
+            gibs = ""
+            if ip.get("broadcast_ms"):
+                gibs = (f"  broadcast: "
+                        f"{ip.get('broadcast_bytes', 0) / (1 << 30) / (ip['broadcast_ms'] / 1000):.2f} GiB/s")
+            print(f"ICI plane: hbm exports: "
+                  f"{int(ip.get('hbm_exports', 0))}  "
+                  f"peer pulls: {int(ip.get('peer_pulls', 0))}  "
+                  f"ici transfers: {int(ip.get('transfers', 0))}  "
+                  f"tcp fallbacks: {int(ip.get('tcp_fallbacks', 0))}"
+                  f"{gibs}")
         rows = rp.get("shards") or []
         if rows:
             print(f"Namespace shards: {len(rows)}")

@@ -51,6 +51,9 @@ class MasterConf:
     heartbeat_check_ms: int = 1_000
     # block allocation
     block_placement_policy: str = "local"   # local|random|robin|weighted|load|ici
+    # ICI torus shape for the hop-count distance function (e.g. [4, 2]
+    # or [2, 2, 2]); empty → distances fall back to host labels
+    ici_mesh_shape: list[int] = field(default_factory=list)
     min_replication: int = 1
     # retry cache
     retry_cache_size: int = 100_000
@@ -154,6 +157,14 @@ class WorkerConf:
     ici_coords: list[int] = field(default_factory=list)
     # hbm tier (bytes reserved on device for cache; 0 disables)
     hbm_capacity: int = 0
+    # ICI data plane (docs/ici-plane.md): advertise HBM-resident blocks
+    # to peers and serve replication pulls device-to-device; any failure
+    # falls back to the TCP rail (counter, never an error)
+    ici_transfer: bool = True
+    # peer-addressable export table entries (LRU, advisory metadata)
+    hbm_export_cap: int = 128
+    # max exported blocks advertised per heartbeat
+    hbm_advertise_max: int = 64
     task_parallelism: int = 4
     # direct-IO data plane for SSD/HDD tiers (worker/io_engine.py —
     # the SPDK-role page-cache bypass): cold block reads and tier-move

@@ -45,7 +45,8 @@ class MasterFilesystem:
                  lost_timeout_ms: int = 30_000,
                  snapshot_interval: int = 100_000,
                  store: MemMetaStore | KvMetaStore | None = None,
-                 id_stride: int = 1, id_offset: int = 0):
+                 id_stride: int = 1, id_offset: int = 0,
+                 ici_mesh_shape: list[int] | None = None):
         self.store = store if store is not None else MemMetaStore()
         self.tree = InodeTree(self.store, id_stride=id_stride,
                               id_offset=id_offset)
@@ -55,7 +56,8 @@ class MasterFilesystem:
         self.snapshot_interval = snapshot_interval
         self._entries_since_snapshot = 0
         if isinstance(placement, str):
-            placement = create_policy(placement)
+            placement = create_policy(placement,
+                                      mesh_shape=ici_mesh_shape or None)
         self.policy = placement
         # worker_id -> block ids scheduled for deletion (drained by heartbeat)
         self.pending_deletes: dict[int, set[int]] = {}

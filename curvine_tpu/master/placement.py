@@ -13,9 +13,43 @@ import random
 from curvine_tpu.common import errors as err
 from curvine_tpu.common.types import WorkerInfo
 
+# distance tiers for the host-label fallback: same host ≈ free, a
+# different host is far but still closer than "we know nothing" — the
+# ordering is what matters, not the magnitudes
+HOST_FAR = 1 << 8
+UNKNOWN_FAR = 1 << 16
+
+
+def topology_distance(a_coords, a_host, b_coords, b_host,
+                      mesh_shape=None) -> int:
+    """Default pluggable distance: ICI torus hop count when both sides
+    carry mesh coordinates, host/rack-label fallback otherwise.
+
+    This is the single distance notion shared by placement (spread
+    replicas far, keep one near the writer) and replication source
+    selection (pull from the nearest holder)."""
+    if a_coords and b_coords and len(a_coords) == len(b_coords):
+        return ici_hops(list(a_coords), list(b_coords), mesh_shape)
+    if a_host and b_host:
+        return 0 if a_host == b_host else HOST_FAR
+    return UNKNOWN_FAR
+
 
 class PlacementPolicy:
     name = "base"
+
+    def __init__(self, mesh_shape: list[int] | None = None,
+                 distance_fn=None):
+        # distance_fn(a_coords, a_host, b_coords, b_host) -> int; the
+        # default closes over the configured torus shape
+        self.mesh_shape = mesh_shape
+        self.distance_fn = distance_fn or (
+            lambda ac, ah, bc, bh: topology_distance(
+                ac, ah, bc, bh, self.mesh_shape))
+
+    def worker_distance(self, a: WorkerInfo, b: WorkerInfo) -> int:
+        return self.distance_fn(a.ici_coords, a.address.hostname,
+                                b.ici_coords, b.address.hostname)
 
     def choose(self, workers: list[WorkerInfo], count: int,
                client_host: str = "", exclude: set[int] | None = None,
@@ -54,7 +88,9 @@ class RandomPolicy(PlacementPolicy):
 class RobinPolicy(PlacementPolicy):
     name = "robin"
 
-    def __init__(self) -> None:
+    def __init__(self, mesh_shape: list[int] | None = None,
+                 distance_fn=None) -> None:
+        super().__init__(mesh_shape, distance_fn)
         self._next = 0
 
     def _pick(self, pool, count, client_host, ici_coords):
@@ -125,32 +161,37 @@ def ici_hops(a: list[int], b: list[int], mesh_shape: list[int] | None = None) ->
 
 
 class IciPolicy(PlacementPolicy):
-    """TPU-native: minimise ICI hop distance to the client's chip, and
-    spread replicas across distinct hosts (failure domains)."""
+    """TPU-native: keep the FIRST replica ICI-near the writer (the hot
+    read path stays on short links), then spread the remaining replicas
+    across ICI-far fault domains by greedy max-min distance — a torus
+    neighborhood shares power/cooling/OCS the way a rack does, so far
+    in hops ≈ far in failure correlation (TPU v4 OCS topology work).
+
+    Distances come from the pluggable ``distance_fn`` (default: torus
+    hop count, host-label fallback when coordinates are missing)."""
 
     name = "ici"
 
-    def __init__(self, mesh_shape: list[int] | None = None):
-        self.mesh_shape = mesh_shape
-
     def _pick(self, pool, count, client_host, ici_coords):
-        ranked = sorted(
-            pool, key=lambda w: (ici_hops(ici_coords or [], w.ici_coords,
-                                          self.mesh_shape),
-                                 -w.available))
-        out: list[WorkerInfo] = []
-        seen_hosts: set[str] = set()
-        for w in ranked:       # first pass: one replica per host
-            if len(out) == count:
-                break
-            if w.address.hostname not in seen_hosts:
-                out.append(w)
-                seen_hosts.add(w.address.hostname)
-        for w in ranked:       # second pass: fill remainder
-            if len(out) == count:
-                break
-            if w not in out:
-                out.append(w)
+        to_writer = lambda w: self.distance_fn(          # noqa: E731
+            ici_coords or [], client_host,
+            w.ici_coords, w.address.hostname)
+        ranked = sorted(pool, key=lambda w: (to_writer(w), -w.available))
+        out: list[WorkerInfo] = [ranked[0]]   # ICI-near the writer
+        while len(out) < count:
+            chosen_hosts = {o.address.hostname for o in out}
+
+            def spread_key(w):
+                # primary: maximise the min distance to everything
+                # already chosen (fault-domain spread); then prefer an
+                # unused host, writer proximity, free capacity
+                dmin = min(self.worker_distance(w, o) for o in out)
+                return (-dmin,
+                        0 if w.address.hostname not in chosen_hosts else 1,
+                        to_writer(w), -w.available)
+
+            out.append(min((w for w in ranked if w not in out),
+                           key=spread_key))
         return out
 
 
@@ -160,9 +201,10 @@ _POLICIES = {
 }
 
 
-def create_policy(name: str) -> PlacementPolicy:
+def create_policy(name: str, mesh_shape: list[int] | None = None,
+                  distance_fn=None) -> PlacementPolicy:
     cls = _POLICIES.get(name)
     if cls is None:
         raise err.InvalidArgument(f"unknown placement policy {name!r}; "
                                   f"have {sorted(_POLICIES)}")
-    return cls()
+    return cls(mesh_shape=mesh_shape, distance_fn=distance_fn)

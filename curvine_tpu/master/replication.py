@@ -52,6 +52,12 @@ class ReplicationManager:
         # quarantined blocks every heartbeat, so this map survives a
         # master restart without being persisted.
         self._evac: dict[int, int] = {}
+        # ICI plane (docs/ici-plane.md): worker_id -> block ids the
+        # worker advertises as HBM-resident. Like _evac this is soft
+        # state re-advertised every heartbeat — never journaled, and it
+        # only ever adds a HINT to a pull job (the device path), never
+        # a requirement: a stale entry costs one fallback counter.
+        self._hbm_blocks: dict[int, set[int]] = {}
         # scrub verdicts (block_id -> "mismatch" | "truncated") from
         # worker reports: the distinction picks the repair path. A
         # truncated replica is re-pulled from a healthy copy; a rotten
@@ -63,6 +69,16 @@ class ReplicationManager:
     def _inc(self, name: str, v: int = 1) -> None:
         if self.metrics is not None:
             self.metrics.inc(name, v)
+
+    def note_hbm_blocks(self, worker_id: int, block_ids) -> None:
+        """Heartbeat advertisement of a worker's HBM-resident blocks
+        (the bounded export-table snapshot). Replaces the previous
+        advertisement wholesale — exports age out of the table, and a
+        beat IS the freshness signal."""
+        if block_ids:
+            self._hbm_blocks[int(worker_id)] = {int(b) for b in block_ids}
+        else:
+            self._hbm_blocks.pop(int(worker_id), None)
 
     def note_verdicts(self, verdicts: dict[int, str]) -> None:
         for bid, verdict in verdicts.items():
@@ -332,7 +348,6 @@ class ReplicationManager:
             log.debug("block %d has no servable source (holders lost)",
                       block_id)
             return False
-        src = serving[0]
         try:
             # replacement_worker chooses among LIVE workers only: a LOST
             # or draining destination is never handed a pull job
@@ -340,6 +355,25 @@ class ReplicationManager:
         except err.CurvineError as e:
             log.debug("no replication target for block %d: %s", block_id, e)
             return False
+        # ICI-edge preference: among equally-healthy sources pull from
+        # the one topologically nearest the destination (shortest torus
+        # path, host-label fallback) — the state tiers still dominate
+        # (LIVE before DECOMMISSIONING before the suspect evac copy),
+        # distance only orders within the LIVE tier
+        if live > 1:          # serving[:live] is exactly the LIVE tier
+            serving[:live] = sorted(
+                serving[:live],
+                key=lambda w: self.fs.policy.worker_distance(w, dst))
+        src = serving[0]
+        # device-path hint: when the chosen source advertises the block
+        # as HBM-resident, tell the destination it may try the ICI
+        # transfer first (worker falls back to this same TCP pull job on
+        # any failure — the hint can never make a pull worse)
+        ici_hint = None
+        if block_id in self._hbm_blocks.get(src.address.worker_id, ()):
+            ici_hint = {"worker_id": src.address.worker_id,
+                        "coords": list(src.ici_coords or [])}
+            self._inc("replication.ici_hinted")
         self._inflight.add(block_id)
         # master fan-out tracing: root the trace here so the whole chain
         # (submit → destination's pull stream → source's read) links up
@@ -353,12 +387,13 @@ class ReplicationManager:
             with span:
                 conn = await self.pool.get(
                     f"{dst.address.ip_addr or dst.address.hostname}:{dst.address.rpc_port}")
+                job = {"block_id": block_id, "block_len": meta.len,
+                       "source": src.address.to_wire()}
+                if ici_hint is not None:
+                    job["ici"] = ici_hint
                 await conn.call(
-                    RpcCode.SUBMIT_BLOCK_REPLICATION_JOB, data=pack({
-                        "block_id": block_id,
-                        "block_len": meta.len,
-                        "source": src.address.to_wire(),
-                    }), deadline=Deadline.after_ms(self.pull_budget_ms))
+                    RpcCode.SUBMIT_BLOCK_REPLICATION_JOB, data=pack(job),
+                    deadline=Deadline.after_ms(self.pull_budget_ms))
         except err.CurvineError as e:
             log.warning("replication submit for block %d to worker %d "
                         "failed: %s", block_id, dst.address.worker_id, e)
@@ -487,7 +522,9 @@ class ReplicationManager:
         self._evac.pop(block_id, None)
 
     def on_result(self, block_id: int, worker_id: int, success: bool,
-                  message: str) -> None:
+                  message: str, via: str = "") -> None:
+        if success and via == "ici":
+            self._inc("replication.ici_transfers")
         if not success:
             log.warning("repair of %d on worker %d failed: %s",
                         block_id, worker_id, message)

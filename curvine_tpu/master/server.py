@@ -82,7 +82,8 @@ class MasterServer:
             lost_timeout_ms=mc.worker_lost_timeout_ms,
             snapshot_interval=mc.snapshot_interval_entries, store=store,
             id_stride=shard_count if shard_id is not None else 1,
-            id_offset=shard_id or 0)
+            id_offset=shard_id or 0,
+            ici_mesh_shape=mc.ici_mesh_shape or None)
         self.fs.audit_log = mc.audit_log
         self.mounts = MountManager(self.fs)
         self.fs.mounts = self.mounts
@@ -940,6 +941,28 @@ class MasterServer:
                     grp[stat] = grp.get(stat, 0) + v
         if cp:
             out["cache_plane"] = cp
+        # ICI-plane rollup (docs/ici-plane.md): worker "ici.*" heartbeat
+        # counters (peer pulls, tcp fallbacks, hbm exports) + client
+        # "client.ici.*" broadcast counters pushed via METRICS_REPORT +
+        # the master's own replication.ici_* dispatch counters
+        ici: dict = {}
+        for counters in self._worker_counters.values():
+            for k, v in counters.items():
+                if k.startswith("ici."):
+                    stat = k[len("ici."):]
+                    ici[stat] = ici.get(stat, 0) + v
+        pre_i = "client.ici."
+        for k, v in self.metrics.counters.items():
+            if k.startswith(pre_i):
+                stat = k[len(pre_i):]
+                ici[stat] = ici.get(stat, 0) + v
+        for name, stat in (("replication.ici_hinted", "hinted"),
+                           ("replication.ici_transfers", "transfers")):
+            v = self.metrics.counters.get(name, 0)
+            if v:
+                ici[stat] = ici.get(stat, 0) + v
+        if ici:
+            out["ici_plane"] = ici
         return out
 
     def _tenant_stats(self, q):
@@ -1166,6 +1189,11 @@ class MasterServer:
             self._dirs_unhealthy[wid_hb] = unhealthy
             self.metrics.gauge("dirs.unhealthy",
                                sum(self._dirs_unhealthy.values()))
+        # ICI plane: bounded snapshot of the worker's HBM export table —
+        # soft state for the replication manager's device-path hints,
+        # refreshed (or cleared) every beat like evac_blocks
+        self.replication.note_hbm_blocks(
+            wid_hb, [int(b) for b in q.get("hbm_blocks") or []])
         wm = q.get("metrics")
         if wm:
             # aggregate worker-plane byte counters (dashboard throughput);
@@ -1246,7 +1274,9 @@ class MasterServer:
 
     def _replication_result(self, q):
         self.replication.on_result(q["block_id"], q["worker_id"],
-                                   q.get("success", False), q.get("message", ""))
+                                   q.get("success", False),
+                                   q.get("message", ""),
+                                   via=q.get("via", ""))
         return {}
 
     def _ec_commit_stripe(self, q):

@@ -144,3 +144,4 @@ class RpcCode(enum.IntEnum):
     HBM_PIN = 100        # pin a cached block into the HBM tier
     HBM_UNPIN = 101
     BROADCAST_MODEL = 102  # checkpoint broadcast over the pod
+    ICI_TRANSFER = 103   # device-path block pull from a peer's HBM tier

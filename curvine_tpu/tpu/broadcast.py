@@ -93,14 +93,14 @@ async def save_checkpoint(client: CurvineClient, path: str,
         await client.write_all(f"{path}/treedef.pkl", pickle.dumps(treedef))
 
 
-async def load_checkpoint(client: CurvineClient, path: str,
-                          placer=None) -> dict:
-    """Read tensors back (short-circuit mmap when co-located). Tensor
-    fetches run CONCURRENTLY, and when ``placer`` is given (an arr→jax
-    transfer fn), each tensor's host→device transfer is dispatched as
-    soon as its bytes land — cache reads overlap device transfers instead
-    of the round-2 read-everything-then-transfer-everything sequence."""
-    import asyncio
+async def _load_manifest(client: CurvineClient, path: str,
+                         allow_pickle: bool = False):
+    """Parse a checkpoint's manifest. Returns (tensors, skel, treedef).
+
+    A manifest without the JSON tree encoding needs the legacy pickled
+    treedef side-file — and unpickling is arbitrary code execution for
+    anyone who can write the checkpoint path, so it is an explicit
+    opt-in (``allow_pickle=True``), not a silent fallback."""
     raw = json.loads(await _read_all(client, f"{path}/manifest.json"))
     if isinstance(raw, list):
         # legacy layout: bare tensor list + pickled treedef side-file
@@ -109,8 +109,14 @@ async def load_checkpoint(client: CurvineClient, path: str,
         manifest, skel = raw["tensors"], raw.get("tree")
     treedef = None
     if skel is None:
-        # unpickling is arbitrary code execution for anyone who can write
-        # the checkpoint path — only the legacy fallback still does it
+        if not allow_pickle:
+            raise ValueError(
+                f"checkpoint {path!r} carries only a legacy pickled "
+                f"treedef, which this reader does not load by default "
+                f"(unpickling runs arbitrary code). Pass "
+                f"allow_pickle=True if you trust the writer, or re-save "
+                f"the checkpoint with save_checkpoint() to get the safe "
+                f"JSON tree encoding.")
         global _warned_pickle
         if not _warned_pickle:
             _warned_pickle = True
@@ -119,6 +125,19 @@ async def load_checkpoint(client: CurvineClient, path: str,
                         path)
         import pickle
         treedef = pickle.loads(await _read_all(client, f"{path}/treedef.pkl"))
+    return manifest, skel, treedef
+
+
+async def load_checkpoint(client: CurvineClient, path: str,
+                          placer=None, allow_pickle: bool = False) -> dict:
+    """Read tensors back (short-circuit mmap when co-located). Tensor
+    fetches run CONCURRENTLY, and when ``placer`` is given (an arr→jax
+    transfer fn), each tensor's host→device transfer is dispatched as
+    soon as its bytes land — cache reads overlap device transfers instead
+    of the round-2 read-everything-then-transfer-everything sequence."""
+    import asyncio
+    manifest, skel, treedef = await _load_manifest(client, path,
+                                                   allow_pickle)
 
     async def load_one(t):
         reader = await client.open(f"{path}/{t['name']}")
@@ -161,19 +180,136 @@ def broadcast_params(params, mesh: Mesh, spec_tree=None):
         params, spec_tree)
 
 
+async def _hbm_source(client: CurvineClient, path: str,
+                      counters: dict | None = None):
+    """Source a cached file's bytes straight from a peer's HBM tier
+    through the ICI device domain (tpu/ici_plane.py) — zero block-read
+    RPCs when every block of the file is advertised. Returns a host
+    uint8 view, or None (caller falls back to the mmap/RPC read path;
+    the fallback is a counter, never an error)."""
+    from curvine_tpu.tpu import ici_plane
+    if not ici_plane.endpoints():
+        return None
+    try:
+        fb = await client.meta.get_block_locations(path)
+    except Exception:            # noqa: BLE001 — any miss → TCP rail
+        return None
+    if not fb.block_locs:
+        return None
+    parts = []
+    for lb in fb.block_locs:
+        got = None
+        for loc in lb.locs:
+            arr = ici_plane.fetch_device_block(loc.worker_id, lb.block.id)
+            if arr is not None and arr.nbytes == lb.block.len:
+                got = np.asarray(arr).reshape(-1).view(np.uint8)
+                break
+        if got is None:
+            # all blocks or nothing — a half-device, half-TCP read
+            # would serialize behind the slow half anyway
+            if counters is not None:
+                counters["ici.tcp_fallbacks"] = \
+                    counters.get("ici.tcp_fallbacks", 0) + 1
+            return None
+        parts.append(got)
+    if counters is not None:
+        counters["ici.peer_pulls"] = \
+            counters.get("ici.peer_pulls", 0) + len(parts)
+        counters["ici.peer_pull_bytes"] = \
+            counters.get("ici.peer_pull_bytes", 0) \
+            + sum(p.nbytes for p in parts)
+    return parts[0] if len(parts) == 1 else np.concatenate(parts)
+
+
+async def _distribute_tree(client: CurvineClient, path: str, mesh: Mesh,
+                           allow_pickle: bool = False):
+    """Topology-scheduled replicated distribution (docs/ici-plane.md):
+
+    * the broadcast plan is derived from the mesh (one reader per host,
+      binomial ICI fan-out after — ici_plane.broadcast_schedule);
+      on a single-host mesh this process is that one reader
+    * tensors dispatch in LPT order (largest first) so the longest
+      read→fan-out chains start earliest and the pipeline drains evenly
+    * tensor bytes come from peer HBM over the device domain when the
+      blocks are advertised (zero TCP block reads), with a transparent
+      fallback to the mmap/RPC rail
+
+    Bit-exact with the flat path — only the sourcing and order differ."""
+    import asyncio
+    import time
+    from curvine_tpu.tpu import ici_plane
+    manifest, skel, treedef = await _load_manifest(client, path,
+                                                   allow_pickle)
+    counters = getattr(client, "counters", None)
+    devs = mesh.devices.reshape(-1)
+    sched = ici_plane.broadcast_schedule(
+        len(devs), coords=[tuple(getattr(d, "coords", None) or (i,))
+                           for i, d in enumerate(devs)])
+    log.debug("broadcast schedule for %s: %d devices, depth %d",
+              path, len(devs), sched.depth())
+    sharding = NamedSharding(mesh, P())
+    t0 = time.perf_counter()
+
+    async def load_one(t):
+        name = f"{path}/{t['name']}"
+        arr = await _hbm_source(client, name, counters)
+        reader = None
+        if arr is None:
+            reader = await client.open(name)
+            view = await reader.mmap_view(0, reader.len)
+            if view is None:
+                view = np.frombuffer(await reader.read_all(),
+                                     dtype=np.uint8)
+            arr = view
+        out = jax.device_put(
+            arr.view(np.dtype(t["dtype"])).reshape(t["shape"]), sharding)
+        if reader is not None:
+            await reader.close()
+        return out
+
+    def size_of(t):
+        n = 1
+        for d in t["shape"]:
+            n *= int(d)
+        return n * np.dtype(t["dtype"]).itemsize
+
+    lpt = sorted(range(len(manifest)), key=lambda i: -size_of(manifest[i]))
+    tasks = {i: asyncio.ensure_future(load_one(manifest[i])) for i in lpt}
+    flat = [await tasks[i] for i in range(len(manifest))]
+    flat = [jax.block_until_ready(a) for a in flat]
+    if counters is not None:
+        counters["ici.broadcast_bytes"] = \
+            counters.get("ici.broadcast_bytes", 0) \
+            + sum(size_of(t) for t in manifest)
+        counters["ici.broadcast_ms"] = \
+            counters.get("ici.broadcast_ms", 0) \
+            + int((time.perf_counter() - t0) * 1000)
+    if skel is not None:
+        return _tree_build(skel, flat)
+    return jax.tree.unflatten(treedef, flat)
+
+
 async def distribute_checkpoint(client: CurvineClient, path: str,
-                                mesh: Mesh, spec_tree=None):
+                                mesh: Mesh, spec_tree=None,
+                                schedule: str = "tree",
+                                allow_pickle: bool = False):
     """cache → pod in one overlapped pass: each tensor is dispatched to
-    its mesh placement the moment its cache read completes (replicated
-    when spec_tree is None, else directly in its TP layout). spec_tree
-    placement for named leaves is resolved after unflatten, so the fast
-    overlapped path is used for the replicated (model-distribution)
-    case."""
+    its mesh placement the moment its bytes land (replicated when
+    spec_tree is None, else directly in its TP layout).
+
+    ``schedule`` picks the replicated rail: "tree" (default) is the
+    topology-scheduled path — LPT tensor order, peer-HBM device-domain
+    sourcing, binomial fan-out plan; "flat" is the legacy read→put
+    baseline, kept for A/B measurement. Both are bit-exact."""
     if spec_tree is None:
+        if schedule == "tree":
+            return await _distribute_tree(client, path, mesh,
+                                          allow_pickle=allow_pickle)
         sharding = NamedSharding(mesh, P())
         return await load_checkpoint(
-            client, path, placer=lambda a: jax.device_put(a, sharding))
-    host = await load_checkpoint(client, path)
+            client, path, placer=lambda a: jax.device_put(a, sharding),
+            allow_pickle=allow_pickle)
+    host = await load_checkpoint(client, path, allow_pickle=allow_pickle)
     return broadcast_params(host, mesh, spec_tree)
 
 

@@ -17,9 +17,65 @@ import numpy as np
 log = logging.getLogger(__name__)
 
 
+class HbmExportTable:
+    """Peer-addressable view of the HBM tier: block_id → device buffer
+    descriptor, advertised in heartbeats and GET_BLOCK_INFO so an
+    ICI-adjacent peer can source the replica device-to-device instead of
+    re-pulling bytes over TCP (tpu/ici_plane.py).
+
+    Bounded LRU, mirroring the shm-export table (worker/shm.py): the
+    advertisement is capability metadata, not ownership — dropping an
+    entry only stops advertising; the tier still holds the block."""
+
+    def __init__(self, cap: int = 128):
+        from collections import OrderedDict
+        self.cap = max(1, int(cap))
+        self._entries: "OrderedDict[int, dict]" = OrderedDict()
+        self.exports = 0        # lifetime advertisements
+        self.evictions = 0      # LRU pressure on the table itself
+
+    def add(self, block_id: int, device_id: int, arr) -> None:
+        e = {"device_id": int(device_id),
+             "shape": list(arr.shape),
+             "dtype": str(arr.dtype),
+             "nbytes": int(arr.nbytes)}
+        if block_id in self._entries:
+            self._entries.pop(block_id)
+        elif len(self._entries) >= self.cap:
+            self._entries.popitem(last=False)
+            self.evictions += 1
+        self._entries[block_id] = e
+        self.exports += 1
+
+    def remove(self, block_id: int) -> None:
+        self._entries.pop(block_id, None)
+
+    def get(self, block_id: int) -> dict | None:
+        e = self._entries.get(block_id)
+        if e is not None:
+            self._entries.move_to_end(block_id)
+        return e
+
+    def __contains__(self, block_id: int) -> bool:
+        return block_id in self._entries
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def snapshot(self, limit: int | None = None) -> list[dict]:
+        """Most-recently-exported first, bounded — the heartbeat payload."""
+        out = []
+        for bid in reversed(self._entries):
+            if limit is not None and len(out) >= limit:
+                break
+            out.append({"block_id": bid, **self._entries[bid]})
+        return out
+
+
 class HbmTier:
     def __init__(self, capacity_bytes: int, device=None,
-                 admission: str = "lru", ghost_entries: int = 2048):
+                 admission: str = "lru", ghost_entries: int = 2048,
+                 exports: HbmExportTable | None = None, policy=None):
         from curvine_tpu.common.cache import make_policy
         self.capacity = capacity_bytes
         self.device = device if device is not None else jax.devices()[0]
@@ -29,10 +85,16 @@ class HbmTier:
         self.hits = 0
         self.misses = 0
         self.spills = 0
+        # peer-addressable advertisement (shared across chips under
+        # MultiHbmTier); None → tier is private, nothing advertised
+        self.exports = exports
         # ghost-cache admission (common/cache.py): HBM is the scarcest
         # tier of all — an autopin sweep over a cold scan must not spill
-        # the hot training blocks, so s3fifo protection applies here too
-        self.policy = make_policy(admission, ghost_entries=ghost_entries)
+        # the hot training blocks, so s3fifo protection applies here too.
+        # An injected shared policy (MultiHbmTier) lets a block evicted
+        # on one chip re-admit straight to main on ANY chip.
+        self.policy = policy if policy is not None else \
+            make_policy(admission, ghost_entries=ghost_entries)
 
     def __contains__(self, block_id: int) -> bool:
         return block_id in self._blocks
@@ -56,6 +118,8 @@ class HbmTier:
         self._atime[block_id] = time.monotonic()
         self.used += need
         self.policy.on_admit(block_id, need)
+        if self.exports is not None:
+            self.exports.add(block_id, self.device.id, dev_arr)
         return dev_arr
 
     def get(self, block_id: int) -> jax.Array | None:
@@ -75,6 +139,8 @@ class HbmTier:
         self._atime.pop(block_id, None)
         if arr is not None:
             self.policy.on_remove(block_id, evicted=evicted)
+            if self.exports is not None:
+                self.exports.remove(block_id)
             self.used -= arr.nbytes
             arr.delete()
 
@@ -107,18 +173,27 @@ class MultiHbmTier:
     (which bound jax.devices()[0] only)."""
 
     def __init__(self, capacity_bytes: int, devices=None,
-                 admission: str = "lru", ghost_entries: int = 2048):
+                 admission: str = "lru", ghost_entries: int = 2048,
+                 export_cap: int = 128):
         """``capacity_bytes`` is the TOTAL HBM budget for the tier (the
         operator's `worker.hbm_capacity`), split evenly across the local
         chips — same semantics as the round-2 single-device tier, so the
         advertised capacity doesn't silently multiply by chip count."""
+        from curvine_tpu.common.cache import make_policy
         devices = devices if devices is not None else jax.local_devices()
         if not devices:
             raise ValueError("no local devices for the HBM tier")
         per_chip = max(1, capacity_bytes // len(devices))
+        # ONE admission policy and ONE export table across all chips:
+        # the ghost queue must be tier-wide (a block evicted on chip A
+        # and re-broadcast onto chip B is the same hot block — it
+        # re-admits straight to main), and peers address the worker's
+        # HBM tier as a whole, not a chip
+        self.policy = make_policy(admission, ghost_entries=ghost_entries)
+        self.exports = HbmExportTable(cap=export_cap)
         self.tiers: dict = {d.id: HbmTier(per_chip, device=d,
-                                          admission=admission,
-                                          ghost_entries=ghost_entries)
+                                          exports=self.exports,
+                                          policy=self.policy)
                             for d in devices}
         self.devices = list(devices)
 
@@ -192,14 +267,21 @@ class MultiHbmTier:
         return [did for did, t in sorted(self.tiers.items())
                 if block_id in t]
 
-    def drop(self, block_id: int) -> None:
+    def drop(self, block_id: int, evicted: bool = False) -> None:
+        """``evicted=True`` marks a capacity/pressure drop: the shared
+        ghost queue remembers the block so a re-broadcast re-admits
+        straight to main. Master-commanded deletes stay evicted=False —
+        a deleted block must NOT enjoy fast re-admission."""
         for t in self.tiers.values():
-            t.drop(block_id)
+            t.drop(block_id, evicted=evicted)
 
     def __contains__(self, block_id: int) -> bool:
         return any(block_id in t for t in self.tiers.values())
 
     def stats(self) -> dict:
+        # policy counters come off the ONE shared policy — per-tier
+        # sums would multiply-count it by chip count
+        ps = self.policy.stats()
         agg = {"capacity": self.capacity, "used": self.used,
                "devices": len(self.tiers),
                "blocks": len({b for t in self.tiers.values()
@@ -207,10 +289,10 @@ class MultiHbmTier:
                "hits": sum(t.hits for t in self.tiers.values()),
                "misses": sum(t.misses for t in self.tiers.values()),
                "spills": sum(t.spills for t in self.tiers.values()),
-               "ghost_hits": sum(t.policy.ghost_hits
-                                 for t in self.tiers.values()),
-               "scan_evicted": sum(t.policy.scan_evicted
-                                   for t in self.tiers.values())}
+               "ghost_hits": ps.get("ghost_hits", 0),
+               "scan_evicted": ps.get("scan_evicted", 0),
+               "exports": len(self.exports),
+               "export_adds": self.exports.exports}
         agg["per_device"] = self.per_device_stats()
         return agg
 

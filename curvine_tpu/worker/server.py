@@ -191,7 +191,8 @@ class WorkerServer:
                 from curvine_tpu.tpu.hbm import MultiHbmTier
                 self.hbm = MultiHbmTier(wc.hbm_capacity,
                                         admission=wc.cache_admission,
-                                        ghost_entries=wc.cache_ghost_entries)
+                                        ghost_entries=wc.cache_ghost_entries,
+                                        export_cap=wc.hbm_export_cap)
             except Exception as e:  # noqa: BLE001 — no device available
                 log.warning("hbm tier disabled: %s", e)
         self._bg: list[asyncio.Task] = []
@@ -226,6 +227,13 @@ class WorkerServer:
         if not self.worker_id:
             self.worker_id = worker_id_for(self.conf.worker.hostname,
                                            self.rpc.port)
+        # join the ICI device domain (docs/ici-plane.md): peers sharing
+        # this process's device runtime can then pull our HBM-resident
+        # blocks device-to-device instead of over the TCP rail
+        if self.hbm is not None and self.conf.worker.ici_transfer:
+            from curvine_tpu.tpu import ici_plane
+            ici_plane.register_endpoint(self.worker_id, self.hbm,
+                                        self.conf.worker.ici_coords)
         # periodic duties ride the scheduled executor
         # (parity: curvine-common/src/executor/ ScheduledExecutor)
         wc = self.conf.worker
@@ -263,6 +271,9 @@ class WorkerServer:
         log.info("worker %d started at %s", self.worker_id, self.addr)
 
     async def stop(self) -> None:
+        if self.hbm is not None:
+            from curvine_tpu.tpu import ici_plane
+            ici_plane.unregister_endpoint(self.worker_id)
         await self.executor.stop()
         for t in self._bg:
             t.cancel()
@@ -363,6 +374,11 @@ class WorkerServer:
             for k in ("hits", "misses", "spills", "ghost_hits",
                       "scan_evicted"):
                 out[f"cache.hbm.{k}"] = st.get(k, 0)
+            # ICI-plane counters (docs/ici-plane.md): advertisement
+            # volume + the device-path vs TCP-fallback split on pulls
+            out["ici.hbm_exports"] = st.get("exports", 0)
+            for k in ("ici.peer_pulls", "ici.tcp_fallbacks"):
+                out[k] = self.metrics.counters.get(k, 0)
         for tenant, used in self.store.tenant_occupancy().items():
             out[f"cache.tier0.{tenant}"] = used
         return out
@@ -404,6 +420,15 @@ class WorkerServer:
         if evac:
             body["evac_blocks"] = evac
             body["worker_id"] = self.worker_id
+        # peer-addressable HBM advertisement (docs/ici-plane.md): a
+        # bounded most-recent snapshot of the export table, re-sent (or
+        # cleared) every beat — the master keeps it as soft state for
+        # device-path pull hints, nothing journaled
+        exports = getattr(self.hbm, "exports", None)
+        if exports is not None and self.conf.worker.ici_transfer:
+            body["hbm_blocks"] = [
+                e["block_id"] for e in exports.snapshot(
+                    limit=self.conf.worker.hbm_advertise_max)]
         payload = pack(body)
         deletes: set[int] = set()
         report_now = False
@@ -500,7 +525,10 @@ class WorkerServer:
         if self.hbm is not None:
             for bid in removed:
                 if not self.store.contains(bid):   # dropped, not demoted
-                    self.hbm.drop(bid)
+                    # capacity pressure, not deletion: ghost the device
+                    # copy so a re-broadcast of this (still-hot) block
+                    # re-admits straight to the policy's main queue
+                    self.hbm.drop(bid, evicted=True)
         # evicted counts only blocks that LEFT the cache; demotions moved
         # tiers without losing data and get their own counter
         if self.store.dropped_total > dropped0:
@@ -700,6 +728,7 @@ class WorkerServer:
         r(RpcCode.HBM_PIN, self._hbm_pin)
         r(RpcCode.HBM_UNPIN, self._hbm_unpin)
         r(RpcCode.SUBMIT_BLOCK_REPLICATION_JOB, self._replicate_block)
+        r(RpcCode.ICI_TRANSFER, self._ici_transfer)
         r(RpcCode.SUBMIT_TASK, self._submit_task)
         r(RpcCode.GET_SPANS, self._get_spans)
 
@@ -1111,6 +1140,18 @@ class WorkerServer:
             # ignores the flags and keeps the fd/socket paths
             rep["shm"] = True
             rep["shm_sock"] = self._shm_channel.path
+        exports = getattr(self.hbm, "exports", None)
+        if exports is not None and self.conf.worker.ici_transfer:
+            e = exports.get(q["block_id"])
+            if e is not None:
+                # peer-addressable HBM advertisement (docs/ici-plane.md):
+                # an ICI-capable consumer can fetch the device buffer
+                # from this worker's tier instead of reading bytes —
+                # device ordinal + mesh coords + buffer shape/dtype
+                rep["hbm"] = {"worker_id": self.worker_id,
+                              "ici_coords": list(
+                                  self.conf.worker.ici_coords or []),
+                              **e}
         return rep
 
     def _shm_servable(self, info) -> bool:
@@ -1183,7 +1224,30 @@ class WorkerServer:
                 log.warning("reconstruct result report failed: %s", e)
             return {"success": ok, "message": message}
         src = WorkerAddress.from_wire(q["source"])
+        via = ""
         try:
+            if not self.store.contains(block_id):
+                # device path first when the master hinted the source
+                # holds the block in HBM (docs/ici-plane.md): zero bytes
+                # on the TCP rail when it lands. ANY failure — peer
+                # outside the device domain, stale advertisement, device
+                # error — falls through to the TCP pull below; the
+                # fallback is a counter, never an error.
+                ici = q.get("ici")
+                if ici is not None and self.conf.worker.ici_transfer:
+                    landed = False
+                    try:
+                        landed = await self._ici_land(
+                            block_id, ici, q.get("block_len", 0))
+                    except Exception as e:  # noqa: BLE001
+                        log.debug("ici pull of block %d failed: %s",
+                                  block_id, e)
+                        self.store.delete(block_id)   # clear any temp
+                    if landed:
+                        via = "ici"
+                        self.metrics.inc("ici.peer_pulls")
+                    else:
+                        self.metrics.inc("ici.tcp_fallbacks")
             if not self.store.contains(block_id):
                 peer = await self.peer_pool.get(
                     f"{src.ip_addr or src.hostname}:{src.rpc_port}")
@@ -1256,10 +1320,75 @@ class WorkerServer:
             await self._leader_call(
                 RpcCode.REPORT_BLOCK_REPLICATION_RESULT,
                 pack({"block_id": block_id, "worker_id": self.worker_id,
-                      "success": ok, "message": message}))
+                      "success": ok, "message": message, "via": via}))
         except Exception as e:
             log.warning("replication result report failed: %s", e)
-        return {"success": ok, "message": message}
+        return {"success": ok, "message": message, "via": via}
+
+    async def _ici_land(self, block_id: int, hint: dict,
+                        block_len: int) -> bool:
+        """Land one replica over the ICI device path: fetch the peer's
+        HBM-resident buffer through the in-process device domain
+        (tpu/ici_plane.py), then commit it locally with the same crc
+        discipline as a TCP pull. Returns False (peer not reachable this
+        way, stale advertisement, length mismatch) to request the TCP
+        fallback; only genuinely local landing failures raise."""
+        import numpy as np
+        from curvine_tpu.tpu import ici_plane
+        arr = await asyncio.to_thread(
+            ici_plane.fetch_device_block,
+            int(hint.get("worker_id", -1)), block_id)
+        if arr is None:
+            return False
+        buf = np.asarray(arr).reshape(-1).view(np.uint8)
+        if block_len and buf.nbytes != block_len:
+            return False        # advertisement outlived the block bytes
+        info = self.store.create_temp(block_id, size_hint=buf.nbytes)
+        if info.is_extent and buf.nbytes > info.alloc_len:
+            self.store.delete(block_id)
+            return False
+        crc_algo = checksum.preferred_algo()
+        crc = checksum.crc_update(crc_algo, buf)
+        f = await asyncio.to_thread(_open_block_writer, info)
+        try:
+            await asyncio.to_thread(f.write, buf)
+        finally:
+            await asyncio.to_thread(f.close)
+        self.store.commit(block_id, buf.nbytes, checksum=crc,
+                          checksum_algo=crc_algo)
+        await self._leader_call(RpcCode.WORKER_BLOCK_REPORT, pack({
+            "worker_id": self.worker_id,
+            "blocks": {block_id: buf.nbytes},
+            "storage_types": {block_id: int(info.tier.storage_type)},
+            "incremental": True}))
+        return True
+
+    async def _ici_transfer(self, msg: Message, conn: ServerConn):
+        """Coordination RPC (RpcCode.ICI_TRANSFER): pair this worker
+        with a named peer to move one block device-to-device. Succeeds
+        only over the device path; a miss replies success=False WITHOUT
+        raising so the caller keeps its TCP rail as the fallback —
+        same contract as the hinted replication pull."""
+        q = unpack(msg.data) or {}
+        block_id = q["block_id"]
+        if self.store.contains(block_id):
+            return {"success": True, "via": "local"}
+        if not self.conf.worker.ici_transfer:
+            return {"success": False, "via": "",
+                    "message": "ici transfer disabled"}
+        landed = False
+        try:
+            landed = await self._ici_land(
+                block_id, {"worker_id": q.get("source_worker_id", -1)},
+                q.get("block_len", 0))
+        except Exception as e:  # noqa: BLE001
+            log.debug("ici transfer of block %d failed: %s", block_id, e)
+            self.store.delete(block_id)
+        if landed:
+            self.metrics.inc("ici.peer_pulls")
+            return {"success": True, "via": "ici"}
+        self.metrics.inc("ici.tcp_fallbacks")
+        return {"success": False, "via": ""}
 
     async def _hbm_pin(self, msg: Message, conn: ServerConn):
         """Pin a cached block into the HBM tier-0 (device-resident).

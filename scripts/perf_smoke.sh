@@ -18,6 +18,7 @@
 #   BENCH_LADDER=0 skips the open-loop concurrency-rung gate.
 #   BENCH_EC=0 skips the erasure-coding gate.
 #   BENCH_CACHE=0 skips the cache-plane (scan resistance + prefetch) gate.
+#   BENCH_ICI=0 skips the ICI data-plane (broadcast rail + peer pull) gate.
 # Exit: 0 = at/above the regression gates, 1 = regression, 2 = harness error.
 
 set -u
@@ -642,6 +643,71 @@ if pct > ceiling:
     print(f"perf_smoke: FAIL — degraded reads cost {pct}% > {ceiling}% "
           "over intact (inline decode or dead-holder short-circuit "
           "regressed)", file=sys.stderr)
+    sys.exit(1)
+print("perf_smoke: PASS")
+EOF
+    rc=$?
+    [ $rc -ne 0 ] && exit $rc
+fi
+
+if [ "${BENCH_ICI:-1}" = "0" ]; then
+    echo "perf_smoke: ICI data-plane gate skipped (BENCH_ICI=0)"
+else
+    # ICI data-plane gate (docs/ici-plane.md): the pipelined chunked
+    # mesh-broadcast rail must beat the flat replicate A/B (absolute
+    # ratio floor — both rails are measured back to back so box load
+    # cancels) and hold the aggregate-bandwidth floor, and a controlled
+    # healing round with the device domain intact must ride the
+    # peer-HBM path. The bench itself skips cleanly (ici_skip) when the
+    # backend cannot form a multi-device mesh.
+    ICI_OUT=$(JAX_PLATFORMS=cpu \
+              XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
+              timeout 240 python - <<'EOF'
+import asyncio, json, os, sys
+sys.path.insert(0, os.getcwd())
+from bench import _ici_smoke
+print(json.dumps(asyncio.run(_ici_smoke())))
+EOF
+)
+    rc=$?
+    if [ $rc -ne 0 ] || [ -z "$ICI_OUT" ]; then
+        echo "perf_smoke: ICI data-plane microbench failed (rc=$rc)" >&2
+        exit 2
+    fi
+    echo "$ICI_OUT"
+    python - "$FLOOR_FILE" <<'EOF' "$ICI_OUT"
+import json, sys
+floor_file, result = sys.argv[1], json.loads(sys.argv[2])
+if "ici_skip" in result:
+    print(f"perf_smoke: ICI gate skipped by bench: {result['ici_skip']}")
+    sys.exit(0)
+floors = json.load(open(floor_file))
+gibs_floor = floors["ckpt_broadcast_gibs"]
+speed_floor = floors["ckpt_broadcast_speedup_min"]
+ratio_floor = floors["ici_peer_pull_ratio_min"]
+gibs = result.get("ckpt_broadcast_gibs", 0.0)
+speed = result.get("ckpt_broadcast_speedup", 0.0)
+ratio = result.get("ici_peer_pull_ratio", 0.0)
+gate = gibs_floor * 0.7                 # >30% regression fails
+print(f"perf_smoke: ckpt_broadcast_gibs={gibs} floor={gibs_floor} "
+      f"gate={gate:.3f} (flat={result.get('ckpt_broadcast_flat_gibs')} "
+      f"speedup={speed} floor={speed_floor})  "
+      f"ici_peer_pull_ratio={ratio} floor={ratio_floor} "
+      f"(pulls={result.get('ici_peer_pulls')})")
+if gibs < gate:
+    print(f"perf_smoke: FAIL — ckpt_broadcast_gibs {gibs} < {gate:.3f} "
+          f"(floor {gibs_floor} - 30%)", file=sys.stderr)
+    sys.exit(1)
+if speed < speed_floor:
+    print(f"perf_smoke: FAIL — ckpt_broadcast_speedup {speed} < "
+          f"{speed_floor} (absolute ratio floor; the chunked rail no "
+          "longer beats the flat replicate)", file=sys.stderr)
+    sys.exit(1)
+if ratio < ratio_floor:
+    print(f"perf_smoke: FAIL — ici_peer_pull_ratio {ratio} < "
+          f"{ratio_floor} (absolute floor; the healing round fell back "
+          "to the TCP rail with the device domain intact)",
+          file=sys.stderr)
     sys.exit(1)
 print("perf_smoke: PASS")
 EOF

@@ -214,7 +214,9 @@ def test_checkpoint_tree_skeleton():
 
 
 async def test_checkpoint_legacy_pickle_fallback():
-    """Old checkpoints (bare-list manifest + treedef.pkl) still load."""
+    """Old checkpoints (bare-list manifest + treedef.pkl) load only
+    behind the allow_pickle opt-in; the default REFUSES with a re-save
+    hint (unpickling is code execution for whoever wrote the path)."""
     import json as _json
     import pickle
     from curvine_tpu.tpu.broadcast import load_checkpoint
@@ -229,7 +231,9 @@ async def test_checkpoint_legacy_pickle_fallback():
         await c.write_all("/ckpt/legacy/manifest.json",
                           _json.dumps(manifest).encode())
         await c.write_all("/ckpt/legacy/treedef.pkl", pickle.dumps(treedef))
-        back = await load_checkpoint(c, "/ckpt/legacy")
+        with pytest.raises(ValueError, match="re-save"):
+            await load_checkpoint(c, "/ckpt/legacy")
+        back = await load_checkpoint(c, "/ckpt/legacy", allow_pickle=True)
         assert np.array_equal(np.asarray(back["w"]), params["w"])
 
 

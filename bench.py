@@ -889,6 +889,183 @@ async def _shm_read_bench(iters: int = 2_000, block_mb: int = 4) -> dict:
     return out
 
 
+async def _warm_shm_read_bench(iters: int = 1_500,
+                               block_mb: int = 4) -> dict:
+    """Warm-cache shm export gate for perf_smoke.sh (docs/data-plane.md
+    warm-cache protocol). The block lives on the SSD tier; a heating
+    pass drives its read-heat over worker.shm_warm_min_reads so the
+    worker copies it once into a sealed memfd, then A/B:
+
+      A (shm_warm): fresh reader — GET_BLOCK_INFO advertises the warm
+                    export, every read is an mmap slice, zero RPCs
+      B (socket):   client.short_circuit off — per-read worker RPC
+
+    read.shm_warm_hits and cache.shm_warm.exports are asserted via the
+    client counters (warm_hits in the artifact) so a silent fd/socket
+    fallback can't masquerade as the warm path. Returns
+    {warm_shm_p99_us, warm_socket_p99_us, warm_shm_p99_speedup,
+    warm_shm_read_gibs, warm_hits}."""
+    import copy
+    import random
+    import shutil
+    from curvine_tpu.client import CurvineClient
+    from curvine_tpu.common.conf import ClusterConf, TierConf
+    from curvine_tpu.testing import MiniCluster
+
+    base = os.path.join(_pick_shm_dir(),
+                        f"curvine-warmbench-{os.getpid()}")
+    size = block_mb * MB
+    slots = size // 4096 - 1
+    out: dict = {}
+    conf = ClusterConf()
+    conf.worker.tiers = [TierConf(storage_type="ssd",
+                                  dir=os.path.join(base, "ssd"),
+                                  capacity=256 * MB)]
+    conf.client.storage_type = "ssd"
+
+    async def lat_us(client, path: str, n: int) -> list:
+        r = await client.open(path)
+        rng = random.Random(13)
+        for _ in range(16):                                  # warm
+            await r.pread_view(rng.randrange(slots) * 4096, 4096)
+        lat = []
+        for _ in range(n):
+            off = rng.randrange(slots) * 4096
+            t0 = time.perf_counter()
+            await r.pread_view(off, 4096)
+            lat.append((time.perf_counter() - t0) * 1e6)
+        await r.close()
+        lat.sort()
+        return lat
+
+    try:
+        async with MiniCluster(workers=1, base_dir=base, journal=False,
+                               conf=conf, block_size=size) as mc:
+            c = mc.client()
+            await c.write_all("/warm/hot.bin", os.urandom(size))
+
+            # heating pass: enough short-circuit reads that the
+            # SC_READ_REPORT flush (512-pending threshold) lands the
+            # block's heat on the worker before the A-side reader opens
+            r = await c.open("/warm/hot.bin")
+            rng = random.Random(5)
+            for _ in range(600):
+                await r.pread_view(rng.randrange(slots) * 4096, 4096)
+            await r.close()                 # close flushes the residue
+
+            a = await lat_us(c, "/warm/hot.bin", iters)
+            out["warm_hits"] = int(c.counters.get("read.shm_warm_hits",
+                                                  0))
+            out["warm_shm_p50_us"] = round(a[len(a) // 2], 1)
+            out["warm_shm_p99_us"] = round(a[int(0.99 * len(a)) - 1], 1)
+
+            # throughput: stream the block through the warm mmap
+            r = await c.open("/warm/hot.bin")
+            reps = 16
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                off = 0
+                while off < size:
+                    v = await r.pread_view(off, MB)
+                    off += len(v)
+            out["warm_shm_read_gibs"] = round(
+                reps * size / (1024 ** 3) / (time.perf_counter() - t0),
+                3)
+            await r.close()
+            await c.close()
+
+            # B side: the same SSD block over the worker socket
+            conf_b = copy.deepcopy(mc.conf)
+            conf_b.client.short_circuit = False
+            conf_b.client.enable_smart_prefetch = False
+            conf_b.client.read_ahead_chunks = 0
+            cb = CurvineClient(conf_b)
+            b = await lat_us(cb, "/warm/hot.bin", max(400, iters // 4))
+            await cb.close()
+            out["warm_socket_p99_us"] = round(
+                b[int(0.99 * len(b)) - 1], 1)
+            out["warm_shm_p99_speedup"] = round(
+                out["warm_socket_p99_us"]
+                / max(out["warm_shm_p99_us"], 1e-9), 2)
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    return out
+
+
+async def _ring_recv_bench(reps: int = 24, block_mb: int = 8) -> dict:
+    """Registered-receive (io_uring READ_FIXED) A/B for perf_smoke.sh.
+    Streams a MEM block over the worker SOCKET path (short-circuit off,
+    so every payload remainder rides the sink recv) with rpc.recv_ring
+    on vs off. Where io_uring doesn't probe healthy the bench returns
+    {ring_skip: true} and the smoke gate skips cleanly — the fallback
+    IS the contract on those kernels. recv_fixed_ops is the pool's op
+    counter delta over the A side, asserted >0 so a silently-latched
+    ring can't report sock numbers as ring numbers. Returns
+    {recv_fixed_read_gibs, recv_fixed_off_read_gibs, recv_fixed_ops,
+    ring_skip}. The two sides run as alternating passes (best-of-N
+    each) so host-throughput drift between "the A minute" and "the B
+    minute" can't masquerade as a ring regression."""
+    import copy
+    import shutil
+    from curvine_tpu.client import CurvineClient
+    from curvine_tpu.rpc.transport import recv_pool
+    from curvine_tpu.testing import MiniCluster
+
+    if recv_pool().ring() is None:
+        return {"ring_skip": True}
+    base = os.path.join(_pick_shm_dir(),
+                        f"curvine-ringbench-{os.getpid()}")
+    size = block_mb * MB
+    out: dict = {"ring_skip": False}
+
+    async def stream_gibs(client, path: str) -> float:
+        r = await client.open(path)
+        await r.pread_view(0, MB)                            # warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            off = 0
+            while off < size:
+                v = await r.pread_view(off, MB)
+                off += len(v)
+        gibs = reps * size / (1024 ** 3) / (time.perf_counter() - t0)
+        await r.close()
+        return gibs
+
+    try:
+        async with MiniCluster(workers=1, base_dir=base, journal=False,
+                               block_size=size) as mc:
+            c = mc.client()
+            await c.write_all("/ring/big.bin", os.urandom(size))
+            await c.close()
+
+            conf = copy.deepcopy(mc.conf)
+            conf.client.short_circuit = False
+            conf.client.enable_smart_prefetch = False
+            conf.client.read_ahead_chunks = 0
+
+            conf_b = copy.deepcopy(conf)
+            conf_b.rpc.recv_ring = False
+
+            ops0 = recv_pool().stats()["fixed_ops"]
+            best_a = best_b = 0.0
+            for _ in range(3):
+                ca = CurvineClient(conf)
+                best_a = max(best_a,
+                             await stream_gibs(ca, "/ring/big.bin"))
+                await ca.close()
+                cb = CurvineClient(conf_b)
+                best_b = max(best_b,
+                             await stream_gibs(cb, "/ring/big.bin"))
+                await cb.close()
+            out["recv_fixed_read_gibs"] = round(best_a, 3)
+            out["recv_fixed_off_read_gibs"] = round(best_b, 3)
+            out["recv_fixed_ops"] = (recv_pool().stats()["fixed_ops"]
+                                     - ops0)
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    return out
+
+
 def _cache_scan_bench(hot_n: int = 16, block_kb: int = 1,
                       cap_kb: int = 64, scan_factor: int = 8,
                       touch_every: int = 64) -> dict:
@@ -1124,23 +1301,30 @@ async def _ladder_smoke(clients: int = 64, duration: float = 2.0,
                         rate: float = 10.0) -> dict:
     """Scaled-down open-loop concurrency rung (scripts/latency_ladder.py
     at 64 clients, short duration) so perf_smoke.sh exercises the fleet
-    rig without the full 1K walk. Returns {ladder_clients,
-    ladder_achieved_qps, ladder_p50_us, ladder_p99_us,
-    ladder_errors}."""
+    rig without the full 1K walk. The fleet is pinned round-robin
+    across cores (the --cpus multi-core tail — recorded beside
+    loop_impl in the artifact) so the rung measures cross-core
+    contention, not one runqueue time-sharing. Returns {ladder_clients,
+    ladder_achieved_qps, ladder_p50_us, ladder_p99_us, ladder_errors,
+    ladder_cpus}."""
     scripts = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "scripts")
     if scripts not in sys.path:
         sys.path.insert(0, scripts)
     from latency_ladder import run_ladder
 
+    procs = min(os.cpu_count() or 2, 4)
+    cpus = sorted(os.sched_getaffinity(0))[:procs] \
+        if hasattr(os, "sched_getaffinity") else []
     res = await run_ladder(rungs=(clients,), duration=duration,
-                           rate=rate, procs=min(os.cpu_count() or 2, 4))
+                           rate=rate, procs=procs, cpus=cpus)
     rung = res["rungs"][0]
     return {"ladder_clients": rung["clients"],
             "ladder_achieved_qps": rung["achieved_qps"],
             "ladder_p50_us": rung["p50_us"],
             "ladder_p99_us": rung["p99_us"],
-            "ladder_errors": rung["errors"]}
+            "ladder_errors": rung["errors"],
+            "ladder_cpus": rung["cpus"]}
 
 
 async def run_bench(total_mb: int = 256, block_mb: int = 64,
@@ -1536,6 +1720,8 @@ async def run_bench(total_mb: int = 256, block_mb: int = 64,
     # open-loop concurrency rung (docs/data-plane.md) ----
     if os.environ.get("BENCH_SHM", "1") != "0":
         results.update(await _shm_read_bench())
+        results.update(await _warm_shm_read_bench())
+        results.update(await _ring_recv_bench())
     if os.environ.get("BENCH_LADDER", "1") != "0":
         results.update(await _ladder_smoke())
 

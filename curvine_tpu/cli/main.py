@@ -331,7 +331,9 @@ async def cmd_report(args):
         dp = rp.get("read_plane")
         if dp:
             print(f"Read plane: shm hits: {int(dp.get('shm_hits', 0))}  "
-                  f"fallbacks: {int(dp.get('shm_fallbacks', 0))}  "
+                  f"warm hits: {int(dp.get('shm_warm_hits', 0))}  "
+                  f"fallbacks: {int(dp.get('shm_fallbacks', 0))}"
+                  f"/{int(dp.get('shm_warm_fallbacks', 0))} warm  "
                   f"zero-copy: "
                   f"{_human(int(dp.get('zero_copy_bytes', 0)))}")
         hl = rp.get("replication")

@@ -160,6 +160,12 @@ class FsReader:
         # (_drop_local closes both)
         self._shm_sock: dict[int, str] = {}
         self._shm_maps: dict[int, tuple[int, mmap.mmap]] = {}
+        # block ids whose shm capability is a WARM export (below-MEM
+        # tier; docs/data-plane.md): same protocol, separate accounting
+        # (read.shm_warm_hits / read.shm_warm_fallbacks, served_by
+        # "shm_warm"). Learned from the GET_BLOCK_INFO probe or the
+        # SC_READ_REPORT reply when heat crosses the worker's threshold.
+        self._shm_warm: set[int] = set()
         # registered receive buffers (rpc/transport.py): caller-visible
         # destinations >= _aligned_min are page-aligned mmap-backed so
         # remote payloads scatter straight into device-ingestible
@@ -288,6 +294,7 @@ class FsReader:
         GC finishes it) — eviction can never tear pages out from under a
         live read. The fd closes either way; the map holds the pages."""
         self._shm_sock.pop(bid, None)
+        self._shm_warm.discard(bid)
         ent = self._shm_maps.pop(bid, None)
         if ent is not None:
             fd, mm = ent
@@ -347,6 +354,8 @@ class FsReader:
                             # fetches the fd and maps it (shm wins
                             # over the preadv fd path)
                             self._shm_sock[bid] = info["shm_sock"]
+                            if info.get("shm_warm"):
+                                self._shm_warm.add(bid)
                 except err.CurvineError as e:
                     log.debug("short-circuit probe failed for %d: %s", bid, e)
         while len(self._local_paths) >= self._SC_CACHE_CAP:
@@ -385,6 +394,21 @@ class FsReader:
     def _served_by(self) -> str:
         return "+".join(sorted(self._serve_paths)) or "none"
 
+    def _shm_hit(self, bid: int) -> None:
+        """Account one shm-served read to the right plane: warm-cache
+        exports (below-MEM tier) keep their own counters so the
+        read-plane rollup separates them from MEM exports."""
+        if bid in self._shm_warm:
+            self._count("read.shm_warm_hits")
+            self._mark("shm_warm")
+        else:
+            self._count("read.shm_hits")
+            self._mark("shm")
+
+    def _shm_fallback(self, bid: int) -> None:
+        self._count("read.shm_warm_fallbacks" if bid in self._shm_warm
+                    else "read.shm_fallbacks")
+
     async def _shm_map(self, lb: LocatedBlock) -> mmap.mmap | None:
         """The block's shm mapping, fetching + sealing-checking on first
         use: connect to the worker's SCM_RIGHTS side channel (blocking
@@ -412,7 +436,7 @@ class FsReader:
             # this block, serve it through fd/socket instead
             log.debug("shm fetch for block %d failed: %s", bid, e)
             self._shm_sock.pop(bid, None)
-            self._count("read.shm_fallbacks")
+            self._shm_fallback(bid)
             return None
         other = self._shm_maps.get(bid)
         if other is not None:
@@ -422,13 +446,13 @@ class FsReader:
         if length != lb.block.len or length <= 0:
             os.close(fd)
             self._shm_sock.pop(bid, None)
-            self._count("read.shm_fallbacks")
+            self._shm_fallback(bid)
             return None
         try:
             mm = mmap.mmap(fd, length, access=mmap.ACCESS_READ)
         except (OSError, ValueError):
             os.close(fd)
-            self._count("read.shm_fallbacks")
+            self._shm_fallback(bid)
             return None
         if self.verify and not self._sc_verify_ok(lb, memoryview(mm)):
             # _sc_verify_ok flagged the replica and dropped the caches
@@ -437,7 +461,7 @@ class FsReader:
             except BufferError:
                 pass
             os.close(fd)
-            self._count("read.shm_fallbacks")
+            self._shm_fallback(bid)
             return None
         self._shm_maps[bid] = (fd, mm)
         return mm
@@ -454,8 +478,7 @@ class FsReader:
         out[:n] = np.frombuffer(mm, dtype=np.uint8, count=n,
                                 offset=block_off)
         self._note_sc_read(lb.block.id, n)
-        self._count("read.shm_hits")
-        self._mark("shm")
+        self._shm_hit(lb.block.id)
         return n
 
     async def _shm_view(self, offset: int, n: int):
@@ -476,9 +499,8 @@ class FsReader:
             return None
         import numpy as np
         self._note_sc_read(lb.block.id, n)
-        self._count("read.shm_hits")
+        self._shm_hit(lb.block.id)
         self._count("read.zero_copy_bytes", n)
-        self._mark("shm")
         return np.frombuffer(mm, dtype=np.uint8, count=n,
                              offset=block_off)
 
@@ -564,8 +586,17 @@ class FsReader:
         for addr, block_reads in by_addr.items():
             try:
                 conn = await self.pool.get(addr)
-                await conn.call(RpcCode.SC_READ_REPORT,
-                                data=pack({"block_reads": block_reads}))
+                rep = await conn.call(RpcCode.SC_READ_REPORT,
+                                      data=pack({"block_reads": block_reads}))
+                # The reply piggybacks warm-cache adverts: blocks whose
+                # heat just crossed the worker's shm_warm threshold.  The
+                # GET_BLOCK_INFO probe ran before the heat accrued, so
+                # this is how the very client that created the heat
+                # learns it can switch to the shm_warm rung.
+                hdr = rep.header if isinstance(rep.header, dict) else {}
+                for bid, sock in (hdr.get("shm_warm") or {}).items():
+                    self._shm_sock[int(bid)] = sock
+                    self._shm_warm.add(int(bid))
             except (err.CurvineError, OSError) as e:
                 log.debug("sc read report to %s failed: %s", addr, e)
 
@@ -729,7 +760,8 @@ class FsReader:
             view = await self._shm_view(offset, n)
             if view is not None:
                 if sp is not None:
-                    sp.set_attr("served_by", "shm")
+                    # _shm_view marked shm or shm_warm as appropriate
+                    sp.set_attr("served_by", self._served_by())
                 return view
             out = self._alloc_out(n)
             got = await self._read_range(offset, n, parallel, out, dl)
@@ -1144,8 +1176,7 @@ class FsReader:
             # bytes API: one mandatory copy (bytes are owning), still
             # zero RPCs and zero syscalls
             self._note_sc_read(lb.block.id, n)
-            self._count("read.shm_hits")
-            self._mark("shm")
+            self._shm_hit(lb.block.id)
             return mm[block_off:block_off + n]
         fd = await self._local_fd(lb)
         if fd is not None:

@@ -205,6 +205,17 @@ class WorkerConf:
     # sealed-memfd export cache entries (LRU; evictions close the
     # worker-side fd — client-held dups stay valid, unlink semantics)
     shm_export_cap: int = 128
+    # warm-cache shm exports for the tiers BELOW mem (docs/data-plane.md):
+    # a read-hot SSD/HDD block's bytes are copied ONCE into a sealed
+    # memfd and served over the same SCM_RIGHTS channel as a MEM export —
+    # zero RPCs and zero syscalls per read from then on. Byte-bounded;
+    # 0 disables the warm cache (MEM-tier exports are unaffected).
+    shm_warm_cap_mb: int = 64
+    # block heat (reads, via the SC_READ_REPORT rail) required before a
+    # below-MEM block qualifies for a warm export — one-touch scans never
+    # earn a copy (and the S3-FIFO warm admission evicts them first if
+    # they somehow do)
+    shm_warm_min_reads: int = 3
     # cache admission on the MEM + HBM tiers (docs/caching.md):
     # "s3fifo" = ghost-cache admission (small probationary FIFO + main
     # FIFO + ghost queue of recently-evicted ids) so a one-touch backfill
@@ -361,6 +372,18 @@ class RpcConf:
     # reads at least this large get an aligned mmap-backed destination
     # instead of a heap numpy buffer
     recv_aligned_min: int = 256 * 1024
+    # TRUE ring registration for bulk receives (docs/data-plane.md):
+    # the pool's fixed slab set is registered with an io_uring instance
+    # (IORING_REGISTER_BUFFERS) and large READ_BLOCK payload remainders
+    # ride IORING_OP_READ_FIXED submissions instead of per-chunk
+    # sock_recv_into. Probed at first use with a loopback self-test;
+    # any failure (no io_uring, locked-memory limits, unsupported op)
+    # falls back to the portable recv path permanently and silently.
+    recv_ring: bool = True
+    # payload remainders at least this large take the ring path; smaller
+    # ones stay on sock_recv_into (a thread hand-off only pays for
+    # itself on multi-hundred-KB payloads)
+    recv_ring_min: int = 256 * 1024
 
 
 @dataclass

@@ -141,12 +141,28 @@ class WorkerServer:
         # cache + SCM_RIGHTS side channel for co-located clients. The
         # channel itself starts in start() (port must be final); deleted
         # blocks drop their export so a stale copy is never handed out.
-        from curvine_tpu.worker.shm import ShmExporter, shm_supported
+        from curvine_tpu.worker.shm import (ShmExporter, WarmShmCache,
+                                            shm_supported)
         self.shm = None
+        self.shm_warm = None
         self._shm_channel = None
         if wc.shm_reads and shm_supported():
             self.shm = ShmExporter(cap=wc.shm_export_cap)
-            self.store.on_delete = self.shm.invalidate
+            if wc.shm_warm_cap_mb > 0:
+                # warm-cache exports for the tiers below MEM: read-hot
+                # SSD/HDD blocks earn a byte-bounded sealed-memfd copy,
+                # admitted through the same policy family as the MEM
+                # tier so scans can't flush the warm working set
+                self.shm_warm = WarmShmCache(
+                    cap_bytes=wc.shm_warm_cap_mb * 1024 * 1024,
+                    admission=wc.cache_admission,
+                    ghost_entries=wc.cache_ghost_entries)
+            # deleted blocks drop both export flavors; a tier move
+            # (promote/demote) does too — the copy's bytes would stay
+            # correct (blocks are immutable) but the block no longer
+            # belongs to the tier whose policy admitted it
+            self.store.on_delete = self._shm_invalidate
+            self.store.on_move = self._shm_invalidate
         # per-dir DiskHealth thresholds from conf (the state machine
         # itself lives on each TierDir — worker/storage.py)
         for tier in self.store.tiers:
@@ -283,6 +299,8 @@ class WorkerServer:
             self._shm_channel = None
         if self.shm is not None:
             self.shm.close()
+        if self.shm_warm is not None:
+            self.shm_warm.close()
         await self.rpc.stop()
         await self.master_pool.close()
         await self.peer_pool.close()
@@ -381,6 +399,23 @@ class WorkerServer:
                 out[k] = self.metrics.counters.get(k, 0)
         for tenant, used in self.store.tenant_occupancy().items():
             out[f"cache.tier0.{tenant}"] = used
+        if self.shm_warm is not None:
+            # warm-cache shm plane (docs/data-plane.md): occupancy and
+            # admission outcomes beside the tier caches they shadow
+            for k, v in self.shm_warm.stats().items():
+                if k in ("entries", "bytes", "exports", "hits",
+                         "evictions"):
+                    out[f"cache.shm_warm.{k}"] = v
+                elif k in ("policy_admits", "policy_ghost_hits",
+                           "policy_scan_evicted"):
+                    out[f"cache.shm_warm.{k[len('policy_'):]}"] = v
+        # ring-registered receive plane: pool-resident bytes only (the
+        # satellite-1 accounting contract — caller-pinned views are NOT
+        # occupancy), whether the io_uring registration armed, and the
+        # READ_FIXED op count; gauges land on /metrics via the heartbeat
+        from curvine_tpu.rpc import transport
+        for k, v in transport.recv_pool().stats().items():
+            out[f"rpc.recv_{k}"] = v
         return out
 
     async def heartbeat_once(self) -> None:
@@ -1140,6 +1175,13 @@ class WorkerServer:
             # ignores the flags and keeps the fd/socket paths
             rep["shm"] = True
             rep["shm_sock"] = self._shm_channel.path
+        elif self._shm_warm_servable(info):
+            # warm-cache export: a read-hot below-MEM block is servable
+            # over the SAME channel/protocol; shm_warm lets the client
+            # account the hit to the warm plane (read.shm_warm_hits)
+            rep["shm"] = True
+            rep["shm_warm"] = True
+            rep["shm_sock"] = self._shm_channel.path
         exports = getattr(self.hbm, "exports", None)
         if exports is not None and self.conf.worker.ici_transfer:
             e = exports.get(q["block_id"])
@@ -1164,19 +1206,47 @@ class WorkerServer:
                 and not getattr(info, "is_extent", False)
                 and info.tier.storage_type == StorageType.MEM)
 
+    def _shm_warm_servable(self, info) -> bool:
+        """Warm-cache eligibility for the tiers below MEM: committed
+        file-layout blocks whose heat (the SC_READ_REPORT rail) crossed
+        worker.shm_warm_min_reads and that fit the warm cache. Extents
+        stay excluded for the same lease reasons as the MEM gate."""
+        warm = self.shm_warm
+        return (warm is not None and self._shm_channel is not None
+                and info.state == BlockState.COMMITTED
+                and not getattr(info, "is_extent", False)
+                and int(info.tier.storage_type) > int(StorageType.MEM)
+                and info.heat >= self.conf.worker.shm_warm_min_reads
+                and info.len <= warm.cap_bytes)
+
+    def _shm_invalidate(self, block_id: int) -> None:
+        """BlockStore on_delete/on_move hook (fires under the store
+        lock): drop both export flavors; must not re-enter the store."""
+        if self.shm is not None:
+            self.shm.invalidate(block_id)
+        if self.shm_warm is not None:
+            self.shm_warm.invalidate(block_id)
+
     def _shm_grant(self, block_id: int) -> tuple[int, int]:
         """Side-channel policy hook (runs on the channel thread): look
-        the block up, gate on tier/layout, export a sealed memfd.
-        LookupError → NOT_FOUND reply → the client falls back."""
+        the block up, gate on tier/layout, export a sealed memfd — from
+        the MEM exporter or, for heat-qualified below-MEM blocks, the
+        warm cache. LookupError → NOT_FOUND reply → the client falls
+        back."""
         try:
             info = self.store.get(block_id, touch=False)
         except err.CurvineError:
             raise LookupError(f"block {block_id}") from None
-        if not self._shm_servable(info):
-            raise LookupError(f"block {block_id} not shm-servable")
-        fd, length = self.shm.export(block_id, info.path, info.len)
-        self.metrics.inc("shm.grants")
-        return fd, length
+        if self._shm_servable(info):
+            fd, length = self.shm.export(block_id, info.path, info.len)
+            self.metrics.inc("shm.grants")
+            return fd, length
+        if self._shm_warm_servable(info):
+            fd, length = self.shm_warm.export(block_id, info.path,
+                                              info.len)
+            self.metrics.inc("shm.warm_grants")
+            return fd, length
+        raise LookupError(f"block {block_id} not shm-servable")
 
     async def _sc_read_report(self, msg: Message, conn: ServerConn):
         """Short-circuit read accounting: clients read through cached fds
@@ -1185,9 +1255,23 @@ class WorkerServer:
         traffic and the promotion/HBM-autopin scans target the truly hot
         blocks instead of the most-probed ones."""
         q = unpack(msg.data) or {}
+        warm: dict[int, str] = {}
         for bid, reads in (q.get("block_reads") or {}).items():
-            self.store.touch_reads(int(bid), int(reads))
-        return {}
+            bid = int(bid)
+            self.store.touch_reads(bid, int(reads))
+            # the report is the moment heat crosses the warm threshold:
+            # advertise newly warm-servable blocks on the REPLY so the
+            # reporting client (which cached its GET_BLOCK_INFO probe
+            # from before the block was hot) learns the capability
+            # without a re-probe — its next read maps the warm copy
+            if self.shm_warm is not None:
+                try:
+                    info = self.store.get(bid, touch=False)
+                except err.CurvineError:
+                    continue
+                if self._shm_warm_servable(info):
+                    warm[bid] = self._shm_channel.path
+        return {"shm_warm": warm} if warm else {}
 
     async def _replicate_block(self, msg: Message, conn: ServerConn):
         """Pull a block replica from a peer worker and report to master.

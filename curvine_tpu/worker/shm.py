@@ -157,6 +157,135 @@ class ShmExporter:
             self._close(fd)
 
 
+class WarmShmCache:
+    """Byte-bounded warm cache of sealed-memfd copies for blocks BELOW
+    the MEM tier (docs/data-plane.md).
+
+    A read-hot SSD/HDD block (heat over ``worker.shm_warm_min_reads``,
+    accumulated through the SC_READ_REPORT rail) gets its bytes copied
+    once into a sealed memfd; from then on co-located clients serve it
+    exactly like a MEM export — zero RPCs, zero syscalls per read. The
+    cache is bounded in BYTES (``worker.shm_warm_cap_mb``) because warm
+    copies are anonymous memory the MEM tier doesn't account for, and
+    eviction runs through the same admission policy family as the MEM
+    tier (S3-FIFO by default): a one-touch scan that sneaks a copy in
+    leaves through the probationary queue without displacing the warm
+    working set. Eviction and invalidation close the WORKER's fd only —
+    client-held dups and mappings stay valid (unlink semantics), same
+    contract as ShmExporter."""
+
+    def __init__(self, cap_bytes: int, admission: str = "s3fifo",
+                 ghost_entries: int = 1024):
+        from curvine_tpu.common.cache import make_policy
+        self.cap_bytes = max(0, cap_bytes)
+        self.policy = make_policy(admission, ghost_entries=ghost_entries)
+        self._lock = threading.Lock()
+        # block_id -> (memfd, length); insertion order only (the policy
+        # owns the eviction order, not this dict)
+        self._fds: dict[int, tuple[int, int]] = {}
+        self._atime: dict[int, float] = {}
+        self.bytes = 0
+        self.exports = 0        # warm copies materialized
+        self.hits = 0           # grants served from the cache
+        self.evictions = 0
+
+    def export(self, block_id: int, path: str, length: int) -> tuple[int, int]:
+        """(memfd, length) for the block file at ``path``; copies once,
+        then serves from the cache. Raises LookupError for blocks larger
+        than the whole cache (never worth evicting everything for)."""
+        import time as _time
+        with self._lock:
+            ent = self._fds.get(block_id)
+            if ent is not None:
+                self.hits += 1
+                self._atime[block_id] = _time.time()
+                self.policy.hits += 1
+                self.policy.on_access(block_id)
+                return ent
+        if length > self.cap_bytes:
+            raise LookupError(
+                f"block {block_id} ({length}B) exceeds warm cache")
+        fd = ShmExporter._copy_to_memfd(block_id, path, length)
+        with self._lock:
+            ent = self._fds.get(block_id)
+            if ent is not None:
+                # raced with another grant: keep the first copy
+                self.hits += 1
+                self._close(fd)
+                return ent
+            self._evict_locked(length)
+            self._fds[block_id] = (fd, length)
+            self._atime[block_id] = _time.time()
+            self.bytes += length
+            self.policy.on_admit(block_id, length)
+            self.exports += 1
+            return fd, length
+
+    def _evict_locked(self, need: int) -> None:
+        """Make room for ``need`` bytes, closing victims in policy
+        order (S3-FIFO: probationary one-touch copies first)."""
+        if self.bytes + need <= self.cap_bytes:
+            return
+        order = iter(self.policy.victim_order(
+            [(k, self._atime.get(k, 0.0)) for k in self._fds]))
+        while self.bytes + need > self.cap_bytes and self._fds:
+            victim = next(order, None)
+            if victim is None or victim not in self._fds:
+                if victim is None:          # policy ran dry: FIFO rest
+                    victim = next(iter(self._fds))
+                else:
+                    continue
+            fd, n = self._fds.pop(victim)
+            self._atime.pop(victim, None)
+            self._close(fd)
+            self.bytes -= n
+            self.policy.on_remove(victim, evicted=True)
+            self.evictions += 1
+
+    def invalidate(self, block_id: int) -> None:
+        """Block deleted or moved tiers: drop the warm copy (a plain
+        removal, not an eviction — no ghost entry, the block is gone)."""
+        with self._lock:
+            ent = self._fds.pop(block_id, None)
+            if ent is not None:
+                self._atime.pop(block_id, None)
+                self.bytes -= ent[1]
+                self.policy.on_remove(block_id, evicted=False)
+        if ent is not None:
+            self._close(ent[0])
+
+    @staticmethod
+    def _close(fd: int) -> None:
+        try:
+            os.close(fd)
+        except OSError:
+            pass
+
+    def __contains__(self, block_id: int) -> bool:
+        with self._lock:
+            return block_id in self._fds
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._fds)
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = {"entries": len(self._fds), "bytes": self.bytes,
+                   "exports": self.exports, "hits": self.hits,
+                   "evictions": self.evictions}
+        out.update({f"policy_{k}": v for k, v in self.policy.stats().items()})
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            fds, self._fds = list(self._fds.values()), {}
+            self._atime.clear()
+            self.bytes = 0
+        for fd, _n in fds:
+            self._close(fd)
+
+
 class ShmChannel:
     """AF_UNIX SCM_RIGHTS side channel serving block fds.
 

@@ -470,6 +470,13 @@ class BlockStore:
         # stale copy is never handed to a new client. Fired under the
         # store lock; the callback must not call back into the store.
         self.on_delete = None
+        # tier-move hook (same contract as on_delete — fired under the
+        # store lock in _move_block's swap phase, must not re-enter the
+        # store): a promoted/demoted block drops its shm exports, since
+        # the copy was admitted under the OLD tier's policy and a
+        # below-MEM warm copy must never outlive the block's tier
+        # residency (docs/data-plane.md)
+        self.on_move = None
         # last scrub cycle's outcome counts (metrics exporter reads it)
         self.scrub_last = {"verified": 0, "mismatch": 0, "truncated": 0,
                            "io_error": 0}
@@ -1040,6 +1047,11 @@ class BlockStore:
             demoting = int(dest.storage_type) > int(src_tier.storage_type)
             src_tier.policy.on_remove(block_id, evicted=demoting)
             dest.policy.on_admit(block_id, length)
+            if self.on_move is not None:
+                try:
+                    self.on_move(block_id)
+                except Exception:  # noqa: BLE001 — the move must land
+                    pass
             info.tier, info.offset, info.alloc_len = dest, new_off, new_alloc
             if was_extent:
                 src_tier.save_index(self.blocks)

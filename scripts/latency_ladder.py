@@ -117,11 +117,30 @@ def _pct(sorted_us: list, q: float) -> float:
     return sorted_us[i]
 
 
+def _parse_cpus(spec: str) -> list[int]:
+    """'0-3,8' → [0, 1, 2, 3, 8]. Empty spec → [] (no pinning)."""
+    cores: list[int] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "-" in part:
+            lo, hi = part.split("-", 1)
+            cores.extend(range(int(lo), int(hi) + 1))
+        else:
+            cores.append(int(part))
+    return cores
+
+
 def _spawn_fleet(master_addr: str, path: str, clients: int, procs: int,
                  rate: float, duration: float, seed: int,
-                 short_circuit: bool) -> dict:
+                 short_circuit: bool, cpus: list[int] | None = None) -> dict:
     """Run one rung: `procs` child processes splitting `clients`
-    open-loop client coroutines; returns merged latency stats."""
+    open-loop client coroutines; returns merged latency stats. With
+    ``cpus``, child i is pinned to cpus[i % len(cpus)] — the multi-core
+    tail rung: fleets stop time-sharing one scheduler runqueue and the
+    ladder measures cross-core contention instead of context-switch
+    noise."""
     procs = max(1, min(procs, clients))
     share = [clients // procs + (1 if i < clients % procs else 0)
              for i in range(procs)]
@@ -130,6 +149,8 @@ def _spawn_fleet(master_addr: str, path: str, clients: int, procs: int,
         cfg = {"master_addr": master_addr, "path": path, "clients": k,
                "rate": rate, "duration": duration,
                "seed": seed + 10_000 * i, "short_circuit": short_circuit}
+        if cpus:
+            cfg["cpu"] = cpus[i % len(cpus)]
         p = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--_worker"],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
@@ -150,6 +171,7 @@ def _spawn_fleet(master_addr: str, path: str, clients: int, procs: int,
         errors += res["errors"]
     lat.sort()
     return {"clients": clients, "procs": procs,
+            "cpus": list(cpus) if cpus else [],
             "rate_per_client": rate, "duration_s": duration,
             "samples": len(lat), "errors": errors,
             "offered_qps": round(clients * rate, 1),
@@ -162,7 +184,8 @@ def _spawn_fleet(master_addr: str, path: str, clients: int, procs: int,
 async def run_ladder(rungs=(64, 256, 1024), duration: float = 5.0,
                      rate: float = 50.0, procs: int = 0,
                      shm: bool = True, block_mb: int = 4,
-                     short_circuit: bool = True, seed: int = 7) -> dict:
+                     short_circuit: bool = True, seed: int = 7,
+                     cpus: list[int] | None = None) -> dict:
     """Spin up the cluster, write the hot file, walk the rungs."""
     from curvine_tpu.common.conf import ClusterConf
     from curvine_tpu.testing import MiniCluster
@@ -178,7 +201,8 @@ async def run_ladder(rungs=(64, 256, 1024), duration: float = 5.0,
                      block_size=size)
     await mc.start()
     out = {"read_size": READ_SIZE, "file_mb": block_mb,
-           "shm": shm, "short_circuit": short_circuit, "rungs": []}
+           "shm": shm, "short_circuit": short_circuit,
+           "cpus": list(cpus) if cpus else [], "rungs": []}
     try:
         c = mc.client()
         payload = os.urandom(size)
@@ -187,7 +211,7 @@ async def run_ladder(rungs=(64, 256, 1024), duration: float = 5.0,
         for n in rungs:
             rung = await asyncio.to_thread(
                 _spawn_fleet, mc.master.addr, "/ladder/hot.bin", n,
-                procs, rate, duration, seed, short_circuit)
+                procs, rate, duration, seed, short_circuit, cpus)
             out["rungs"].append(rung)
             print(f"  {n:>5} clients  {rung['achieved_qps']:>9.0f} qps  "
                   f"p50 {rung['p50_us']:>8.1f}us  "
@@ -212,6 +236,10 @@ def main() -> int:
     ap.add_argument("--procs", type=int, default=0,
                     help="fleet processes (0 = min(cpus, 8))")
     ap.add_argument("--block-mb", type=int, default=4)
+    ap.add_argument("--cpus", default="",
+                    help="pin fleet processes round-robin across these "
+                         "cores, e.g. '0-3' or '0,2,4,6' (recorded in "
+                         "the artifact; empty = no pinning)")
     ap.add_argument("--no-shm", action="store_true",
                     help="disable worker.shm_reads (A/B baseline)")
     ap.add_argument("--no-short-circuit", action="store_true",
@@ -226,6 +254,12 @@ def main() -> int:
 
     if args._worker:
         cfg = json.loads(sys.stdin.read())
+        cpu = cfg.get("cpu")
+        if cpu is not None and hasattr(os, "sched_setaffinity"):
+            try:
+                os.sched_setaffinity(0, {int(cpu)})
+            except OSError:
+                pass        # core offline/cpuset-restricted: run unpinned
         res = asyncio.run(_worker_main(cfg))
         sys.stdout.write(json.dumps(res))
         return 0
@@ -238,7 +272,8 @@ def main() -> int:
         rungs=rungs, duration=duration, rate=args.rate,
         procs=args.procs, shm=not args.no_shm,
         block_mb=args.block_mb,
-        short_circuit=not args.no_short_circuit, seed=7))
+        short_circuit=not args.no_short_circuit, seed=7,
+        cpus=_parse_cpus(args.cpus)))
     result["generated_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                            time.gmtime())
     text = json.dumps(result, indent=2)

@@ -344,6 +344,110 @@ print("perf_smoke: PASS")
 EOF
     rc=$?
     [ $rc -ne 0 ] && exit $rc
+
+    # warm-cache shm gate (docs/data-plane.md warm-cache protocol): a
+    # read-hot SSD-tier block's sealed-memfd warm copy must beat the
+    # per-read socket path by the ABSOLUTE warm_shm_p99_speedup_min
+    # ratio, hold the warm_shm_read_gibs floor (30% slack), and have
+    # actually served warm hits (warm_hits>0 — a silent fd/socket
+    # fallback must not fake the gate).
+    WARM_OUT=$(JAX_PLATFORMS=cpu timeout 300 python - <<'EOF'
+import asyncio, json, os, sys
+sys.path.insert(0, os.getcwd())
+from bench import _warm_shm_read_bench
+print(json.dumps(asyncio.run(_warm_shm_read_bench())))
+EOF
+)
+    rc=$?
+    if [ $rc -ne 0 ] || [ -z "$WARM_OUT" ]; then
+        echo "perf_smoke: warm-cache microbench failed (rc=$rc)" >&2
+        exit 2
+    fi
+    echo "$WARM_OUT"
+    python - "$FLOOR_FILE" <<'EOF' "$WARM_OUT"
+import json, sys
+floor_file, result = sys.argv[1], json.loads(sys.argv[2])
+floors = json.load(open(floor_file))
+gibs = result.get("warm_shm_read_gibs", 0.0)
+speedup = result.get("warm_shm_p99_speedup", 0.0)
+hits = result.get("warm_hits", 0)
+gibs_gate = floors["warm_shm_read_gibs"] * 0.7  # >30% regression fails
+print(f"perf_smoke: warm_shm_read_gibs={gibs} gate={gibs_gate:.3f} "
+      f"warm_shm_p99_speedup={speedup} "
+      f"floor={floors['warm_shm_p99_speedup_min']} "
+      f"warm_hits={hits} "
+      f"(p99 warm={result.get('warm_shm_p99_us')}us "
+      f"socket={result.get('warm_socket_p99_us')}us)")
+if hits <= 0:
+    print("perf_smoke: FAIL — warm_hits=0: the bench never took the "
+          "warm-cache shm path (silent fallback would fake the gate)",
+          file=sys.stderr)
+    sys.exit(1)
+if gibs < gibs_gate:
+    print(f"perf_smoke: FAIL — warm_shm_read_gibs {gibs} < "
+          f"{gibs_gate:.3f} (floor {floors['warm_shm_read_gibs']} "
+          "- 30%)", file=sys.stderr)
+    sys.exit(1)
+if speedup < floors["warm_shm_p99_speedup_min"]:
+    print(f"perf_smoke: FAIL — warm_shm_p99_speedup {speedup}x < "
+          f"{floors['warm_shm_p99_speedup_min']}x (absolute floor: the "
+          "warm copy must beat per-read RPCs for SSD blocks)",
+          file=sys.stderr)
+    sys.exit(1)
+print("perf_smoke: PASS")
+EOF
+    rc=$?
+    [ $rc -ne 0 ] && exit $rc
+
+    # registered-receive gate: ring-armed large-payload streaming must
+    # not regress vs plain sock_recv_into (recv_fixed_ratio_min,
+    # absolute) and must have actually ridden READ_FIXED
+    # (recv_fixed_ops>0). Where io_uring doesn't probe healthy the
+    # bench reports ring_skip and the gate skips cleanly — the silent
+    # fallback is the contract there.
+    RING_OUT=$(JAX_PLATFORMS=cpu timeout 300 python - <<'EOF'
+import asyncio, json, os, sys
+sys.path.insert(0, os.getcwd())
+from bench import _ring_recv_bench
+print(json.dumps(asyncio.run(_ring_recv_bench())))
+EOF
+)
+    rc=$?
+    if [ $rc -ne 0 ] || [ -z "$RING_OUT" ]; then
+        echo "perf_smoke: registered-receive microbench failed (rc=$rc)" >&2
+        exit 2
+    fi
+    echo "$RING_OUT"
+    python - "$FLOOR_FILE" <<'EOF' "$RING_OUT"
+import json, sys
+floor_file, result = sys.argv[1], json.loads(sys.argv[2])
+if result.get("ring_skip"):
+    print("perf_smoke: ring-recv gate skipped (io_uring READ_FIXED "
+          "not available here — sock_recv_into fallback is the "
+          "contract)")
+    sys.exit(0)
+floors = json.load(open(floor_file))
+ratio_floor = floors["recv_fixed_ratio_min"]
+on = result.get("recv_fixed_read_gibs", 0.0)
+off = result.get("recv_fixed_off_read_gibs", 0.0)
+ops = result.get("recv_fixed_ops", 0)
+ratio = on / max(off, 1e-9)
+print(f"perf_smoke: recv_fixed_read_gibs={on} off={off} "
+      f"ratio={ratio:.3f} floor={ratio_floor} recv_fixed_ops={ops}")
+if ops <= 0:
+    print("perf_smoke: FAIL — recv_fixed_ops=0: the ring armed but no "
+          "payload rode READ_FIXED (a latched-off ring would report "
+          "sock numbers as ring numbers)", file=sys.stderr)
+    sys.exit(1)
+if ratio < ratio_floor:
+    print(f"perf_smoke: FAIL — ring recv ratio {ratio:.3f} < "
+          f"{ratio_floor} (registered receive became a regression over "
+          "sock_recv_into)", file=sys.stderr)
+    sys.exit(1)
+print("perf_smoke: PASS")
+EOF
+    rc=$?
+    [ $rc -ne 0 ] && exit $rc
 fi
 
 if [ "${BENCH_LADDER:-1}" = "0" ]; then

@@ -296,6 +296,217 @@ def test_alloc_aligned_and_registered_pool():
     pool.drain()
 
 
+# ---------------- warm cache: zero-syscall reads below MEM ----------------
+
+def _ssd_conf(tmp_path, warm_mb: int = 8, min_reads: int = 3,
+              with_mem: bool = False) -> ClusterConf:
+    """SSD-backed cluster conf for the warm-cache plane. SSD-only by
+    default so the promotion scan can't move the block out from under
+    the test; with_mem adds a MEM tier for the invalidation tests."""
+    from curvine_tpu.common.conf import TierConf
+    conf = ClusterConf()
+    conf.data_dir = str(tmp_path)
+    tiers = []
+    if with_mem:
+        tiers.append(TierConf(storage_type="mem",
+                              dir=str(tmp_path / "mem"),
+                              capacity=64 * MB))
+    tiers.append(TierConf(storage_type="ssd", dir=str(tmp_path / "ssd"),
+                          capacity=64 * MB))
+    conf.worker.tiers = tiers
+    conf.worker.shm_warm_cap_mb = warm_mb
+    conf.worker.shm_warm_min_reads = min_reads
+    return conf
+
+
+async def _write_ssd(c, path: str, payload: bytes) -> None:
+    w = await c.create(path, storage_type="ssd")
+    await w.write(payload)
+    await w.close()
+
+
+async def test_warm_shm_export_after_heat(tmp_path):
+    """An SSD-tier block that crosses worker.shm_warm_min_reads earns a
+    sealed-memfd warm copy: a fresh reader's probe sees the shm_warm
+    capability and serves reads from the mapping — warm hit counters
+    move, the worker's RPC read path does not."""
+    conf = _ssd_conf(tmp_path, min_reads=3)
+    async with MiniCluster(workers=1, conf=conf, base_dir=str(tmp_path),
+                           block_size=MB) as mc:
+        c = mc.client()
+        payload = os.urandom(MB)
+        await _write_ssd(c, "/warm/a.bin", payload)
+
+        # heat the block past the threshold; close() flushes the
+        # SC_READ_REPORT heat rail
+        r = await c.open("/warm/a.bin")
+        bid = r.blocks.block_locs[0].block.id
+        for i in range(5):
+            await r.pread_view(i * 4096, 4096)
+        await r.close()
+        assert mc.workers[0].store.get(bid, touch=False).heat >= 5
+        assert c.counters.get("read.shm_warm_hits", 0) == 0
+
+        # a fresh reader probes, sees shm_warm, and maps the warm copy
+        r2 = await c.open("/warm/a.bin")
+        for off in (0, 4096, MB - 4096):
+            got = await r2.pread_view(off, 4096)
+            assert bytes(got) == payload[off:off + 4096]
+        assert bid in r2._shm_warm
+        assert c.counters.get("read.shm_warm_hits", 0) >= 3
+        assert c.counters.get("read.shm_hits", 0) == 0
+        # the data plane never touched the worker's RPC read path
+        assert mc.workers[0].metrics.counters.get("bytes.read", 0) == 0
+        assert mc.workers[0].metrics.counters.get("shm.warm_grants",
+                                                  0) >= 1
+        assert bid in mc.workers[0].shm_warm
+        assert mc.workers[0].shm_warm.stats()["exports"] == 1
+
+        # zero-copy view rides the same mapping, marked shm_warm
+        view = await r2.read_range(8192, 4096)
+        assert isinstance(view, np.ndarray)
+        assert not view.flags.writeable
+        assert bytes(view) == payload[8192:8192 + 4096]
+        assert "shm_warm" in r2._served_by()
+        await r2.close()
+
+        # the warm counters ride METRICS_REPORT into the master's
+        # read-plane rollup (the `cv report` feed)
+        await c.flush_metrics()
+        table = await mc.master._shard_table({})
+        assert table["read_plane"]["shm_warm_hits"] >= 3
+        await c.close()
+
+
+async def test_warm_advert_rides_sc_report_reply(tmp_path):
+    """The very client that created the heat learns the capability from
+    the SC_READ_REPORT reply (its probe predates the heat): after a
+    flush, the SAME reader switches to the warm rung without re-probing."""
+    conf = _ssd_conf(tmp_path, min_reads=3)
+    async with MiniCluster(workers=1, conf=conf, base_dir=str(tmp_path),
+                           block_size=MB) as mc:
+        c = mc.client()
+        payload = os.urandom(MB)
+        await _write_ssd(c, "/warm/b.bin", payload)
+        r = await c.open("/warm/b.bin")
+        bid = r.blocks.block_locs[0].block.id
+        for i in range(6):          # heat accrues client-side, unflushed
+            await r.pread_view(i * 4096, 4096)
+        assert bid not in r._shm_warm
+        await r._flush_sc_reads()   # reply piggybacks the warm advert
+        assert bid in r._shm_warm and r._shm_sock.get(bid)
+        got = await r.pread_view(0, 4096)
+        assert bytes(got) == payload[:4096]
+        assert c.counters.get("read.shm_warm_hits", 0) >= 1
+        await r.close()
+        await c.close()
+
+
+async def test_warm_copy_invalidated_on_promote(tmp_path):
+    """A tier move drops the warm copy (BlockStore.on_move): the copy
+    was admitted under the SSD tier's policy and must not outlive the
+    block's tier residency. Reads after the promote stay correct."""
+    conf = _ssd_conf(tmp_path, min_reads=2, with_mem=True)
+    async with MiniCluster(workers=1, conf=conf, base_dir=str(tmp_path),
+                           block_size=MB) as mc:
+        c = mc.client()
+        payload = os.urandom(MB)
+        await _write_ssd(c, "/warm/mv.bin", payload)
+        r = await c.open("/warm/mv.bin")
+        bid = r.blocks.block_locs[0].block.id
+        for i in range(4):
+            await r.pread_view(i * 4096, 4096)
+        await r.close()
+        r2 = await c.open("/warm/mv.bin")
+        await r2.pread_view(0, 4096)             # maps the warm copy
+        assert bid in mc.workers[0].shm_warm
+        promoted = mc.workers[0].store.promote_scan(min_reads=0)
+        assert bid in promoted
+        assert bid not in mc.workers[0].shm_warm
+        assert mc.workers[0].shm_warm.stats()["evictions"] == 0
+        # the held mapping still serves (sealed pages outlive the fd);
+        # a fresh reader resolves the MEM-tier location cleanly
+        got = await r2.pread_view(4096, 4096)
+        assert bytes(got) == payload[4096:8192]
+        await r2.close()
+        r3 = await c.open("/warm/mv.bin")
+        assert bytes(await r3.pread_view(0, 8192)) == payload[:8192]
+        await r3.close()
+        await c.close()
+
+
+async def test_warm_copy_invalidated_on_delete(tmp_path):
+    """Deleting the block fires on_delete into the warm cache too: the
+    worker's memfd closes and the entry leaves without ghosting."""
+    conf = _ssd_conf(tmp_path, min_reads=2)
+    async with MiniCluster(workers=1, conf=conf, base_dir=str(tmp_path),
+                           block_size=MB) as mc:
+        c = mc.client()
+        await _write_ssd(c, "/warm/del.bin", os.urandom(MB))
+        r = await c.open("/warm/del.bin")
+        bid = r.blocks.block_locs[0].block.id
+        for i in range(3):
+            await r.pread_view(i * 4096, 4096)
+        await r.close()
+        r2 = await c.open("/warm/del.bin")
+        await r2.pread_view(0, 4096)
+        assert bid in mc.workers[0].shm_warm
+        await r2.close()
+        mc.workers[0].store.delete(bid)
+        assert bid not in mc.workers[0].shm_warm
+        assert mc.workers[0].shm_warm.stats()["bytes"] == 0
+        await c.close()
+
+
+def test_warm_cache_unit_eviction_and_scan_resistance(tmp_path):
+    """WarmShmCache unit contract: byte-bounded eviction through
+    S3-FIFO (a one-touch scan leaves through probation, the re-touched
+    working set survives), caller-held dups outlive eviction, oversized
+    blocks are refused, invalidate is a plain removal."""
+    blk = 4096
+    paths = {}
+    for i in range(12):
+        p = tmp_path / f"w{i}"
+        p.write_bytes(bytes([i]) * blk)
+        paths[i] = str(p)
+    cache = wshm.WarmShmCache(cap_bytes=4 * blk, admission="s3fifo")
+    try:
+        # working set: two blocks, each re-touched (freq >= 1)
+        for h in (0, 1):
+            cache.export(h, paths[h], blk)
+            cache.export(h, paths[h], blk)       # hit -> on_access
+        assert cache.hits == 2 and cache.exports == 2
+        fd_scan, _ = cache.export(2, paths[2], blk)   # one-touch
+        dup = os.dup(fd_scan)                    # a client-held dup
+        try:
+            # one-touch scan far past capacity: probationary entries
+            # leave, the re-touched working set never gets displaced
+            for s in range(3, 12):
+                cache.export(s, paths[s], blk)
+            assert 0 in cache and 1 in cache
+            assert 2 not in cache
+            assert cache.evictions > 0
+            assert cache.policy.scan_evicted > 0
+            assert cache.stats()["bytes"] <= 4 * blk
+            # eviction closed the worker's fd, not the client's dup
+            with pytest.raises(OSError):
+                os.fstat(fd_scan)
+            assert os.pread(dup, blk, 0) == bytes([2]) * blk
+        finally:
+            os.close(dup)
+        # a block bigger than the whole cache is never worth it
+        with pytest.raises(LookupError):
+            cache.export(99, paths[0], 5 * blk)
+        # invalidate: plain removal, bytes drop, no eviction counted
+        ev = cache.evictions
+        assert 0 in cache
+        cache.invalidate(0)
+        assert 0 not in cache and cache.evictions == ev
+    finally:
+        cache.close()
+    assert len(cache) == 0 and cache.stats()["bytes"] == 0
+
+
 # ---------------- observability: counters reach the master ----------------
 
 async def test_read_plane_rollup_reaches_master(tmp_path):
@@ -324,18 +535,23 @@ async def test_read_plane_rollup_reaches_master(tmp_path):
 # ---------------- the ladder, scaled down to a tier-1 smoke ----------------
 
 async def test_latency_ladder_smoke():
-    """One scaled-down open-loop rung (64 clients over a process fleet,
-    Poisson arrivals) completes with zero errors — the tier-1 guard for
-    scripts/latency_ladder.py and the perf_smoke concurrency gate."""
+    """One scaled-down open-loop rung (64 clients over a CPU-pinned
+    process fleet, Poisson arrivals) completes with zero errors — the
+    tier-1 guard for scripts/latency_ladder.py and the perf_smoke
+    concurrency gate, now covering the --cpus multi-core tail path."""
     scripts = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "scripts")
     if scripts not in sys.path:
         sys.path.insert(0, scripts)
     from latency_ladder import run_ladder
 
-    res = await run_ladder(rungs=(64,), duration=1.0, rate=4.0, procs=2)
+    cpus = sorted(os.sched_getaffinity(0))[:2]
+    res = await run_ladder(rungs=(64,), duration=1.0, rate=4.0, procs=2,
+                           cpus=cpus)
+    assert res["cpus"] == cpus
     rung = res["rungs"][0]
     assert rung["clients"] == 64
+    assert rung["cpus"] == cpus                  # pinning recorded
     assert rung["errors"] == 0
     assert rung["samples"] > 0
     assert rung["p99_us"] == rung["p99_us"]      # not NaN

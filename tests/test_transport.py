@@ -436,6 +436,185 @@ async def test_server_rejects_oversized_frame_mid_stream():
         await srv.stop()
 
 
+# ------------------------------------------- registered receive / ring
+
+
+def _make_ring():
+    try:
+        return transport_mod.RingRecv(slab_bytes=256 * 1024, nslabs=2)
+    except Exception as e:  # noqa: BLE001 — any failure = unavailable
+        pytest.skip(f"io_uring fixed-buffer recv unavailable: {e}")
+
+
+async def test_ring_recv_byte_exact_multi_slab():
+    """READ_FIXED recv over a socketpair, payload several times the
+    slab size: bytes land exactly as sock_recv_into would deliver them
+    and the fixed-op counters account the traffic."""
+    loop = asyncio.get_running_loop()
+    ring = _make_ring()
+    a, b = _nb_socketpair()
+    try:
+        payload = bytes(range(256)) * 4096          # 1MB > 256K slab
+        send = asyncio.ensure_future(loop.sock_sendall(a, payload))
+        out = bytearray(len(payload))
+        await ring.recv_into(loop, b, memoryview(out))
+        await send
+        assert bytes(out) == payload
+        assert ring.fixed_ops >= len(payload) // ring.slab_bytes
+        assert ring.fixed_bytes == len(payload)
+        assert not ring.dead
+    finally:
+        a.close()
+        b.close()
+        ring.close()
+
+
+async def test_ring_fatal_error_latches_and_falls_back(monkeypatch):
+    """A ring-infrastructure errno mid-payload latches the ring dead
+    and finishes the payload on the socket path — byte-exact, because a
+    failed op consumed no stream bytes. The pool then reports the ring
+    unregistered and hands out None forever."""
+    import errno as _errno
+    loop = asyncio.get_running_loop()
+    ring = _make_ring()
+    a, b = _nb_socketpair()
+    try:
+        def boom(fd, want, dst):
+            raise OSError(_errno.ENOSYS, "ring gone")
+
+        monkeypatch.setattr(ring, "_read_once", boom)
+        payload = bytes(range(256)) * 512
+        send = asyncio.ensure_future(loop.sock_sendall(a, payload))
+        out = bytearray(len(payload))
+        await ring.recv_into(loop, b, memoryview(out))
+        await send
+        assert bytes(out) == payload                # fallback byte-exact
+        assert ring.dead
+
+        pool = transport_mod.RegisteredBuffers()
+        pool._ring = ring
+        pool._ring_state = 1
+        assert not pool.ring_registered()
+        assert pool.stats()["ring_registered"] == 0
+        assert pool.ring() is None                  # latched permanently
+        assert pool._ring_state == -1
+    finally:
+        a.close()
+        b.close()
+        ring.close()
+
+
+async def test_ring_stream_error_propagates(monkeypatch):
+    """A NON-fatal errno (the stream died, not the ring) must propagate
+    like the sock path would — no silent retry, no latch-off."""
+    import errno as _errno
+    loop = asyncio.get_running_loop()
+    ring = _make_ring()
+    a, b = _nb_socketpair()
+    try:
+        def boom(fd, want, dst):
+            raise OSError(_errno.ECONNRESET, "peer vanished")
+
+        monkeypatch.setattr(ring, "_read_once", boom)
+        await loop.sock_sendall(a, b"x" * 64)
+        with pytest.raises(OSError) as ei:
+            await ring.recv_into(loop, b, memoryview(bytearray(64)))
+        assert ei.value.errno == _errno.ECONNRESET
+    finally:
+        a.close()
+        b.close()
+        ring.close()
+
+
+def test_registered_pool_pinned_accounting_and_double_release():
+    """Satellite-1 accounting contract: `pinned` tracks checked-out
+    bytes cleared exactly once (release or view-GC, whichever first),
+    `retained` is pool-resident bytes only, and a double release never
+    parks the same region twice (which would hand one region to two
+    concurrent acquirers)."""
+    import gc
+    MB = 1024 * 1024
+    pool = transport_mod.RegisteredBuffers(max_bytes=2 * MB,
+                                           min_size=64 * 1024,
+                                           max_size=MB)
+    cls = 128 * 1024                        # power-of-two class of 100K
+    a = pool.acquire(100_000)
+    assert pool.pinned == cls and pool.retained == 0
+    pool.release(a)
+    assert pool.pinned == 0 and pool.retained == cls
+    pool.release(a)                         # double release: no-op
+    assert pool.pinned == 0 and pool.retained == cls
+    b = pool.acquire(100_000)
+    c = pool.acquire(100_000)
+    assert b.ctypes.data != c.ctypes.data, \
+        "double release handed one region to two acquirers"
+    assert pool.pinned == 2 * cls
+    pool.release(b)
+    pool.release(c)
+    assert pool.pinned == 0
+    # escaped buffer: GC unpins without ever re-entering the pool
+    d = pool.acquire(100_000)
+    retained = pool.retained                # after the checkout
+    assert pool.pinned == cls
+    del d
+    gc.collect()
+    assert pool.pinned == 0 and pool.retained == retained
+    # release-then-GC must not double-decrement pinned
+    e = pool.acquire(100_000)
+    pool.release(e)
+    del e
+    gc.collect()
+    assert pool.pinned == 0
+    # stats() exposes the /metrics keys and never constructs the ring
+    st = pool.stats()
+    assert set(st) == {"registered_bytes", "pinned_bytes", "acquired",
+                       "reused", "ring_registered", "fixed_ops",
+                       "fixed_bytes"}
+    assert st["registered_bytes"] == pool.retained
+    assert st["pinned_bytes"] == 0
+    assert pool._ring_state == 0, "stats() must not arm io_uring"
+    pool.drain()
+    assert pool.retained == 0
+
+
+def test_connection_ring_gate(monkeypatch):
+    """rpc.recv_ring / recv_ring_min gate the ring path per call; only
+    large remainders with the flag on reach the pool."""
+    from types import SimpleNamespace
+    from curvine_tpu.rpc import client as client_mod
+    sentinel = object()
+    monkeypatch.setattr(client_mod, "recv_pool",
+                        lambda: SimpleNamespace(ring=lambda: sentinel))
+    off = Connection("h:1", rpc_conf=SimpleNamespace(recv_ring=False))
+    assert off._ring_for(64 * 1024 * 1024) is None
+    on = Connection("h:1", rpc_conf=SimpleNamespace(
+        recv_ring=True, recv_ring_min=256 * 1024))
+    assert on._ring_for(4096) is None           # under the floor
+    assert on._ring_for(1024 * 1024) is sentinel
+
+
+async def test_large_sink_payload_with_ring_policy_end_to_end():
+    """A multi-chunk sink stream with the ring policy enabled at a tiny
+    floor: bytes are exact whether the kernel armed READ_FIXED or the
+    silent sock_recv_into fallback served it — the contract is that the
+    caller cannot tell the difference."""
+    from types import SimpleNamespace
+    srv = await _echo_server()
+    rc = SimpleNamespace(recv_ring=True, recv_ring_min=4 * 1024)
+    conn = await Connection(f"127.0.0.1:{srv.port}", rpc_conf=rc).connect()
+    try:
+        chunks = 8
+        sink = bytearray(chunks * 1024)
+        got = await conn.call_readinto(9_901, memoryview(sink),
+                                       header={"chunks": chunks})
+        assert got == chunks * 1024
+        for i in range(chunks):
+            assert sink[i * 1024:(i + 1) * 1024] == bytes([i]) * 1024
+    finally:
+        await conn.close()
+        await srv.stop()
+
+
 # ------------------------------------------------------------ uvloop
 
 
